@@ -1,0 +1,23 @@
+"""Test configuration: force CPU JAX with 8 virtual devices.
+
+Mirrors the reference's distributed-without-a-cluster strategy
+(torchx/test/fixtures.py:253-305) using XLA's host-platform device-count
+flag so mesh/sharding tests run anywhere — including sandboxes whose
+sitecustomize force-registers a vendor TPU platform (hence the explicit
+jax.config.update, which wins over site hooks as long as no backend has
+initialized yet).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("TPX_EVENT_DESTINATION", "null")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,192 @@
+"""Component materialization + finder + linter tests (reference analog:
+torchx/components/test/, specs/test/builders_test, finder_test)."""
+
+import pytest
+
+from torchx_tpu.components.dist import parse_j
+from torchx_tpu.specs.api import AppDef
+from torchx_tpu.specs.builders import (
+    ComponentArgumentError,
+    component_args_from_str,
+    materialize_appdef,
+)
+from torchx_tpu.specs.file_linter import parse_docstring, validate_source
+from torchx_tpu.specs.finder import (
+    ComponentNotFoundException,
+    get_component,
+    get_components,
+)
+
+
+class TestParseJ:
+    def test_forms(self):
+        assert parse_j("2x4") == (None, 2, 4)
+        assert parse_j("4") == (None, 4, None)
+        assert parse_j("1:4") == (1, 4, None)
+        assert parse_j("1:4x8") == (1, 4, 8)
+
+    def test_invalid(self):
+        for bad in ("", "x4", "ax2", "1:2:3"):
+            with pytest.raises(ValueError):
+                parse_j(bad)
+
+
+class TestFinder:
+    def test_builtins_discovered(self):
+        components = get_components()
+        for expected in ("dist.spmd", "dist.ddp", "utils.echo", "utils.sh", "utils.python"):
+            assert expected in components, expected
+
+    def test_get_component_unknown(self):
+        with pytest.raises(ComponentNotFoundException):
+            get_component("nope.nothing")
+
+    def test_custom_file_component(self, tmp_path):
+        f = tmp_path / "comp.py"
+        f.write_text(
+            "from torchx_tpu.specs import AppDef, Role\n"
+            "def my_comp(msg: str = 'hi') -> AppDef:\n"
+            "    '''My component.\n\n    Args:\n        msg: the message\n    '''\n"
+            "    return AppDef(name='x', roles=[Role(name='r', image='i', entrypoint='echo', args=[msg])])\n"
+        )
+        c = get_component(f"{f}:my_comp")
+        app = materialize_appdef(c.fn, ["--msg", "yo"])
+        assert app.roles[0].args == ["yo"]
+
+    def test_custom_file_component_missing_fn(self, tmp_path):
+        f = tmp_path / "comp.py"
+        f.write_text("x = 1\n")
+        with pytest.raises(ComponentNotFoundException):
+            get_component(f"{f}:nope")
+
+
+class TestMaterialize:
+    def test_spmd_materialize(self):
+        c = get_component("dist.spmd")
+        app = materialize_appdef(
+            c.fn,
+            ["-j", "2x4", "--script", "train.py", "--", "--lr", "0.1"],
+        )
+        role = app.roles[0]
+        assert role.num_replicas == 2
+        assert "--script" in role.args and "train.py" in role.args
+        assert role.args[-2:] == ["--lr", "0.1"]
+        assert role.env["XLA_FLAGS"].endswith("device_count=4")
+
+    def test_spmd_tpu_slice(self):
+        c = get_component("dist.spmd")
+        app = materialize_appdef(c.fn, ["--tpu", "v5p-32", "-m", "train"])
+        role = app.roles[0]
+        assert role.resource.tpu.chips == 16
+        assert role.num_replicas == 1  # one slice; hosts derived by scheduler
+
+    def test_spmd_elastic(self):
+        c = get_component("dist.spmd")
+        app = materialize_appdef(c.fn, ["-j", "1:4", "-m", "train"])
+        assert app.roles[0].min_replicas == 1
+        assert app.roles[0].num_replicas == 4
+
+    def test_spmd_requires_script_or_m(self):
+        c = get_component("dist.spmd")
+        with pytest.raises(ValueError):
+            materialize_appdef(c.fn, ["-j", "1"])
+
+    def test_ddp_single_node_endpoint(self):
+        c = get_component("dist.ddp")
+        app = materialize_appdef(c.fn, ["-j", "1x2", "--script", "t.py"])
+        args = " ".join(app.roles[0].args)
+        assert "localhost:0" in args
+
+    def test_ddp_multi_node_defers_endpoint(self):
+        c = get_component("dist.ddp")
+        app = materialize_appdef(c.fn, ["-j", "2x2", "--script", "t.py"])
+        role = app.roles[0]
+        assert role.entrypoint == "sh"
+        joined = " ".join(role.args)
+        # macro still unsubstituted at materialize time
+        assert "${coordinator_env}" in joined
+
+    def test_echo_defaults(self):
+        c = get_component("utils.echo")
+        app = materialize_appdef(c.fn, [])
+        assert app.roles[0].args == ["hello world"]
+
+    def test_component_defaults_from_config(self):
+        c = get_component("utils.echo")
+        app = materialize_appdef(c.fn, [], defaults={"msg": "from-config"})
+        assert app.roles[0].args == ["from-config"]
+
+    def test_cli_overrides_config_defaults(self):
+        c = get_component("utils.echo")
+        app = materialize_appdef(
+            c.fn, ["--msg", "from-cli"], defaults={"msg": "from-config"}
+        )
+        assert app.roles[0].args == ["from-cli"]
+
+    def test_required_arg_missing(self):
+        c = get_component("utils.touch")
+        with pytest.raises(ComponentArgumentError):
+            materialize_appdef(c.fn, [])
+
+    def test_dict_and_bool_decoding(self):
+        c = get_component("dist.spmd")
+        app = materialize_appdef(
+            c.fn,
+            ["-m", "t", "--env", "A=1,B=2", "--debug", "true"],
+        )
+        role = app.roles[0]
+        assert role.env["A"] == "1" and role.env["B"] == "2"
+        assert role.env["JAX_LOG_COMPILES"] == "1"  # debug preset applied
+
+    def test_args_from_str(self):
+        assert component_args_from_str("-j 1x2 --msg 'a b'") == ["-j", "1x2", "--msg", "a b"]
+
+
+class TestLinter:
+    def test_valid_component(self):
+        src = (
+            "def c(x: int, y: str = 'a') -> AppDef:\n"
+            "    '''doc'''\n"
+            "    return AppDef(name='x')\n"
+        )
+        assert validate_source(src, "c") == []
+
+    def test_missing_annotation(self):
+        src = "def c(x) -> AppDef:\n    '''d'''\n    return None\n"
+        errors = validate_source(src, "c")
+        assert any("missing a type annotation" in e.description for e in errors)
+
+    def test_unsupported_type(self):
+        src = "def c(x: object) -> AppDef:\n    '''d'''\n    return None\n"
+        errors = validate_source(src, "c")
+        assert any("unsupported type" in e.description for e in errors)
+
+    def test_missing_return(self):
+        src = "def c(x: int):\n    '''d'''\n    return None\n"
+        errors = validate_source(src, "c")
+        assert any("return annotation" in e.description for e in errors)
+
+    def test_kwargs_rejected(self):
+        src = "def c(**kw: str) -> AppDef:\n    '''d'''\n    return None\n"
+        errors = validate_source(src, "c")
+        assert any("kwargs" in e.description for e in errors)
+
+    def test_fn_not_found(self):
+        errors = validate_source("x = 1", "c")
+        assert errors and "not found" in errors[0].description
+
+    def test_all_builtins_lint_clean(self):
+        for name, c in get_components().items():
+            assert c.validation_errors == [], f"{name}: {c.validation_errors}"
+
+    def test_parse_docstring(self):
+        summary, args = parse_docstring(
+            "Does a thing.\n\n"
+            "    Args:\n"
+            "        alpha: first arg\n"
+            "            continued help\n"
+            "        beta: second arg\n"
+        )
+        assert summary == "Does a thing."
+        assert args["alpha"] == "first arg continued help"
+        assert args["beta"] == "second arg"

@@ -1,0 +1,108 @@
+"""Config precedence + telemetry event tests (reference analogs:
+torchx/runner/test/config_test.py, runner/test/events/)."""
+
+import io
+import json
+
+from torchx_tpu.runner import config as tpx_config
+from torchx_tpu.runner.events import log_event
+from torchx_tpu.runner.events.api import TpxEvent
+from torchx_tpu.specs.api import runopts
+
+
+def write_cfg(path, text):
+    path.write_text(text)
+
+
+class TestConfig:
+    def test_apply_fills_missing_only(self, tmp_path):
+        write_cfg(
+            tmp_path / ".tpxconfig",
+            "[local]\nlog_dir = /cfg/logs\nprepend_cwd = true\n",
+        )
+        cfg = {"log_dir": "/cli/logs"}
+        tpx_config.apply("local", cfg, dirs=[str(tmp_path)])
+        assert cfg["log_dir"] == "/cli/logs"  # CLI wins
+        assert cfg["prepend_cwd"] == "true"  # filled from file
+
+    def test_precedence_between_dirs(self, tmp_path):
+        low = tmp_path / "low"
+        high = tmp_path / "high"
+        low.mkdir()
+        high.mkdir()
+        write_cfg(low / ".tpxconfig", "[local]\nlog_dir = /low\n")
+        write_cfg(high / ".tpxconfig", "[local]\nlog_dir = /high\n")
+        cfg = {}
+        tpx_config.apply("local", cfg, dirs=[str(low), str(high)])
+        assert cfg["log_dir"] == "/high"
+
+    def test_none_sentinel(self, tmp_path):
+        write_cfg(tmp_path / ".tpxconfig", "[local]\nlog_dir = None\n")
+        cfg = {}
+        tpx_config.apply("local", cfg, dirs=[str(tmp_path)])
+        assert cfg["log_dir"] is None
+
+    def test_component_sections(self, tmp_path):
+        write_cfg(
+            tmp_path / ".tpxconfig",
+            "[component:dist.spmd]\nj = 2x4\n[component:utils.echo]\nmsg = hi\n",
+        )
+        sections = tpx_config.load_sections("component", dirs=[str(tmp_path)])
+        assert sections == {"dist.spmd": {"j": "2x4"}, "utils.echo": {"msg": "hi"}}
+
+    def test_cli_section(self, tmp_path):
+        write_cfg(tmp_path / ".tpxconfig", "[cli:run]\ncomponent = dist.spmd\n")
+        assert (
+            tpx_config.get_config("cli", "run", "component", dirs=[str(tmp_path)])
+            == "dist.spmd"
+        )
+
+    def test_tracker_sections(self, tmp_path):
+        write_cfg(
+            tmp_path / ".tpxconfig",
+            "[tracker:fsspec]\nconfig = /tmp/experiments\n[tracker:custom:mod]\n",
+        )
+        trackers = tpx_config.load_tracker_sections(dirs=[str(tmp_path)])
+        assert trackers["fsspec"] == "/tmp/experiments"
+        assert trackers["custom:mod"] is None
+
+    def test_dump_roundtrip(self, tmp_path):
+        opts = runopts()
+        opts.add("log_dir", type_=str, help="h", default="/d")
+        opts.add("project", type_=str, help="h", required=True)
+        buf = io.StringIO()
+        tpx_config.dump(buf, {"local": opts})
+        text = buf.getvalue()
+        assert "[local]" in text
+        assert "project =" in text
+        assert "#log_dir = /d" in text
+
+    def test_malformed_file_skipped(self, tmp_path):
+        write_cfg(tmp_path / ".tpxconfig", "not an ini [[[")
+        cfg = {}
+        tpx_config.apply("local", cfg, dirs=[str(tmp_path)])  # no raise
+        assert cfg == {}
+
+
+class TestEvents:
+    def test_log_event_records_timing(self):
+        with log_event("run", "local", session="s") as ev:
+            pass
+        assert ev._event.wall_time_usec is not None
+        assert ev._event.api == "run"
+
+    def test_log_event_captures_exception(self):
+        try:
+            with log_event("run", "local", session="s") as ev:
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+        assert ev._event.exception_type == "RuntimeError"
+        assert "boom" in ev._event.raw_exception
+        assert ev._event.exception_source_location is not None
+
+    def test_event_serialization_roundtrip(self):
+        ev = TpxEvent(session="s", scheduler="local", api="run", app_id="a1")
+        restored = TpxEvent.deserialize(ev.serialize())
+        assert restored == ev
+        assert json.loads(ev.serialize())["app_id"] == "a1"

@@ -1,0 +1,262 @@
+"""Local scheduler tests against real subprocesses (reference analog:
+torchx/schedulers/test/local_scheduler_test.py — real Popen, no mocks)."""
+
+import os
+import time
+from pathlib import Path
+
+import pytest
+
+from torchx_tpu.schedulers.api import Stream
+from torchx_tpu.schedulers.local_scheduler import (
+    CWDImageProvider,
+    LocalDirectoryImageProvider,
+    LocalScheduler,
+    tpu_device_env,
+)
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppState,
+    Resource,
+    Role,
+    TpuSlice,
+    macros,
+)
+
+
+@pytest.fixture
+def sched():
+    s = LocalScheduler(session_name="test", cache_size=10)
+    yield s
+    s.close()
+
+
+def sh_role(name: str, script: str, num_replicas: int = 1, **kwargs) -> Role:
+    return Role(
+        name=name,
+        image="",
+        entrypoint="sh",
+        args=["-c", script],
+        num_replicas=num_replicas,
+        **kwargs,
+    )
+
+
+def wait_terminal(sched: LocalScheduler, app_id: str, timeout: float = 30) -> AppState:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        desc = sched.describe(app_id)
+        assert desc is not None
+        if desc.state in (AppState.SUCCEEDED, AppState.FAILED, AppState.CANCELLED):
+            return desc.state
+        time.sleep(0.05)
+    raise TimeoutError(f"app {app_id} did not finish")
+
+
+class TestLocalScheduler:
+    def test_submit_success(self, sched, tmp_path):
+        app = AppDef(name="ok", roles=[sh_role("r", "echo hello")])
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        assert wait_terminal(sched, app_id) == AppState.SUCCEEDED
+        out = tmp_path / app_id / "r" / "0" / "stdout.log"
+        assert out.read_text().strip() == "hello"
+        # SUCCESS marker written
+        assert (tmp_path / app_id / "SUCCESS").exists()
+
+    def test_submit_failure_kills_gang(self, sched, tmp_path):
+        app = AppDef(
+            name="fail",
+            roles=[
+                sh_role("bad", "exit 3"),
+                sh_role("slow", "sleep 30"),
+            ],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        state = wait_terminal(sched, app_id, timeout=20)
+        assert state == AppState.FAILED
+        # gang fail-fast: the sleeper must not still be running
+        desc = sched.describe(app_id)
+        slow = [rs for rs in desc.roles_statuses if rs.role == "slow"][0]
+        assert all(r.state != AppState.RUNNING for r in slow.replicas)
+
+    def test_macro_substitution(self, sched, tmp_path):
+        app = AppDef(
+            name="macro",
+            roles=[
+                sh_role(
+                    "m",
+                    f"echo replica={macros.replica_id} app={macros.app_id}",
+                    num_replicas=2,
+                )
+            ],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        wait_terminal(sched, app_id)
+        out0 = (tmp_path / app_id / "m" / "0" / "stdout.log").read_text()
+        out1 = (tmp_path / app_id / "m" / "1" / "stdout.log").read_text()
+        assert f"replica=0 app={app_id}" in out0
+        assert f"replica=1 app={app_id}" in out1
+
+    def test_gang_env_injection(self, sched, tmp_path):
+        app = AppDef(
+            name="env",
+            roles=[sh_role("e", "echo $TPX_REPLICA_ID/$TPX_NUM_REPLICAS-$TPX_COORDINATOR_HOST", num_replicas=2)],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        wait_terminal(sched, app_id)
+        assert (tmp_path / app_id / "e" / "1" / "stdout.log").read_text().strip() == (
+            "1/2-localhost"
+        )
+
+    def test_tpu_role_expands_to_hosts(self, sched, tmp_path):
+        # v5p-32 = 16 chips = 4 hosts -> 4 replicas
+        role = sh_role("t", "echo $TPX_NUM_REPLICAS")
+        role.resource = Resource(cpu=1, memMB=512, tpu=TpuSlice("v5p", 16))
+        app = AppDef(name="tpu", roles=[role])
+        info = sched.submit_dryrun(app, {"log_dir": str(tmp_path)})
+        assert len(info.request.role_params["t"]) == 4
+        env = info.request.role_params["t"][0].env
+        assert env["TPX_NUM_REPLICAS"] == "4"
+        assert env["TPX_TPU_ACCELERATOR_TYPE"] == "v5p-32"
+        # no local chips in CI: simulation env is set
+        assert env.get("JAX_PLATFORMS") == "cpu"
+        assert "xla_force_host_platform_device_count=4" in env.get("XLA_FLAGS", "")
+
+    def test_multislice_megascale_env(self, sched, tmp_path):
+        role = sh_role("t", "true")
+        role.resource = Resource(cpu=1, memMB=512, tpu=TpuSlice("v5e", 8))
+        role.num_replicas = 2  # 2 slices x 1 host
+        app = AppDef(name="ms", roles=[role])
+        info = sched.submit_dryrun(app, {"log_dir": str(tmp_path)})
+        params = info.request.role_params["t"]
+        assert len(params) == 2
+        assert params[0].env["MEGASCALE_NUM_SLICES"] == "2"
+        assert params[0].env["MEGASCALE_SLICE_ID"] == "0"
+        assert params[1].env["MEGASCALE_SLICE_ID"] == "1"
+
+    def test_cancel(self, sched, tmp_path):
+        app = AppDef(name="c", roles=[sh_role("s", "sleep 60")])
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        time.sleep(0.2)
+        sched.cancel(app_id)
+        assert wait_terminal(sched, app_id) == AppState.CANCELLED
+
+    def test_error_file_surfaced(self, sched, tmp_path):
+        script = (
+            'mkdir -p "$(dirname $TPX_ERROR_FILE)"; '
+            'echo \'{"message": {"message": "kaboom", "extraInfo": {}}, "exitcode": 5, "hostname": "h"}\' > $TPX_ERROR_FILE; '
+            "exit 5"
+        )
+        app = AppDef(name="err", roles=[sh_role("e", script)])
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        assert wait_terminal(sched, app_id) == AppState.FAILED
+        desc = sched.describe(app_id)
+        assert "kaboom" in desc.structured_error_msg
+
+    def test_log_iter(self, sched, tmp_path):
+        app = AppDef(name="logs", roles=[sh_role("l", "echo a; echo b; echo c")])
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        wait_terminal(sched, app_id)
+        lines = list(sched.log_iter(app_id, "l", 0, streams=Stream.STDOUT))
+        assert lines == ["a", "b", "c"]
+
+    def test_log_iter_tail(self, sched, tmp_path):
+        app = AppDef(
+            name="tail", roles=[sh_role("t", "echo first; sleep 0.8; echo last")]
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        lines = list(
+            sched.log_iter(app_id, "t", 0, should_tail=True, streams=Stream.STDOUT)
+        )
+        assert lines == ["first", "last"]
+
+    def test_log_iter_regex(self, sched, tmp_path):
+        app = AppDef(name="re", roles=[sh_role("r", "echo keep; echo drop")])
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        wait_terminal(sched, app_id)
+        lines = list(
+            sched.log_iter(app_id, "r", 0, regex="keep", streams=Stream.STDOUT)
+        )
+        assert lines == ["keep"]
+
+    def test_list(self, sched, tmp_path):
+        app = AppDef(name="lst", roles=[sh_role("x", "true")])
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        wait_terminal(sched, app_id)
+        listing = sched.list()
+        assert any(a.app_id == app_id for a in listing)
+
+    def test_lru_eviction(self, tmp_path):
+        sched = LocalScheduler(session_name="lru", cache_size=2)
+        try:
+            ids = []
+            for i in range(3):
+                app = AppDef(name=f"a{i}", roles=[sh_role("r", "true")])
+                app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+                wait_terminal(sched, app_id)
+                ids.append(app_id)
+            assert sched.describe(ids[0]) is None  # evicted
+            assert sched.describe(ids[2]) is not None
+        finally:
+            sched.close()
+
+    def test_combined_stream(self, sched, tmp_path):
+        app = AppDef(name="comb", roles=[sh_role("c", "echo out; echo err 1>&2")])
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        wait_terminal(sched, app_id)
+        time.sleep(0.3)  # allow tee to drain
+        combined = (tmp_path / app_id / "c" / "0" / "combined.log").read_text()
+        assert "out" in combined and "err" in combined
+
+    def test_dir_image_provider(self, tmp_path):
+        img = tmp_path / "img"
+        img.mkdir()
+        (img / "hello.sh").write_text("#!/bin/sh\necho from-image\n")
+        os.chmod(img / "hello.sh", 0o755)
+        sched = LocalScheduler(
+            session_name="dir", image_provider=LocalDirectoryImageProvider()
+        )
+        try:
+            app = AppDef(
+                name="img",
+                roles=[
+                    Role(name="r", image=str(img), entrypoint="hello.sh", args=[])
+                ],
+            )
+            app_id = sched.submit(app, {"log_dir": str(tmp_path / "logs")})
+            assert wait_terminal(sched, app_id) == AppState.SUCCEEDED
+            out = tmp_path / "logs" / app_id / "r" / "0" / "stdout.log"
+            assert out.read_text().strip() == "from-image"
+        finally:
+            sched.close()
+
+    def test_dir_image_provider_rejects_missing(self):
+        with pytest.raises(ValueError):
+            LocalDirectoryImageProvider().fetch("/definitely/not/a/dir")
+
+
+class TestTpuDeviceEnv:
+    def test_partitioning(self):
+        env = tpu_device_env(4, replica_id=1, replicas_on_host=2, host_chips=8, simulate=True)
+        assert env["TPU_VISIBLE_CHIPS"] == "4,5,6,7"
+
+    def test_single_replica_uses_all_chips(self):
+        assert tpu_device_env(4, 0, replicas_on_host=1, host_chips=4, simulate=True) == {}
+
+    def test_partition_disabled_on_real_host(self):
+        env = tpu_device_env(4, 0, replicas_on_host=2, host_chips=4, simulate=True, partition=False)
+        assert env == {}  # no CPU simulation forced on a host with chips
+
+    def test_oversubscription_raises(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            tpu_device_env(1, 5, replicas_on_host=8, host_chips=4, simulate=True)
+
+    def test_simulation(self):
+        env = tpu_device_env(4, 0, 1, host_chips=0, simulate=True)
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert "device_count=4" in env["XLA_FLAGS"]
+
+    def test_no_sim_no_chips(self):
+        assert tpu_device_env(4, 0, 1, host_chips=0, simulate=False) == {}

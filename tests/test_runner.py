@@ -1,0 +1,177 @@
+"""Runner tests with a stub scheduler (reference analog:
+torchx/runner/test/api_test.py) plus a real local-scheduler e2e."""
+
+import threading
+from typing import Mapping, Optional
+
+import pytest
+
+from torchx_tpu.runner.api import Runner, get_runner
+from torchx_tpu.schedulers.api import DescribeAppResponse, ListAppResponse, Scheduler
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppDryRunInfo,
+    AppState,
+    CfgVal,
+    Role,
+    runopts,
+)
+
+
+class StubScheduler(Scheduler[dict]):
+    def __init__(self, session_name: str, **kwargs):
+        super().__init__("stub", session_name)
+        self.apps: dict[str, AppState] = {}
+        self.cancelled: list[str] = []
+        self._counter = 0
+
+    def run_opts(self) -> runopts:
+        opts = runopts()
+        opts.add("knob", type_=str, help="a knob", default="k0")
+        return opts
+
+    def _submit_dryrun(self, app: AppDef, cfg: Mapping[str, CfgVal]):
+        return AppDryRunInfo({"app": app, "cfg": dict(cfg)})
+
+    def schedule(self, dryrun_info) -> str:
+        self._counter += 1
+        app_id = f"stub_app_{self._counter}"
+        self.apps[app_id] = AppState.RUNNING
+        return app_id
+
+    def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
+        if app_id not in self.apps:
+            return None
+        return DescribeAppResponse(app_id=app_id, state=self.apps[app_id])
+
+    def _cancel_existing(self, app_id: str) -> None:
+        self.apps[app_id] = AppState.CANCELLED
+        self.cancelled.append(app_id)
+
+    def list(self):
+        return [ListAppResponse(app_id=a, state=s) for a, s in self.apps.items()]
+
+
+@pytest.fixture
+def runner():
+    stub = StubScheduler("test")
+    r = Runner("test", {"stub": lambda session_name, **kw: stub})
+    yield r
+    r.close()
+
+
+def simple_app(**role_kwargs) -> AppDef:
+    defaults = dict(name="r", image="i", entrypoint="echo", args=["hi"])
+    defaults.update(role_kwargs)
+    return AppDef(name="app", roles=[Role(**defaults)])
+
+
+class TestRunner:
+    def test_run_and_status(self, runner):
+        handle = runner.run(simple_app(), "stub")
+        assert handle.startswith("stub://test/")
+        status = runner.status(handle)
+        assert status.state == AppState.RUNNING
+
+    def test_dryrun_resolves_cfg(self, runner):
+        info = runner.dryrun(simple_app(), "stub", {"knob": "custom"})
+        assert info.request["cfg"]["knob"] == "custom"
+        info = runner.dryrun(simple_app(), "stub")
+        assert info.request["cfg"]["knob"] == "k0"
+
+    def test_dryrun_validation(self, runner):
+        with pytest.raises(ValueError):
+            runner.dryrun(AppDef(name="empty"), "stub")
+        with pytest.raises(ValueError):
+            runner.dryrun(simple_app(entrypoint=""), "stub")
+        with pytest.raises(ValueError):
+            runner.dryrun(simple_app(num_replicas=0), "stub")
+        with pytest.raises(ValueError):
+            runner.dryrun(simple_app(min_replicas=5, num_replicas=2), "stub")
+
+    def test_schedule_requires_runner_dryrun(self, runner):
+        with pytest.raises(ValueError):
+            runner.schedule(AppDryRunInfo({"raw": True}))
+
+    def test_cancel(self, runner):
+        handle = runner.run(simple_app(), "stub")
+        runner.cancel(handle)
+        assert runner.status(handle).state == AppState.CANCELLED
+
+    def test_status_unknown_app(self, runner):
+        assert runner.status("stub://test/ghost") is None
+
+    def test_unknown_scheduler(self, runner):
+        with pytest.raises(KeyError):
+            runner.run(simple_app(), "nope")
+
+    def test_list(self, runner):
+        runner.run(simple_app(), "stub")
+        assert len(runner.list("stub")) == 1
+
+    def test_wait_terminal(self, runner):
+        handle = runner.run(simple_app(), "stub")
+        _, _, app_id = handle.partition("//")[0], None, handle.rsplit("/", 1)[-1]
+
+        def finish():
+            sched = runner._scheduler("stub")
+            sched.apps[app_id] = AppState.SUCCEEDED
+
+        t = threading.Timer(0.3, finish)
+        t.start()
+        status = runner.wait(handle, wait_interval=0.05)
+        assert status.state == AppState.SUCCEEDED
+
+    def test_run_component_via_stub(self, runner):
+        handle = runner.run_component(
+            "utils.echo", ["--msg", "yo"], "stub"
+        )
+        assert handle.startswith("stub://")
+
+    def test_dryrun_does_not_mutate_caller_app(self, runner):
+        app = simple_app()
+        runner.dryrun(app, "stub", workspace=None)
+        assert app.roles[0].env == {}
+        before = app.roles[0].image
+        runner.dryrun(app, "stub")
+        assert app.roles[0].image == before
+
+    def test_component_defaults_applied(self):
+        stub = StubScheduler("test")
+        r = Runner(
+            "test",
+            {"stub": lambda session_name, **kw: stub},
+            component_defaults={"utils.echo": {"msg": "default-msg"}},
+        )
+        info = r.dryrun_component("utils.echo", [], "stub")
+        assert info.request["app"].roles[0].args == ["default-msg"]
+
+
+class TestGetRunner:
+    def test_get_runner_has_registered_backends(self):
+        from torchx_tpu.schedulers import DEFAULT_SCHEDULER_MODULES
+
+        with get_runner() as runner:
+            backends = runner.scheduler_backends()
+            for expected in DEFAULT_SCHEDULER_MODULES:
+                assert expected in backends
+
+    def test_env_param_harvest(self, monkeypatch):
+        monkeypatch.setenv("TPX_PARAMS_CACHE_SIZE", "5")
+        with get_runner() as runner:
+            assert runner._scheduler_params.get("cache_size") == "5"
+
+
+class TestRunnerLocalE2E:
+    def test_echo_end_to_end(self, tmp_path):
+        with get_runner("e2e") as runner:
+            handle = runner.run_component(
+                "utils.echo",
+                ["--msg", "runner-e2e"],
+                "local",
+                {"log_dir": str(tmp_path)},
+            )
+            status = runner.wait(handle, wait_interval=0.1)
+            assert status.state == AppState.SUCCEEDED
+            lines = list(runner.log_lines(handle, "echo", 0))
+            assert "runner-e2e" in lines

@@ -1,0 +1,308 @@
+"""Unit tests for the specs core data model."""
+
+import json
+
+import pytest
+
+from torchx_tpu.specs import (
+    AppDef,
+    AppState,
+    AppStatus,
+    BindMount,
+    DeviceMount,
+    InvalidRunConfigException,
+    MalformedAppHandleException,
+    Resource,
+    Role,
+    TpuSlice,
+    VolumeMount,
+    Workspace,
+    is_started,
+    is_terminal,
+    macros,
+    make_app_handle,
+    make_structured_error,
+    named_resources,
+    parse_app_handle,
+    parse_mounts,
+    resource,
+    runopts,
+)
+
+
+class TestTpuSlice:
+    def test_v5p_naming_counts_cores(self):
+        s = TpuSlice.from_type("v5p-32")
+        assert s.chips == 16
+        assert s.cores == 32
+        assert s.accelerator_type == "v5p-32"
+        assert s.hosts == 4  # 4 chips per host
+
+    def test_v5e_naming_counts_chips(self):
+        s = TpuSlice.from_type("v5litepod-8")
+        assert s.accelerator == "v5e"
+        assert s.chips == 8
+        assert s.hosts == 1
+        assert s.accelerator_type == "v5litepod-8"
+
+    def test_v6e(self):
+        s = TpuSlice.from_type("v6e-16")
+        assert s.chips == 16
+        assert s.hosts == 2
+
+    def test_v4_single_host(self):
+        s = TpuSlice.from_type("v4-8")
+        assert s.chips == 4
+        assert s.hosts == 1
+
+    def test_topology_validation(self):
+        TpuSlice(accelerator="v5p", chips=16, topology="2x2x4")
+        with pytest.raises(ValueError):
+            TpuSlice(accelerator="v5p", chips=16, topology="2x2x2")
+
+    def test_default_topology_product(self):
+        for n in (4, 8, 16, 32, 64, 128):
+            s = TpuSlice(accelerator="v5p", chips=n)
+            dims = [int(d) for d in s.default_topology().split("x")]
+            assert len(dims) == 3
+            assert dims[0] * dims[1] * dims[2] == n
+        s = TpuSlice(accelerator="v5e", chips=16)
+        a, b = (int(d) for d in s.default_topology().split("x"))
+        assert a * b == 16
+
+    def test_unknown_generation(self):
+        with pytest.raises(ValueError):
+            TpuSlice(accelerator="v99", chips=4)
+        with pytest.raises(ValueError):
+            TpuSlice.from_type("h100-8")
+
+    def test_malformed_type(self):
+        with pytest.raises(ValueError):
+            TpuSlice.from_type("v5p")
+
+
+class TestNamedResources:
+    def test_catalog_lookup(self):
+        r = named_resources["tpu_v5p_16"]
+        assert r.tpu is not None and r.tpu.chips == 16
+        assert r.cpu == 208
+
+    def test_cloud_name_lookup(self):
+        r = named_resources["v5p-32"]
+        assert r.tpu.chips == 16
+
+    def test_uncataloged_size_fallback(self):
+        r = named_resources["v5e-12"]
+        assert r.tpu.chips == 12
+
+    def test_generic(self):
+        r = named_resources["cpu_small"]
+        assert r.cpu == 2 and r.tpu is None
+
+    def test_contains(self):
+        assert "v5p-32" in named_resources
+        assert "nonsense" not in named_resources
+
+    def test_unknown_raises(self):
+        with pytest.raises(KeyError):
+            named_resources["gpu_a100"]
+
+    def test_resource_factory_h_wins(self):
+        r = resource(cpu=1, memMB=1, h="v5litepod-4")
+        assert r.tpu.chips == 4 and r.cpu != 1
+
+    def test_resource_factory_tpu_str(self):
+        r = resource(tpu="v4-16")
+        assert r.tpu.chips == 8
+
+
+class TestMounts:
+    def test_parse_bind(self):
+        (m,) = parse_mounts(["type=bind,src=/host,dst=/job,readonly"])
+        assert isinstance(m, BindMount)
+        assert m.src_path == "/host" and m.dst_path == "/job" and m.read_only
+
+    def test_parse_multiple_groups(self):
+        ms = parse_mounts(
+            ["type=bind,src=/a,dst=/b", "type=volume,src=models,dst=/models"]
+        )
+        assert isinstance(ms[0], BindMount) and isinstance(ms[1], VolumeMount)
+
+    def test_parse_device(self):
+        (m,) = parse_mounts(["type=device,src=/dev/accel0"])
+        assert isinstance(m, DeviceMount) and m.dst_path == "/dev/accel0"
+
+    def test_parse_errors(self):
+        with pytest.raises(ValueError):
+            parse_mounts(["src=/a,dst=/b"])
+        with pytest.raises(ValueError):
+            parse_mounts(["type=bind,src=/a"])
+        with pytest.raises(ValueError):
+            parse_mounts(["type=nope,src=/a,dst=/b"])
+
+
+class TestMacros:
+    def test_apply_substitutes_args_env_entrypoint(self):
+        role = Role(
+            name="trainer",
+            image="img",
+            entrypoint="bash",
+            args=["-c", f"run --id {macros.app_id} --replica {macros.replica_id}"],
+            env={"LOGROOT": f"{macros.img_root}/logs"},
+            mounts=[BindMount(src_path=f"{macros.img_root}/d", dst_path="/d")],
+        )
+        v = macros.Values(
+            img_root="/img", app_id="app_1", replica_id="3", num_replicas="4"
+        )
+        out = v.apply(role)
+        assert out.args == ["-c", "run --id app_1 --replica 3"]
+        assert out.env["LOGROOT"] == "/img/logs"
+        assert out.mounts[0].src_path == "/img/d"
+        # original untouched
+        assert macros.app_id in role.args[1]
+
+    def test_coordinator_env_substitution(self):
+        role = Role(
+            name="t",
+            image="i",
+            entrypoint="sh",
+            args=["-c", f"echo $${macros.coordinator_env}"],
+        )
+        out = macros.Values(coordinator_env="MY_COORD_HOST").apply(role)
+        # one $ remains for the runtime shell to expand
+        assert out.args[1] == "echo $MY_COORD_HOST"
+
+
+class TestStatus:
+    def test_terminal_and_started(self):
+        assert is_terminal(AppState.SUCCEEDED)
+        assert is_terminal(AppState.FAILED)
+        assert not is_terminal(AppState.RUNNING)
+        assert is_started(AppState.RUNNING)
+        assert not is_started(AppState.PENDING)
+
+    def test_raise_for_status(self):
+        AppStatus(state=AppState.SUCCEEDED).raise_for_status()
+        from torchx_tpu.specs import AppStatusError
+
+        with pytest.raises(AppStatusError):
+            AppStatus(state=AppState.FAILED).raise_for_status()
+
+    def test_structured_error_format(self):
+        err = make_structured_error("boom", exitcode=2, hostname="worker-0")
+        st = AppStatus(state=AppState.FAILED, structured_error_msg=err)
+        text = st.format()
+        assert "boom" in text and "exitcode: 2" in text and "worker-0" in text
+
+    def test_format_plain(self):
+        st = AppStatus(state=AppState.RUNNING, msg="ok")
+        assert "RUNNING" in st.format()
+
+
+class TestRunopts:
+    def make(self) -> runopts:
+        opts = runopts()
+        opts.add("log_dir", type_=str, help="log dir", default="/tmp/logs")
+        opts.add("replicas", type_=int, help="n", default=1)
+        opts.add("mounts", type_=list, help="mounts", default=None)
+        opts.add("labels", type_=dict, help="labels", default=None)
+        opts.add("detach", type_=bool, help="detach", default=False)
+        opts.add("project", type_=str, help="gcp project", required=True)
+        return opts
+
+    def test_resolve_defaults_and_required(self):
+        opts = self.make()
+        cfg = opts.resolve({"project": "p1"})
+        assert cfg["log_dir"] == "/tmp/logs" and cfg["replicas"] == 1
+        with pytest.raises(InvalidRunConfigException):
+            opts.resolve({})
+
+    def test_resolve_type_error(self):
+        with pytest.raises(InvalidRunConfigException):
+            self.make().resolve({"project": "p", "replicas": "abc"})
+
+    def test_str_coercion_and_camel_alias(self):
+        cfg = self.make().resolve({"project": "p", "replicas": "3", "Detach": "true"})
+        assert cfg["replicas"] == 3 and cfg["detach"] is True
+
+    def test_cfg_from_str(self):
+        opts = self.make()
+        cfg = opts.cfg_from_str("project=p,replicas=2;detach=yes")
+        assert cfg == {"project": "p", "replicas": 2, "detach": True}
+
+    def test_cfg_from_str_list_continuation(self):
+        opts = self.make()
+        cfg = opts.cfg_from_str("mounts=a,b,c;project=p")
+        assert cfg["mounts"] == ["a", "b", "c"]
+
+    def test_cfg_from_str_dict(self):
+        cfg = self.make().cfg_from_str("labels=team:ml")
+        assert cfg["labels"] == {"team": "ml"}
+
+    def test_cfg_from_str_dict_multi_entry(self):
+        cfg = self.make().cfg_from_str("labels=a:1,b:2;project=p")
+        assert cfg["labels"] == {"a": "1", "b": "2"}
+        assert cfg["project"] == "p"
+
+    def test_error_details_non_dict_json(self):
+        from torchx_tpu.specs import AppState, AppStatus
+
+        st = AppStatus(state=AppState.FAILED, structured_error_msg='"oom killed"')
+        assert "oom killed" in st.format()
+
+    def test_unknown_passthrough(self):
+        cfg = self.make().resolve({"project": "p", "plugin_knob": "x"})
+        assert cfg["plugin_knob"] == "x"
+
+    def test_merge(self):
+        a = runopts()
+        a.add("x", type_=int, help="", default=1)
+        b = runopts()
+        b.add("y", type_=int, help="", default=2)
+        merged = a | b
+        assert {k for k, _ in merged} == {"x", "y"}
+
+    def test_json_repr(self):
+        cfg = self.make().cfg_from_json_repr(json.dumps({"project": "p"}))
+        assert cfg == {"project": "p"}
+
+
+class TestHandles:
+    def test_roundtrip(self):
+        h = make_app_handle("gke", "sess", "app_abc123")
+        assert parse_app_handle(h) == ("gke", "sess", "app_abc123")
+
+    def test_empty_session(self):
+        assert parse_app_handle("local://" + "/app1") == ("local", "", "app1")
+
+    def test_malformed(self):
+        with pytest.raises(MalformedAppHandleException):
+            parse_app_handle("not-a-handle")
+
+
+class TestWorkspaceSpec:
+    def test_from_str_single(self):
+        assert Workspace.from_str(".").projects == {".": ""}
+
+    def test_from_str_mapping(self):
+        ws = Workspace.from_str("./src=app/src,./conf=conf")
+        assert ws.projects == {"./src": "app/src", "./conf": "conf"}
+
+    def test_merge(self):
+        a = Workspace(projects={"x": "1"})
+        b = Workspace(projects={"x": "0", "y": "2"})
+        assert a.merge_into(b).projects == {"x": "1", "y": "2"}
+
+
+class TestRoleAppDef:
+    def test_defaults(self):
+        role = Role(name="r", image="i")
+        assert role.num_replicas == 1
+        app = AppDef(name="a", roles=[role])
+        assert app.roles[0].name == "r"
+
+    def test_resource_copy(self):
+        r = Resource(cpu=1, memMB=2, capabilities={"a": 1})
+        r2 = Resource.copy(r, b=2)
+        assert r2.capabilities == {"a": 1, "b": 2}
+        assert r.capabilities == {"a": 1}

@@ -1,0 +1,14 @@
+"""torchx_tpu — a TPU-native universal job launcher.
+
+Define distributed applications as typed specs (AppDef / Role / Resource
+with TPU slice topology), materialize them from parameterized component
+functions, package local code via workspaces, gang-schedule onto local
+processes / Docker / Slurm / GKE TPU pod slices, then monitor, log-tail,
+cancel and track.
+
+Built from scratch against the capability surface of meta-pytorch/torchx
+(see SURVEY.md); the execution model is JAX SPMD over TPU slices instead of
+torchrun/NCCL gangs.
+"""
+
+from torchx_tpu.version import __version__  # noqa: F401

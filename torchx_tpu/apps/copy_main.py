@@ -1,0 +1,32 @@
+"""fsspec-powered copy app (reference analog: torchx/apps/utils/copy_main.py)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="copy a file/dir between URLs")
+    parser.add_argument("--src", required=True)
+    parser.add_argument("--dst", required=True)
+    args = parser.parse_args(argv)
+    try:
+        import fsspec
+
+        with fsspec.open(args.src, "rb") as r:
+            with fsspec.open(args.dst, "wb") as w:
+                shutil.copyfileobj(r, w)
+    except ImportError:
+        # plain filesystem fallback
+        if os.path.isdir(args.src):
+            shutil.copytree(args.src, args.dst, dirs_exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(os.path.abspath(args.dst)), exist_ok=True)
+            shutil.copyfile(args.src, args.dst)
+    print(f"copied {args.src} -> {args.dst}")
+
+
+if __name__ == "__main__":
+    main()

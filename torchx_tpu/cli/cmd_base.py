@@ -1,0 +1,16 @@
+"""SubCommand base (reference analog: torchx/cli/cmd_base.py)."""
+
+from __future__ import annotations
+
+import argparse
+from abc import ABC, abstractmethod
+
+
+class SubCommand(ABC):
+    @abstractmethod
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        ...
+
+    @abstractmethod
+    def run(self, args: argparse.Namespace) -> None:
+        ...

@@ -1,0 +1,79 @@
+"""``tpx log`` — fan-out log tailing across replicas.
+
+Reference analog: torchx/cli/cmd_log.py (211 LoC). Identifier grammar::
+
+    SCHEDULER://[SESSION]/APP_ID[/ROLE[/REPLICA_IDS,..]]
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+import threading
+
+from torchx_tpu.cli.cmd_base import SubCommand
+from torchx_tpu.runner.api import get_runner
+from torchx_tpu.util.log_tee_helpers import (
+    find_role_replicas,
+    tee_logs,
+    wait_for_app_started,
+)
+
+_ID_RE = re.compile(
+    r"^(?P<scheduler>\w+)://(?P<session>[^/]*)/(?P<app_id>[^/]+)"
+    r"(?:/(?P<role>[^/]+)(?:/(?P<replicas>[\d,]+))?)?$"
+)
+
+
+class CmdLog(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument(
+            "identifier", help="scheduler://session/app_id[/role[/replica,..]]"
+        )
+        subparser.add_argument("-t", "--tail", action="store_true", help="follow logs")
+        subparser.add_argument("--regex", default=None, help="filter lines by regex")
+
+    def run(self, args: argparse.Namespace) -> None:
+        m = _ID_RE.match(args.identifier)
+        if not m:
+            print(f"malformed identifier: {args.identifier}", file=sys.stderr)
+            sys.exit(1)
+        scheduler, session, app_id = (
+            m.group("scheduler"),
+            m.group("session"),
+            m.group("app_id"),
+        )
+        role = m.group("role")
+        replica_ids = (
+            [int(r) for r in m.group("replicas").split(",")]
+            if m.group("replicas")
+            else None
+        )
+        app_handle = f"{scheduler}://{session}/{app_id}"
+        with get_runner() as runner:
+            status = wait_for_app_started(runner, app_handle)
+            if status is None:
+                print(f"app not found: {app_handle}", file=sys.stderr)
+                sys.exit(1)
+            pairs = find_role_replicas(status, role)
+            if replica_ids is not None:
+                pairs = [(r, i) for r, i in pairs if i in replica_ids]
+            if not pairs:
+                print("no matching replicas", file=sys.stderr)
+                sys.exit(1)
+            threads = []
+            lock = threading.Lock()
+            for r, i in pairs:
+                def stream(r=r, i=i):  # noqa: ANN001
+                    for line in runner.log_lines(
+                        app_handle, r, i, regex=args.regex, should_tail=args.tail
+                    ):
+                        with lock:
+                            print(f"{r}/{i} {line}", flush=True)
+
+                t = threading.Thread(target=stream, daemon=True)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join()

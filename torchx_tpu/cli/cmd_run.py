@@ -1,0 +1,195 @@
+"""``tpx run`` — materialize a component and submit it.
+
+Reference analog: torchx/cli/cmd_run.py (505 LoC): component + args parsing
+(with default component from .tpxconfig ``[cli:run]``), ``--dryrun``
+printing the AppDef and materialized scheduler request, ``--wait`` /
+``--log`` streaming, and auto-wait for local runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import threading
+from typing import Optional
+
+from torchx_tpu.cli.cmd_base import SubCommand
+from torchx_tpu.runner import config as tpx_config
+from torchx_tpu.runner.api import Runner, get_runner
+from torchx_tpu.specs.api import parse_app_handle
+from torchx_tpu.specs.finder import (
+    ComponentNotFoundException,
+    ComponentValidationException,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class CmdRun(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument(
+            "-s",
+            "--scheduler",
+            type=str,
+            default=None,
+            help="scheduler backend to submit to (default: first registered)",
+        )
+        subparser.add_argument(
+            "-cfg",
+            "--scheduler_args",
+            type=str,
+            default="",
+            help="scheduler run config as comma-separated k=v pairs",
+        )
+        subparser.add_argument(
+            "--dryrun",
+            action="store_true",
+            help="print the materialized AppDef and scheduler request, do not submit",
+        )
+        subparser.add_argument(
+            "--wait",
+            action="store_true",
+            help="block until the app reaches a terminal state",
+        )
+        subparser.add_argument(
+            "--log",
+            action="store_true",
+            help="stream all replica logs (implies --wait)",
+        )
+        subparser.add_argument(
+            "--workspace",
+            type=str,
+            default=None,
+            help="local workspace to package into the job image",
+        )
+        subparser.add_argument(
+            "--parent_run_id", type=str, default=None, help="tracker parent run id"
+        )
+        subparser.add_argument(
+            "conf_args",
+            nargs=argparse.REMAINDER,
+            help="component name followed by its arguments"
+            " (e.g. dist.spmd -j 1x4 --script train.py)",
+        )
+
+    def run(self, args: argparse.Namespace) -> None:
+        with get_runner(component_defaults=tpx_config.load_sections("component")) as runner:
+            self._run(runner, args)
+
+    def _run(self, runner: Runner, args: argparse.Namespace) -> None:
+        scheduler = args.scheduler
+        if scheduler is None:
+            from torchx_tpu.schedulers import get_default_scheduler_name
+
+            scheduler = (
+                tpx_config.get_config("cli", "run", "scheduler")
+                or get_default_scheduler_name()
+            )
+
+        component, component_args = self._parse_component(args.conf_args)
+
+        cfg = runner.scheduler_run_opts(scheduler).cfg_from_str(args.scheduler_args)
+        tpx_config.apply(scheduler, cfg)
+
+        try:
+            if args.dryrun:
+                dryrun_info = runner.dryrun_component(
+                    component,
+                    component_args,
+                    scheduler,
+                    cfg,
+                    workspace=args.workspace,
+                    parent_run_id=args.parent_run_id,
+                )
+                print("=== APPLICATION ===")
+                print(_pretty_app(dryrun_info._app))
+                print("=== SCHEDULER REQUEST ===")
+                print(dryrun_info)
+                return
+            app_handle = runner.run_component(
+                component,
+                component_args,
+                scheduler,
+                cfg,
+                workspace=args.workspace,
+                parent_run_id=args.parent_run_id,
+            )
+        except (ComponentValidationException, ComponentNotFoundException) as e:
+            print(f"error: {e}", file=sys.stderr)
+            sys.exit(1)
+        except ValueError as e:
+            # component functions raise ValueError for bad arg combinations
+            # (e.g. malformed -j); show it cleanly, not as a traceback
+            print(f"error: {e}", file=sys.stderr)
+            sys.exit(1)
+
+        print(app_handle)
+        # local runs auto-wait so ctrl-c cleans up children (reference
+        # cmd_run.py:321-324)
+        should_wait = args.wait or args.log or scheduler == "local"
+        if not should_wait:
+            return
+
+        log_thread: Optional[threading.Thread] = None
+        if args.log:
+            from torchx_tpu.util.log_tee_helpers import tee_logs
+
+            log_thread = tee_logs(runner, app_handle, should_tail=True)
+        try:
+            status = runner.wait(app_handle, wait_interval=1)
+        except KeyboardInterrupt:
+            logger.warning("ctrl-c: cancelling %s", app_handle)
+            runner.cancel(app_handle)
+            raise
+        if log_thread is not None:
+            log_thread.join(timeout=10)
+        if status is None:
+            print("job not found while waiting", file=sys.stderr)
+            sys.exit(1)
+        print(status.format())
+        if status.state.name != "SUCCEEDED":
+            sys.exit(1)
+
+    def _parse_component(self, conf_args: list[str]) -> tuple[str, list[str]]:
+        """First positional is the component name; a missing name falls back
+        to .tpxconfig [cli:run] component= (reference cmd_run.py:120-180)."""
+        if conf_args and conf_args[0] == "--":
+            conf_args = conf_args[1:]
+        if not conf_args or conf_args[0].startswith("-"):
+            default = tpx_config.get_config("cli", "run", "component")
+            if not default:
+                print(
+                    "error: no component specified and no default component"
+                    " configured in .tpxconfig [cli:run]",
+                    file=sys.stderr,
+                )
+                sys.exit(1)
+            return default, conf_args
+        return conf_args[0], conf_args[1:]
+
+
+def _pretty_app(app) -> str:  # noqa: ANN001
+    if app is None:
+        return "<none>"
+    out = {
+        "name": app.name,
+        "roles": [
+            {
+                "name": r.name,
+                "image": r.image,
+                "entrypoint": r.entrypoint,
+                "args": r.args,
+                "env": r.env,
+                "num_replicas": r.num_replicas,
+                "resource": {
+                    "cpu": r.resource.cpu,
+                    "memMB": r.resource.memMB,
+                    "tpu": str(r.resource.tpu) if r.resource.tpu else None,
+                },
+            }
+            for r in app.roles
+        ],
+    }
+    return json.dumps(out, indent=2)

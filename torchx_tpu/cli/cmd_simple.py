@@ -1,0 +1,139 @@
+"""Thin Runner-wrapper subcommands: status / describe / list / cancel /
+delete / runopts / builtins / configure.
+
+Reference analog: torchx/cli/cmd_*.py (~400 LoC combined).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from torchx_tpu.cli.cmd_base import SubCommand
+from torchx_tpu.runner import config as tpx_config
+from torchx_tpu.runner.api import get_runner
+
+
+class CmdStatus(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument("app_handle", help="scheduler://session/app_id")
+
+    def run(self, args: argparse.Namespace) -> None:
+        with get_runner() as runner:
+            status = runner.status(args.app_handle)
+            if status is None:
+                print(f"app not found: {args.app_handle}", file=sys.stderr)
+                sys.exit(1)
+            print(status.format())
+
+
+class CmdDescribe(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument("app_handle", help="scheduler://session/app_id")
+
+    def run(self, args: argparse.Namespace) -> None:
+        with get_runner() as runner:
+            app = runner.describe(args.app_handle)
+            if app is None:
+                print(f"app not found: {args.app_handle}", file=sys.stderr)
+                sys.exit(1)
+            print(json.dumps({"name": app.name, "roles": [r.name for r in app.roles]}, indent=2))
+
+
+class CmdList(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument(
+            "-s", "--scheduler", required=True, help="scheduler backend to list"
+        )
+
+    def run(self, args: argparse.Namespace) -> None:
+        with get_runner() as runner:
+            for app in runner.list(args.scheduler):
+                print(f"{app.app_id}\t{app.state}\t{app.name}")
+
+
+class CmdCancel(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument("app_handle")
+
+    def run(self, args: argparse.Namespace) -> None:
+        with get_runner() as runner:
+            runner.cancel(args.app_handle)
+            print(f"cancelled {args.app_handle}")
+
+
+class CmdDelete(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument("app_handle")
+
+    def run(self, args: argparse.Namespace) -> None:
+        with get_runner() as runner:
+            runner.delete(args.app_handle)
+            print(f"deleted {args.app_handle}")
+
+
+class CmdRunopts(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument(
+            "scheduler", nargs="?", default=None, help="show only this scheduler"
+        )
+
+    def run(self, args: argparse.Namespace) -> None:
+        with get_runner() as runner:
+            names = [args.scheduler] if args.scheduler else runner.scheduler_backends()
+            for name in names:
+                print(f"{name}:")
+                try:
+                    print(runner.scheduler_run_opts(name))
+                except Exception as e:  # noqa: BLE001 - optional backend deps
+                    print(f"    <unavailable: {e}>")
+                print()
+
+
+class CmdBuiltins(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument(
+            "--print", dest="print_component", default=None, help="print component source"
+        )
+
+    def run(self, args: argparse.Namespace) -> None:
+        from torchx_tpu.specs.finder import get_builtin_source, get_components
+
+        if args.print_component:
+            print(get_builtin_source(args.print_component))
+            return
+        components = get_components()
+        print(f"Found {len(components)} builtin components:")
+        for name, c in sorted(components.items()):
+            print(f"  {name} - {c.description}")
+
+
+class CmdConfigure(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument(
+            "-s",
+            "--schedulers",
+            default=None,
+            help="comma list of schedulers to emit sections for (default: all)",
+        )
+        subparser.add_argument(
+            "--required_only", action="store_true", help="only required options"
+        )
+
+    def run(self, args: argparse.Namespace) -> None:
+        with get_runner() as runner:
+            names = (
+                args.schedulers.split(",")
+                if args.schedulers
+                else runner.scheduler_backends()
+            )
+            opts = {}
+            for name in names:
+                try:
+                    opts[name] = runner.scheduler_run_opts(name)
+                except Exception:  # noqa: BLE001
+                    continue
+            with open(tpx_config.CONFIG_FILE, "w") as f:
+                tpx_config.dump(f, opts, required_only=args.required_only)
+            print(f"wrote {tpx_config.CONFIG_FILE}")

@@ -1,0 +1,90 @@
+"""``tpx`` CLI entry point (reference analog: torchx/cli/main.py).
+
+Subcommands can be overridden/extended via the ``tpx.cli.cmds`` entry-point
+group (reference cli/main.py:51-71).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import Optional
+
+from torchx_tpu.cli.cmd_base import SubCommand
+from torchx_tpu.cli.cmd_log import CmdLog
+from torchx_tpu.cli.cmd_run import CmdRun
+from torchx_tpu.cli.cmd_simple import (
+    CmdBuiltins,
+    CmdCancel,
+    CmdConfigure,
+    CmdDelete,
+    CmdDescribe,
+    CmdList,
+    CmdRunopts,
+    CmdStatus,
+)
+from torchx_tpu.version import __version__
+
+CMDS_ENTRYPOINT_GROUP = "tpx.cli.cmds"
+
+
+def get_sub_cmds() -> dict[str, SubCommand]:
+    cmds: dict[str, SubCommand] = {
+        "run": CmdRun(),
+        "status": CmdStatus(),
+        "describe": CmdDescribe(),
+        "list": CmdList(),
+        "log": CmdLog(),
+        "cancel": CmdCancel(),
+        "delete": CmdDelete(),
+        "runopts": CmdRunopts(),
+        "builtins": CmdBuiltins(),
+        "configure": CmdConfigure(),
+    }
+    try:
+        from importlib.metadata import entry_points
+
+        for ep in entry_points(group=CMDS_ENTRYPOINT_GROUP):
+            cmds[ep.name] = ep.load()()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from torchx_tpu.cli.cmd_tracker import CmdTracker
+
+        cmds["tracker"] = CmdTracker()
+    except ImportError:
+        pass
+    return cmds
+
+
+def create_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpx", description="tpx — TPU-native universal job launcher"
+    )
+    parser.add_argument("--version", action="version", version=f"tpx {__version__}")
+    parser.add_argument("--log_level", default="INFO", help="client log level")
+    subparsers = parser.add_subparsers(title="sub-commands", dest="cmd")
+    for name, cmd in get_sub_cmds().items():
+        sub = subparsers.add_parser(name)
+        cmd.add_arguments(sub)
+        sub.set_defaults(func=cmd.run)
+    return parser
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    parser = create_parser()
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, str(args.log_level).upper(), logging.INFO),
+        format="%(levelname)s %(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    if not hasattr(args, "func"):
+        parser.print_help()
+        sys.exit(1)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

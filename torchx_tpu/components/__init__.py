@@ -1,0 +1,5 @@
+"""Built-in components: plain functions returning AppDef.
+
+Discovered by specs.finder; names are relative to this package
+(``dist.spmd``, ``utils.echo``). Reference analog: torchx/components/.
+"""

@@ -1,0 +1,47 @@
+"""compute_mesh_size — the canonical end-to-end probe app.
+
+The TPU analog of the reference's ``compute_world_size`` example
+(torchx/examples/apps/compute_world_size/main.py:10-28): a single psum over
+every device in the gang validates specs → runner → scheduler → rendezvous
+→ jax.distributed init → global collective, with zero cloud dependencies
+(runs on simulated CPU devices under the local scheduler).
+
+Run via the launcher:
+
+    tpx run -s local dist.spmd -j 1x4 --script torchx_tpu/examples/compute_mesh_size.py
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def compute_mesh_size() -> int:
+    n_global = jax.device_count()
+    n_local = jax.local_device_count()
+    # one psum across every device in the (possibly multi-process) mesh
+    ones = jnp.ones((n_local,))
+    total = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(ones)
+    mesh_size = int(total[0])
+    print(
+        f"process={jax.process_index()}/{jax.process_count()}"
+        f" local_devices={n_local} global_devices={n_global}"
+        f" computed_mesh_size={mesh_size}",
+        flush=True,
+    )
+    assert mesh_size == n_global, (mesh_size, n_global)
+    return mesh_size
+
+
+def main() -> None:
+    if os.environ.get("TPX_EXAMPLE_THROWS"):  # fault-injection hook for tests
+        raise RuntimeError("injected failure (TPX_EXAMPLE_THROWS)")
+    size = compute_mesh_size()
+    print(f"mesh size: {size}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

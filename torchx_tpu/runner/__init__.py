@@ -1,0 +1,1 @@
+from torchx_tpu.runner.api import Runner, get_runner  # noqa: F401

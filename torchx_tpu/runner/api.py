@@ -1,0 +1,352 @@
+"""Runner: session-scoped client API over all schedulers.
+
+Reference analog: torchx/runner/api.py (679 LoC). The Runner resolves
+components, materializes AppDefs, builds workspaces, submits via the chosen
+scheduler, and exposes the full monitor surface
+(status/wait/cancel/delete/describe/log_lines/list). Every public call is
+wrapped in a telemetry :func:`log_event`.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import os
+import time
+from datetime import datetime
+from types import TracebackType
+from typing import Any, Iterable, Mapping, Optional, Type
+
+from torchx_tpu import settings
+from torchx_tpu.runner.events import log_event
+from torchx_tpu.schedulers import (
+    SchedulerFactory,
+    get_scheduler_factories,
+)
+from torchx_tpu.schedulers.api import (
+    DescribeAppResponse,
+    ListAppResponse,
+    Scheduler,
+    Stream,
+)
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppDryRunInfo,
+    AppHandle,
+    AppState,
+    AppStatus,
+    CfgVal,
+    is_terminal,
+    make_app_handle,
+    parse_app_handle,
+    runopts,
+)
+from torchx_tpu.util.session import get_session_id_or_create_new
+
+logger = logging.getLogger(__name__)
+
+
+class Runner:
+    """A named session owning lazily-created scheduler instances."""
+
+    def __init__(
+        self,
+        name: str,
+        scheduler_factories: Mapping[str, SchedulerFactory],
+        component_defaults: Optional[Mapping[str, Mapping[str, str]]] = None,
+        scheduler_params: Optional[Mapping[str, Any]] = None,
+    ) -> None:
+        self._name = name
+        self._scheduler_factories = dict(scheduler_factories)
+        self._scheduler_instances: dict[str, Scheduler] = {}
+        self._component_defaults = dict(component_defaults or {})
+        self._scheduler_params = dict(scheduler_params or {})
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def __enter__(self) -> "Runner":
+        return self
+
+    def __exit__(
+        self,
+        exc_type: Optional[Type[BaseException]],
+        exc: Optional[BaseException],
+        tb: Optional[TracebackType],
+    ) -> bool:
+        self.close()
+        return False
+
+    def close(self) -> None:
+        for sched in self._scheduler_instances.values():
+            sched.close()
+        self._scheduler_instances.clear()
+
+    # -- component path ----------------------------------------------------
+
+    def run_component(
+        self,
+        component: str,
+        component_args: list[str],
+        scheduler: str,
+        cfg: Optional[Mapping[str, CfgVal]] = None,
+        workspace: Optional[str] = None,
+        parent_run_id: Optional[str] = None,
+    ) -> AppHandle:
+        """Resolve a component (builtin name / file.py:fn), materialize it
+        with the given CLI-style args, and run it."""
+        dryrun_info = self.dryrun_component(
+            component, component_args, scheduler, cfg, workspace, parent_run_id
+        )
+        return self.schedule(dryrun_info)
+
+    def dryrun_component(
+        self,
+        component: str,
+        component_args: list[str],
+        scheduler: str,
+        cfg: Optional[Mapping[str, CfgVal]] = None,
+        workspace: Optional[str] = None,
+        parent_run_id: Optional[str] = None,
+    ) -> AppDryRunInfo:
+        from torchx_tpu.specs.builders import materialize_appdef
+        from torchx_tpu.specs.finder import get_component
+
+        component_def = get_component(component)
+        app = materialize_appdef(
+            component_def.fn,
+            component_args,
+            self._component_defaults.get(component),
+        )
+        return self.dryrun(
+            app, scheduler, cfg, workspace=workspace, parent_run_id=parent_run_id
+        )
+
+    # -- run path ----------------------------------------------------------
+
+    def run(
+        self,
+        app: AppDef,
+        scheduler: str,
+        cfg: Optional[Mapping[str, CfgVal]] = None,
+        workspace: Optional[str] = None,
+        parent_run_id: Optional[str] = None,
+    ) -> AppHandle:
+        dryrun_info = self.dryrun(
+            app, scheduler, cfg, workspace=workspace, parent_run_id=parent_run_id
+        )
+        return self.schedule(dryrun_info)
+
+    def dryrun(
+        self,
+        app: AppDef,
+        scheduler: str,
+        cfg: Optional[Mapping[str, CfgVal]] = None,
+        workspace: Optional[str] = None,
+        parent_run_id: Optional[str] = None,
+    ) -> AppDryRunInfo:
+        """Validate + build workspace + materialize the scheduler request.
+
+        Works on a deep copy: workspace builds mutate role.image and tracker
+        env injection mutates role.env; the caller's AppDef stays pristine.
+        """
+        app = copy.deepcopy(app)
+        cfg = dict(cfg or {})
+        # validation (reference runner/api.py:346-369)
+        if not app.roles:
+            raise ValueError(f"AppDef {app.name} has no roles")
+        for role in app.roles:
+            if not role.entrypoint:
+                raise ValueError(f"role {role.name} has no entrypoint")
+            if role.num_replicas <= 0:
+                raise ValueError(
+                    f"role {role.name} has num_replicas={role.num_replicas}; must be > 0"
+                )
+            if role.min_replicas is not None and not (
+                0 < role.min_replicas <= role.num_replicas
+            ):
+                raise ValueError(
+                    f"role {role.name}: 0 < min_replicas <= num_replicas violated"
+                )
+
+        sched = self._scheduler(scheduler)
+        with log_event(
+            "dryrun",
+            scheduler,
+            app_image=app.roles[0].image,
+            runcfg=json.dumps(cfg, default=str),
+            session=self._name,
+        ):
+            self._inject_tracker_env(app, parent_run_id)
+            resolved_cfg = sched.run_opts().resolve(cfg)
+            sched._pre_build_validate(app, resolved_cfg)
+            from torchx_tpu.specs.api import Workspace
+            from torchx_tpu.workspace.api import WorkspaceMixin
+
+            if isinstance(sched, WorkspaceMixin):
+                if workspace:
+                    ws = Workspace.from_str(workspace)
+                    for role in app.roles:
+                        role.workspace = (
+                            ws if role.workspace is None else ws.merge_into(role.workspace)
+                        )
+                sched.build_workspaces(app.roles, resolved_cfg)
+            sched._validate(app, resolved_cfg)
+            return sched.materialize_dryrun(app, resolved_cfg)
+
+    def schedule(self, dryrun_info: AppDryRunInfo) -> AppHandle:
+        scheduler = dryrun_info._scheduler
+        if not scheduler:
+            raise ValueError(
+                "dryrun_info was not produced by Runner.dryrun/submit_dryrun"
+            )
+        sched = self._scheduler(scheduler)
+        app = dryrun_info._app
+        with log_event(
+            "schedule",
+            scheduler,
+            app_image=app.roles[0].image if app and app.roles else None,
+            session=self._name,
+        ) as ev:
+            app_id = sched.schedule(dryrun_info)
+            handle = make_app_handle(scheduler, self._name, app_id)
+            ev._event.app_id = app_id
+            if app:
+                logger.info("launched app %s on %s", app_id, scheduler)
+            return handle
+
+    # -- monitor path ------------------------------------------------------
+
+    def status(self, app_handle: AppHandle) -> Optional[AppStatus]:
+        scheduler, _, app_id = parse_app_handle(app_handle)
+        sched = self._scheduler(scheduler)
+        with log_event("status", scheduler, app_id, session=self._name):
+            desc = sched.describe(app_id)
+            if desc is None:
+                return None
+            return AppStatus(
+                state=desc.state,
+                num_restarts=desc.num_restarts,
+                msg=desc.msg,
+                structured_error_msg=desc.structured_error_msg,
+                ui_url=desc.ui_url,
+                roles=desc.roles_statuses,
+            )
+
+    def wait(
+        self, app_handle: AppHandle, wait_interval: float = 10
+    ) -> Optional[AppStatus]:
+        """Block until the app reaches a terminal state."""
+        while True:
+            status = self.status(app_handle)
+            if status is None or status.is_terminal():
+                return status
+            time.sleep(wait_interval)
+
+    def cancel(self, app_handle: AppHandle) -> None:
+        scheduler, _, app_id = parse_app_handle(app_handle)
+        with log_event("cancel", scheduler, app_id, session=self._name):
+            self._scheduler(scheduler).cancel(app_id)
+
+    def delete(self, app_handle: AppHandle) -> None:
+        scheduler, _, app_id = parse_app_handle(app_handle)
+        with log_event("delete", scheduler, app_id, session=self._name):
+            self._scheduler(scheduler).delete(app_id)
+
+    def describe(self, app_handle: AppHandle) -> Optional[AppDef]:
+        """Best-effort reconstruction of the AppDef from the backend."""
+        scheduler, _, app_id = parse_app_handle(app_handle)
+        with log_event("describe", scheduler, app_id, session=self._name):
+            desc = self._scheduler(scheduler).describe(app_id)
+            if desc is None:
+                return None
+            return AppDef(name=app_id, roles=desc.roles)
+
+    def list(self, scheduler: str) -> list[ListAppResponse]:
+        with log_event("list", scheduler, session=self._name):
+            return self._scheduler(scheduler).list()
+
+    def log_lines(
+        self,
+        app_handle: AppHandle,
+        role_name: str,
+        k: int = 0,
+        regex: Optional[str] = None,
+        since: Optional[datetime] = None,
+        until: Optional[datetime] = None,
+        should_tail: bool = False,
+        streams: Optional[Stream] = None,
+    ) -> Iterable[str]:
+        scheduler, _, app_id = parse_app_handle(app_handle)
+        with log_event("log_lines", scheduler, app_id, session=self._name):
+            return self._scheduler(scheduler).log_iter(
+                app_id,
+                role_name,
+                k,
+                regex,
+                since.timestamp() if since else None,
+                until.timestamp() if until else None,
+                should_tail,
+                streams,
+            )
+
+    # -- scheduler access --------------------------------------------------
+
+    def scheduler_backends(self) -> list[str]:
+        return list(self._scheduler_factories)
+
+    def scheduler_run_opts(self, scheduler: str) -> runopts:
+        return self._scheduler(scheduler).run_opts()
+
+    def run_opts(self) -> dict[str, runopts]:
+        return {name: self._scheduler(name).run_opts() for name in self._scheduler_factories}
+
+    def _scheduler(self, scheduler: str) -> Scheduler:
+        sched = self._scheduler_instances.get(scheduler)
+        if sched is None:
+            factory = self._scheduler_factories.get(scheduler)
+            if factory is None:
+                raise KeyError(
+                    f"scheduler {scheduler!r} not registered;"
+                    f" available: {list(self._scheduler_factories)}"
+                )
+            params = dict(self._scheduler_params)
+            sched = factory(session_name=self._name, **params)
+            self._scheduler_instances[scheduler] = sched
+        return sched
+
+    # -- tracker env injection (reference runner/api.py:358-391) -----------
+
+    def _inject_tracker_env(self, app: AppDef, parent_run_id: Optional[str]) -> None:
+        from torchx_tpu.tracker.api import tracker_config_env_vars
+
+        env = tracker_config_env_vars(parent_run_id)
+        if not env:
+            return
+        for role in app.roles:
+            for k, v in env.items():
+                role.env.setdefault(k, v)
+
+
+def get_runner(
+    name: Optional[str] = None,
+    component_defaults: Optional[Mapping[str, Mapping[str, str]]] = None,
+    **scheduler_params: Any,
+) -> Runner:
+    """Create a Runner with all registered scheduler factories.
+
+    Scheduler params are also harvested from ``TPX_PARAMS_*`` env vars
+    (reference analog: TORCHX_* harvesting, runner/api.py:128-134).
+    """
+    if not name:
+        name = f"tpx_{get_session_id_or_create_new()[:8]}"
+    for key, value in os.environ.items():
+        if key.startswith(settings.ENV_TPX_PARAMS_PREFIX):
+            param = key[len(settings.ENV_TPX_PARAMS_PREFIX) :].lower()
+            scheduler_params.setdefault(param, value)
+    return Runner(
+        name,
+        get_scheduler_factories(),
+        component_defaults=component_defaults,
+        scheduler_params=scheduler_params,
+    )

@@ -1,0 +1,96 @@
+"""Telemetry: every Runner API call is wrapped in :func:`log_event`.
+
+Reference analog: torchx/runner/events/__init__.py:79-175. Events go to a
+non-propagating logger named ``torchx_tpu.events`` whose destination is
+pluggable via $TPX_EVENT_DESTINATION (default: "null" — drop; "console" —
+stderr; "log" — normal logging). Organizations point this at their
+telemetry pipeline with a logging handler.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+import traceback
+from types import TracebackType
+from typing import Optional, Type
+
+from torchx_tpu.runner.events.api import TpxEvent
+
+_events_logger: Optional[logging.Logger] = None
+
+
+def _get_destination_handler(dest: str) -> logging.Handler:
+    if dest == "console":
+        return logging.StreamHandler(sys.stderr)
+    if dest == "log":
+        return logging.StreamHandler(sys.stderr)
+    return logging.NullHandler()
+
+
+def get_events_logger(destination: Optional[str] = None) -> logging.Logger:
+    global _events_logger
+    if _events_logger is None:
+        dest = destination or os.environ.get("TPX_EVENT_DESTINATION", "null")
+        logger = logging.getLogger("torchx_tpu.events")
+        logger.setLevel(logging.INFO)
+        logger.propagate = False  # never leak telemetry into app logs
+        logger.addHandler(_get_destination_handler(dest))
+        _events_logger = logger
+    return _events_logger
+
+
+def record(event: TpxEvent) -> None:
+    get_events_logger().info(event.serialize())
+
+
+class log_event:
+    """Context manager measuring cpu/wall time and capturing exceptions for
+    one Runner API call."""
+
+    def __init__(
+        self,
+        api: str,
+        scheduler: str = "",
+        app_id: Optional[str] = None,
+        app_image: Optional[str] = None,
+        runcfg: Optional[str] = None,
+        session: str = "",
+    ) -> None:
+        self._event = TpxEvent(
+            session=session,
+            scheduler=scheduler,
+            api=api,
+            app_id=app_id,
+            app_image=app_image,
+            runcfg=runcfg,
+        )
+
+    def __enter__(self) -> "log_event":
+        self._start_cpu = time.process_time_ns()
+        self._start_wall = time.perf_counter_ns()
+        self._event.start_epoch_time_usec = int(time.time() * 1e6)
+        return self
+
+    def __exit__(
+        self,
+        exc_type: Optional[Type[BaseException]],
+        exc: Optional[BaseException],
+        tb: Optional[TracebackType],
+    ) -> bool:
+        self._event.cpu_time_usec = (time.process_time_ns() - self._start_cpu) // 1000
+        self._event.wall_time_usec = (time.perf_counter_ns() - self._start_wall) // 1000
+        if exc is not None:
+            self._event.raw_exception = "".join(
+                traceback.format_exception(exc_type, exc, tb)
+            )
+            self._event.exception_type = exc_type.__name__ if exc_type else None
+            if tb is not None:
+                frame = traceback.extract_tb(tb)[-1]
+                self._event.exception_source_location = (
+                    f"{frame.filename}:{frame.lineno}:{frame.name}"
+                )
+        record(self._event)
+        return False

@@ -1,0 +1,37 @@
+"""Telemetry event model (reference analog: torchx/runner/events/api.py:24-58)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+from typing import Optional
+
+
+@dataclass
+class TpxEvent:
+    """One client-API telemetry record."""
+
+    session: str
+    scheduler: str
+    api: str
+    app_id: Optional[str] = None
+    app_image: Optional[str] = None
+    app_metadata: Optional[dict] = None
+    runcfg: Optional[str] = None
+    source: str = "UNKNOWN"
+    cpu_time_usec: Optional[int] = None
+    wall_time_usec: Optional[int] = None
+    start_epoch_time_usec: Optional[int] = None
+    raw_exception: Optional[str] = None
+    exception_type: Optional[str] = None
+    exception_source_location: Optional[str] = None
+
+    def __str__(self) -> str:
+        return self.serialize()
+
+    def serialize(self) -> str:
+        return json.dumps(asdict(self))
+
+    @staticmethod
+    def deserialize(data: str) -> "TpxEvent":
+        return TpxEvent(**json.loads(data))

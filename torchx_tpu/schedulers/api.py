@@ -1,0 +1,236 @@
+"""Scheduler interface: the lifecycle contract every backend implements.
+
+Reference analog: torchx/schedulers/api.py:364-526. The load-bearing design
+decision (kept): ``submit = resolve cfg -> build workspace -> submit_dryrun
+-> schedule`` where ``submit_dryrun`` returns the *complete materialized
+backend request* without submitting — tests assert on that request object
+with no cluster (reference api.py:410-426).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any, Generic, Iterable, Mapping, Optional, TypeVar
+
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppDryRunInfo,
+    AppState,
+    AppStatus,
+    CfgVal,
+    NULL_RESOURCE,
+    ReplicaStatus,
+    Role,
+    RoleStatus,
+    runopts,
+)
+
+T = TypeVar("T")
+
+
+class Stream(str, Enum):
+    STDOUT = "stdout"
+    STDERR = "stderr"
+    COMBINED = "combined"
+
+
+@dataclass
+class DescribeAppResponse:
+    """Scheduler's view of a submitted app (reference api.py:330-345)."""
+
+    app_id: str = "<NOT_SET>"
+    state: AppState = AppState.UNSUBMITTED
+    num_restarts: int = -1
+    msg: str = ""
+    structured_error_msg: str = "<NONE>"
+    ui_url: Optional[str] = None
+    roles_statuses: list[RoleStatus] = None  # type: ignore[assignment]
+    roles: list[Role] = None  # type: ignore[assignment]
+
+    def __post_init__(self) -> None:
+        if self.roles_statuses is None:
+            self.roles_statuses = []
+        if self.roles is None:
+            self.roles = []
+
+
+@dataclass
+class ListAppResponse:
+    app_id: str
+    state: AppState
+    name: str = ""
+
+
+def filter_regex(regex: str, data: Iterable[str]) -> Iterable[str]:
+    """Lazily filter log lines by a regex (reference api.py:528-539)."""
+    import re
+
+    r = re.compile(regex)
+    return (line for line in data if r.search(line))
+
+
+def split_lines(text: str) -> list[str]:
+    """Split keeping trailing newlines on each line (reference api.py:541-554)."""
+    lines = text.splitlines(keepends=True)
+    return lines
+
+
+class Scheduler(ABC, Generic[T]):
+    """Backend lifecycle contract.
+
+    Subclasses implement ``_submit_dryrun`` (materialize the full request),
+    ``schedule`` (actually submit), ``describe``, ``list``, and
+    ``_cancel_existing``; optionally ``log_iter``, ``delete``, ``_validate``.
+    """
+
+    def __init__(self, backend: str, session_name: str) -> None:
+        self.backend = backend
+        self.session_name = session_name
+
+    # -- submission path ---------------------------------------------------
+
+    def submit(self, app: AppDef, cfg: Mapping[str, CfgVal]) -> str:
+        """Convenience: resolve + workspace + dryrun + schedule."""
+        resolved = self.run_opts().resolve(cfg)
+        from torchx_tpu.workspace.api import WorkspaceMixin
+
+        if isinstance(self, WorkspaceMixin):
+            self.build_workspaces(app.roles, resolved)
+        return self.schedule(self.materialize_dryrun(app, resolved))
+
+    def submit_dryrun(self, app: AppDef, cfg: Mapping[str, CfgVal]) -> AppDryRunInfo[T]:
+        """Materialize the complete backend request WITHOUT submitting."""
+        return self.materialize_dryrun(app, self.run_opts().resolve(cfg))
+
+    def materialize_dryrun(
+        self, app: AppDef, resolved_cfg: Mapping[str, CfgVal]
+    ) -> AppDryRunInfo[T]:
+        """Like submit_dryrun but for callers (Runner) that already resolved
+        the cfg — the single materialization point; cfg is resolved exactly
+        once per submission path."""
+        dryrun_info = self._submit_dryrun(app, resolved_cfg)
+        for role in app.roles:
+            dryrun_info = role.pre_proc_fn(self.backend, dryrun_info)
+        dryrun_info._app = app
+        dryrun_info._cfg = resolved_cfg
+        dryrun_info._scheduler = self.backend
+        return dryrun_info
+
+    @abstractmethod
+    def _submit_dryrun(self, app: AppDef, cfg: Mapping[str, CfgVal]) -> AppDryRunInfo[T]:
+        ...
+
+    @abstractmethod
+    def schedule(self, dryrun_info: AppDryRunInfo[T]) -> str:
+        """Submit the materialized request; returns the backend app_id."""
+        ...
+
+    # -- monitoring path ---------------------------------------------------
+
+    @abstractmethod
+    def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
+        ...
+
+    def list(self) -> list[ListAppResponse]:
+        raise NotImplementedError(
+            f"{self.backend} scheduler does not support listing apps"
+        )
+
+    def exists(self, app_id: str) -> bool:
+        return self.describe(app_id) is not None
+
+    def cancel(self, app_id: str) -> None:
+        if self.exists(app_id):
+            self._cancel_existing(app_id)
+
+    @abstractmethod
+    def _cancel_existing(self, app_id: str) -> None:
+        ...
+
+    def delete(self, app_id: str) -> None:
+        """Remove all backend records of a (terminal) app. Optional."""
+        raise NotImplementedError(
+            f"{self.backend} scheduler does not support app deletion"
+        )
+
+    def log_iter(
+        self,
+        app_id: str,
+        role_name: str,
+        k: int = 0,
+        regex: Optional[str] = None,
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+        should_tail: bool = False,
+        streams: Optional[Stream] = None,
+    ) -> Iterable[str]:
+        raise NotImplementedError(
+            f"{self.backend} scheduler does not support log iteration"
+        )
+
+    # -- config / validation ----------------------------------------------
+
+    def run_opts(self) -> runopts:
+        return runopts()
+
+    def _pre_build_validate(self, app: AppDef, cfg: Mapping[str, CfgVal]) -> None:
+        """Hook before workspace build (cheap checks)."""
+
+    def _validate(self, app: AppDef, cfg: Mapping[str, CfgVal]) -> None:
+        """Hook after workspace build, before dryrun."""
+
+    def close(self) -> None:
+        """Release client connections / child processes. Idempotent."""
+
+
+# =========================================================================
+# Gang expansion: roles with multi-host TPU slices -> per-host replicas
+# =========================================================================
+
+
+def tpu_hosts_for_role(role: Role) -> int:
+    """Number of host processes a role's gang needs.
+
+    For TPU roles the gang size is derived from the slice (one JAX process
+    per TPU-VM host); ``num_replicas`` then means *number of slices* when >1
+    (multi-slice DCN training). CPU roles just use num_replicas.
+    """
+    if role.resource is not None and role.resource.tpu is not None:
+        return role.resource.tpu.hosts * max(1, role.num_replicas)
+    return role.num_replicas
+
+
+def role_replica_env(
+    role: Role,
+    replica_id: int,
+    coordinator_host: str,
+    coordinator_port: int,
+) -> dict[str, str]:
+    """Env vars every scheduler injects into each replica: gang identity +
+    coordinator bootstrap for ``jax.distributed.initialize`` (the analog of
+    the reference's c10d endpoint wiring, components/dist.py:234-243)."""
+    from torchx_tpu import settings
+
+    num = tpu_hosts_for_role(role)
+    env = {
+        settings.ENV_TPX_REPLICA_ID: str(replica_id),
+        settings.ENV_TPX_ROLE_NAME: role.name,
+        settings.ENV_TPX_NUM_REPLICAS: str(num),
+        settings.ENV_TPX_COORDINATOR_HOST: coordinator_host,
+    }
+    if role.resource is not None and role.resource.tpu is not None:
+        tpu = role.resource.tpu
+        env["TPX_TPU_ACCELERATOR_TYPE"] = tpu.accelerator_type
+        env["TPX_TPU_TOPOLOGY"] = tpu.default_topology()
+        if role.num_replicas > 1:  # multi-slice: DCN identity
+            from torchx_tpu import settings as s
+
+            slice_id = replica_id // tpu.hosts
+            env[s.ENV_MEGASCALE_NUM_SLICES] = str(role.num_replicas)
+            env[s.ENV_MEGASCALE_SLICE_ID] = str(slice_id)
+            env[s.ENV_MEGASCALE_COORDINATOR_ADDRESS] = (
+                f"{coordinator_host}:{coordinator_port + 1}"
+            )
+    return env

@@ -1,0 +1,24 @@
+"""Unique app-id generation (reference analog: torchx/schedulers/ids.py)."""
+
+from __future__ import annotations
+
+import random
+import re
+import string
+
+_ALPHABET = string.ascii_lowercase + string.digits  # base-36
+
+
+def random_id(length: int = 13) -> str:
+    return "".join(random.choices(_ALPHABET, k=length))
+
+
+def make_unique(name: str) -> str:
+    """``trainer`` -> ``trainer-d8se6kyiptu2a`` (collision-safe suffix)."""
+    return f"{cleanup(name)}-{random_id()}"
+
+
+def cleanup(name: str) -> str:
+    """Normalize to DNS-1123-ish: lowercase alphanumerics and dashes."""
+    name = re.sub(r"[^a-z0-9\-]", "-", name.lower())
+    return re.sub(r"-+", "-", name).strip("-") or "app"

@@ -1,0 +1,675 @@
+"""Local scheduler: runs each replica as a host subprocess.
+
+Reference analog: torchx/schedulers/local_scheduler.py (1211 LoC). Kept
+behaviors: ImageProvider abstraction, per-replica log dirs with
+stdout/stderr/combined Tee, macro substitution, coordinator env injection,
+error-file injection, LRU app cache, SIGTERM->SIGKILL kill ladder, orphan
+cleanup on client signals, tail-follow log iteration.
+
+TPU-first departures:
+
+* instead of ``auto_set_CUDA_VISIBLE_DEVICES`` (reference :855-945), replicas
+  sharing one TPU host get ``TPU_VISIBLE_CHIPS`` partitioning; and when the
+  role wants TPU but the host has none, ``tpu_simulate=True`` (default) runs
+  the replica on CPU JAX with ``xla_force_host_platform_device_count`` equal
+  to the requested per-host chip count — so SPMD apps run anywhere.
+* the injected rendezvous env is ``TPX_COORDINATOR_HOST=localhost`` plus the
+  gang identity vars consumed by ``torchx_tpu.distributed.init_from_env``
+  (the analog of TORCHX_RANK0_HOST at reference :990-993).
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import signal
+import subprocess
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, IO, Iterable, Mapping, Optional, TextIO
+
+from torchx_tpu import settings
+from torchx_tpu.schedulers.api import (
+    DescribeAppResponse,
+    ListAppResponse,
+    Scheduler,
+    Stream,
+    filter_regex,
+    role_replica_env,
+    tpu_hosts_for_role,
+)
+from torchx_tpu.schedulers.ids import make_unique
+from torchx_tpu.schedulers.streams import Tee
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppDryRunInfo,
+    AppState,
+    CfgVal,
+    NONE,
+    ReplicaStatus,
+    RoleStatus,
+    is_terminal,
+    macros,
+    runopts,
+)
+
+logger = logging.getLogger(__name__)
+
+KILL_GRACE_SECONDS = 10
+APP_CACHE_SIZE = 100
+
+
+# =========================================================================
+# Image providers
+# =========================================================================
+
+
+class ImageProvider:
+    """Resolves a Role.image to a local directory (reference :110-279)."""
+
+    def fetch(self, image: str) -> str:
+        """Returns the root dir for the image; '' means no chroot."""
+        raise NotImplementedError
+
+    def get_entrypoint(self, img_root: str, role_args_entrypoint: str) -> str:
+        entrypoint = role_args_entrypoint
+        if img_root and not os.path.isabs(entrypoint):
+            candidate = os.path.join(img_root, entrypoint)
+            if os.path.exists(candidate):
+                return candidate
+        return entrypoint
+
+
+class LocalDirectoryImageProvider(ImageProvider):
+    """image is an existing local directory path."""
+
+    def fetch(self, image: str) -> str:
+        if not os.path.isdir(image):
+            raise ValueError(
+                f"image {image!r} must be an existing local directory"
+                " for the local scheduler"
+            )
+        return image
+
+
+class CWDImageProvider(ImageProvider):
+    """ignore image entirely; run from the current working directory."""
+
+    def fetch(self, image: str) -> str:
+        return os.getcwd()
+
+
+# =========================================================================
+# TPU host inventory / partitioning
+# =========================================================================
+
+
+def local_tpu_chip_count() -> int:
+    """Count TPU chips attached to this host (accel device nodes)."""
+    return len(glob.glob("/dev/accel*")) or len(glob.glob("/dev/vfio/[0-9]*"))
+
+
+def tpu_device_env(
+    role_tpu_chips_per_host: int,
+    replica_id: int,
+    replicas_on_host: int,
+    host_chips: int,
+    simulate: bool,
+    partition: bool = True,
+) -> dict[str, str]:
+    """Env partitioning a host's chips among colocated replicas, or CPU
+    simulation when the host has no TPUs (analog of the reference's
+    CUDA_VISIBLE_DEVICES partitioning, local_scheduler.py:855-945).
+
+    Raises at dryrun time when the gang is over-subscribed (more replicas
+    than chips) — better than a wedged collective at runtime.
+    """
+    if host_chips <= 0:
+        if not simulate:
+            return {}
+        return {
+            settings.ENV_JAX_PLATFORMS: "cpu",
+            settings.ENV_XLA_FLAGS: (
+                f"--xla_force_host_platform_device_count={role_tpu_chips_per_host}"
+            ),
+        }
+    if not partition or replicas_on_host <= 1:
+        return {}  # replica sees all host chips
+    if replicas_on_host > host_chips:
+        raise ValueError(
+            f"{replicas_on_host} replicas cannot share {host_chips} TPU chips"
+            " on this host (at least one chip per replica required);"
+            " reduce replicas or disable auto_set_tpu_chips"
+        )
+    per = host_chips // replicas_on_host
+    start = (replica_id % replicas_on_host) * per
+    chips = ",".join(str(c) for c in range(start, start + per))
+    return {settings.ENV_TPU_VISIBLE_CHIPS: chips, settings.ENV_TPU_SKIP_MDS_QUERY: "true"}
+
+
+# =========================================================================
+# Materialized request
+# =========================================================================
+
+
+@dataclass
+class ReplicaParam:
+    """Everything needed to Popen one replica (pre-substituted)."""
+
+    args: list[str]
+    env: dict[str, str]
+    stdout: str
+    stderr: str
+    combined: str
+    cwd: Optional[str] = None
+
+
+@dataclass
+class PopenRequest:
+    app_id: str
+    log_dir: str
+    role_params: dict[str, list[ReplicaParam]] = field(default_factory=dict)
+
+
+# =========================================================================
+# Live process bookkeeping
+# =========================================================================
+
+
+class _LocalReplica:
+    def __init__(
+        self,
+        role_name: str,
+        replica_id: int,
+        proc: subprocess.Popen,
+        stdout: Optional[IO],
+        stderr: Optional[IO],
+        tee: Optional[Tee],
+        error_file: str,
+    ) -> None:
+        self.role_name = role_name
+        self.replica_id = replica_id
+        self.proc = proc
+        self.stdout = stdout
+        self.stderr = stderr
+        self.tee = tee
+        self.error_file = error_file
+
+    def terminate(self) -> None:
+        """SIGTERM the whole process group, wait, then SIGKILL survivors."""
+        try:
+            pgid = os.getpgid(self.proc.pid)
+            os.killpg(pgid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        try:
+            self.proc.wait(timeout=KILL_GRACE_SECONDS)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            self.proc.wait()
+        self._close_files()
+
+    def _close_files(self) -> None:
+        if self.tee:
+            self.tee.close()
+            self.tee = None
+        for f in (self.stdout, self.stderr):
+            if f:
+                f.close()
+        self.stdout = self.stderr = None
+
+    def is_alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def failed(self) -> bool:
+        rc = self.proc.returncode
+        return rc is not None and rc != 0
+
+
+class _LocalApp:
+    def __init__(self, app_id: str, log_dir: str) -> None:
+        self.app_id = app_id
+        self.log_dir = log_dir
+        self.roles: dict[str, list[_LocalReplica]] = {}
+        self.state = AppState.PENDING
+        self.last_updated = time.time()
+
+    def add_replica(self, role_name: str, replica: _LocalReplica) -> None:
+        self.roles.setdefault(role_name, []).append(replica)
+
+    def replicas(self) -> Iterable[_LocalReplica]:
+        for rs in self.roles.values():
+            yield from rs
+
+    def set_state(self, state: AppState) -> None:
+        self.state = state
+        self.last_updated = time.time()
+
+    def kill(self) -> None:
+        for r in self.replicas():
+            r.terminate()
+        if not is_terminal(self.state):
+            self.set_state(AppState.CANCELLED)
+
+    def first_error_file(self) -> str:
+        """Earliest-written error file among failed replicas (reference
+        _LocalAppDef._get_error_file, :422-433)."""
+        candidates = [
+            r.error_file
+            for r in self.replicas()
+            if r.failed() and os.path.exists(r.error_file)
+        ]
+        if not candidates:
+            return ""
+        return min(candidates, key=lambda p: os.path.getmtime(p))
+
+
+# =========================================================================
+# Scheduler
+# =========================================================================
+
+
+class LocalScheduler(Scheduler[PopenRequest]):
+    """Executes AppDef roles as local subprocesses."""
+
+    def __init__(
+        self,
+        session_name: str,
+        image_provider: Optional[ImageProvider] = None,
+        cache_size: int = APP_CACHE_SIZE,
+        extra_paths: Optional[list[str]] = None,
+    ) -> None:
+        super().__init__("local", session_name)
+        self._image_provider = image_provider or CWDImageProvider()
+        self._apps: dict[str, _LocalApp] = {}
+        self._cache_size = cache_size
+        self._extra_paths = extra_paths or []
+        self._installed_signal_cleanup = False
+
+    # -- runopts ----------------------------------------------------------
+
+    def run_opts(self) -> runopts:
+        opts = runopts()
+        opts.add(
+            "log_dir",
+            type_=str,
+            default=None,
+            help="root dir for per-replica logs (default: a tmp dir)",
+        )
+        opts.add(
+            "prepend_cwd",
+            type_=bool,
+            default=False,
+            help="prepend CWD to PATH when resolving entrypoints",
+        )
+        opts.add(
+            "auto_set_tpu_chips",
+            type_=bool,
+            default=True,
+            help="partition the host's TPU chips among colocated replicas"
+            " via TPU_VISIBLE_CHIPS",
+        )
+        opts.add(
+            "tpu_simulate",
+            type_=bool,
+            default=True,
+            help="when a role requests TPU but this host has no chips, run"
+            " on CPU JAX with xla_force_host_platform_device_count set to"
+            " the per-host chip count",
+        )
+        return opts
+
+    # -- dryrun -----------------------------------------------------------
+
+    def _submit_dryrun(
+        self, app: AppDef, cfg: Mapping[str, CfgVal]
+    ) -> AppDryRunInfo[PopenRequest]:
+        app_id = make_unique(app.name)
+        base_log_dir = cfg.get("log_dir") or os.path.join(
+            tempfile.gettempdir(), "torchx_tpu", self.session_name
+        )
+        log_dir = os.path.join(str(base_log_dir), app_id)
+        request = PopenRequest(app_id=app_id, log_dir=log_dir)
+        host_chips = local_tpu_chip_count()
+
+        for role in app.roles:
+            img_root = self._image_provider.fetch(role.image)
+            replicas: list[ReplicaParam] = []
+            num_replicas = tpu_hosts_for_role(role)
+            for replica_id in range(num_replicas):
+                values = macros.Values(
+                    img_root=img_root,
+                    app_id=app_id,
+                    replica_id=str(replica_id),
+                    num_replicas=str(num_replicas),
+                    coordinator_env=settings.ENV_TPX_COORDINATOR_HOST,
+                )
+                rrole = values.apply(role)
+                replica_log_dir = os.path.join(log_dir, role.name, str(replica_id))
+
+                env = dict(os.environ)
+                env.update(rrole.env)
+                env["PYTHONUNBUFFERED"] = "1"
+                env[settings.ENV_TPX_APP_ID] = app_id
+                env[settings.ENV_TPX_JOB_ID] = f"{self.backend}://{self.session_name}/{app_id}"
+                env[settings.ENV_TPX_LOG_DIR] = replica_log_dir
+                error_file = os.path.join(replica_log_dir, "error.json")
+                env[settings.ENV_TPX_ERROR_FILE] = error_file
+                env.update(
+                    role_replica_env(
+                        role,
+                        replica_id,
+                        coordinator_host="localhost",
+                        coordinator_port=settings.TPX_COORDINATOR_PORT,
+                    )
+                )
+                if role.resource is not None and role.resource.tpu is not None:
+                    env.update(
+                        tpu_device_env(
+                            role.resource.tpu.chips_per_host,
+                            replica_id,
+                            replicas_on_host=num_replicas,
+                            host_chips=host_chips,
+                            simulate=bool(cfg.get("tpu_simulate", True)),
+                            partition=bool(cfg.get("auto_set_tpu_chips", True)),
+                        )
+                    )
+                paths = [p for p in self._extra_paths]
+                if cfg.get("prepend_cwd"):
+                    paths.insert(0, os.getcwd())
+                if img_root:
+                    paths.append(img_root)
+                if paths:
+                    env["PATH"] = os.pathsep.join(paths + [env.get("PATH", "")])
+
+                entrypoint = self._image_provider.get_entrypoint(
+                    img_root, rrole.entrypoint
+                )
+                replicas.append(
+                    ReplicaParam(
+                        args=[entrypoint, *rrole.args],
+                        env=env,
+                        stdout=os.path.join(replica_log_dir, "stdout.log"),
+                        stderr=os.path.join(replica_log_dir, "stderr.log"),
+                        combined=os.path.join(replica_log_dir, "combined.log"),
+                        cwd=img_root or None,
+                    )
+                )
+            request.role_params[role.name] = replicas
+
+        return AppDryRunInfo(request, fmt=_pretty_request)
+
+    # -- schedule ---------------------------------------------------------
+
+    def schedule(self, dryrun_info: AppDryRunInfo[PopenRequest]) -> str:
+        request = dryrun_info.request
+        self._evict_lru()
+        self._install_signal_cleanup()
+        app = _LocalApp(request.app_id, request.log_dir)
+        try:
+            for role_name, replicas in request.role_params.items():
+                for replica_id, rp in enumerate(replicas):
+                    app.add_replica(role_name, self._popen(role_name, replica_id, rp))
+        except Exception:
+            app.kill()
+            raise
+        app.set_state(AppState.RUNNING)
+        self._apps[request.app_id] = app
+        return request.app_id
+
+    def _popen(self, role_name: str, replica_id: int, rp: ReplicaParam) -> _LocalReplica:
+        os.makedirs(os.path.dirname(rp.stdout), exist_ok=True)
+        stdout = open(rp.stdout, "wb")
+        stderr = open(rp.stderr, "wb")
+        tee = Tee(Path(rp.combined), Path(rp.stdout), Path(rp.stderr))
+        proc = subprocess.Popen(
+            rp.args,
+            env=rp.env,
+            stdout=stdout,
+            stderr=stderr,
+            cwd=rp.cwd,
+            start_new_session=True,  # own process group: clean gang kill
+        )
+        logger.debug(
+            "started %s/%s pid=%d: %s", role_name, replica_id, proc.pid, rp.args
+        )
+        return _LocalReplica(
+            role_name,
+            replica_id,
+            proc,
+            stdout,
+            stderr,
+            tee,
+            error_file=rp.env.get(settings.ENV_TPX_ERROR_FILE, ""),
+        )
+
+    def _evict_lru(self) -> None:
+        while len(self._apps) >= self._cache_size:
+            terminal = [
+                (a.last_updated, app_id)
+                for app_id, a in self._apps.items()
+                if is_terminal(a.state)
+            ]
+            if not terminal:
+                raise RuntimeError(
+                    f"app cache full ({self._cache_size}) with no terminal"
+                    " apps to evict; wait for or cancel running apps"
+                )
+            _, oldest = min(terminal)
+            self._apps.pop(oldest)
+
+    def _install_signal_cleanup(self) -> None:
+        """Kill all child gangs if the client process dies (reference
+        :541-549). Only from the main thread; no-op otherwise."""
+        if self._installed_signal_cleanup:
+            return
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+            prev = signal.getsignal(sig)
+
+            def handler(signum, frame, prev=prev):  # noqa: ANN001
+                self.close()
+                if callable(prev):
+                    prev(signum, frame)
+                else:
+                    signal.signal(signum, signal.SIG_DFL)
+                    signal.raise_signal(signum)
+
+            try:
+                signal.signal(sig, handler)
+            except ValueError:
+                return  # not main thread after all
+        self._installed_signal_cleanup = True
+
+    # -- monitoring -------------------------------------------------------
+
+    def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
+        app = self._apps.get(app_id)
+        if app is None:
+            return None
+        self._update_app_state(app)
+        roles_statuses = []
+        for role_name, replicas in app.roles.items():
+            rs = RoleStatus(role=role_name)
+            for r in replicas:
+                rc = r.proc.poll()
+                if rc is None:
+                    state = AppState.RUNNING
+                elif rc == 0:
+                    state = AppState.SUCCEEDED
+                else:
+                    state = (
+                        AppState.CANCELLED
+                        if app.state == AppState.CANCELLED
+                        else AppState.FAILED
+                    )
+                rs.replicas.append(
+                    ReplicaStatus(
+                        id=r.replica_id,
+                        state=state,
+                        role=role_name,
+                        hostname="localhost",
+                    )
+                )
+            roles_statuses.append(rs)
+
+        structured_error_msg = NONE
+        err_file = app.first_error_file()
+        if app.state == AppState.FAILED and err_file:
+            try:
+                structured_error_msg = Path(err_file).read_text()
+            except OSError:
+                pass
+
+        return DescribeAppResponse(
+            app_id=app_id,
+            state=app.state,
+            num_restarts=0,
+            structured_error_msg=structured_error_msg,
+            ui_url=f"file://{app.log_dir}",
+            roles_statuses=roles_statuses,
+        )
+
+    def _update_app_state(self, app: _LocalApp) -> None:
+        if is_terminal(app.state):
+            return
+        any_alive = False
+        any_failed = False
+        for r in app.replicas():
+            rc = r.proc.poll()
+            if rc is None:
+                any_alive = True
+            else:
+                r._close_files()
+                if rc != 0:
+                    any_failed = True
+        if any_failed:
+            # fail fast: kill the rest of the gang (SPMD semantics — a dead
+            # host wedges the collective anyway)
+            for r in app.replicas():
+                if r.is_alive():
+                    r.terminate()
+            app.set_state(AppState.FAILED)
+        elif not any_alive:
+            app.set_state(AppState.SUCCEEDED)
+            Path(app.log_dir, "SUCCESS").touch()
+
+    def list(self) -> list[ListAppResponse]:
+        out = []
+        for app_id, app in self._apps.items():
+            self._update_app_state(app)
+            out.append(ListAppResponse(app_id=app_id, state=app.state, name=app_id))
+        return out
+
+    def _cancel_existing(self, app_id: str) -> None:
+        app = self._apps[app_id]
+        app.kill()
+
+    def log_iter(
+        self,
+        app_id: str,
+        role_name: str,
+        k: int = 0,
+        regex: Optional[str] = None,
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+        should_tail: bool = False,
+        streams: Optional[Stream] = None,
+    ) -> Iterable[str]:
+        app = self._apps.get(app_id)
+        if app is None:
+            raise ValueError(f"unknown app: {app_id}")
+        stream = streams or Stream.COMBINED
+        fname = {
+            Stream.STDOUT: "stdout.log",
+            Stream.STDERR: "stderr.log",
+            Stream.COMBINED: "combined.log",
+        }[stream]
+        log_file = os.path.join(app.log_dir, role_name, str(k), fname)
+        it: Iterable[str] = LogIterator(self, app_id, log_file, should_tail)
+        if regex:
+            it = filter_regex(regex, it)
+        return it
+
+    def close(self) -> None:
+        for app in self._apps.values():
+            if not is_terminal(app.state):
+                app.kill()
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class LogIterator:
+    """File-follow log iterator with app-finished detection (reference
+    LogIterator, local_scheduler.py:1130-1196)."""
+
+    def __init__(
+        self,
+        scheduler: LocalScheduler,
+        app_id: str,
+        log_file: str,
+        should_tail: bool,
+        poll_interval: float = 0.1,
+    ) -> None:
+        self._scheduler = scheduler
+        self._app_id = app_id
+        self._log_file = log_file
+        self._should_tail = should_tail
+        self._poll = poll_interval
+        self._fp: Optional[TextIO] = None
+        self._app_finished = False
+
+    def _check_finished(self) -> None:
+        resp = self._scheduler.describe(self._app_id)
+        self._app_finished = resp is None or is_terminal(resp.state)
+
+    def __iter__(self):
+        # wait for the file to exist (app may still be starting)
+        while not os.path.isfile(self._log_file):
+            self._check_finished()
+            if self._app_finished and not os.path.isfile(self._log_file):
+                return
+            time.sleep(self._poll)
+        with open(self._log_file, errors="replace") as fp:
+            while True:
+                line = fp.readline()
+                if line:
+                    if line.endswith("\n"):
+                        yield line[:-1]
+                    else:
+                        yield line
+                    continue
+                if self._app_finished or not self._should_tail:
+                    # one final drain already happened (readline returned '')
+                    return
+                self._check_finished()
+                time.sleep(self._poll)
+
+
+def _pretty_request(req: PopenRequest) -> str:
+    lines = [f"app_id: {req.app_id}", f"log_dir: {req.log_dir}", "roles:"]
+    for role, replicas in req.role_params.items():
+        lines.append(f"  {role}:")
+        for i, rp in enumerate(replicas):
+            lines.append(f"    [{i}] cmd: {' '.join(rp.args)}")
+    return "\n".join(lines)
+
+
+def create_scheduler(session_name: str, **kwargs: Any) -> LocalScheduler:
+    known = {"image_provider", "cache_size", "extra_paths"}
+    return LocalScheduler(
+        session_name=session_name,
+        **{k: v for k, v in kwargs.items() if k in known},
+    )

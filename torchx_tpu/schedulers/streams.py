@@ -1,0 +1,45 @@
+"""Tee — fan-in stdout+stderr files into one combined log.
+
+Reference analog: torchx/schedulers/streams.py:16-71. A background thread
+tails the two source files and appends interleaved lines to the combined
+file until closed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+from typing import IO
+
+
+class Tee:
+    def __init__(self, combined: Path, stdout: Path, stderr: Path) -> None:
+        self._combined: IO[bytes] = open(combined, "ab")
+        self._sources = [open(stdout, "rb"), open(stderr, "rb")]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self) -> None:
+        while True:
+            wrote = False
+            for src in self._sources:
+                line = src.readline()
+                while line:
+                    self._combined.write(line)
+                    wrote = True
+                    line = src.readline()
+            if wrote:
+                self._combined.flush()
+            if self._stop.is_set() and not wrote:
+                break
+            if not wrote:
+                time.sleep(0.05)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        for src in self._sources:
+            src.close()
+        self._combined.close()

@@ -1,0 +1,95 @@
+"""Central registry of every environment variable the framework reads/writes.
+
+Reference analog: torchx/settings.py:1-37 (all ``TORCHX_*`` env constants
+centralized in one module). We use the ``TPX_`` prefix.
+
+Variables fall into three groups:
+
+* client-side knobs read by the Runner / CLI,
+* in-job variables injected by schedulers into every replica,
+* TPU runtime variables owned by the platform (GKE / libtpu) that the
+  launcher must cooperate with rather than own.
+"""
+
+# ---------------------------------------------------------------------------
+# Client side
+# ---------------------------------------------------------------------------
+
+# Points at an explicit config file, overriding the lookup chain
+# (CLI > $TPXCONFIG > $HOME/.tpxconfig > CWD). See runner/config.py.
+ENV_TPXCONFIG = "TPXCONFIG"
+
+# Comma list of extra named-resource modules to load (module[:fn] specs).
+ENV_TPX_CUSTOM_NAMED_RESOURCES = "TPX_CUSTOM_NAMED_RESOURCES"
+
+# Bitmask controlling which plugin sources are consulted (see plugins/).
+ENV_TPX_PLUGINS_SOURCE = "TPX_PLUGINS_SOURCE"
+
+# Propagates the client session id into subprocesses for event correlation.
+ENV_TPX_INTERNAL_SESSION_ID = "TPX_INTERNAL_SESSION_ID"
+
+# Scheduler params harvested by the Runner from the environment, e.g.
+# TPX_PARAMS_LOG_DIR=... (analog of TORCHX_* param harvesting,
+# reference torchx/runner/api.py:128-134).
+ENV_TPX_PARAMS_PREFIX = "TPX_PARAMS_"
+
+# ---------------------------------------------------------------------------
+# In-job (injected by schedulers into every replica)
+# ---------------------------------------------------------------------------
+
+# App handle / id of the surrounding job.
+ENV_TPX_APP_ID = "TPX_APP_ID"
+ENV_TPX_JOB_ID = "TPX_JOB_ID"  # full handle scheme://session/app_id
+
+# Replica identity within the role's gang.
+ENV_TPX_REPLICA_ID = "TPX_REPLICA_ID"
+ENV_TPX_ROLE_NAME = "TPX_ROLE_NAME"
+ENV_TPX_NUM_REPLICAS = "TPX_NUM_REPLICAS"
+
+# Host that replica 0 of role 0 runs on -- the SPMD coordinator. The *name*
+# of the env var holding it is what ``macros.coordinator_env`` substitutes
+# (reference analog: rank0_env, torchx/specs/api.py:216-222).
+ENV_TPX_COORDINATOR_HOST = "TPX_COORDINATOR_HOST"
+
+# Default port for jax.distributed coordinator service (analog of c10d 29500).
+TPX_COORDINATOR_PORT = 8476
+
+# File each replica writes a structured error JSON into on failure
+# (reference analog: TORCHELASTIC_ERROR_FILE, local_scheduler.py:996-1001).
+ENV_TPX_ERROR_FILE = "TPX_ERROR_FILE"
+
+# Per-replica log directory.
+ENV_TPX_LOG_DIR = "TPX_LOG_DIR"
+
+# Experiment tracking (reference analog: TORCHX_TRACKERS family,
+# torchx/tracker/api.py:209-239).
+ENV_TPX_TRACKERS = "TPX_TRACKERS"
+ENV_TPX_TRACKER_PREFIX = "TPX_TRACKER_"  # TPX_TRACKER_<NAME>_CONFIG
+ENV_TPX_PARENT_RUN_ID = "TPX_PARENT_RUN_ID"
+
+# ---------------------------------------------------------------------------
+# TPU platform variables (owned by GKE / libtpu / JAX; the launcher reads or
+# forwards these but does not invent them)
+# ---------------------------------------------------------------------------
+
+# Injected by GKE on TPU node pools; authoritative host list for a slice.
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TPU_SKIP_MDS_QUERY = "TPU_SKIP_MDS_QUERY"
+
+# Host-local chip partitioning (used by the local scheduler to split one
+# host's chips between replicas -- analog of auto_set_CUDA_VISIBLE_DEVICES,
+# reference local_scheduler.py:855-945).
+ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+ENV_TPU_PROCESS_BOUNDS = "TPU_PROCESS_BOUNDS"
+ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
+
+# Simulation: run "TPU" jobs on CPU with N virtual devices.
+ENV_JAX_PLATFORMS = "JAX_PLATFORMS"
+ENV_XLA_FLAGS = "XLA_FLAGS"
+
+# Multi-slice (DCN) wiring -- analog of the EFA device plumbing in the
+# reference (named_resources_aws.py:40, kubernetes_scheduler.py:346-358).
+ENV_MEGASCALE_COORDINATOR_ADDRESS = "MEGASCALE_COORDINATOR_ADDRESS"
+ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"

@@ -1,0 +1,150 @@
+"""Public interface of the specs layer.
+
+Reference analog: torchx/specs/__init__.py:32-239 — re-exports the data
+model and hosts the named-resource registry with merge order
+generic < tpu < custom ($TPX_CUSTOM_NAMED_RESOURCES) < plugins.
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+from typing import Callable, Mapping, Optional
+
+from torchx_tpu import settings
+from torchx_tpu.specs.api import (  # noqa: F401
+    NONE,
+    NULL_RESOURCE,
+    RESOURCE_UNSET,
+    AppDef,
+    AppDryRunInfo,
+    AppHandle,
+    AppState,
+    AppStatus,
+    AppStatusError,
+    BindMount,
+    CfgVal,
+    DeviceMount,
+    InvalidRunConfigException,
+    MalformedAppHandleException,
+    MountType,
+    ReplicaStatus,
+    Resource,
+    RetryPolicy,
+    Role,
+    RoleStatus,
+    TpuSlice,
+    VolumeMount,
+    Workspace,
+    is_started,
+    is_terminal,
+    macros,
+    make_app_handle,
+    make_structured_error,
+    parse_app_handle,
+    parse_mounts,
+    runopt,
+    runopts,
+)
+from torchx_tpu.specs.named_resources_generic import named_resources_generic
+from torchx_tpu.specs.named_resources_tpu import named_resources_tpu, tpu_slice
+
+logger = logging.getLogger(__name__)
+
+_named_resource_factories: Optional[dict[str, Callable[[], Resource]]] = None
+
+
+def _load_custom_factories() -> Mapping[str, Callable[[], Resource]]:
+    """$TPX_CUSTOM_NAMED_RESOURCES is a comma list of ``module[:fn]`` specs;
+    each fn returns a mapping of name -> factory."""
+    out: dict[str, Callable[[], Resource]] = {}
+    spec = os.environ.get(settings.ENV_TPX_CUSTOM_NAMED_RESOURCES, "")
+    for entry in filter(None, (s.strip() for s in spec.split(","))):
+        mod_name, _, fn_name = entry.partition(":")
+        try:
+            mod = importlib.import_module(mod_name)
+            fn = getattr(mod, fn_name or "named_resources")
+            out.update(fn())
+        except Exception as e:  # noqa: BLE001 - custom modules must not kill the CLI
+            logger.warning("failed to load custom named resources %r: %s", entry, e)
+    return out
+
+
+def _factories() -> dict[str, Callable[[], Resource]]:
+    global _named_resource_factories
+    if _named_resource_factories is None:
+        merged: dict[str, Callable[[], Resource]] = {}
+        merged.update(named_resources_generic())
+        merged.update(named_resources_tpu())
+        merged.update(_load_custom_factories())
+        try:  # plugins may not be importable during bootstrap
+            from torchx_tpu.plugins import get_plugin_named_resources
+
+            merged.update(get_plugin_named_resources())
+        except ImportError:
+            pass
+        _named_resource_factories = merged
+    return _named_resource_factories
+
+
+class _NamedResources(Mapping[str, Resource]):
+    """Lazy mapping view: ``named_resources["v5p-32"]`` -> Resource.
+
+    Falls back to parsing unknown keys as accelerator-type strings so any
+    slice size works without being pre-registered.
+    """
+
+    def __getitem__(self, name: str) -> Resource:
+        f = _factories().get(name)
+        if f is not None:
+            return f()
+        try:
+            return tpu_slice(name)
+        except ValueError:
+            raise KeyError(
+                f"unknown named resource {name!r}; known: {sorted(_factories())[:20]}..."
+            ) from None
+
+    def __contains__(self, name: object) -> bool:
+        if name in _factories():
+            return True
+        try:
+            tpu_slice(str(name))
+            return True
+        except ValueError:
+            return False
+
+    def __iter__(self):
+        return iter(_factories())
+
+    def __len__(self) -> int:
+        return len(_factories())
+
+
+named_resources: Mapping[str, Resource] = _NamedResources()
+
+
+def resource(
+    cpu: Optional[float] = None,
+    memMB: Optional[int] = None,
+    tpu: Optional[str] = None,
+    h: Optional[str] = None,
+) -> Resource:
+    """Resource factory used by components.
+
+    ``h`` (named resource, e.g. "v5p-32" or "cpu_small") wins over explicit
+    cpu/memMB/tpu, matching the reference's precedence
+    (torchx/specs/__init__.py:75-181).
+    """
+    if h:
+        return named_resources[h]
+    return Resource(
+        cpu=cpu if cpu is not None else 1,
+        memMB=memMB if memMB is not None else 1024,
+        tpu=TpuSlice.from_type(tpu) if tpu else None,
+    )
+
+
+def get_named_resources() -> Mapping[str, Callable[[], Resource]]:
+    return dict(_factories())

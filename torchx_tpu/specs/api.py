@@ -1,0 +1,893 @@
+"""Core data model for the TPU-native job launcher.
+
+This is the foundation layer: everything else in the package imports it and it
+imports nothing above it (reference analog: torchx/specs/api.py — AppDef /
+Role / Resource / AppStatus / runopts / macros).
+
+The central TPU-first departure from the reference: a :class:`Resource` does
+not carry a GPU count; it carries a :class:`TpuSlice` — accelerator
+generation, chip count and ICI topology — because TPUs are allocated as whole
+pod slices with a fixed interconnect shape, not as per-node device counts
+(reference analog it replaces: ``Resource.gpu`` at specs/api.py:97-170).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import math
+import re
+from dataclasses import dataclass, field
+from datetime import datetime
+from enum import Enum
+from string import Template
+from typing import Any, Callable, Generic, Iterator, Mapping, Optional, TypeVar, Union
+
+# =========================================================================
+# TPU slice model
+# =========================================================================
+
+# Physical facts per TPU generation. ``cores_per_chip`` matters because v4/v5p
+# slice names count TensorCores ("v4-8" = 4 chips) while v5e/v6e names count
+# chips ("v5litepod-8" = 8 chips). ``chips_per_host`` bounds how many chips a
+# single TPU-VM host exposes, which determines the number of workers (hosts)
+# the launcher must gang-schedule for a slice.
+_TPU_GENERATIONS: dict[str, dict[str, Any]] = {
+    "v2": {"cores_per_chip": 2, "chips_per_host": 4, "name_counts_cores": True},
+    "v3": {"cores_per_chip": 2, "chips_per_host": 4, "name_counts_cores": True},
+    "v4": {"cores_per_chip": 2, "chips_per_host": 4, "name_counts_cores": True},
+    "v5p": {"cores_per_chip": 2, "chips_per_host": 4, "name_counts_cores": True},
+    "v5e": {"cores_per_chip": 1, "chips_per_host": 8, "name_counts_cores": False},
+    "v6e": {"cores_per_chip": 1, "chips_per_host": 8, "name_counts_cores": False},
+    "v7x": {"cores_per_chip": 2, "chips_per_host": 4, "name_counts_cores": False},
+}
+
+# Aliases seen in Cloud TPU accelerator-type strings.
+_TPU_ALIASES = {
+    "v5litepod": "v5e",
+    "v5lite": "v5e",
+    "v6litepod": "v6e",
+}
+
+_ACCEL_TYPE_RE = re.compile(r"^(?P<gen>[a-z0-9]+)-(?P<count>\d+)$")
+
+
+def _factor3(chips: int) -> str:
+    """Pick a default 3D ICI topology ``AxBxC`` for a chip count.
+
+    Real slices come in specific shapes; for the common power-of-two counts
+    this reproduces the standard shapes (e.g. 8 -> 2x2x2, 16 -> 2x2x4,
+    32 -> 2x4x4). Callers that care about the exact physical shape should
+    pass ``topology`` explicitly.
+    """
+    dims = [1, 1, 1]
+    i = 0
+    remaining = chips
+    # Greedily split prime factors over the three axes, smallest axis first.
+    for p in _prime_factors(remaining):
+        dims.sort()
+        dims[0] *= p
+        i += 1
+    dims.sort()
+    return "x".join(str(d) for d in dims)
+
+
+def _prime_factors(n: int) -> list[int]:
+    out = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return out
+
+
+@dataclass(frozen=True)
+class TpuSlice:
+    """A TPU pod slice: the unit of accelerator allocation.
+
+    A slice is all-or-nothing — the ICI mesh only exists within a slice, so
+    the launcher gang-schedules ``hosts`` workers together, one process per
+    TPU-VM host (the canonical JAX process layout).
+
+    Attributes:
+        accelerator: generation, e.g. ``"v5p"``, ``"v5e"``, ``"v4"``, ``"v6e"``.
+        chips: total chips in the slice.
+        topology: ICI topology string like ``"2x2x4"`` (v4/v5p are 3D tori,
+            v5e/v6e are 2D meshes like ``"4x8"``). ``None`` means "any shape
+            with this chip count" — schedulers that need a concrete shape
+            (GKE node selectors) will default it via :meth:`default_topology`.
+    """
+
+    accelerator: str
+    chips: int
+    topology: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        gen = _TPU_ALIASES.get(self.accelerator, self.accelerator)
+        if gen not in _TPU_GENERATIONS:
+            raise ValueError(
+                f"unknown TPU generation: {self.accelerator!r};"
+                f" known: {sorted(_TPU_GENERATIONS)} (+aliases {sorted(_TPU_ALIASES)})"
+            )
+        object.__setattr__(self, "accelerator", gen)
+        if self.chips <= 0:
+            raise ValueError(f"chips must be positive, got {self.chips}")
+        if self.topology is not None:
+            prod = math.prod(int(d) for d in self.topology.split("x"))
+            if prod != self.chips:
+                raise ValueError(
+                    f"topology {self.topology} has {prod} chips, expected {self.chips}"
+                )
+
+    # -- derived facts -----------------------------------------------------
+
+    @property
+    def cores_per_chip(self) -> int:
+        return _TPU_GENERATIONS[self.accelerator]["cores_per_chip"]
+
+    @property
+    def cores(self) -> int:
+        return self.chips * self.cores_per_chip
+
+    @property
+    def chips_per_host(self) -> int:
+        """Chips exposed to each TPU-VM host in this slice."""
+        return min(self.chips, _TPU_GENERATIONS[self.accelerator]["chips_per_host"])
+
+    @property
+    def hosts(self) -> int:
+        """Number of TPU-VM hosts (== JAX processes) in the slice."""
+        return max(1, math.ceil(self.chips / self.chips_per_host))
+
+    def default_topology(self) -> str:
+        """A concrete topology for schedulers that require one.
+
+        v4/v5p use 3D tori; v5e/v6e use 2D meshes.
+        """
+        if self.topology:
+            return self.topology
+        if _TPU_GENERATIONS[self.accelerator]["cores_per_chip"] == 2 and self.accelerator in (
+            "v4",
+            "v5p",
+        ):
+            return _factor3(self.chips)
+        # 2D mesh: as square as possible.
+        a = int(math.sqrt(self.chips))
+        while a > 1 and self.chips % a:
+            a -= 1
+        return f"{a}x{self.chips // a}"
+
+    # -- naming ------------------------------------------------------------
+
+    @property
+    def accelerator_type(self) -> str:
+        """Cloud TPU accelerator-type string, e.g. ``"v5p-32"`` / ``"v5litepod-8"``.
+
+        v2..v5p count TensorCores in the suffix; v5e/v6e count chips
+        (this inconsistency is Cloud TPU's, faithfully reproduced).
+        """
+        info = _TPU_GENERATIONS[self.accelerator]
+        if info["name_counts_cores"]:
+            return f"{self.accelerator}-{self.cores}"
+        name = {"v5e": "v5litepod", "v6e": "v6e"}.get(self.accelerator, self.accelerator)
+        return f"{name}-{self.chips}"
+
+    @classmethod
+    def from_type(cls, accelerator_type: str, topology: Optional[str] = None) -> "TpuSlice":
+        """Parse a Cloud TPU accelerator-type string.
+
+        >>> TpuSlice.from_type("v5p-32").chips
+        16
+        >>> TpuSlice.from_type("v5litepod-8").chips
+        8
+        """
+        m = _ACCEL_TYPE_RE.match(accelerator_type.strip().lower())
+        if not m:
+            raise ValueError(f"malformed TPU accelerator type: {accelerator_type!r}")
+        gen = _TPU_ALIASES.get(m.group("gen"), m.group("gen"))
+        if gen not in _TPU_GENERATIONS:
+            raise ValueError(f"unknown TPU generation in {accelerator_type!r}")
+        count = int(m.group("count"))
+        info = _TPU_GENERATIONS[gen]
+        chips = count // info["cores_per_chip"] if info["name_counts_cores"] else count
+        if chips <= 0:
+            raise ValueError(f"accelerator type {accelerator_type!r} has no chips")
+        return cls(accelerator=gen, chips=chips, topology=topology)
+
+    def __str__(self) -> str:
+        t = f", topology={self.topology}" if self.topology else ""
+        return f"TpuSlice({self.accelerator_type}, chips={self.chips}{t})"
+
+
+# =========================================================================
+# Resource
+# =========================================================================
+
+
+@dataclass
+class Resource:
+    """Per-replica resource requirements.
+
+    Attributes:
+        cpu: logical CPUs (on TPU-VM hosts this is usually the whole host).
+        memMB: host RAM in MB.
+        tpu: TPU slice this replica's gang occupies, or None for CPU-only.
+            NOTE: ``tpu`` describes the *whole slice for the role*; a role
+            with a multi-host slice gets ``tpu.hosts`` replicas scheduled by
+            TPU-aware backends (one process per host).
+        capabilities: scheduler-interpreted extras (machine type, disk, spot).
+        devices: named host devices with counts (e.g. ``{"nvidia.com/gpu": 1}``
+            for heterogeneous clusters; TPU chips do NOT go here).
+        tags: freeform labels propagated to backends that support them.
+    """
+
+    cpu: float = -1
+    memMB: int = -1
+    tpu: Optional[TpuSlice] = None
+    capabilities: dict[str, Any] = field(default_factory=dict)
+    devices: dict[str, int] = field(default_factory=dict)
+    tags: dict[str, str] = field(default_factory=dict)
+
+    @staticmethod
+    def copy(original: "Resource", **capabilities: Any) -> "Resource":
+        res = copy.deepcopy(original)
+        res.capabilities.update(capabilities)
+        return res
+
+
+NULL_RESOURCE = Resource(cpu=-1, memMB=-1)
+
+# Sentinel used by components: "scheduler should fill in the resource".
+RESOURCE_UNSET = "__UNSET__"
+
+
+# =========================================================================
+# Mounts
+# =========================================================================
+
+
+class MountType(str, Enum):
+    BIND = "bind"
+    VOLUME = "volume"
+    DEVICE = "device"
+
+
+@dataclass
+class BindMount:
+    """Bind-mount a host path into the replica container."""
+
+    src_path: str
+    dst_path: str
+    read_only: bool = False
+
+
+@dataclass
+class VolumeMount:
+    """Mount a named volume (docker volume / k8s PVC / GCS fuse bucket)."""
+
+    src: str
+    dst_path: str
+    read_only: bool = False
+
+
+@dataclass
+class DeviceMount:
+    """Expose a host device node inside the container."""
+
+    src_path: str
+    dst_path: str
+    permissions: str = "rwm"
+
+
+def parse_mounts(opts: list[str]) -> list[Union[BindMount, VolumeMount, DeviceMount]]:
+    """Parse docker-style mount options into typed mounts.
+
+    Format (repeating)::
+
+        type=<bind|volume|device>,src=<src>,dst=<dst>[,readonly][,perm=<rwm>]
+
+    ``--mount type=bind,src=/host,dst=/job,readonly``
+
+    Reference analog: torchx/specs/builders.py:311-376.
+    """
+    mounts: list[Union[BindMount, VolumeMount, DeviceMount]] = []
+    cur: dict[str, str] = {}
+    groups: list[dict[str, str]] = []
+    for opt in opts:
+        for kv in opt.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            if "=" in kv:
+                k, _, v = kv.partition("=")
+            else:
+                k, v = kv, "true"
+            k = k.lower()
+            if k == "type" and cur:
+                groups.append(cur)
+                cur = {}
+            cur[k] = v
+    if cur:
+        groups.append(cur)
+
+    for g in groups:
+        mtype = g.get("type")
+        if mtype is None:
+            raise ValueError(f"mount spec missing type=: {g}")
+        src = g.get("src") or g.get("source")
+        dst = g.get("dst") or g.get("destination") or g.get("target")
+        readonly = g.get("readonly", "false").lower() in ("true", "1", "")
+        if mtype == MountType.BIND.value:
+            if not src or not dst:
+                raise ValueError(f"bind mount needs src and dst: {g}")
+            mounts.append(BindMount(src_path=src, dst_path=dst, read_only=readonly))
+        elif mtype == MountType.VOLUME.value:
+            if not src or not dst:
+                raise ValueError(f"volume mount needs src and dst: {g}")
+            mounts.append(VolumeMount(src=src, dst_path=dst, read_only=readonly))
+        elif mtype == MountType.DEVICE.value:
+            if not src:
+                raise ValueError(f"device mount needs src: {g}")
+            mounts.append(
+                DeviceMount(
+                    src_path=src, dst_path=dst or src, permissions=g.get("perm", "rwm")
+                )
+            )
+        else:
+            raise ValueError(f"unknown mount type {mtype!r} in {g}")
+    return mounts
+
+
+# =========================================================================
+# Workspace spec
+# =========================================================================
+
+
+@dataclass
+class Workspace:
+    """Maps local project directories to destination subdirs in the image.
+
+    ``{"./src": "app/src", "./conf": "conf"}`` copies two local trees into
+    the built workspace image / job dir (reference analog:
+    torchx/specs/api.py:340-411).
+    """
+
+    projects: dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_str(cls, spec: str) -> "Workspace":
+        """Either a single path ("." / "./proj") or a YAML/JSON-ish mapping
+        string ``src1=dst1,src2=dst2``."""
+        spec = spec.strip()
+        if not spec:
+            return cls()
+        if "=" not in spec:
+            return cls(projects={spec: ""})
+        projects = {}
+        for pair in spec.split(","):
+            src, _, dst = pair.partition("=")
+            projects[src.strip()] = dst.strip()
+        return cls(projects=projects)
+
+    def merge_into(self, other: "Workspace") -> "Workspace":
+        merged = dict(other.projects)
+        merged.update(self.projects)
+        return Workspace(projects=merged)
+
+    def __bool__(self) -> bool:
+        return bool(self.projects)
+
+
+# =========================================================================
+# Macros
+# =========================================================================
+
+
+class macros:
+    """Template variables substituted into Role args/env at materialize time.
+
+    Reference analog: torchx/specs/api.py:183-274. The TPU-specific twist:
+    ``coordinator_env`` substitutes to the *name* of the scheduler-specific
+    env var that holds the coordinator (replica-0) hostname; the value is
+    resolved by the shell at runtime — e.g.
+    ``--coordinator=$${coordinator_env}:8476`` (the reference's rank0_env
+    trick, specs/api.py:216-222).
+    """
+
+    img_root = "${img_root}"
+    app_id = "${app_id}"
+    replica_id = "${replica_id}"
+    num_replicas = "${num_replicas}"
+    coordinator_env = "${coordinator_env}"
+
+    @dataclass
+    class Values:
+        img_root: str = ""
+        app_id: str = ""
+        replica_id: str = ""
+        num_replicas: str = ""
+        coordinator_env: str = "TPX_COORDINATOR_HOST"
+
+        def apply(self, role: "Role") -> "Role":
+            """Return a deep-copied Role with macros substituted in args,
+            env values, entrypoint and mount paths."""
+            role = copy.deepcopy(role)
+            role.entrypoint = self.substitute(role.entrypoint)
+            role.args = [self.substitute(a) for a in role.args]
+            role.env = {k: self.substitute(v) for k, v in role.env.items()}
+            for m in role.mounts:
+                if isinstance(m, (BindMount, DeviceMount)):
+                    m.src_path = self.substitute(m.src_path)
+                    m.dst_path = self.substitute(m.dst_path)
+                elif isinstance(m, VolumeMount):
+                    m.dst_path = self.substitute(m.dst_path)
+            return role
+
+        def substitute(self, arg: str) -> str:
+            return Template(arg).safe_substitute(
+                img_root=self.img_root,
+                app_id=self.app_id,
+                replica_id=self.replica_id,
+                num_replicas=self.num_replicas,
+                coordinator_env=self.coordinator_env,
+            )
+
+
+# =========================================================================
+# Role / AppDef
+# =========================================================================
+
+
+class RetryPolicy(str, Enum):
+    """What to restart when a replica fails.
+
+    REPLICA: restart only the failed replica (stateless services).
+    APPLICATION: restart the whole app (SPMD training — a dead host kills the
+        ICI collective, so the whole gang must restart; this is the default
+        for TPU roles).
+    ROLE: restart all replicas of the failed role.
+    """
+
+    REPLICA = "REPLICA"
+    APPLICATION = "APPLICATION"
+    ROLE = "ROLE"
+
+
+@dataclass
+class Role:
+    """A homogeneous gang of replicas (one container/process template).
+
+    For TPU roles, ``num_replicas`` is the number of TPU-VM *hosts*: one JAX
+    process per host. :func:`AppDef` validation and TPU-aware schedulers keep
+    ``num_replicas == resource.tpu.hosts`` in sync (see
+    :meth:`Role.tpu_hosts`).
+
+    Reference analog: torchx/specs/api.py:277-505.
+    """
+
+    name: str
+    image: str = ""
+    min_replicas: Optional[int] = None  # elastic lower bound; None = rigid gang
+    entrypoint: str = ""
+    args: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+    num_replicas: int = 1
+    max_retries: int = 0
+    retry_policy: RetryPolicy = RetryPolicy.APPLICATION
+    resource: Resource = field(default_factory=lambda: copy.deepcopy(NULL_RESOURCE))
+    port_map: dict[str, int] = field(default_factory=dict)
+    metadata: dict[str, Any] = field(default_factory=dict)
+    mounts: list[Union[BindMount, VolumeMount, DeviceMount]] = field(default_factory=list)
+    workspace: Optional[Workspace] = None
+    # Hook applied to the raw scheduler request during submit_dryrun
+    # (reference analog: Role.pre_proc, schedulers/api.py:410-422).
+    pre_proc: Optional[Callable[[str, Any], Any]] = None
+
+    def pre_proc_fn(self, scheduler: str, dryrun_info: Any) -> Any:
+        if self.pre_proc is None:
+            return dryrun_info
+        return self.pre_proc(scheduler, dryrun_info)
+
+
+@dataclass
+class AppDef:
+    """An application: a named set of roles launched as one job."""
+
+    name: str
+    roles: list[Role] = field(default_factory=list)
+    metadata: dict[str, str] = field(default_factory=dict)
+
+
+# =========================================================================
+# Status model
+# =========================================================================
+
+
+class AppState(int, Enum):
+    """Lifecycle states (reference analog: torchx/specs/api.py:529-560)."""
+
+    UNSUBMITTED = 0
+    SUBMITTED = 1
+    PENDING = 2
+    RUNNING = 3
+    SUCCEEDED = 4
+    FAILED = 5
+    CANCELLED = 6
+    UNKNOWN = 7
+
+    def __str__(self) -> str:
+        return self.name
+
+
+_TERMINAL_STATES = frozenset(
+    (AppState.SUCCEEDED, AppState.FAILED, AppState.CANCELLED)
+)
+_STARTED_STATES = frozenset(
+    (AppState.RUNNING, AppState.SUCCEEDED, AppState.FAILED, AppState.CANCELLED)
+)
+
+
+def is_terminal(state: AppState) -> bool:
+    return state in _TERMINAL_STATES
+
+
+def is_started(state: AppState) -> bool:
+    return state in _STARTED_STATES
+
+
+NONE: str = "<NONE>"
+
+
+@dataclass
+class ReplicaStatus:
+    id: int
+    state: AppState
+    role: str
+    hostname: str = ""
+    structured_error_msg: str = NONE
+
+
+@dataclass
+class RoleStatus:
+    role: str
+    replicas: list[ReplicaStatus] = field(default_factory=list)
+
+
+@dataclass
+class AppStatus:
+    """Status of a submitted app, aggregated over roles/replicas.
+
+    ``structured_error_msg`` carries the JSON error file content written by
+    the first failed replica (see settings.ENV_TPX_ERROR_FILE); ``format()``
+    pretty-prints it (reference analog: specs/api.py:596-778).
+    """
+
+    state: AppState
+    num_restarts: int = 0
+    msg: str = ""
+    structured_error_msg: str = NONE
+    ui_url: Optional[str] = None
+    roles: list[RoleStatus] = field(default_factory=list)
+
+    def is_terminal(self) -> bool:
+        return is_terminal(self.state)
+
+    def raise_for_status(self) -> None:
+        if self.state != AppState.SUCCEEDED:
+            raise AppStatusError(self, f"job did not succeed: {self}")
+
+    def _error_details(self) -> str:
+        if self.structured_error_msg == NONE:
+            return ""
+        try:
+            err = json.loads(self.structured_error_msg)
+        except json.JSONDecodeError:
+            return self.structured_error_msg
+        if not isinstance(err, dict):  # user code may write arbitrary JSON
+            return self.structured_error_msg
+        msg = err.get("message", {})
+        if isinstance(msg, str):
+            return msg
+        ext = msg.get("extraInfo", {})
+        ts = ext.get("timestamp")
+        when = (
+            datetime.fromtimestamp(int(ts)).isoformat() if ts else "<unknown time>"
+        )
+        return (
+            f"{msg.get('message', '')}\n"
+            f"  exitcode: {err.get('exitcode', '<n/a>')}\n"
+            f"  hostname: {err.get('hostname', '<n/a>')}\n"
+            f"  timestamp: {when}\n"
+            f"  python_traceback: {ext.get('py_callstack', '<n/a>')}"
+        )
+
+    def format(self) -> str:
+        lines = [
+            f"AppStatus:",
+            f"  state: {self.state}",
+            f"  num_restarts: {self.num_restarts}",
+        ]
+        if self.msg:
+            lines.append(f"  msg: {self.msg}")
+        if self.ui_url:
+            lines.append(f"  ui_url: {self.ui_url}")
+        details = self._error_details()
+        if details:
+            lines.append("  error:")
+            lines.extend("    " + ln for ln in details.splitlines())
+        for rs in self.roles:
+            lines.append(f"  role: {rs.role}")
+            for r in rs.replicas:
+                host = f" on {r.hostname}" if r.hostname else ""
+                lines.append(f"    [{r.id}] {r.state}{host}")
+        return "\n".join(lines)
+
+    def __str__(self) -> str:
+        return f"AppStatus(state={self.state}, num_restarts={self.num_restarts}, msg={self.msg!r})"
+
+
+class AppStatusError(Exception):
+    def __init__(self, status: AppStatus, message: str) -> None:
+        super().__init__(f"{message}\n{status.format()}")
+        self.status = status
+
+
+# =========================================================================
+# Dry-run info
+# =========================================================================
+
+T = TypeVar("T")
+
+
+@dataclass
+class AppDryRunInfo(Generic[T]):
+    """The fully materialized scheduler request, pre-submission.
+
+    This is the single most important testability hook in the design
+    (reference analog: schedulers/api.py:410-426): ``submit_dryrun`` returns
+    the complete backend payload (Popen argv / sbatch script / JobSet dict)
+    without submitting, so tests assert on it with no cluster.
+    """
+
+    request: T
+    fmt: Callable[[T], str] = str
+    # filled in by Scheduler.submit_dryrun:
+    _app: Optional[AppDef] = None
+    _cfg: Optional[Mapping[str, Any]] = None
+    _scheduler: Optional[str] = None
+
+    def __str__(self) -> str:
+        return self.fmt(self.request)
+
+
+# =========================================================================
+# runopts — typed scheduler run-config schema
+# =========================================================================
+
+CfgVal = Union[str, int, float, bool, list[str], dict[str, str], None]
+
+
+class InvalidRunConfigException(Exception):
+    def __init__(self, reason: str, cfg_key: str, runopts_: "runopts") -> None:
+        super().__init__(f"{reason}. Available options:\n{runopts_}")
+        self.cfg_key = cfg_key
+
+
+@dataclass
+class runopt:
+    default: CfgVal
+    opt_type: type
+    is_required: bool
+    help: str
+
+
+class runopts:
+    """Schema + validator for per-scheduler run configs.
+
+    Reference analog: torchx/specs/api.py:838-1154 (runopts container with
+    resolve() validation, string/JSON parsing, camelCase aliasing, merge).
+    """
+
+    def __init__(self) -> None:
+        self._opts: dict[str, runopt] = {}
+
+    def add(
+        self,
+        cfg_key: str,
+        type_: type,
+        help: str,
+        default: CfgVal = None,
+        required: bool = False,
+    ) -> None:
+        if required and default is not None:
+            raise ValueError(f"required option {cfg_key} must not have a default")
+        self._opts[cfg_key] = runopt(default, type_, required, help)
+
+    def get(self, key: str) -> Optional[runopt]:
+        return self._opts.get(key)
+
+    def __iter__(self) -> Iterator[tuple[str, runopt]]:
+        return iter(self._opts.items())
+
+    def __or__(self, other: "runopts") -> "runopts":
+        merged = runopts()
+        merged._opts = {**self._opts, **other._opts}
+        return merged
+
+    @staticmethod
+    def canonical(key: str) -> str:
+        """camelCase -> snake_case aliasing so ``imageRepo`` finds ``image_repo``."""
+        return re.sub(r"(?<=[a-z0-9])([A-Z])", r"_\1", key).lower()
+
+    def resolve(self, cfg: Mapping[str, CfgVal]) -> dict[str, CfgVal]:
+        """Validate + fill defaults. Unknown keys warn-pass-through (so
+        plugins can piggyback), wrong types and missing-required raise."""
+        resolved: dict[str, CfgVal] = {}
+        seen = set()
+        for key, val in cfg.items():
+            ckey = key if key in self._opts else self.canonical(key)
+            opt = self._opts.get(ckey)
+            if opt is None:
+                resolved[key] = val  # pass through for forward/plugin compat
+                continue
+            seen.add(ckey)
+            if val is None:
+                resolved[ckey] = opt.default
+                continue
+            val = self._coerce(ckey, val, opt)
+            resolved[ckey] = val
+        for key, opt in self._opts.items():
+            if key in seen:
+                continue
+            if opt.is_required:
+                raise InvalidRunConfigException(
+                    f"missing required option: {key}", key, self
+                )
+            resolved[key] = opt.default
+        return resolved
+
+    def _coerce(self, key: str, val: CfgVal, opt: runopt) -> CfgVal:
+        t = opt.opt_type
+        if isinstance(val, str) and t is not str:
+            return _decode_cfg_str(val, t, key, self)
+        if t is float and isinstance(val, int) and not isinstance(val, bool):
+            return float(val)
+        if not isinstance(val, t):
+            raise InvalidRunConfigException(
+                f"option {key} expected {t.__name__},"
+                f" got {type(val).__name__} ({val!r})",
+                key,
+                self,
+            )
+        return val
+
+    def cfg_from_str(self, cfg_str: str) -> dict[str, CfgVal]:
+        """Parse ``k1=v1,k2=v2;k3=v3`` (both ``,`` and ``;`` separate pairs;
+        a list-typed value uses ``,`` within — parse is type-directed)."""
+        cfg: dict[str, CfgVal] = {}
+        if not cfg_str.strip():
+            return cfg
+        for pair in re.split(r"[;,]", cfg_str):
+            pair = pair.strip()
+            if not pair:
+                continue
+            if "=" not in pair:
+                # continuation of a previous list/dict value (the value itself
+                # contained commas, which also separate cfg pairs)
+                last = next(reversed(cfg), None)
+                if last is not None and isinstance(cfg[last], list):
+                    cfg[last].append(pair)  # type: ignore[union-attr]
+                    continue
+                if last is not None and isinstance(cfg[last], dict) and ":" in pair:
+                    k, _, v = pair.partition(":")
+                    cfg[last][k] = v  # type: ignore[index]
+                    continue
+                raise InvalidRunConfigException(
+                    f"malformed cfg pair {pair!r} (expected key=value)", pair, self
+                )
+            key, _, val = pair.partition("=")
+            key = key.strip()
+            ckey = key if key in self._opts else self.canonical(key)
+            opt = self._opts.get(ckey)
+            if opt is not None and opt.opt_type is list:
+                cfg[ckey] = val.split(",") if val else []
+            elif opt is not None:
+                cfg[ckey] = _decode_cfg_str(val, opt.opt_type, ckey, self)
+            else:
+                cfg[key] = val
+        return cfg
+
+    def cfg_from_json_repr(self, json_repr: str) -> dict[str, CfgVal]:
+        return {k: v for k, v in json.loads(json_repr).items()}
+
+    def __repr__(self) -> str:
+        lines = []
+        for key, opt in self._opts.items():
+            req = "required" if opt.is_required else f"default: {opt.default!r}"
+            lines.append(f"    {key} ({opt.opt_type.__name__}, {req}): {opt.help}")
+        return "\n".join(lines) or "    <no options>"
+
+    __str__ = __repr__
+
+
+def _decode_cfg_str(val: str, t: type, key: str, opts: runopts) -> CfgVal:
+    try:
+        if t is bool:
+            low = val.strip().lower()
+            if low in ("true", "1", "yes", "on"):
+                return True
+            if low in ("false", "0", "no", "off"):
+                return False
+            raise ValueError(f"not a bool: {val!r}")
+        if t is int:
+            return int(val)
+        if t is float:
+            return float(val)
+        if t is list:
+            return val.split(",") if val else []
+        if t is dict:
+            return dict(p.split(":", 1) for p in val.split(",") if p)
+        return val
+    except (ValueError, TypeError) as e:
+        raise InvalidRunConfigException(
+            f"option {key} could not parse {val!r} as {t.__name__}: {e}", key, opts
+        ) from e
+
+
+# =========================================================================
+# App handles
+# =========================================================================
+
+AppHandle = str
+
+_HANDLE_RE = re.compile(
+    r"^(?P<scheduler>[a-z_\-0-9]+)://(?P<session>[^/]*)/(?P<app_id>.+)$"
+)
+
+
+class MalformedAppHandleException(Exception):
+    def __init__(self, app_handle: str) -> None:
+        super().__init__(
+            f"malformed app handle: {app_handle!r}"
+            " (expected scheduler://[session]/app_id)"
+        )
+
+
+def make_app_handle(scheduler_backend: str, session_name: str, app_id: str) -> AppHandle:
+    return f"{scheduler_backend}://{session_name}/{app_id}"
+
+
+def parse_app_handle(app_handle: AppHandle) -> tuple[str, str, str]:
+    """-> (scheduler_backend, session_name, app_id)"""
+    m = _HANDLE_RE.match(app_handle)
+    if not m:
+        raise MalformedAppHandleException(app_handle)
+    return m.group("scheduler"), m.group("session"), m.group("app_id")
+
+
+# =========================================================================
+# Structured error files (in-job side writes, client side reads)
+# =========================================================================
+
+
+def make_structured_error(message: str, exitcode: int = 1, hostname: str = "") -> str:
+    """JSON error payload written to $TPX_ERROR_FILE by failing replicas;
+    format mirrors the torchelastic error file the reference consumes
+    (specs/api.py:689-719)."""
+    import socket
+    import time
+
+    return json.dumps(
+        {
+            "message": {
+                "message": message,
+                "extraInfo": {"timestamp": int(time.time()), "py_callstack": ""},
+            },
+            "exitcode": exitcode,
+            "hostname": hostname or socket.gethostname(),
+        }
+    )

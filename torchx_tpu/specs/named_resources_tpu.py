@@ -1,0 +1,93 @@
+"""Named resources for Cloud TPU slices.
+
+The TPU analog of the reference's AWS instance-type catalog
+(torchx/specs/named_resources_aws.py, 631 LoC): maps human-readable slice
+names ("tpu_v5p_32", or the raw accelerator type "v5p-32") to fully
+specified :class:`Resource` objects — host CPU/RAM per TPU-VM worker plus
+the :class:`TpuSlice`.
+
+Host shapes below are the documented Cloud TPU VM machine shapes
+(per-worker):
+
+==========  ==================  ====== =======
+generation  machine type        vCPU   RAM GB
+==========  ==================  ====== =======
+v2/v3       n1-based            96     340
+v4          ct4p-hightpu-4t     240    400
+v5e         ct5lp-hightpu-*t    24-224 48-448
+v5p         ct5p-hightpu-4t     208    448
+v6e         ct6e-standard-*t    44-180 176-720
+==========  ==================  ====== =======
+
+A small "RAM tax" (:data:`MEM_TAX`) is applied the way the reference taxes
+AWS memory (named_resources_aws.py:48) so requests fit under node allocatable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+from torchx_tpu.specs.api import Resource, TpuSlice
+
+MEM_TAX = 0.96
+GiB = 1024
+
+# per-host (cpu, memMB) by generation
+_HOST_SHAPES: dict[str, tuple[int, int]] = {
+    "v2": (96, int(340 * GiB * MEM_TAX)),
+    "v3": (96, int(340 * GiB * MEM_TAX)),
+    "v4": (240, int(400 * GiB * MEM_TAX)),
+    "v5e": (112, int(192 * GiB * MEM_TAX)),
+    "v5p": (208, int(448 * GiB * MEM_TAX)),
+    "v6e": (180, int(720 * GiB * MEM_TAX)),
+    "v7x": (224, int(960 * GiB * MEM_TAX)),
+}
+
+# The slice sizes we pre-register by name. Arbitrary sizes remain reachable
+# through tpu_slice("v5e-123")-style dynamic lookup below.
+_CATALOG_CHIPS: dict[str, list[int]] = {
+    "v4": [4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096],
+    "v5e": [1, 4, 8, 16, 32, 64, 128, 256],
+    "v5p": [4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4608],
+    "v6e": [1, 4, 8, 16, 32, 64, 128, 256],
+}
+
+
+def tpu_slice(accelerator_type: str, topology: str | None = None) -> Resource:
+    """Resource for an arbitrary accelerator-type string, e.g. "v5p-32"."""
+    sl = TpuSlice.from_type(accelerator_type, topology=topology)
+    cpu, mem = _HOST_SHAPES[sl.accelerator]
+    return Resource(
+        cpu=cpu,
+        memMB=mem,
+        tpu=sl,
+        capabilities={"tpu.accelerator_type": sl.accelerator_type},
+    )
+
+
+def _mk(gen: str, chips: int) -> Callable[[], Resource]:
+    def factory() -> Resource:
+        sl = TpuSlice(accelerator=gen, chips=chips)
+        cpu, mem = _HOST_SHAPES[gen]
+        return Resource(
+            cpu=cpu,
+            memMB=mem,
+            tpu=sl,
+            capabilities={"tpu.accelerator_type": sl.accelerator_type},
+        )
+
+    factory.__name__ = f"tpu_{gen}_{chips}"
+    return factory
+
+
+def named_resources_tpu() -> Mapping[str, Callable[[], Resource]]:
+    """Registry: both pythonic names (tpu_v5p_32 = 32 chips) and raw
+    accelerator-type names (v5p-64 = Cloud naming, 32 chips) resolve."""
+    out: dict[str, Callable[[], Resource]] = {}
+    for gen, sizes in _CATALOG_CHIPS.items():
+        for chips in sizes:
+            f = _mk(gen, chips)
+            out[f"tpu_{gen}_{chips}"] = f  # chips-count naming
+            accel = TpuSlice(accelerator=gen, chips=chips).accelerator_type
+            out[accel] = f  # cloud naming ("v5p-64", "v5litepod-8")
+    return out

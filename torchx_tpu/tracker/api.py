@@ -1,0 +1,217 @@
+"""Experiment tracking / lineage API.
+
+Reference analog: torchx/tracker/api.py (275 LoC):
+
+* :class:`TrackerBase` — backend ABC (artifacts, metadata, lineage, run ids).
+* :class:`AppRun` — the in-job API; ``AppRun.run_from_env()`` reads the env
+  vars the Runner injected at dryrun (TPX_JOB_ID / TPX_TRACKERS /
+  TPX_TRACKER_<NAME>_CONFIG) and fans writes out to every configured backend.
+
+Client side, :func:`tracker_config_env_vars` turns the entries configured in
+``.tpxconfig`` ``[tracker:<name>]`` sections (or the ``tpx_trackers``
+entrypoint group) into those env vars.
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Any, Iterable, Mapping, Optional
+
+from torchx_tpu import settings
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TrackerArtifact:
+    name: str
+    path: str
+    metadata: Optional[Mapping[str, Any]] = None
+
+
+@dataclass
+class TrackerSource:
+    source_run_id: str
+    artifact_name: Optional[str] = None
+
+
+@dataclass
+class Lineage:
+    # placeholder for a richer lineage graph object
+    run_id: str
+    sources: list[TrackerSource]
+
+
+class TrackerBase(ABC):
+    """Backend contract (reference tracker/api.py:61-122)."""
+
+    @abstractmethod
+    def add_artifact(
+        self, run_id: str, name: str, path: str, metadata: Optional[Mapping[str, Any]] = None
+    ) -> None:
+        ...
+
+    @abstractmethod
+    def artifacts(self, run_id: str) -> Mapping[str, TrackerArtifact]:
+        ...
+
+    @abstractmethod
+    def add_metadata(self, run_id: str, **kwargs: Any) -> None:
+        ...
+
+    @abstractmethod
+    def metadata(self, run_id: str) -> Mapping[str, Any]:
+        ...
+
+    @abstractmethod
+    def add_source(
+        self, run_id: str, source_id: str, artifact_name: Optional[str] = None
+    ) -> None:
+        ...
+
+    @abstractmethod
+    def sources(
+        self, run_id: str, artifact_name: Optional[str] = None
+    ) -> Iterable[TrackerSource]:
+        ...
+
+    @abstractmethod
+    def run_ids(self, **kwargs: str) -> Iterable[str]:
+        ...
+
+    def lineage(self, run_id: str) -> Lineage:
+        return Lineage(run_id=run_id, sources=list(self.sources(run_id)))
+
+
+# =========================================================================
+# Factory / env-var plumbing
+# =========================================================================
+
+# entry-point group name for tracker backend factories
+TRACKER_ENTRYPOINT_GROUP = "tpx_trackers"
+
+
+def _load_tracker(name: str, config: Optional[str]) -> Optional[TrackerBase]:
+    """name is either an entry-point name or a ``module:fn`` factory spec;
+    the factory takes (config: str | None) and returns a TrackerBase."""
+    factory = None
+    try:
+        from importlib.metadata import entry_points
+
+        for ep in entry_points(group=TRACKER_ENTRYPOINT_GROUP):
+            if ep.name == name:
+                factory = ep.load()
+                break
+    except Exception:  # noqa: BLE001
+        pass
+    if factory is None and ":" in name:
+        mod_name, _, fn_name = name.partition(":")
+        try:
+            factory = getattr(importlib.import_module(mod_name), fn_name)
+        except (ImportError, AttributeError) as e:
+            logger.warning("cannot load tracker %r: %s", name, e)
+            return None
+    if factory is None:
+        # builtin shorthand
+        if name == "fsspec":
+            from torchx_tpu.tracker.backend.fsspec import create as factory
+        elif name == "mlflow":
+            from torchx_tpu.tracker.mlflow import create as factory
+        else:
+            logger.warning("unknown tracker backend %r", name)
+            return None
+    try:
+        return factory(config)
+    except Exception as e:  # noqa: BLE001
+        logger.warning("tracker %r factory failed: %s", name, e)
+        return None
+
+
+def trackers_from_environ() -> dict[str, TrackerBase]:
+    """In-job: instantiate every tracker named in $TPX_TRACKERS."""
+    names = [
+        n.strip()
+        for n in os.environ.get(settings.ENV_TPX_TRACKERS, "").split(",")
+        if n.strip()
+    ]
+    out: dict[str, TrackerBase] = {}
+    for name in names:
+        key = name.replace(":", "_").replace(".", "_").upper()
+        config = os.environ.get(f"{settings.ENV_TPX_TRACKER_PREFIX}{key}_CONFIG")
+        tracker = _load_tracker(name, config)
+        if tracker is not None:
+            out[name] = tracker
+    return out
+
+
+def tracker_config_env_vars(
+    parent_run_id: Optional[str] = None,
+    trackers: Optional[Mapping[str, Optional[str]]] = None,
+) -> dict[str, str]:
+    """Client side: env vars the Runner injects into every role at dryrun
+    (reference runner/api.py:68-87,358-391). ``trackers`` maps backend name
+    -> optional config string; default comes from .tpxconfig [tracker:*]."""
+    if trackers is None:
+        from torchx_tpu.runner.config import load_tracker_sections
+
+        trackers = load_tracker_sections()
+    if not trackers:
+        return {}
+    env = {settings.ENV_TPX_TRACKERS: ",".join(trackers)}
+    for name, config in trackers.items():
+        if config:
+            key = name.replace(":", "_").replace(".", "_").upper()
+            env[f"{settings.ENV_TPX_TRACKER_PREFIX}{key}_CONFIG"] = config
+    if parent_run_id:
+        env[settings.ENV_TPX_PARENT_RUN_ID] = parent_run_id
+    return env
+
+
+# =========================================================================
+# In-job AppRun facade
+# =========================================================================
+
+
+class AppRun:
+    """Job-side tracking handle fanning out to all configured backends."""
+
+    _instance: Optional["AppRun"] = None
+
+    def __init__(self, id: str, backends: Mapping[str, TrackerBase]) -> None:
+        self.id = id
+        self.backends = dict(backends)
+
+    @classmethod
+    def run_from_env(cls) -> "AppRun":
+        """Singleton built from scheduler-injected env (TPX_JOB_ID et al.).
+
+        Outside a tpx-launched job, returns an id of "<unknown_run_id>" with
+        zero backends: all calls become no-ops so user code runs unchanged.
+        """
+        if cls._instance is None:
+            run_id = os.environ.get(settings.ENV_TPX_JOB_ID, "<unknown_run_id>")
+            backends = trackers_from_environ()
+            run = cls(run_id, backends)
+            parent = os.environ.get(settings.ENV_TPX_PARENT_RUN_ID)
+            if parent:
+                run.add_source(parent)
+            cls._instance = run
+        return cls._instance
+
+    def add_metadata(self, **kwargs: Any) -> None:
+        for b in self.backends.values():
+            b.add_metadata(self.id, **kwargs)
+
+    def add_artifact(
+        self, name: str, path: str, metadata: Optional[Mapping[str, Any]] = None
+    ) -> None:
+        for b in self.backends.values():
+            b.add_artifact(self.id, name, path, metadata)
+
+    def add_source(self, source_id: str, artifact_name: Optional[str] = None) -> None:
+        for b in self.backends.values():
+            b.add_source(self.id, source_id, artifact_name)

@@ -1,0 +1,109 @@
+"""Multi-replica threaded log tee with role/replica prefixes.
+
+Reference analog: torchx/util/log_tee_helpers.py — one thread per replica
+streams ``runner.log_lines`` to stdout, each line prefixed ``role/replica``
+with a stable ANSI color per replica.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Optional, TextIO
+
+from torchx_tpu.runner.api import Runner
+from torchx_tpu.specs.api import AppStatus, is_started
+
+_COLORS = [36, 32, 33, 34, 35, 31]  # cyan, green, yellow, blue, magenta, red
+
+
+def _colored(prefix: str, idx: int, enabled: bool) -> str:
+    if not enabled:
+        return prefix
+    return f"\x1b[{_COLORS[idx % len(_COLORS)]}m{prefix}\x1b[0m"
+
+
+def find_role_replicas(
+    app_status: Optional[AppStatus], role_name: Optional[str]
+) -> list[tuple[str, int]]:
+    """All (role, replica_id) pairs, optionally filtered to one role."""
+    out: list[tuple[str, int]] = []
+    if app_status is None:
+        return out
+    for role_status in app_status.roles:
+        if role_name and role_status.role != role_name:
+            continue
+        for r in role_status.replicas:
+            out.append((role_status.role, r.id))
+    return out
+
+
+def _stream_one(
+    runner: Runner,
+    app_handle: str,
+    role: str,
+    replica: int,
+    prefix: str,
+    should_tail: bool,
+    out: TextIO,
+    lock: threading.Lock,
+) -> None:
+    try:
+        for line in runner.log_lines(
+            app_handle, role, replica, should_tail=should_tail
+        ):
+            with lock:
+                out.write(f"{prefix} {line}\n")
+                out.flush()
+    except Exception as e:  # noqa: BLE001 - log streaming is best-effort
+        with lock:
+            out.write(f"{prefix} <log stream error: {e}>\n")
+
+
+def wait_for_app_started(
+    runner: Runner, app_handle: str, poll_interval: float = 0.5, timeout: float = 600
+) -> Optional[AppStatus]:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status = runner.status(app_handle)
+        if status is None:
+            return None
+        if is_started(status.state):
+            return status
+        time.sleep(poll_interval)
+    return runner.status(app_handle)
+
+
+def tee_logs(
+    runner: Runner,
+    app_handle: str,
+    role_name: Optional[str] = None,
+    should_tail: bool = True,
+    out: TextIO = sys.stderr,
+    colors: Optional[bool] = None,
+) -> threading.Thread:
+    """Spawn one streaming thread per replica; returns a supervisor thread
+    that joins them all."""
+    status = wait_for_app_started(runner, app_handle)
+    replicas = find_role_replicas(status, role_name)
+    use_colors = colors if colors is not None else out.isatty()
+    lock = threading.Lock()
+    threads = []
+    for idx, (role, replica) in enumerate(replicas):
+        prefix = _colored(f"{role}/{replica}", idx, use_colors)
+        t = threading.Thread(
+            target=_stream_one,
+            args=(runner, app_handle, role, replica, prefix, should_tail, out, lock),
+            daemon=True,
+        )
+        t.start()
+        threads.append(t)
+
+    def _join_all() -> None:
+        for t in threads:
+            t.join()
+
+    supervisor = threading.Thread(target=_join_all, daemon=True)
+    supervisor.start()
+    return supervisor

@@ -1,0 +1,7 @@
+"""Version of the torchx_tpu package."""
+
+__version__ = "0.1.0"
+
+# The image used by components when none is given. For the local scheduler the
+# image is a directory; remote schedulers expect a container image tag.
+TORCHX_TPU_IMAGE = f"ghcr.io/torchx-tpu/torchx-tpu:{__version__}"

@@ -1,0 +1,1 @@
+from torchx_tpu.workspace.api import WorkspaceMixin, walk_workspace  # noqa: F401

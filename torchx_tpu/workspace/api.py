@@ -1,0 +1,139 @@
+"""Workspace layer: package local code into the job's image.
+
+Reference analog: torchx/workspace/api.py (247 LoC). ``WorkspaceMixin`` is
+mixed into scheduler classes; ``build_workspaces`` re-points ``role.image``
+at the built artifact (a patched docker image, or a snapshot directory).
+Includes the ``.tpxignore``/``.dockerignore`` walker with ``!`` negation.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import posixpath
+from abc import abstractmethod
+from typing import Any, Generic, Iterable, Mapping, Optional, TypeVar
+
+from torchx_tpu.specs.api import CfgVal, Role, Workspace, runopts
+
+T = TypeVar("T")  # workspace build artifact type
+
+IGNORE_FILES = (".tpxignore", ".torchxignore", ".dockerignore")
+
+
+class WorkspaceMixin(Generic[T]):
+    """Adds workspace building to a Scheduler.
+
+    ``build_workspaces(roles, cfg)`` builds each distinct (image, workspace)
+    pair once (build cache) and mutates ``role.image`` to the result
+    (reference api.py:97-154).
+    """
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+
+    def workspace_opts(self) -> runopts:
+        return runopts()
+
+    @abstractmethod
+    def build_workspace_and_update_role(
+        self, role: Role, workspace: Workspace, cfg: Mapping[str, CfgVal]
+    ) -> None:
+        """Build the workspace for one role and mutate role.image in place."""
+        ...
+
+    def build_workspaces(
+        self, roles: list[Role], cfg: Mapping[str, CfgVal]
+    ) -> None:
+        cache: dict[tuple[str, tuple[tuple[str, str], ...]], str] = {}
+        for role in roles:
+            ws = role.workspace
+            if not ws:
+                continue
+            key = (role.image, tuple(sorted(ws.projects.items())))
+            if key in cache:
+                role.image = cache[key]
+                continue
+            old_image = role.image
+            self.build_workspace_and_update_role(role, ws, cfg)
+            cache[key] = role.image
+            if role.image != old_image:
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "built workspace for role %s: %s -> %s",
+                    role.name,
+                    old_image,
+                    role.image,
+                )
+
+    # push contract for docker-ish backends (reference api.py:169-179)
+    def dryrun_push_images(self, app: Any, cfg: Mapping[str, CfgVal]) -> Any:
+        return None
+
+    def push_images(self, images_to_push: Any) -> None:
+        pass
+
+
+# =========================================================================
+# Ignore-file walker
+# =========================================================================
+
+
+def _load_ignore_patterns(root: str) -> list[str]:
+    patterns: list[str] = []
+    for name in IGNORE_FILES:
+        path = os.path.join(root, name)
+        if os.path.isfile(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and not line.startswith("#"):
+                        patterns.append(line)
+    return patterns
+
+
+def _is_ignored(rel_path: str, patterns: list[str]) -> bool:
+    """dockerignore-style matching with ``!`` negation; last match wins."""
+    ignored = False
+    for pat in patterns:
+        negate = pat.startswith("!")
+        if negate:
+            pat = pat[1:]
+        pat = pat.rstrip("/")
+        # a pattern matches the path itself or any parent directory
+        hit = fnmatch.fnmatch(rel_path, pat) or fnmatch.fnmatch(
+            rel_path, pat + "/*"
+        )
+        if not hit:
+            parts = rel_path.split("/")
+            hit = any(
+                fnmatch.fnmatch("/".join(parts[: i + 1]), pat)
+                for i in range(len(parts))
+            )
+        if hit:
+            ignored = not negate
+    return ignored
+
+
+def walk_workspace(root: str) -> Iterable[tuple[str, str]]:
+    """Yield (abs_path, rel_path) for every non-ignored file under root,
+    honoring .tpxignore/.dockerignore (reference api.py:182-247)."""
+    root = os.path.abspath(root)
+    patterns = _load_ignore_patterns(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, root)
+        rel_dir = "" if rel_dir == "." else rel_dir.replace(os.sep, "/")
+        # prune ignored directories in place so we never descend
+        dirnames[:] = [
+            d
+            for d in dirnames
+            if not _is_ignored(posixpath.join(rel_dir, d) if rel_dir else d, patterns)
+        ]
+        for fname in filenames:
+            rel = posixpath.join(rel_dir, fname) if rel_dir else fname
+            if fname in IGNORE_FILES:
+                continue
+            if _is_ignored(rel, patterns):
+                continue
+            yield os.path.join(dirpath, fname), rel
