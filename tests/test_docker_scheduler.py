@@ -1,0 +1,140 @@
+"""Docker scheduler tests with a mock client (reference analog:
+docker_scheduler_test.py — injected client, assert on dryrun request)."""
+
+from unittest import mock
+
+import pytest
+
+from torchx_tpu.schedulers.docker_scheduler import DockerScheduler
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppState,
+    BindMount,
+    Resource,
+    Role,
+    TpuSlice,
+)
+
+
+@pytest.fixture
+def sched():
+    return DockerScheduler("test", docker_client=mock.MagicMock())
+
+
+def app(**role_kwargs) -> AppDef:
+    defaults = dict(
+        name="r",
+        image="img:1",
+        entrypoint="python",
+        args=["-m", "t"],
+        num_replicas=2,
+        resource=Resource(cpu=2, memMB=2048),
+    )
+    defaults.update(role_kwargs)
+    return AppDef(name="app", roles=[Role(**defaults)])
+
+
+class TestDockerDryrun:
+    def test_containers_share_network_and_coordinator(self, sched):
+        info = sched.submit_dryrun(app(), {})
+        req = info.request
+        assert len(req.containers) == 2
+        c0, c1 = req.containers
+        assert c0.kwargs["network"] == "tpx"
+        # coordinator = container name of role replica 0
+        assert c0.kwargs["environment"]["TPX_COORDINATOR_HOST"] == c0.kwargs["name"]
+        assert c1.kwargs["environment"]["TPX_COORDINATOR_HOST"] == c0.kwargs["name"]
+        assert c1.kwargs["environment"]["TPX_REPLICA_ID"] == "1"
+
+    def test_resource_limits(self, sched):
+        info = sched.submit_dryrun(app(), {})
+        c = info.request.containers[0]
+        assert c.kwargs["mem_limit"] == "2048m"
+        assert c.kwargs["nano_cpus"] == int(2e9)
+
+    def test_restart_policy(self, sched):
+        info = sched.submit_dryrun(app(max_retries=3), {})
+        assert info.request.containers[0].kwargs["restart_policy"] == {
+            "Name": "on-failure",
+            "MaximumRetryCount": 3,
+        }
+
+    def test_mounts(self, sched):
+        info = sched.submit_dryrun(
+            app(mounts=[BindMount(src_path="/data", dst_path="/data", read_only=True)]),
+            {},
+        )
+        (m,) = info.request.containers[0].kwargs["mounts"]
+        assert m["source"] == "/data" and m["read_only"] is True
+
+    def test_tpu_role_expands_hosts(self, sched):
+        info = sched.submit_dryrun(
+            app(
+                num_replicas=1,
+                resource=Resource(cpu=1, memMB=1, tpu=TpuSlice("v5e", 16)),
+            ),
+            {},
+        )
+        assert len(info.request.containers) == 2  # 16 v5e chips -> 2 hosts
+
+    def test_copy_env_globs(self, sched, monkeypatch):
+        monkeypatch.setenv("TPX_TEST_SECRETVAR", "v")
+        monkeypatch.setenv("OTHER", "x")
+        info = sched.submit_dryrun(app(), {"copy_env": ["TPX_TEST_*"]})
+        env = info.request.containers[0].kwargs["environment"]
+        assert env["TPX_TEST_SECRETVAR"] == "v"
+        assert "OTHER" not in env
+
+    def test_schedule_runs_containers(self, sched):
+        info = sched.submit_dryrun(app(), {})
+        app_id = sched.schedule(info)
+        assert app_id == info.request.app_id
+        assert sched._client.containers.run.call_count == 2
+
+
+class TestDockerDescribe:
+    def _container(self, role, replica, status, exit_code=0, name="c"):
+        c = mock.MagicMock()
+        c.labels = {
+            "tpx.sh/app-id": "app1",
+            "tpx.sh/role-name": role,
+            "tpx.sh/replica-id": str(replica),
+        }
+        c.status = status
+        c.attrs = {"State": {"ExitCode": exit_code}}
+        c.name = name
+        return c
+
+    def test_running(self, sched):
+        sched._client.containers.list.return_value = [
+            self._container("r", 0, "running"),
+            self._container("r", 1, "running"),
+        ]
+        resp = sched.describe("app1")
+        assert resp.state == AppState.RUNNING
+        assert len(resp.roles_statuses[0].replicas) == 2
+
+    def test_failed_dominates(self, sched):
+        sched._client.containers.list.return_value = [
+            self._container("r", 0, "exited", exit_code=1),
+            self._container("r", 1, "running"),
+        ]
+        assert sched.describe("app1").state == AppState.FAILED
+
+    def test_all_succeeded(self, sched):
+        sched._client.containers.list.return_value = [
+            self._container("r", 0, "exited", exit_code=0),
+        ]
+        assert sched.describe("app1").state == AppState.SUCCEEDED
+
+    def test_list_partial_not_terminal(self, sched):
+        sched._client.containers.list.return_value = [
+            self._container("r", 0, "exited", exit_code=0),
+            self._container("r", 1, "running"),
+        ]
+        (app,) = sched.list()
+        assert app.state == AppState.RUNNING
+
+    def test_missing(self, sched):
+        sched._client.containers.list.return_value = []
+        assert sched.describe("ghost") is None

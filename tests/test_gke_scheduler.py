@@ -1,0 +1,234 @@
+"""GKE scheduler tests: assert on the materialized JobSet dict (reference
+analog: kubernetes_scheduler_test.py, 1935 LoC — dryrun request checks with
+no cluster)."""
+
+import pytest
+
+from torchx_tpu.schedulers.api import DescribeAppResponse
+from torchx_tpu.schedulers.gke_scheduler import (
+    GKEScheduler,
+    app_to_jobset,
+    describe_jobset,
+    jobset_state,
+    sanitize_name,
+)
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppState,
+    Resource,
+    Role,
+    TpuSlice,
+    VolumeMount,
+    macros,
+)
+from torchx_tpu.specs.overlays import DEL, PUT, set_overlay
+
+
+def tpu_role(chips=16, accelerator="v5p", num_replicas=1, **kwargs) -> Role:
+    return Role(
+        name="trainer",
+        image="gcr.io/proj/img:1",
+        entrypoint="python",
+        args=["-m", "train", f"--replica={macros.replica_id}"],
+        num_replicas=num_replicas,
+        resource=Resource(
+            cpu=208, memMB=448 * 1024, tpu=TpuSlice(accelerator, chips)
+        ),
+        **kwargs,
+    )
+
+
+def make_jobset(app, **kwargs):
+    defaults = dict(
+        app_name="app-x", namespace="default", queue=None, service_account=None
+    )
+    defaults.update(kwargs)
+    return app_to_jobset(app, **defaults)
+
+
+class TestJobSetMaterialization:
+    def test_tpu_role_indexed_job(self):
+        js = make_jobset(AppDef(name="a", roles=[tpu_role()]))
+        assert js["kind"] == "JobSet"
+        (rj,) = js["spec"]["replicatedJobs"]
+        assert rj["name"] == "trainer"
+        assert rj["replicas"] == 1
+        spec = rj["template"]["spec"]
+        # v5p-32: 16 chips -> 4 hosts
+        assert spec["parallelism"] == 4 and spec["completions"] == 4
+        assert spec["completionMode"] == "Indexed"
+        assert spec["backoffLimit"] == 0
+
+    def test_tpu_node_selectors_and_limits(self):
+        js = make_jobset(AppDef(name="a", roles=[tpu_role()]))
+        pod = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]
+        sel = pod["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5p-slice"
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x2x4"
+        container = pod["spec"]["containers"][0]
+        assert container["resources"]["limits"]["google.com/tpu"] == 4
+        assert pod["spec"]["tolerations"][0]["key"] == "google.com/tpu"
+
+    def test_replica_id_via_completion_index(self):
+        js = make_jobset(AppDef(name="a", roles=[tpu_role()]))
+        container = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"][
+            "spec"
+        ]["containers"][0]
+        env = {e["name"]: e for e in container["env"]}
+        assert env["TPX_REPLICA_ID"]["value"] == "$(JOB_COMPLETION_INDEX)"
+        assert env["JOB_COMPLETION_INDEX"]["valueFrom"]["fieldRef"][
+            "fieldPath"
+        ].endswith("job-completion-index']")
+        # macro in args resolves to the env reference, expanded by kubelet
+        assert "--replica=$(TPX_REPLICA_ID)" in container["command"]
+
+    def test_coordinator_dns(self):
+        js = make_jobset(AppDef(name="a", roles=[tpu_role()]))
+        container = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"][
+            "spec"
+        ]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["TPX_COORDINATOR_HOST"] == "app-x-trainer-0-0.app-x"
+        assert env["TPX_NUM_REPLICAS"] == "4"
+
+    def test_multislice(self):
+        js = make_jobset(AppDef(name="a", roles=[tpu_role(num_replicas=2)]))
+        (rj,) = js["spec"]["replicatedJobs"]
+        assert rj["replicas"] == 2  # one Job per slice
+        container = rj["template"]["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+
+    def test_cpu_role(self):
+        role = Role(
+            name="reader",
+            image="img",
+            entrypoint="python",
+            args=["-m", "read"],
+            num_replicas=3,
+            resource=Resource(cpu=2, memMB=4096),
+        )
+        js = make_jobset(AppDef(name="a", roles=[role]))
+        spec = js["spec"]["replicatedJobs"][0]["template"]["spec"]
+        assert spec["completions"] == 3 and spec["parallelism"] == 3
+        pod_spec = spec["template"]["spec"]
+        assert "nodeSelector" not in pod_spec
+        container = pod_spec["containers"][0]
+        assert container["resources"]["limits"]["cpu"] == "2000m"
+        assert container["resources"]["requests"]["cpu"] == "1900m"  # reserved
+
+    def test_retries_to_failure_policy(self):
+        js = make_jobset(AppDef(name="a", roles=[tpu_role(max_retries=3)]))
+        assert js["spec"]["failurePolicy"] == {"maxRestarts": 3}
+
+    def test_kueue_queue_suspends(self):
+        js = make_jobset(AppDef(name="a", roles=[tpu_role()]), queue="tpu-queue")
+        assert js["metadata"]["labels"]["kueue.x-k8s.io/queue-name"] == "tpu-queue"
+        assert js["spec"]["suspend"] is True
+
+    def test_volume_mounts(self):
+        role = tpu_role(mounts=[VolumeMount(src="ckpts", dst_path="/ckpt")])
+        js = make_jobset(AppDef(name="a", roles=[role]))
+        pod_spec = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"][
+            "spec"
+        ]
+        vols = {v["name"]: v for v in pod_spec["volumes"]}
+        assert vols["mount-0"]["persistentVolumeClaim"]["claimName"] == "ckpts"
+        assert "dshm" in vols  # /dev/shm tmpfs always present
+
+    def test_overlay_applied(self):
+        role = tpu_role()
+        set_overlay(
+            role,
+            "gke",
+            {
+                "metadata": {"labels": {"team": "research"}},
+                PUT("apiVersion"): "jobset.x-k8s.io/v1beta1",
+            },
+        )
+        js = make_jobset(AppDef(name="a", roles=[role]))
+        assert js["metadata"]["labels"]["team"] == "research"
+        assert js["metadata"]["name"] == "app-x"  # merge kept siblings
+        assert js["apiVersion"] == "jobset.x-k8s.io/v1beta1"
+
+    def test_sanitize_name(self):
+        assert sanitize_name("My Job!") == "my-job"
+        long = sanitize_name("x" * 100)
+        assert len(long) <= 53
+
+
+class TestGKESchedulerDryrun:
+    def test_submit_dryrun(self):
+        sched = GKEScheduler("test", client=object())
+        app = AppDef(name="train", roles=[tpu_role()])
+        info = sched.submit_dryrun(app, {"namespace": "ml"})
+        assert info._scheduler == "gke"
+        assert info.request.namespace == "ml"
+        assert info.request.resource["kind"] == "JobSet"
+        name = info.request.resource["metadata"]["name"]
+        assert name.startswith("train-")
+
+    def test_workspace_requires_repo_for_sha(self):
+        sched = GKEScheduler("test", client=object())
+        role = tpu_role()
+        role.image = "sha256:" + "a" * 64
+        app = AppDef(name="t", roles=[role])
+        with pytest.raises(KeyError):
+            sched.submit_dryrun(app, {})
+
+    def test_image_repo_rewrites_sha(self):
+        sched = GKEScheduler("test", client=object())
+        role = tpu_role()
+        role.image = "sha256:" + "a" * 64
+        app = AppDef(name="t", roles=[role])
+        info = sched.submit_dryrun(app, {"image_repo": "gcr.io/p/r"})
+        assert info.request.images_to_push == {
+            "sha256:" + "a" * 64: ("gcr.io/p/r", "a" * 12)
+        }
+        container = info.request.resource["spec"]["replicatedJobs"][0]["template"][
+            "spec"
+        ]["template"]["spec"]["containers"][0]
+        assert container["image"] == "gcr.io/p/r:" + "a" * 12
+
+
+class TestJobSetStateMapping:
+    def test_completed(self):
+        js = {"status": {"conditions": [{"type": "Completed", "status": "True"}]}}
+        assert jobset_state(js) == AppState.SUCCEEDED
+
+    def test_failed(self):
+        js = {"status": {"conditions": [{"type": "Failed", "status": "True"}]}}
+        assert jobset_state(js) == AppState.FAILED
+
+    def test_suspended_spec(self):
+        assert jobset_state({"spec": {"suspend": True}, "status": {}}) == AppState.PENDING
+
+    def test_running(self):
+        js = {"status": {"replicatedJobsStatus": [{"active": 4}]}}
+        assert jobset_state(js) == AppState.RUNNING
+
+    def test_describe_with_pods(self):
+        js = {
+            "metadata": {"namespace": "default", "name": "app-x"},
+            "status": {
+                "restarts": 1,
+                "replicatedJobsStatus": [{"active": 2}],
+            },
+        }
+        pods = [
+            {
+                "metadata": {
+                    "labels": {"tpx.sh/role-name": "trainer"},
+                    "annotations": {"batch.kubernetes.io/job-completion-index": "1"},
+                    "name": "app-x-trainer-0-1",
+                },
+                "status": {"phase": "Running", "podIP": "10.0.0.7"},
+            }
+        ]
+        resp = describe_jobset(js, pods)
+        assert isinstance(resp, DescribeAppResponse)
+        assert resp.state == AppState.RUNNING
+        assert resp.num_restarts == 1
+        (rs,) = resp.roles_statuses
+        assert rs.replicas[0].id == 1
+        assert rs.replicas[0].hostname == "10.0.0.7"

@@ -1,0 +1,166 @@
+"""Overlays, StructuredOpts, and workspace-layer tests."""
+
+import io
+import tarfile
+from dataclasses import dataclass, field
+from typing import Optional
+
+import pytest
+
+from torchx_tpu.schedulers.structured_opts import StructuredOpts
+from torchx_tpu.specs.api import Role, Workspace
+from torchx_tpu.specs.overlays import (
+    DEL,
+    JOIN,
+    PUT,
+    apply_overlay,
+    get_overlay,
+    set_overlay,
+    validate_overlay,
+)
+from torchx_tpu.workspace.api import walk_workspace
+from torchx_tpu.workspace.dir_workspace import DirWorkspaceMixin, copy_workspace
+from torchx_tpu.workspace.docker_workspace import build_context
+
+
+class TestOverlays:
+    def test_strategic_merge(self):
+        target = {"a": {"b": 1, "c": 2}, "keep": True}
+        out = apply_overlay(target, {"a": {"b": 9}})
+        assert out == {"a": {"b": 9, "c": 2}, "keep": True}
+        assert target["a"]["b"] == 1  # original untouched
+
+    def test_put_replaces(self):
+        out = apply_overlay({"a": {"b": 1}}, {PUT("a"): {"x": 1}})
+        assert out["a"] == {"x": 1}
+
+    def test_del(self):
+        out = apply_overlay({"a": 1, "b": 2}, {DEL("a"): None})
+        assert out == {"b": 2}
+
+    def test_join_by_name(self):
+        target = {"containers": [{"name": "main", "image": "a"}, {"name": "side"}]}
+        out = apply_overlay(
+            target,
+            {
+                JOIN("containers"): [
+                    {"name": "main", "image": "b"},
+                    {"name": "new"},
+                ]
+            },
+        )
+        names = [c["name"] for c in out["containers"]]
+        assert names == ["main", "side", "new"]
+        assert out["containers"][0]["image"] == "b"
+
+    def test_join_custom_key(self):
+        target = {"env": [{"key": "A", "v": 1}]}
+        out = apply_overlay(target, {JOIN("env", "key"): [{"key": "A", "v": 2}]})
+        assert out["env"] == [{"key": "A", "v": 2}]
+
+    def test_validate(self):
+        assert validate_overlay({"a": 1}) == []
+        assert validate_overlay({DEL("a"): "not-empty"})
+        assert validate_overlay("nope")
+        assert validate_overlay({PUT(""): 1})
+
+    def test_role_attachment(self):
+        role = Role(name="r", image="i")
+        set_overlay(role, "gke", {"a": 1})
+        assert get_overlay(role, "gke") == {"a": 1}
+        assert get_overlay(role, "slurm") is None
+        with pytest.raises(ValueError):
+            set_overlay(role, "gke", {DEL("x"): "bad"})
+
+
+@dataclass
+class _Nested(StructuredOpts):
+    context: str = "default-ctx"
+    """kube context to use."""
+
+
+@dataclass
+class _MyOpts(StructuredOpts):
+    namespace: str = "default"
+    """namespace to submit into."""
+    replicas: int = 1
+    """number of replicas."""
+    queue: Optional[str] = None
+    """queue name."""
+    k8s: _Nested = field(default_factory=_Nested)
+
+
+class TestStructuredOpts:
+    def test_to_runopts_docs_and_defaults(self):
+        opts = _MyOpts.to_runopts()
+        d = dict(opts)
+        assert d["namespace"].default == "default"
+        assert d["namespace"].help == "namespace to submit into."
+        assert d["replicas"].opt_type is int
+        assert "k8s.context" in d  # nested group flattened
+
+    def test_from_cfg(self):
+        cfg = _MyOpts.to_runopts().resolve(
+            {"namespace": "ml", "replicas": "3", "k8s.context": "prod"}
+        )
+        typed = _MyOpts.from_cfg(cfg)
+        assert typed.namespace == "ml"
+        assert typed.replicas == 3
+        assert typed.k8s.context == "prod"
+        assert typed["namespace"] == "ml"  # mapping protocol
+        assert typed.get("nope", "dflt") == "dflt"
+
+
+class TestWorkspaceWalk:
+    def make_tree(self, tmp_path):
+        (tmp_path / "src").mkdir()
+        (tmp_path / "src" / "main.py").write_text("print()")
+        (tmp_path / "data").mkdir()
+        (tmp_path / "data" / "big.bin").write_text("x" * 10)
+        (tmp_path / "keep.bin").write_text("k")
+        (tmp_path / ".git").mkdir()
+        (tmp_path / ".git" / "obj").write_text("g")
+        (tmp_path / ".tpxignore").write_text("*.bin\n!keep.bin\n.git\ndata\n")
+        return tmp_path
+
+    def test_ignore_with_negation(self, tmp_path):
+        root = self.make_tree(tmp_path)
+        rels = {rel for _, rel in walk_workspace(str(root))}
+        assert rels == {"src/main.py", "keep.bin"}
+
+    def test_copy_workspace(self, tmp_path):
+        root = self.make_tree(tmp_path)
+        dst = tmp_path / "out"
+        n = copy_workspace(Workspace(projects={str(root): "app"}), str(dst))
+        assert n == 2
+        assert (dst / "app" / "src" / "main.py").exists()
+
+    def test_dir_mixin_points_image(self, tmp_path):
+        root = self.make_tree(tmp_path)
+
+        class S(DirWorkspaceMixin):
+            pass
+
+        role = Role(name="r", image="orig")
+        S().build_workspace_and_update_role(
+            role, Workspace(projects={str(root): ""}), {"job_dir": str(tmp_path / "jd")}
+        )
+        assert role.image == str(tmp_path / "jd" / "workspace")
+
+    def test_build_context_generates_dockerfile(self, tmp_path):
+        root = self.make_tree(tmp_path)
+        buf = build_context("base:1", Workspace(projects={str(root): ""}))
+        with tarfile.open(fileobj=buf) as tar:
+            names = tar.getnames()
+            assert "Dockerfile" in names
+            assert "src/main.py" in names
+            df = tar.extractfile("Dockerfile").read().decode()
+            assert "COPY . ." in df
+
+    def test_build_context_custom_dockerfile(self, tmp_path):
+        root = self.make_tree(tmp_path)
+        (root / "Dockerfile.tpx").write_text("FROM custom\n")
+        buf = build_context("base:1", Workspace(projects={str(root): ""}))
+        with tarfile.open(fileobj=buf) as tar:
+            df = tar.extractfile("Dockerfile").read().decode()
+            assert df == "FROM custom\n"

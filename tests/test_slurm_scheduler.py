@@ -1,0 +1,213 @@
+"""Slurm scheduler tests with canned CLI output (reference analog:
+slurm_scheduler_test.py + slurm-squeue-output.json fixtures)."""
+
+import json
+import subprocess
+from unittest import mock
+
+import pytest
+
+from torchx_tpu.schedulers.slurm_scheduler import (
+    SlurmScheduler,
+    slurm_state,
+)
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppState,
+    Resource,
+    Role,
+    TpuSlice,
+    macros,
+)
+
+
+def tpu_role(**kwargs) -> Role:
+    defaults = dict(
+        name="trainer",
+        image="/shared/job",
+        entrypoint="python",
+        args=["-m", "train", f"--id={macros.app_id}"],
+        resource=Resource(cpu=208, memMB=1000, tpu=TpuSlice("v5p", 8)),
+    )
+    defaults.update(kwargs)
+    return Role(**defaults)
+
+
+def completed(stdout="", rc=0, stderr=""):
+    return subprocess.CompletedProcess([], returncode=rc, stdout=stdout, stderr=stderr)
+
+
+@pytest.fixture
+def sched():
+    return SlurmScheduler("test")
+
+
+class TestSbatchMaterialization:
+    def test_tpu_role_het_groups(self, sched):
+        app = AppDef(name="t", roles=[tpu_role()])
+        info = sched.submit_dryrun(app, {})
+        script = info.request.script()
+        # v5p-16: 8 chips -> 2 hosts -> 2 het groups
+        assert script.count("#SBATCH hetjob") == 1
+        assert script.count("--het-group=") == 2
+        assert "--cpus-per-task=208" in script
+        assert "TPX_COORDINATOR_HOST=$(scontrol show hostnames" in script
+        assert 'TPX_REPLICA_ID="0"' in script and 'TPX_REPLICA_ID="1"' in script
+        assert "--kill-on-bad-exit=1" in script
+
+    def test_macro_substitution_defers_job_id(self, sched):
+        app = AppDef(name="t", roles=[tpu_role()])
+        script = sched.submit_dryrun(app, {}).request.script()
+        # double-quoted, not single-quoted: the macro must expand at runtime
+        assert '"--id=${SLURM_JOB_ID}"' in script
+        assert "'--id=${SLURM_JOB_ID}'" not in script
+
+    def test_per_group_job_names(self, sched):
+        app = AppDef(name="t", roles=[tpu_role()])
+        script = sched.submit_dryrun(app, {}).request.script()
+        assert "#SBATCH --job-name=trainer-0" in script
+        assert "#SBATCH --job-name=trainer-1" in script
+
+    def test_log_files_use_leader_job_id(self, sched):
+        app = AppDef(name="t", roles=[tpu_role()])
+        script = sched.submit_dryrun(app, {}).request.script()
+        assert "--output=slurm-${SLURM_JOB_ID}-trainer-0.out" in script
+        assert "%j" not in script
+
+    def test_requeue_on_retries(self, sched):
+        app = AppDef(name="t", roles=[tpu_role(max_retries=2)])
+        script = sched.submit_dryrun(app, {}).request.script()
+        assert "scontrol requeue" in script
+        assert "TPX_MAX_RETRIES=2" in script
+        assert "trap tpx_requeue ERR" in script
+
+    def test_no_requeue_without_retries(self, sched):
+        app = AppDef(name="t", roles=[tpu_role()])
+        assert "requeue" not in sched.submit_dryrun(app, {}).request.script()
+
+    def test_partition_time_nomem(self, sched):
+        app = AppDef(name="t", roles=[tpu_role()])
+        script = sched.submit_dryrun(
+            app, {"partition": "tpu", "time": "2:00:00", "nomem": True}
+        ).request.script()
+        assert "--partition=tpu" in script
+        assert "--time=2:00:00" in script
+        assert "--mem=" not in script
+
+    def test_schedule_parses_job_id(self, sched, tmp_path, monkeypatch):
+        monkeypatch.setattr(
+            sched, "_run_cmd", lambda cmd, **kw: completed(stdout="1234\n")
+        )
+        monkeypatch.setattr(
+            "torchx_tpu.schedulers.slurm_scheduler._registry_path",
+            lambda: str(tmp_path / "jobdirs"),
+        )
+        app = AppDef(name="t", roles=[tpu_role()])
+        info = sched.submit_dryrun(app, {"job_dir": str(tmp_path)})
+        app_id = sched.schedule(info)
+        assert app_id == "1234"
+        assert (tmp_path / "tpx_sbatch.sh").exists()
+        assert "1234 = " in (tmp_path / "jobdirs").read_text()
+
+    def test_schedule_sbatch_failure(self, sched, tmp_path, monkeypatch):
+        monkeypatch.setattr(
+            sched, "_run_cmd", lambda cmd, **kw: completed(rc=1, stderr="bad partition")
+        )
+        app = AppDef(name="t", roles=[tpu_role()])
+        info = sched.submit_dryrun(app, {"job_dir": str(tmp_path)})
+        with pytest.raises(RuntimeError, match="bad partition"):
+            sched.schedule(info)
+
+
+class TestSlurmDescribe:
+    def test_describe_squeue(self, sched, monkeypatch):
+        payload = {
+            "jobs": [
+                {
+                    "job_id": 1234,
+                    "name": "trainer-0",
+                    "job_state": ["RUNNING"],
+                    "job_resources": {"nodes": "node01"},
+                },
+                {
+                    "job_id": 1235,
+                    "name": "trainer-1",
+                    "job_state": "RUNNING",
+                },
+            ]
+        }
+        monkeypatch.setattr(
+            sched, "_run_cmd", lambda cmd, **kw: completed(stdout=json.dumps(payload))
+        )
+        resp = sched.describe("1234")
+        assert resp.state == AppState.RUNNING
+        (rs,) = resp.roles_statuses
+        assert rs.role == "trainer" and len(rs.replicas) == 2
+        assert rs.replicas[0].hostname == "node01"
+
+    def test_describe_falls_back_to_sacct(self, sched, monkeypatch):
+        sacct_out = (
+            "JobID|JobName|State\n"
+            "1234+0|trainer-0|COMPLETED\n"
+            "1234+0.batch|batch|COMPLETED\n"
+            "1234+1|trainer-1|COMPLETED\n"
+        )
+        def run_cmd(cmd, **kw):
+            if cmd[0] == "squeue":
+                return completed(rc=1, stderr="Invalid job id")
+            return completed(stdout=sacct_out)
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        resp = sched.describe("1234")
+        assert resp.state == AppState.SUCCEEDED
+        (rs,) = resp.roles_statuses
+        assert len(rs.replicas) == 2
+
+    def test_describe_failed_dominates(self, sched, monkeypatch):
+        sacct_out = (
+            "JobID|JobName|State\n"
+            "1234+0|trainer-0|COMPLETED\n"
+            "1234+1|trainer-1|FAILED\n"
+        )
+        def run_cmd(cmd, **kw):
+            if cmd[0] == "squeue":
+                return completed(rc=1)
+            return completed(stdout=sacct_out)
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        assert sched.describe("1234").state == AppState.FAILED
+
+    def test_describe_missing(self, sched, monkeypatch):
+        monkeypatch.setattr(sched, "_run_cmd", lambda cmd, **kw: completed(rc=1))
+        assert sched.describe("9999") is None
+
+    def test_cancel(self, sched, monkeypatch):
+        calls = []
+
+        def run_cmd(cmd, **kw):
+            calls.append(cmd)
+            if cmd[0] == "squeue":
+                return completed(stdout=json.dumps({"jobs": [{"job_id": 1, "name": "x", "job_state": "RUNNING"}]}))
+            return completed()
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        sched.cancel("1")
+        assert ["scancel", "1"] in calls
+
+    def test_log_iter(self, sched, tmp_path, monkeypatch):
+        monkeypatch.setattr(
+            "torchx_tpu.schedulers.slurm_scheduler._registry_path",
+            lambda: str(tmp_path / "jobdirs"),
+        )
+        (tmp_path / "jobdirs").write_text(f"77 = {tmp_path}\n")
+        (tmp_path / "slurm-77-trainer-0.out").write_text("line1\nline2\n")
+        lines = list(sched.log_iter("77", "trainer", 0))
+        assert lines == ["line1", "line2"]
+
+
+class TestStateMap:
+    def test_states(self):
+        assert slurm_state("COMPLETED") == AppState.SUCCEEDED
+        assert slurm_state("CANCELLED by 1000") == AppState.CANCELLED
+        assert slurm_state("NODE_FAIL") == AppState.FAILED
+        assert slurm_state("WEIRD") == AppState.UNKNOWN

@@ -16,6 +16,9 @@ SchedulerFactory = Callable[..., Scheduler]
 # name -> "module:function". Order matters: first is the default.
 DEFAULT_SCHEDULER_MODULES: dict[str, str] = {
     "local": "torchx_tpu.schedulers.local_scheduler:create_scheduler",
+    "gke": "torchx_tpu.schedulers.gke_scheduler:create_scheduler",
+    "slurm": "torchx_tpu.schedulers.slurm_scheduler:create_scheduler",
+    "local_docker": "torchx_tpu.schedulers.docker_scheduler:create_scheduler",
 }
 
 

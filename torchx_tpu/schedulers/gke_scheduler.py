@@ -1,0 +1,681 @@
+"""GKE scheduler: gang-schedule TPU pod slices via JobSet (+ optional Kueue).
+
+Reference analog: torchx/schedulers/kubernetes_scheduler.py (1131 LoC),
+which maps AppDef -> Volcano Job CRD. The TPU-first redesign maps AppDef ->
+**JobSet** (jobset.x-k8s.io/v1alpha2), the stack GKE documents for TPU
+training:
+
+* one ReplicatedJob per role; for TPU roles each Job is an **Indexed Job**
+  with ``completions = parallelism = slice.hosts`` (one pod per TPU-VM
+  host) — the all-or-nothing unit GKE's TPU node pools expose;
+* ``Role.num_replicas`` > 1 on a TPU role means N slices (multi-slice DCN
+  training): ``replicatedJob.replicas = N`` and megascale env wiring;
+* TPU placement via node selectors ``cloud.google.com/gke-tpu-accelerator``
+  + ``cloud.google.com/gke-tpu-topology`` and the ``google.com/tpu``
+  resource limit (chips per host) — the role the Volcano task + nvidia.com
+  /gpu limits play in the reference (kubernetes_scheduler.py:330-381);
+* gang semantics come from JobSet's failure policy (any pod failure
+  restarts the whole set, up to ``max_retries``) plus optional Kueue queue
+  admission (``kueue.x-k8s.io/queue-name`` label) in place of Volcano
+  gang scheduling (reference :553-569);
+* rendezvous: JobSet's per-job headless service gives pods stable DNS;
+  the coordinator address is the role-0/job-0/pod-0 DNS name injected as
+  ``TPX_COORDINATOR_HOST`` (analog of ``VC_{role}_0_HOSTS``, reference
+  :524). ``macros.replica_id`` substitutes to ``$(TPX_REPLICA_ID)`` which
+  kubelet expands from the Job completion index at runtime.
+
+The kubernetes client import is deferred and injectable: all request
+materialization is plain dicts, so dryrun tests run with no cluster
+(reference test strategy, kubernetes_scheduler_test.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Mapping, Optional, TYPE_CHECKING
+
+from torchx_tpu import settings
+from torchx_tpu.schedulers.api import (
+    DescribeAppResponse,
+    ListAppResponse,
+    Scheduler,
+    Stream,
+    filter_regex,
+)
+from torchx_tpu.schedulers.ids import cleanup, make_unique, random_id
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppDryRunInfo,
+    AppState,
+    BindMount,
+    CfgVal,
+    DeviceMount,
+    ReplicaStatus,
+    RetryPolicy,
+    Role,
+    RoleStatus,
+    VolumeMount,
+    macros,
+    runopts,
+)
+from torchx_tpu.specs.overlays import apply_overlay, get_overlay
+from torchx_tpu.workspace.docker_workspace import DockerWorkspaceMixin
+
+if TYPE_CHECKING:
+    from kubernetes.client import ApiClient
+
+logger = logging.getLogger(__name__)
+
+JOBSET_GROUP = "jobset.x-k8s.io"
+JOBSET_VERSION = "v1alpha2"
+JOBSET_PLURAL = "jobsets"
+
+# accelerator node-selector values per generation (GKE naming)
+GKE_TPU_ACCELERATORS = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+# node overhead subtracted from requests so pods fit on the node after
+# kubelet reservations (reference kubernetes_scheduler.py:155-161)
+RESERVED_MILLICPU = 100
+RESERVED_MEMMB = 1024
+
+# JobSet condition type -> AppState (reference state maps :203-254)
+JOBSET_STATE_MAP = {
+    "Completed": AppState.SUCCEEDED,
+    "Failed": AppState.FAILED,
+    "Suspended": AppState.PENDING,
+    "StartupPolicyCompleted": AppState.RUNNING,
+}
+
+POD_STATE_MAP = {
+    "Pending": AppState.PENDING,
+    "Running": AppState.RUNNING,
+    "Succeeded": AppState.SUCCEEDED,
+    "Failed": AppState.FAILED,
+    "Unknown": AppState.UNKNOWN,
+}
+
+LABEL_APP_NAME = "tpx.sh/app-name"
+LABEL_ROLE_NAME = "tpx.sh/role-name"
+LABEL_VERSION = "tpx.sh/version"
+ANNOTATION_APP = "tpx.sh/appdef"
+
+
+@dataclass
+class GKEJob:
+    """Materialized request: the JobSet resource + images to push."""
+
+    namespace: str
+    resource: dict[str, Any]
+    images_to_push: dict[str, tuple[str, str]] = field(default_factory=dict)
+
+    def __str__(self) -> str:
+        return json.dumps(self.resource, indent=2, default=str)
+
+
+# =========================================================================
+# Request materialization (pure functions -> testable without a cluster)
+# =========================================================================
+
+
+def sanitize_name(name: str, max_len: int = 53) -> str:
+    """DNS-1123 subdomain, shortened to leave room for JobSet suffixes
+    (jobset adds -{job}-{index}-{podindex}; the 63-char pod-name check the
+    reference does at :862-889 is enforced here by budgeting upfront)."""
+    name = cleanup(name)
+    if len(name) > max_len:
+        name = name[: max_len - 6].rstrip("-") + "-" + random_id(5)
+    return name
+
+
+def role_to_container(role: Role) -> dict[str, Any]:
+    tpu = role.resource.tpu
+    limits: dict[str, Any] = {}
+    requests: dict[str, Any] = {}
+    if role.resource.cpu > 0:
+        mcpu = int(role.resource.cpu * 1000)
+        limits["cpu"] = f"{mcpu}m"
+        requests["cpu"] = f"{max(0, mcpu - RESERVED_MILLICPU)}m"
+    if role.resource.memMB > 0:
+        limits["memory"] = f"{role.resource.memMB}M"
+        requests["memory"] = f"{max(0, role.resource.memMB - RESERVED_MEMMB)}M"
+    if tpu is not None:
+        limits["google.com/tpu"] = tpu.chips_per_host
+        requests["google.com/tpu"] = tpu.chips_per_host
+    for dev, count in role.resource.devices.items():
+        limits[dev] = count
+        requests[dev] = count
+
+    volume_mounts = []
+    for i, m in enumerate(role.mounts):
+        if isinstance(m, BindMount):
+            volume_mounts.append(
+                {"name": f"mount-{i}", "mountPath": m.dst_path, "readOnly": m.read_only}
+            )
+        elif isinstance(m, VolumeMount):
+            volume_mounts.append(
+                {"name": f"mount-{i}", "mountPath": m.dst_path, "readOnly": m.read_only}
+            )
+        elif isinstance(m, DeviceMount):
+            volume_mounts.append(
+                {
+                    "name": f"mount-{i}",
+                    "mountPath": m.dst_path,
+                    "readOnly": "w" not in m.permissions,
+                }
+            )
+    # /dev/shm tmpfs for framework IPC (reference :370-381)
+    volume_mounts.append({"name": "dshm", "mountPath": "/dev/shm"})
+
+    env = [{"name": k, "value": v} for k, v in role.env.items()]
+    ports = [
+        {"name": name[:15], "containerPort": port}
+        for name, port in role.port_map.items()
+    ]
+    return {
+        "name": sanitize_name(role.name),
+        "image": role.image,
+        "command": [role.entrypoint, *role.args],
+        "env": env,
+        "ports": ports,
+        "resources": {"limits": limits, "requests": requests},
+        "volumeMounts": volume_mounts,
+    }
+
+
+def role_to_pod_template(
+    role: Role,
+    app_name: str,
+    coordinator_host: str,
+    coordinator_port: int,
+    service_account: Optional[str],
+) -> dict[str, Any]:
+    """Pod template for one TPU-VM host (or CPU replica) of the role."""
+    tpu = role.resource.tpu
+    num_hosts = tpu.hosts if tpu else role.num_replicas
+
+    container = role_to_container(role)
+    # gang identity: completion index -> TPX_REPLICA_ID; kubelet expands
+    # $(JOB_COMPLETION_INDEX) references in env/args at pod start
+    container["env"] = [
+        {
+            "name": "JOB_COMPLETION_INDEX",
+            "valueFrom": {
+                "fieldRef": {
+                    "fieldPath": "metadata.annotations['batch.kubernetes.io/job-completion-index']"
+                }
+            },
+        },
+        {"name": settings.ENV_TPX_REPLICA_ID, "value": "$(JOB_COMPLETION_INDEX)"},
+        {"name": settings.ENV_TPX_ROLE_NAME, "value": role.name},
+        {"name": settings.ENV_TPX_NUM_REPLICAS, "value": str(num_hosts)},
+        {"name": settings.ENV_TPX_COORDINATOR_HOST, "value": coordinator_host},
+        {"name": settings.ENV_TPX_APP_ID, "value": app_name},
+        {"name": settings.ENV_TPX_ERROR_FILE, "value": "/tmp/tpx_error.json"},
+        *container["env"],
+    ]
+
+    volumes: list[dict[str, Any]] = []
+    for i, m in enumerate(role.mounts):
+        if isinstance(m, BindMount):
+            volumes.append(
+                {"name": f"mount-{i}", "hostPath": {"path": m.src_path}}
+            )
+        elif isinstance(m, VolumeMount):
+            volumes.append(
+                {
+                    "name": f"mount-{i}",
+                    "persistentVolumeClaim": {"claimName": m.src},
+                }
+            )
+        elif isinstance(m, DeviceMount):
+            volumes.append(
+                {"name": f"mount-{i}", "hostPath": {"path": m.src_path}}
+            )
+    volumes.append({"name": "dshm", "emptyDir": {"medium": "Memory"}})
+
+    spec: dict[str, Any] = {
+        "restartPolicy": "Never",
+        "containers": [container],
+        "volumes": volumes,
+    }
+    if service_account:
+        spec["serviceAccountName"] = service_account
+    if tpu is not None:
+        spec["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": GKE_TPU_ACCELERATORS.get(
+                tpu.accelerator, f"tpu-{tpu.accelerator}-slice"
+            ),
+            "cloud.google.com/gke-tpu-topology": tpu.default_topology(),
+        }
+        # TPU nodes are tainted; tolerate the dedicated taint
+        spec["tolerations"] = [
+            {"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"}
+        ]
+
+    return {
+        "metadata": {
+            "labels": {
+                LABEL_APP_NAME: app_name,
+                LABEL_ROLE_NAME: sanitize_name(role.name),
+            },
+        },
+        "spec": spec,
+    }
+
+
+def app_to_jobset(
+    app: AppDef,
+    app_name: str,
+    namespace: str,
+    queue: Optional[str],
+    service_account: Optional[str],
+    coordinator_port: int = settings.TPX_COORDINATOR_PORT,
+) -> dict[str, Any]:
+    """AppDef -> JobSet resource dict."""
+    replicated_jobs = []
+    max_retries = max((r.max_retries for r in app.roles), default=0)
+
+    for role in app.roles:
+        role_name = sanitize_name(role.name)
+        tpu = role.resource.tpu
+        hosts = tpu.hosts if tpu else 1
+        # For TPU roles: one Job per slice (replicas=num_replicas), each an
+        # indexed job over the slice's hosts. CPU roles: one job, indexed
+        # over num_replicas pods.
+        if tpu:
+            job_replicas, completions = role.num_replicas, hosts
+        else:
+            job_replicas, completions = 1, role.num_replicas
+
+        # JobSet DNS: {jobset}-{replicatedJob}-{jobIndex}-{podIndex}.{jobset}
+        role0 = sanitize_name(app.roles[0].name)
+        coordinator_host = f"{app_name}-{role0}-0-0.{app_name}"
+
+        values = macros.Values(
+            img_root="",
+            app_id=app_name,
+            replica_id=f"$({settings.ENV_TPX_REPLICA_ID})",
+            num_replicas=str(completions),
+            coordinator_env=settings.ENV_TPX_COORDINATOR_HOST,
+        )
+        srole = values.apply(role)
+        if tpu and role.num_replicas > 1:
+            # multi-slice: every job gets DCN identity via the jobset-level
+            # env JobSet injects (JOB_INDEX); megascale coordinator = slice 0
+            srole.env.setdefault(
+                settings.ENV_MEGASCALE_NUM_SLICES, str(role.num_replicas)
+            )
+            srole.env.setdefault(
+                settings.ENV_MEGASCALE_COORDINATOR_ADDRESS,
+                f"{coordinator_host}:{coordinator_port + 1}",
+            )
+
+        pod_template = role_to_pod_template(
+            srole, app_name, coordinator_host, coordinator_port, service_account
+        )
+
+        job_spec: dict[str, Any] = {
+            "parallelism": completions,
+            "completions": completions,
+            "completionMode": "Indexed",
+            "backoffLimit": 0,  # gang: restarts are JobSet-level
+            "template": pod_template,
+        }
+        replicated_jobs.append(
+            {
+                "name": role_name,
+                "replicas": job_replicas,
+                "template": {"spec": job_spec},
+            }
+        )
+
+    jobset_spec: dict[str, Any] = {
+        "replicatedJobs": replicated_jobs,
+        "successPolicy": {"operator": "All", "targetReplicatedJobs": []},
+    }
+    if max_retries > 0:
+        jobset_spec["failurePolicy"] = {"maxRestarts": max_retries}
+
+    metadata: dict[str, Any] = {
+        "name": app_name,
+        "namespace": namespace,
+        "labels": {LABEL_APP_NAME: app_name},
+    }
+    if queue:
+        metadata.setdefault("labels", {})["kueue.x-k8s.io/queue-name"] = queue
+        jobset_spec["suspend"] = True  # Kueue admits by unsuspending
+
+    resource = {
+        "apiVersion": f"{JOBSET_GROUP}/{JOBSET_VERSION}",
+        "kind": "JobSet",
+        "metadata": metadata,
+        "spec": jobset_spec,
+    }
+
+    # per-role raw-request overlays (reference :164-192)
+    for role in app.roles:
+        overlay = get_overlay(role, "gke")
+        if overlay:
+            resource = apply_overlay(resource, overlay)
+    return resource
+
+
+# =========================================================================
+# Scheduler
+# =========================================================================
+
+
+class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
+    """Submits AppDefs as JobSets to a GKE (or any JobSet-enabled) cluster."""
+
+    def __init__(
+        self,
+        session_name: str,
+        client: Optional["ApiClient"] = None,
+        docker_client: Optional[Any] = None,
+    ) -> None:
+        super().__init__(docker_client=docker_client, backend="gke", session_name=session_name)
+        self._client = client
+
+    # -- k8s clients (deferred import; injectable) -------------------------
+
+    def _api_client(self) -> "ApiClient":
+        if self._client is None:
+            from kubernetes import client as k8s_client, config as k8s_config
+
+            try:
+                k8s_config.load_kube_config()
+            except Exception:  # noqa: BLE001 - in-cluster fallback
+                k8s_config.load_incluster_config()
+            self._client = k8s_client.ApiClient()
+        return self._client
+
+    def _custom_objects_api(self):  # noqa: ANN202
+        from kubernetes.client import CustomObjectsApi
+
+        return CustomObjectsApi(self._api_client())
+
+    def _core_api(self):  # noqa: ANN202
+        from kubernetes.client import CoreV1Api
+
+        return CoreV1Api(self._api_client())
+
+    # -- runopts ----------------------------------------------------------
+
+    def run_opts(self) -> runopts:
+        opts = runopts()
+        opts.add("namespace", type_=str, help="k8s namespace", default="default")
+        opts.add(
+            "queue",
+            type_=str,
+            help="Kueue LocalQueue name for gang admission (jobs submit"
+            " suspended and Kueue unsuspends when the full slice fits)",
+            default=None,
+        )
+        opts.add(
+            "service_account",
+            type_=str,
+            help="k8s service account for the pods",
+            default=None,
+        )
+        opts.add(
+            "coordinator_port",
+            type_=int,
+            help="jax.distributed coordinator port",
+            default=settings.TPX_COORDINATOR_PORT,
+        )
+        return opts | self.workspace_opts()
+
+    # -- dryrun / schedule -------------------------------------------------
+
+    def _submit_dryrun(
+        self, app: AppDef, cfg: Mapping[str, CfgVal]
+    ) -> AppDryRunInfo[GKEJob]:
+        app_name = sanitize_name(make_unique(app.name))
+        images_to_push = self.dryrun_push_images(app, cfg)
+        resource = app_to_jobset(
+            app,
+            app_name,
+            namespace=str(cfg.get("namespace") or "default"),
+            queue=cfg.get("queue"),  # type: ignore[arg-type]
+            service_account=cfg.get("service_account"),  # type: ignore[arg-type]
+            coordinator_port=int(cfg.get("coordinator_port") or settings.TPX_COORDINATOR_PORT),
+        )
+        req = GKEJob(
+            namespace=str(cfg.get("namespace") or "default"),
+            resource=resource,
+            images_to_push=images_to_push,
+        )
+        return AppDryRunInfo(req)
+
+    def schedule(self, dryrun_info: AppDryRunInfo[GKEJob]) -> str:
+        req = dryrun_info.request
+        self.push_images(req.images_to_push)
+        from kubernetes.client.rest import ApiException
+
+        try:
+            self._custom_objects_api().create_namespaced_custom_object(
+                group=JOBSET_GROUP,
+                version=JOBSET_VERSION,
+                namespace=req.namespace,
+                plural=JOBSET_PLURAL,
+                body=req.resource,
+            )
+        except ApiException as e:
+            if e.status == 409:
+                raise ValueError(
+                    f"jobset {req.resource['metadata']['name']} already exists"
+                ) from e
+            raise
+        return f"{req.namespace}:{req.resource['metadata']['name']}"
+
+    # -- monitoring --------------------------------------------------------
+
+    @staticmethod
+    def _parse_app_id(app_id: str) -> tuple[str, str]:
+        namespace, _, name = app_id.partition(":")
+        if not name:
+            raise ValueError(f"invalid gke app id {app_id!r}; expected namespace:name")
+        return namespace, name
+
+    def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
+        namespace, name = self._parse_app_id(app_id)
+        from kubernetes.client.rest import ApiException
+
+        try:
+            jobset = self._custom_objects_api().get_namespaced_custom_object(
+                group=JOBSET_GROUP,
+                version=JOBSET_VERSION,
+                namespace=namespace,
+                plural=JOBSET_PLURAL,
+                name=name,
+            )
+        except ApiException as e:
+            if e.status == 404:
+                return None
+            raise
+        return describe_jobset(jobset, self._list_pods(namespace, name))
+
+    def _list_pods(self, namespace: str, name: str) -> list[dict[str, Any]]:
+        try:
+            pods = self._core_api().list_namespaced_pod(
+                namespace=namespace,
+                label_selector=f"jobset.sigs.k8s.io/jobset-name={name}",
+            )
+            return [p.to_dict() if hasattr(p, "to_dict") else p for p in pods.items]
+        except Exception:  # noqa: BLE001 - pod detail is best-effort
+            return []
+
+    def list(self) -> list[ListAppResponse]:
+        out = []
+        jobsets = self._custom_objects_api().list_cluster_custom_object(
+            group=JOBSET_GROUP, version=JOBSET_VERSION, plural=JOBSET_PLURAL
+        )
+        for js in jobsets.get("items", []):
+            meta = js.get("metadata", {})
+            out.append(
+                ListAppResponse(
+                    app_id=f"{meta.get('namespace')}:{meta.get('name')}",
+                    state=jobset_state(js),
+                    name=meta.get("name", ""),
+                )
+            )
+        return out
+
+    def _cancel_existing(self, app_id: str) -> None:
+        """Suspend (preserves spec + logs) rather than delete (reference
+        cancel=abort-preserving-spec, :901-934)."""
+        namespace, name = self._parse_app_id(app_id)
+        self._custom_objects_api().patch_namespaced_custom_object(
+            group=JOBSET_GROUP,
+            version=JOBSET_VERSION,
+            namespace=namespace,
+            plural=JOBSET_PLURAL,
+            name=name,
+            body={"spec": {"suspend": True}},
+        )
+
+    def delete(self, app_id: str) -> None:
+        namespace, name = self._parse_app_id(app_id)
+        from kubernetes.client.rest import ApiException
+
+        try:
+            self._custom_objects_api().delete_namespaced_custom_object(
+                group=JOBSET_GROUP,
+                version=JOBSET_VERSION,
+                namespace=namespace,
+                plural=JOBSET_PLURAL,
+                name=name,
+            )
+        except ApiException as e:
+            if e.status != 404:
+                raise
+
+    def log_iter(
+        self,
+        app_id: str,
+        role_name: str,
+        k: int = 0,
+        regex: Optional[str] = None,
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+        should_tail: bool = False,
+        streams: Optional[Stream] = None,
+    ) -> Iterable[str]:
+        namespace, name = self._parse_app_id(app_id)
+        pod_name = self._resolve_pod_name(namespace, name, role_name, k)
+        core = self._core_api()
+        resp = core.read_namespaced_pod_log(
+            name=pod_name,
+            namespace=namespace,
+            follow=should_tail,
+            _preload_content=False,
+        )
+        lines = (ln.decode("utf-8", errors="replace").rstrip("\n") for ln in resp)
+        if regex:
+            lines = filter_regex(regex, lines)
+        return lines
+
+    def _resolve_pod_name(
+        self, namespace: str, name: str, role_name: str, k: int
+    ) -> str:
+        """Job-created pods carry a random suffix, so the name cannot be
+        computed — resolve replica ``k`` by listing the jobset's pods for
+        the role and ordering by (job index, completion index); across
+        multi-slice jobs ``k`` counts hosts globally."""
+        pods = self._core_api().list_namespaced_pod(
+            namespace=namespace,
+            label_selector=(
+                f"jobset.sigs.k8s.io/jobset-name={name},"
+                f"jobset.sigs.k8s.io/replicatedjob-name={sanitize_name(role_name)}"
+            ),
+        )
+        indexed: list[tuple[int, int, str]] = []
+        for pod in pods.items:
+            meta = pod.metadata
+            labels = meta.labels or {}
+            annotations = meta.annotations or {}
+            job_index = int(labels.get("jobset.sigs.k8s.io/job-index", 0))
+            completion_index = int(
+                annotations.get("batch.kubernetes.io/job-completion-index", 0)
+            )
+            indexed.append((job_index, completion_index, meta.name))
+        indexed.sort()
+        if k >= len(indexed):
+            raise ValueError(
+                f"replica {k} of role {role_name} not found"
+                f" ({len(indexed)} pods exist for jobset {name})"
+            )
+        return indexed[k][2]
+
+
+# =========================================================================
+# Status mapping (pure functions over dicts -> fixture-testable)
+# =========================================================================
+
+
+def jobset_state(jobset: Mapping[str, Any]) -> AppState:
+    status = jobset.get("status") or {}
+    conditions = status.get("conditions") or []
+    for cond in reversed(conditions):
+        if cond.get("status") == "True" and cond.get("type") in JOBSET_STATE_MAP:
+            return JOBSET_STATE_MAP[cond["type"]]
+    if jobset.get("spec", {}).get("suspend"):
+        return AppState.PENDING
+    if status.get("replicatedJobsStatus"):
+        return AppState.RUNNING
+    return AppState.PENDING if status else AppState.SUBMITTED
+
+
+def describe_jobset(
+    jobset: Mapping[str, Any], pods: list[Mapping[str, Any]]
+) -> DescribeAppResponse:
+    state = jobset_state(jobset)
+    status = jobset.get("status") or {}
+    roles: dict[str, RoleStatus] = {}
+    for pod in pods:
+        meta = pod.get("metadata") or {}
+        labels = meta.get("labels") or {}
+        role = labels.get(LABEL_ROLE_NAME) or labels.get(
+            "jobset.sigs.k8s.io/replicatedjob-name", "unknown"
+        )
+        idx = int(
+            (meta.get("annotations") or {}).get(
+                "batch.kubernetes.io/job-completion-index", 0
+            )
+        )
+        phase = ((pod.get("status") or {}).get("phase")) or "Unknown"
+        pod_ip = (pod.get("status") or {}).get("pod_ip") or (
+            pod.get("status") or {}
+        ).get("podIP", "")
+        roles.setdefault(role, RoleStatus(role=role)).replicas.append(
+            ReplicaStatus(
+                id=idx,
+                state=POD_STATE_MAP.get(phase, AppState.UNKNOWN),
+                role=role,
+                hostname=pod_ip or meta.get("name", ""),
+            )
+        )
+    restarts = int(status.get("restarts", 0) or 0)
+    return DescribeAppResponse(
+        app_id=f"{jobset.get('metadata', {}).get('namespace')}:"
+        f"{jobset.get('metadata', {}).get('name')}",
+        state=state,
+        num_restarts=restarts,
+        roles_statuses=sorted(roles.values(), key=lambda r: r.role),
+    )
+
+
+def create_scheduler(session_name: str, **kwargs: Any) -> GKEScheduler:
+    known = {"client", "docker_client"}
+    return GKEScheduler(
+        session_name=session_name,
+        **{k: v for k, v in kwargs.items() if k in known},
+    )

@@ -1,0 +1,482 @@
+"""Slurm scheduler: AppDef -> one heterogeneous sbatch job.
+
+Reference analog: torchx/schedulers/slurm_scheduler.py (931 LoC). Kept
+design: every replica is a hetjob group materialized into a bash script
+with ``#SBATCH hetjob`` separators and a single ``srun`` with
+``:``-separated groups (reference :285-330); the coordinator host is
+derived from het-group-0's nodelist (reference rank0 via
+``SLURM_JOB_NODELIST_HET_GROUP_0``, :538); retries requeue the job while
+``TPX_MAX_RETRIES > SLURM_RESTART_COUNT`` (reference :313-327); describe
+goes through ``squeue --json`` falling back to ``sacct --parsable2``
+(reference :572-810); per-replica logs land in
+``slurm-{jobid}-{role}-{replica}.{out,err}`` with a job-dir registry file
+(reference :52,913-931).
+
+TPU twist: a role with a TpuSlice expands to one het group per TPU-VM host
+(``tpu_hosts_for_role``), and each group exports the gang identity env the
+SPMD bootstrap consumes — Slurm on TPU-VM pools is plain multi-node
+CPU scheduling; the chips ride along with the nodes.
+
+All subprocess calls go through ``self._run_cmd`` so tests inject canned
+squeue/sacct/sbatch output (reference test strategy:
+slurm-squeue-output.json fixtures).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shlex
+import subprocess
+import tempfile
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Iterable, Mapping, Optional
+
+from torchx_tpu import settings
+from torchx_tpu.schedulers.api import (
+    DescribeAppResponse,
+    ListAppResponse,
+    Scheduler,
+    Stream,
+    filter_regex,
+    tpu_hosts_for_role,
+)
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppDryRunInfo,
+    AppState,
+    CfgVal,
+    ReplicaStatus,
+    Role,
+    RoleStatus,
+    macros,
+    runopts,
+)
+from torchx_tpu.workspace.dir_workspace import DirWorkspaceMixin
+
+logger = logging.getLogger(__name__)
+
+SLURM_JOB_DIRS_FILE = ".tpxslurmjobdirs"
+
+SLURM_STATE_MAP: dict[str, AppState] = {
+    "PENDING": AppState.PENDING,
+    "CONFIGURING": AppState.PENDING,
+    "REQUEUED": AppState.PENDING,
+    "REQUEUE_FED": AppState.PENDING,
+    "REQUEUE_HOLD": AppState.PENDING,
+    "SUSPENDED": AppState.PENDING,
+    "RUNNING": AppState.RUNNING,
+    "COMPLETING": AppState.RUNNING,
+    "RESIZING": AppState.RUNNING,
+    "SIGNALING": AppState.RUNNING,
+    "STAGE_OUT": AppState.RUNNING,
+    "COMPLETED": AppState.SUCCEEDED,
+    "FAILED": AppState.FAILED,
+    "BOOT_FAIL": AppState.FAILED,
+    "DEADLINE": AppState.FAILED,
+    "NODE_FAIL": AppState.FAILED,
+    "OUT_OF_MEMORY": AppState.FAILED,
+    "TIMEOUT": AppState.FAILED,
+    "PREEMPTED": AppState.FAILED,
+    "CANCELLED": AppState.CANCELLED,
+    "REVOKED": AppState.CANCELLED,
+}
+
+
+def _dquote(s: str) -> str:
+    """Double-quote for bash: metachars are safe but ``$var``/``${var}``
+    still expand (runtime macros depend on this)."""
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"').replace("`", "\\`") + '"'
+
+
+def slurm_state(state_str: str) -> AppState:
+    # sacct can report "CANCELLED by 12345"
+    return SLURM_STATE_MAP.get(state_str.split()[0].rstrip("+"), AppState.UNKNOWN)
+
+
+@dataclass
+class SlurmReplicaRequest:
+    """One hetjob group == one replica (reference :178-271)."""
+
+    name: str  # {role}-{replica}
+    sbatch_opts: list[str]
+    srun_opts: list[str]
+    env: dict[str, str]
+    cmd: list[str]
+
+
+@dataclass
+class SlurmBatchRequest:
+    cmd: list[str]  # sbatch argv (script path appended at schedule time)
+    replicas: list[SlurmReplicaRequest]
+    job_dir: Optional[str]
+    max_retries: int = 0
+
+    def script(self) -> str:
+        return materialize_script(self)
+
+    def __str__(self) -> str:
+        return " ".join(self.cmd) + " <<script>>\n" + self.script()
+
+
+def _role_to_replicas(
+    role: Role, cfg: Mapping[str, CfgVal]
+) -> list[SlurmReplicaRequest]:
+    out = []
+    num = tpu_hosts_for_role(role)
+    partition = cfg.get("partition")
+    for replica_id in range(num):
+        values = macros.Values(
+            img_root=role.image,
+            app_id="${SLURM_JOB_ID}",
+            replica_id=str(replica_id),
+            num_replicas=str(num),
+            coordinator_env=settings.ENV_TPX_COORDINATOR_HOST,
+        )
+        rrole = values.apply(role)
+        # per-group job name: describe() parses {role}-{replica} back out of
+        # squeue/sacct JobName (reference slurm_scheduler.py:260)
+        sbatch_opts = [
+            f"--job-name={role.name}-{replica_id}",
+            "--nodes=1",
+            "--ntasks-per-node=1",
+        ]
+        if partition:
+            sbatch_opts.append(f"--partition={shlex.quote(str(partition))}")
+        if rrole.resource.cpu > 0:
+            sbatch_opts.append(f"--cpus-per-task={int(rrole.resource.cpu)}")
+        if rrole.resource.memMB > 0 and not cfg.get("nomem"):
+            sbatch_opts.append(f"--mem={int(rrole.resource.memMB)}")
+        if cfg.get("time"):
+            sbatch_opts.append(f"--time={cfg['time']}")
+        for cap, val in rrole.resource.capabilities.items():
+            if cap == "slurm.constraint":
+                sbatch_opts.append(f"--constraint={val}")
+        env = dict(rrole.env)
+        env[settings.ENV_TPX_REPLICA_ID] = str(replica_id)
+        env[settings.ENV_TPX_ROLE_NAME] = role.name
+        env[settings.ENV_TPX_NUM_REPLICAS] = str(num)
+        if rrole.resource.tpu is not None:
+            env["TPX_TPU_ACCELERATOR_TYPE"] = rrole.resource.tpu.accelerator_type
+        out.append(
+            SlurmReplicaRequest(
+                name=f"{role.name}-{replica_id}",
+                sbatch_opts=sbatch_opts,
+                srun_opts=["--kill-on-bad-exit=1", "--wait=60"],
+                env=env,
+                cmd=[rrole.entrypoint, *rrole.args],
+            )
+        )
+    return out
+
+
+def materialize_script(req: SlurmBatchRequest) -> str:
+    """The full sbatch script: SBATCH headers (hetjob groups), coordinator
+    export, requeue-on-failure logic, and the single srun line."""
+    lines = ["#!/bin/bash"]
+    for i, rep in enumerate(req.replicas):
+        if i > 0:
+            lines.append("#SBATCH hetjob")
+        lines.extend(f"#SBATCH {opt}" for opt in rep.sbatch_opts)
+    lines += [
+        "",
+        "set -e",
+        "# coordinator = first node of het group 0 (role-0/replica-0)",
+        'export TPX_COORDINATOR_HOST=$(scontrol show hostnames'
+        ' "${SLURM_JOB_NODELIST_HET_GROUP_0:-$SLURM_JOB_NODELIST}" | head -n 1)',
+        f"export TPX_APP_ID=tpx-${{SLURM_JOB_ID}}",
+        "",
+    ]
+    if req.max_retries > 0:
+        lines += [
+            f"export TPX_MAX_RETRIES={req.max_retries}",
+            "tpx_requeue() {",
+            '  if [ "${SLURM_RESTART_COUNT:-0}" -lt "$TPX_MAX_RETRIES" ]; then',
+            '    scontrol requeue "$SLURM_JOB_ID"',
+            "  fi",
+            "}",
+            "trap tpx_requeue ERR",
+            "",
+        ]
+    srun_groups = []
+    for i, rep in enumerate(req.replicas):
+        # _dquote (not shlex single-quotes) so runtime macros like
+        # ${SLURM_JOB_ID} and $TPX_COORDINATOR_HOST still expand; and
+        # ${SLURM_JOB_ID} (the het-leader id, uniform across groups) in the
+        # log file names rather than %j (which expands to each het
+        # component's own id, breaking log_iter lookup for groups > 0)
+        env_prefix = " ".join(
+            f"{k}={_dquote(v)}" for k, v in sorted(rep.env.items())
+        )
+        group = " ".join(
+            [
+                f"--het-group={i}" if len(req.replicas) > 1 else "",
+                *rep.srun_opts,
+                f"--output=slurm-${{SLURM_JOB_ID}}-{rep.name}.out",
+                f"--error=slurm-${{SLURM_JOB_ID}}-{rep.name}.err",
+                ("env " + env_prefix) if env_prefix else "env",
+                " ".join(_dquote(c) for c in rep.cmd),
+            ]
+        ).strip()
+        srun_groups.append(group)
+    lines.append("srun " + " : ".join(srun_groups))
+    lines.append("")
+    return "\n".join(lines)
+
+
+class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
+    """Submits AppDefs as heterogeneous sbatch jobs."""
+
+    def __init__(self, session_name: str) -> None:
+        super().__init__(backend="slurm", session_name=session_name)
+
+    def _run_cmd(self, cmd: list[str], **kwargs: Any) -> subprocess.CompletedProcess:
+        """Single subprocess seam — tests monkeypatch this."""
+        return subprocess.run(cmd, capture_output=True, text=True, **kwargs)
+
+    def run_opts(self) -> runopts:
+        opts = runopts()
+        opts.add("partition", type_=str, help="slurm partition", default=None)
+        opts.add(
+            "time", type_=str, help="job time limit (e.g. 2:00:00)", default=None
+        )
+        opts.add(
+            "nomem",
+            type_=bool,
+            help="do not pass --mem (for clusters with RealMemory"
+            " misconfigured; reference analog of the partition mem probe)",
+            default=False,
+        )
+        opts.add(
+            "comment", type_=str, help="sbatch --comment metadata", default=None
+        )
+        return opts | self.workspace_opts()
+
+    def _submit_dryrun(
+        self, app: AppDef, cfg: Mapping[str, CfgVal]
+    ) -> AppDryRunInfo[SlurmBatchRequest]:
+        replicas: list[SlurmReplicaRequest] = []
+        for role in app.roles:
+            replicas.extend(_role_to_replicas(role, cfg))
+        cmd = ["sbatch", "--parsable"]
+        if cfg.get("comment"):
+            cmd.append(f"--comment={cfg['comment']}")
+        req = SlurmBatchRequest(
+            cmd=cmd,
+            replicas=replicas,
+            job_dir=str(cfg["job_dir"]) if cfg.get("job_dir") else None,
+            max_retries=max((r.max_retries for r in app.roles), default=0),
+        )
+        return AppDryRunInfo(req)
+
+    def schedule(self, dryrun_info: AppDryRunInfo[SlurmBatchRequest]) -> str:
+        req = dryrun_info.request
+        job_dir = req.job_dir or tempfile.mkdtemp(prefix="tpx_slurm_")
+        script_path = os.path.join(job_dir, "tpx_sbatch.sh")
+        with open(script_path, "w") as f:
+            f.write(req.script())
+        proc = self._run_cmd([*req.cmd, script_path], cwd=job_dir)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"sbatch failed (rc={proc.returncode}):\n{proc.stderr}"
+            )
+        job_id = proc.stdout.strip().split(";")[0]
+        _save_job_dir(job_id, job_dir)
+        return job_id
+
+    # -- monitoring --------------------------------------------------------
+
+    def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
+        resp = self._describe_squeue(app_id)
+        if resp is not None:
+            return resp
+        return self._describe_sacct(app_id)
+
+    def _describe_squeue(self, app_id: str) -> Optional[DescribeAppResponse]:
+        proc = self._run_cmd(["squeue", "--json", "-j", app_id])
+        if proc.returncode != 0:
+            return None
+        try:
+            payload = json.loads(proc.stdout)
+        except json.JSONDecodeError:
+            return None
+        jobs = payload.get("jobs") or []
+        if not jobs:
+            return None
+        return _describe_from_squeue_jobs(app_id, jobs)
+
+    def _describe_sacct(self, app_id: str) -> Optional[DescribeAppResponse]:
+        proc = self._run_cmd(
+            ["sacct", "--parsable2", "-j", app_id, "--format", "JobID,JobName,State"]
+        )
+        if proc.returncode != 0 or not proc.stdout.strip():
+            return None
+        lines = proc.stdout.strip().splitlines()
+        if len(lines) < 2:
+            return None
+        header = lines[0].split("|")
+        roles: dict[str, RoleStatus] = {}
+        app_state = AppState.UNKNOWN
+        for line in lines[1:]:
+            row = dict(zip(header, line.split("|")))
+            job_id = row.get("JobID", "")
+            if "." in job_id:  # step rows
+                continue
+            state = slurm_state(row.get("State", ""))
+            name = row.get("JobName", "")
+            if job_id.split("+")[0] == app_id:
+                app_state = state if app_state == AppState.UNKNOWN else app_state
+                if _is_worse(state, app_state):
+                    app_state = state
+            role, _, rep = name.rpartition("-")
+            if role and rep.isdigit():
+                roles.setdefault(role, RoleStatus(role=role)).replicas.append(
+                    ReplicaStatus(id=int(rep), state=state, role=role)
+                )
+        return DescribeAppResponse(
+            app_id=app_id,
+            state=app_state,
+            roles_statuses=list(roles.values()),
+        )
+
+    def list(self) -> list[ListAppResponse]:
+        proc = self._run_cmd(["squeue", "--json", "--me"])
+        if proc.returncode != 0:
+            raise RuntimeError(f"squeue failed: {proc.stderr}")
+        payload = json.loads(proc.stdout)
+        out = []
+        for job in payload.get("jobs", []):
+            out.append(
+                ListAppResponse(
+                    app_id=str(job.get("job_id")),
+                    state=_squeue_job_state(job),
+                    name=job.get("name", ""),
+                )
+            )
+        return out
+
+    def _cancel_existing(self, app_id: str) -> None:
+        proc = self._run_cmd(["scancel", app_id])
+        if proc.returncode != 0:
+            raise RuntimeError(f"scancel failed: {proc.stderr}")
+
+    def log_iter(
+        self,
+        app_id: str,
+        role_name: str,
+        k: int = 0,
+        regex: Optional[str] = None,
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+        should_tail: bool = False,
+        streams: Optional[Stream] = None,
+    ) -> Iterable[str]:
+        job_dir = _load_job_dir(app_id)
+        if job_dir is None:
+            raise RuntimeError(
+                f"no job dir recorded for {app_id} in {SLURM_JOB_DIRS_FILE}"
+            )
+        ext = "err" if streams == Stream.STDERR else "out"
+        log_file = os.path.join(job_dir, f"slurm-{app_id}-{role_name}-{k}.{ext}")
+        if not os.path.exists(log_file):
+            # non-het (single-replica) jobs may write slurm-{id}.out
+            fallback = os.path.join(job_dir, f"slurm-{app_id}.{ext}")
+            if os.path.exists(fallback):
+                log_file = fallback
+        lines: Iterable[str] = _read_lines(log_file)
+        if regex:
+            lines = filter_regex(regex, lines)
+        return lines
+
+
+def _read_lines(path: str) -> Iterable[str]:
+    if not os.path.exists(path):
+        return iter(())
+    with open(path, errors="replace") as f:
+        return iter(f.read().splitlines())
+
+
+_STATE_BADNESS = {
+    AppState.FAILED: 3,
+    AppState.CANCELLED: 2,
+    AppState.RUNNING: 1,
+}
+
+
+def _is_worse(a: AppState, b: AppState) -> bool:
+    return _STATE_BADNESS.get(a, 0) > _STATE_BADNESS.get(b, 0)
+
+
+def _squeue_job_state(job: Mapping[str, Any]) -> AppState:
+    js = job.get("job_state")
+    if isinstance(js, list):
+        js = js[0] if js else "UNKNOWN"
+    return slurm_state(str(js))
+
+
+def _describe_from_squeue_jobs(
+    app_id: str, jobs: list[Mapping[str, Any]]
+) -> DescribeAppResponse:
+    roles: dict[str, RoleStatus] = {}
+    app_state = AppState.UNKNOWN
+    for job in jobs:
+        state = _squeue_job_state(job)
+        if app_state == AppState.UNKNOWN or _is_worse(state, app_state):
+            app_state = state
+        name = str(job.get("name", ""))
+        role, _, rep = name.rpartition("-")
+        if role and rep.isdigit():
+            nodes = job.get("job_resources", {}) or {}
+            roles.setdefault(role, RoleStatus(role=role)).replicas.append(
+                ReplicaStatus(
+                    id=int(rep),
+                    state=state,
+                    role=role,
+                    hostname=str(nodes.get("nodes", "")),
+                )
+            )
+    if not roles:
+        # single sbatch job (not hetjob-split): synthesize one role from name
+        name = str(jobs[0].get("name", "job"))
+        roles[name] = RoleStatus(
+            role=name,
+            replicas=[ReplicaStatus(id=0, state=app_state, role=name)],
+        )
+    return DescribeAppResponse(
+        app_id=app_id, state=app_state, roles_statuses=list(roles.values())
+    )
+
+
+# =========================================================================
+# Job-dir registry (reference :52,913-931)
+# =========================================================================
+
+
+def _registry_path() -> str:
+    return os.path.join(os.path.expanduser("~"), SLURM_JOB_DIRS_FILE)
+
+
+def _save_job_dir(job_id: str, job_dir: str) -> None:
+    try:
+        with open(_registry_path(), "a") as f:
+            f.write(f"{job_id} = {job_dir}\n")
+    except OSError as e:
+        logger.warning("could not record job dir for %s: %s", job_id, e)
+
+
+def _load_job_dir(job_id: str) -> Optional[str]:
+    try:
+        with open(_registry_path()) as f:
+            for line in f:
+                jid, _, jdir = line.partition(" = ")
+                if jid.strip() == job_id:
+                    return jdir.strip()
+    except OSError:
+        return None
+    return None
+
+
+def create_scheduler(session_name: str, **kwargs: Any) -> SlurmScheduler:
+    return SlurmScheduler(session_name=session_name)
