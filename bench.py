@@ -1,0 +1,85 @@
+"""Benchmark: Llama training throughput on the available hardware.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+On a real TPU chip this trains Llama-3.2-1B (bf16, remat, flash attention)
+on synthetic data and reports tokens/sec/chip and MFU; ``vs_baseline``
+is MFU relative to the 45%-MFU north-star from BASELINE.json (the
+reference itself publishes no numbers — it is a launcher; see BASELINE.md).
+Also reported: launch-to-first-step (process start -> step-1 done), the
+other north-star metric.
+
+On CPU (no TPU) it falls back to the tiny config so the metric stays
+runnable anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+_START = time.monotonic()
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    from torchx_tpu.examples.train_llama import train
+    from torchx_tpu.models import llama
+
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        cfg = llama.llama3_1b()
+        seq, steps = 2048, 20
+        batch_candidates = [8, 4, 2, 1]
+    else:
+        cfg = llama.llama_tiny()
+        seq, steps = 128, 4
+        batch_candidates = [8]
+
+    from torchx_tpu.parallel.mesh import MeshConfig
+
+    mesh_cfg = MeshConfig(dp=1, fsdp=-1, tp=1, sp=1)
+
+    metrics = None
+    batch_used = None
+    for batch in batch_candidates:
+        try:
+            metrics = train(cfg, mesh_cfg, batch=batch, seq=seq, steps=steps, log_every=4)
+            batch_used = batch
+            break
+        except Exception as e:  # noqa: BLE001 - OOM -> halve the batch
+            msg = str(e).lower()
+            if any(
+                s in msg
+                for s in ("resource_exhausted", "out of memory", "hbm", "oom")
+            ):
+                print(f"batch={batch} OOM, retrying smaller", file=sys.stderr)
+                continue
+            raise
+    if metrics is None:
+        raise RuntimeError("all batch sizes OOMed")
+
+    result = {
+        "metric": f"llama training tokens/sec/chip ({'llama3_1b' if on_tpu else 'tiny'},"
+        f" bf16, seq={seq}, batch={batch_used}, {platform})",
+        "value": round(metrics["tokens_per_sec_per_chip"], 1),
+        "unit": "tokens/sec/chip",
+        # north star: >=45% MFU (BASELINE.json); reference publishes no
+        # numbers (control-plane launcher), so baseline = the MFU target
+        "vs_baseline": round(metrics["mfu"] / 0.45, 3),
+        "mfu": round(metrics["mfu"], 4),
+        "launch_to_first_step_s": round(metrics["launch_to_first_step_s"], 1),
+        "loss": round(metrics["loss"], 4),
+        "devices": jax.device_count(),
+        "platform": platform,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -31,24 +31,11 @@ from torchx_tpu import settings
 
 
 def _gang() -> tuple[int, int, str]:
-    """(process_id, num_processes, coordinator_address) from injected env,
-    falling back to GKE's TPU_WORKER_* when present."""
-    process_id = int(
-        os.environ.get(settings.ENV_TPX_REPLICA_ID)
-        or os.environ.get(settings.ENV_TPU_WORKER_ID)
-        or 0
-    )
-    num = int(os.environ.get(settings.ENV_TPX_NUM_REPLICAS) or 0)
-    coordinator = os.environ.get(settings.ENV_TPX_COORDINATOR_HOST, "")
-    if not coordinator:
-        hostnames = os.environ.get(settings.ENV_TPU_WORKER_HOSTNAMES, "")
-        if hostnames:
-            hosts = hostnames.split(",")
-            coordinator = hosts[0]
-            num = num or len(hosts)
-    if not num:
-        num = 1
-    return process_id, num, coordinator or "localhost"
+    """(process_id, num_processes, coordinator_host) — shared parser in
+    torchx_tpu.distributed so user code and the bootstrap agree."""
+    from torchx_tpu.distributed import gang_info
+
+    return gang_info()
 
 
 def _wait_for_coordinator(host: str, port: int, timeout: float = 300.0) -> None:
@@ -80,15 +67,13 @@ def initialize_distributed(port: int) -> None:
     process_id, num_processes, coordinator = _gang()
     if num_processes <= 1:
         return  # single process: jax works without a coordinator
-    import jax
+    from torchx_tpu import distributed as tpx_dist
 
     if process_id != 0:
         _wait_for_coordinator(coordinator, port)
-    jax.distributed.initialize(
-        coordinator_address=f"{coordinator}:{port}",
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    # init through the shared helper so a user script that also calls
+    # init_from_env() sees the world as already initialized
+    tpx_dist.init_from_env(port)
 
 
 def write_error_file(exc: BaseException) -> None:

@@ -1,0 +1,249 @@
+"""Llama pretraining example/benchmark — the flagship launched job.
+
+The analog of the reference's ``lightning`` example trainer
+(torchx/examples/apps/lightning) re-imagined for TPU SPMD: a pjit-style
+training step (AdamW, remat, bf16) over the 4-axis dp/fsdp/tp/sp mesh,
+launched via::
+
+    tpx run -s gke dist.spmd --tpu v5p-32 -m torchx_tpu.examples.train_llama -- \
+        --config llama3_8b --mesh fsdp=-1 --batch 16 --seq 8192
+
+Prints per-step tokens/sec and model FLOPs utilization (MFU); the
+launch-to-first-step latency (the BASELINE.md north-star metric) is
+reported as the time from process start to the end of step 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import functools
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchx_tpu.models import llama
+from torchx_tpu.parallel.mesh import BATCH_SPEC, MeshConfig, make_mesh
+
+_PROCESS_START = time.monotonic()
+
+# peak bf16 FLOPs/s per chip by generation (for MFU)
+PEAK_FLOPS = {
+    "tpu v2": 23e12,
+    "tpu v3": 61.5e12,  # per chip (2 cores)
+    "tpu v4": 275e12,
+    "tpu v5": 197e12,  # v5e (v5 lite)
+    "tpu v5p": 459e12,
+    "tpu v6": 918e12,
+    "cpu": 1e12,  # nominal, keeps MFU finite in simulation
+}
+
+
+def device_peak_flops() -> float:
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu").lower()
+    for prefix, flops in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if kind.startswith(prefix):
+            return flops
+    return PEAK_FLOPS["cpu"]
+
+
+def make_optimizer(
+    lr: float = 3e-4, weight_decay: float = 0.1, warmup: int = 100
+) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=lr,
+        warmup_steps=warmup,
+        decay_steps=100_000,
+        end_value=lr * 0.1,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: llama.Params
+    opt_state: Any
+    step: jnp.ndarray
+
+
+jax.tree_util.register_dataclass(
+    TrainState, data_fields=["params", "opt_state", "step"], meta_fields=[]
+)
+
+
+def init_state(
+    cfg: llama.LlamaConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    seed: int = 0,
+) -> TrainState:
+    """Initialize params *sharded* (jit with out_shardings so the full
+    fp32 model never materializes on one device)."""
+    specs = llama.param_specs(cfg)
+    out_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+
+    @functools.partial(jax.jit, out_shardings=out_shardings)
+    def _init(key):  # noqa: ANN001
+        return llama.init_params(cfg, key)
+
+    params = _init(jax.random.PRNGKey(seed))
+    opt_state = jax.jit(
+        optimizer.init,
+        out_shardings=None,  # let XLA choose opt-state shardings from params
+    )(params)
+    return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+
+def make_train_step(
+    cfg: llama.LlamaConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+):
+    """The jitted SPMD training step: grads + AdamW update, donated state."""
+
+    def step(state: TrainState, batch: dict[str, jnp.ndarray]):
+        loss, grads = jax.value_and_grad(llama.loss_fn)(
+            state.params, batch, cfg, mesh
+        )
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            loss,
+        )
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def synthetic_batch(
+    cfg: llama.LlamaConfig, mesh: Mesh, batch: int, seq: int, seed: int = 0
+) -> dict[str, jnp.ndarray]:
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(seed), (batch, seq + 1), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    return {"tokens": jax.device_put(tokens, NamedSharding(mesh, BATCH_SPEC))}
+
+
+def parse_mesh_arg(spec: str) -> MeshConfig:
+    """``dp=2,fsdp=-1,tp=4`` -> MeshConfig."""
+    kwargs = {}
+    for pair in spec.split(","):
+        if not pair.strip():
+            continue
+        k, _, v = pair.partition("=")
+        kwargs[k.strip()] = int(v)
+    return MeshConfig(**kwargs)
+
+
+def train(
+    cfg: llama.LlamaConfig,
+    mesh_config: MeshConfig,
+    batch: int,
+    seq: int,
+    steps: int,
+    log_every: int = 1,
+    lr: float = 3e-4,
+    warmup: int = 100,
+) -> dict[str, float]:
+    cfg = dataclasses.replace(cfg, max_seq=seq)
+    mesh = make_mesh(mesh_config)
+    optimizer = make_optimizer(lr=lr, warmup=warmup)
+    state = init_state(cfg, mesh, optimizer)
+    train_step = make_train_step(cfg, mesh, optimizer)
+    data = synthetic_batch(cfg, mesh, batch, seq)
+
+    n_devices = jax.device_count()
+    tokens_per_step = batch * seq
+    flops_per_token = cfg.flops_per_token()  # cfg.max_seq already == seq
+    peak = device_peak_flops() * n_devices
+
+    # step 1 (compile + run) = launch-to-first-step
+    state, loss = train_step(state, data)
+    jax.block_until_ready(loss)
+    first_step_s = time.monotonic() - _PROCESS_START
+    if jax.process_index() == 0:
+        print(
+            f"step 1 loss={float(loss):.4f}"
+            f" launch-to-first-step={first_step_s:.1f}s",
+            flush=True,
+        )
+
+    if steps <= 1:
+        # single-step smoke: the compile-including step is the only timing
+        return {
+            "loss": float(loss),
+            "tokens_per_sec": tokens_per_step / first_step_s,
+            "tokens_per_sec_per_chip": tokens_per_step / first_step_s / n_devices,
+            "mfu": tokens_per_step / first_step_s * flops_per_token / peak,
+            "launch_to_first_step_s": first_step_s,
+        }
+
+    # a few untimed warmup steps: dispatch pipelining + allocator settling
+    warmup_steps = min(3, max(steps - 2, 0))
+    for _ in range(warmup_steps):
+        state, loss = train_step(state, data)
+    jax.block_until_ready(loss)
+
+    t0 = time.monotonic()
+    timed_steps = max(steps - 1 - warmup_steps, 1)
+    for i in range(timed_steps):
+        state, loss = train_step(state, data)
+        step_no = 1 + warmup_steps + i + 1
+        if (i + 1) % log_every == 0 or i + 1 == timed_steps:
+            jax.block_until_ready(loss)
+            dt = (time.monotonic() - t0) / (i + 1)
+            tps = tokens_per_step / dt
+            mfu = tps * flops_per_token / peak
+            if jax.process_index() == 0:
+                print(
+                    f"step {step_no} loss={float(loss):.4f}"
+                    f" tokens/sec={tps:,.0f}"
+                    f" tokens/sec/chip={tps / n_devices:,.0f}"
+                    f" MFU={mfu:.1%}",
+                    flush=True,
+                )
+    jax.block_until_ready(state.params)
+    total = time.monotonic() - t0
+    tps = tokens_per_step * timed_steps / total
+    return {
+        "loss": float(loss),
+        "tokens_per_sec": tps,
+        "tokens_per_sec_per_chip": tps / n_devices,
+        "mfu": tps * flops_per_token / peak,
+        "launch_to_first_step_s": first_step_s,
+    }
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", default="tiny", choices=sorted(llama.CONFIGS))
+    parser.add_argument("--mesh", default="fsdp=-1", help="e.g. dp=2,fsdp=-1,tp=4")
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=128)
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--ring-attention", action="store_true")
+    args = parser.parse_args(argv)
+
+    cfg = llama.CONFIGS[args.config]()
+    if args.ring_attention:
+        cfg = dataclasses.replace(cfg, use_ring_attention=True)
+    metrics = train(
+        cfg, parse_mesh_arg(args.mesh), args.batch, args.seq, args.steps
+    )
+    if jax.process_index() == 0:
+        print("final:", metrics, flush=True)
+
+
+if __name__ == "__main__":
+    main()

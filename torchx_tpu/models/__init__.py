@@ -1,0 +1,8 @@
+from torchx_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    forward,
+    init_params,
+    loss_fn,
+    param_specs,
+    shard_params,
+)

@@ -1,0 +1,3 @@
+from torchx_tpu.ops.norms import rms_norm  # noqa: F401
+from torchx_tpu.ops.rope import apply_rope, rope_frequencies  # noqa: F401
+from torchx_tpu.ops.attention import attention  # noqa: F401

@@ -1,0 +1,118 @@
+"""Causal multi-head attention with GQA, TPU-first.
+
+Kernel selection (``impl``):
+
+* ``"pallas"`` — the Pallas TPU flash-attention kernel
+  (jax.experimental.pallas.ops.tpu.flash_attention): O(seq) memory, tiled
+  for the MXU. Used automatically on TPU for long sequences.
+* ``"xla"`` — plain einsum softmax attention. XLA fuses this well for short
+  sequences and it runs everywhere (CPU tests); also the numerical
+  reference the pallas path is tested against.
+* ``"auto"`` — pallas on TPU when shapes allow (head_dim multiple of 128,
+  seq multiple of the block size), else xla.
+
+All paths compute softmax in float32 and accept grouped KV heads
+(n_kv_heads <= n_heads, Llama-3 GQA).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False
+
+
+def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[b, s, kv_heads, d] -> [b, s, kv_heads*n_rep, d]"""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d
+    )
+
+
+def xla_attention(
+    q: jnp.ndarray,  # [b, s, h, d]
+    k: jnp.ndarray,  # [b, s, kv_h, d]
+    v: jnp.ndarray,
+    causal: bool = True,
+    segment_ids: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    s_q, s_k = q.shape[1], k.shape[1]
+    if causal:
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(seg_mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _pallas_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    return d in (64, 128, 256) and s_q % 128 == 0 and s_k % 128 == 0 and s_q >= 512
+
+
+def pallas_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = True
+) -> jnp.ndarray:
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention,
+    )
+
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    # pallas kernel takes [b, h, s, d]
+    out = flash_attention(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        causal=causal,
+        sm_scale=q.shape[-1] ** -0.5,
+    )
+    return out.transpose(0, 2, 1, 3)
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    segment_ids: Optional[jnp.ndarray] = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """[b, s, heads, head_dim] x3 -> [b, s, heads, head_dim]."""
+    if impl == "pallas" and segment_ids is not None:
+        raise ValueError(
+            "the pallas flash-attention path does not support segment_ids;"
+            " use impl='xla' (or 'auto', which falls back) for packed"
+            " cross-document masking"
+        )
+    if impl == "pallas" or (
+        impl == "auto"
+        and segment_ids is None
+        and _on_tpu()
+        and _pallas_ok(q, k)
+    ):
+        return pallas_attention(q, k, v, causal=causal)
+    return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)

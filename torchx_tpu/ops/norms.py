@@ -1,0 +1,18 @@
+"""Normalization ops.
+
+RMSNorm computes in float32 regardless of input dtype (bf16 squares
+underflow badly) and casts back — the standard TPU-stable recipe. XLA fuses
+the whole thing into the surrounding matmul's epilogue; no custom kernel is
+warranted for a bandwidth-bound elementwise op.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    rrms = jnp.reciprocal(jnp.sqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps))
+    return ((xf * rrms) * weight.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,132 @@
+"""Ring attention: causal attention over sequence shards (context parallel).
+
+Long-context training shards the sequence axis across devices (`sp` mesh
+axis). Attention then needs every query to see all earlier keys, which live
+on other devices. Ring attention rotates KV blocks around the `sp` axis
+with ``lax.ppermute`` while accumulating the softmax online (flash-style
+running max / normalizer merge), so each device only ever holds one extra
+KV block: O(seq/n) memory, and the permute overlaps with the block matmuls
+on TPU (ICI is bidirectional; XLA pipelines the ring).
+
+Causality note: with sequence blocks laid out contiguously (block i holds
+positions [i*B, (i+1)*B)), block j contributes to queries in block i iff
+j < i (fully visible) or j == i (triangular). Blocks j > i are skipped —
+but in a ring every device must keep permuting to feed its neighbors, so
+skipped blocks still travel; their contribution is masked out.
+
+The public entry :func:`ring_attention` wraps the per-shard kernel in
+``jax.shard_map`` over the given mesh and is differentiable end-to-end
+(ppermute's transpose is the reverse permute).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attn(
+    q: jnp.ndarray,  # [b, sq, h, d]
+    k: jnp.ndarray,  # [b, sk, h, d] (kv heads already repeated)
+    v: jnp.ndarray,
+    mode: jnp.ndarray,  # scalar int: 0 = skip, 1 = causal (diagonal), 2 = full
+    scale: float,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (logits-masked [b,h,sq,sk] f32, none); computes masked logits."""
+    logits = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    sq, sk = q.shape[1], k.shape[1]
+    tril = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+    mask = jnp.where(
+        mode == 2,
+        jnp.ones((sq, sk), dtype=bool),
+        jnp.where(mode == 1, tril, jnp.zeros((sq, sk), dtype=bool)),
+    )
+    return jnp.where(mask[None, None], logits, _NEG_INF)
+
+
+def _ring_attention_shard(
+    q: jnp.ndarray,  # [b, s_local, h, d] — this device's query block
+    k: jnp.ndarray,  # [b, s_local, kv_h, d]
+    v: jnp.ndarray,
+    axis_name: str,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Per-shard causal ring attention (runs inside shard_map)."""
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+
+    n_rep = q.shape[2] // k.shape[2]
+    if n_rep > 1:
+        b, s, h, d = k.shape
+        k = jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+            b, s, h * n_rep, d
+        )
+        v = jnp.broadcast_to(v[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+            b, s, h * n_rep, d
+        )
+
+    b, sq, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        o, m, l, kv = carry
+        k_blk, v_blk = kv
+        src = (my_idx - i) % n  # global block index this kv came from
+        mode = jnp.where(src == my_idx, 1, jnp.where(src < my_idx, 2, 0))
+        logits = _block_attn(q, k_blk, v_blk, mode, scale)  # [b,h,sq,sk] f32
+        m_blk = jnp.max(logits, axis=-1)  # [b,h,sq]
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)  # rescale old accumulator
+        p = jnp.exp(logits - m_new[..., None])  # [b,h,sq,sk]
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        kv_next = jax.tree.map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), (k_blk, v_blk)
+        )
+        return (o_new, m_new, l_new, kv_next), None
+
+    o0 = jnp.zeros((b, h, sq, d), dtype=jnp.float32)
+    m0 = jnp.full((b, h, sq), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, sq), dtype=jnp.float32)
+    (o, m, l, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, (k, v)), jnp.arange(n)
+    )
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [b, sq, h, d]
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    seq_axis: str = "sp",
+    batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+) -> jnp.ndarray:
+    """Causal attention over a sequence-sharded [b, s, h, d] layout.
+
+    q/k/v are global arrays whose ``s`` axis is sharded over ``seq_axis``;
+    returns output in the same layout. Works inside jit.
+    """
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_shard, axis_name=seq_axis),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

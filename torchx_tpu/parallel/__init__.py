@@ -1,0 +1,5 @@
+from torchx_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    make_mesh,
+    named_sharding,
+)

@@ -1,0 +1,86 @@
+"""Device mesh construction + sharding helpers for SPMD training.
+
+The canonical 4-axis mesh for TPU LLM training (scaling-book recipe: pick a
+mesh, annotate shardings, let XLA insert the collectives over ICI/DCN):
+
+* ``dp``   — pure data parallelism (between slices, rides DCN),
+* ``fsdp`` — data parallelism with parameter sharding (rides ICI),
+* ``tp``   — tensor (model) parallelism within attention/MLP blocks,
+* ``sp``   — sequence/context parallelism for long sequences.
+
+Axis sizes multiply to the device count; unused axes get size 1 so
+PartitionSpecs can always name all four axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Mesh axis sizes; -1 on at most one axis means "all remaining
+    devices"."""
+
+    dp: int = 1
+    fsdp: int = -1
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {a: getattr(self, a) for a in AXES}
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices, have {n_devices}"
+            )
+        return sizes
+
+
+def make_mesh(
+    config: MeshConfig = MeshConfig(),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the 4-axis mesh over all (or the given) devices.
+
+    Axis order is (dp, fsdp, tp, sp) — outermost-to-innermost matches
+    slowest-to-fastest interconnect: dp between slices over DCN, tp on the
+    innermost ICI dimension where its all-reduces are cheapest.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    sizes = config.resolve(len(devs))
+    shape = tuple(sizes[a] for a in AXES)
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+# Canonical PartitionSpecs for transformer training state. Batch shards over
+# both data axes; sequence over sp (Megatron-style sequence parallelism for
+# the residual stream; attention itself uses ring attention over sp).
+# raw token batches shard on batch only: the seq axis of data often has
+# odd lengths (seq+1 for next-token targets) and activations pick up their
+# sp sharding from the in-model constraints instead
+BATCH_SPEC = P(("dp", "fsdp"), None)  # tokens [batch, seq]
+ACT_SPEC = P(("dp", "fsdp"), "sp", None)  # activations [batch, seq, dim]
+ACT_TP_SPEC = P(("dp", "fsdp"), None, "tp")  # attn/mlp inner activations
