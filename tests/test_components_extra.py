@@ -1,0 +1,182 @@
+"""Tests for auxiliary components, structured args, process_monitor,
+notebook workspace, deprecations."""
+
+import subprocess
+import sys
+import time
+import warnings
+from pathlib import Path
+
+import pytest
+
+from torchx_tpu.components import metrics, serve, utils
+from torchx_tpu.components.component_test_base import ComponentTestCase
+from torchx_tpu.components.structured_arg import (
+    StructuredJArgument,
+    StructuredNameArgument,
+)
+from torchx_tpu.specs.builders import materialize_appdef
+
+
+class TestStructuredArgs:
+    def test_name_parse(self):
+        a = StructuredNameArgument.parse_from("exp/run")
+        assert (a.app_name, a.role_name) == ("exp", "run")
+        a = StructuredNameArgument.parse_from("justapp")
+        assert a.app_name == "justapp" and a.role_name == "role"
+        a = StructuredNameArgument.parse_from("/justrole")
+        assert a.app_name == "app" and a.role_name == "justrole"
+
+    def test_j_parse_explicit(self):
+        a = StructuredJArgument.parse_from("1:2x4")
+        assert (a.min_replicas, a.replicas, a.nproc) == (1, 2, 4)
+        assert str(a) == "1:2x4"
+
+    def test_j_nproc_inferred_from_named_resource(self):
+        a = StructuredJArgument.parse_from("2", h="v5litepod-8")
+        assert a.nproc == 8
+        a = StructuredJArgument.parse_from("2", h="cpu_small")
+        assert a.nproc == 1
+
+
+class TestAuxComponents(ComponentTestCase):
+    def test_tensorboard_lints(self):
+        self.validate(metrics, "tensorboard")
+
+    def test_model_server_lints(self):
+        self.validate(serve, "model_server")
+
+    def test_tensorboard_materializes(self):
+        app = materialize_appdef(
+            metrics.tensorboard,
+            ["--logdir", "/mnt/logs", "--exit_on_file", "/mnt/logs/DONE"],
+        )
+        args = " ".join(app.roles[0].args)
+        assert "process_monitor" in args
+        assert "--logdir /mnt/logs" in args
+        assert "--exit_on_file /mnt/logs/DONE" in args
+        assert app.roles[0].port_map["http"] == 6006
+
+    def test_model_server_materializes(self):
+        app = materialize_appdef(
+            serve.model_server,
+            [
+                "--model_path",
+                "gs://b/m",
+                "--management_api",
+                "http://srv:8081",
+            ],
+        )
+        args = app.roles[0].args
+        assert "gs://b/m" in args and "http://srv:8081" in args
+
+    def test_run_component_helper(self, tmp_path=None):
+        handle = self.run_component(
+            utils.echo, ["--msg", "from-component-test"], scheduler="local"
+        )
+        assert handle.startswith("local://")
+
+
+class TestProcessMonitor:
+    def test_exit_on_file(self, tmp_path):
+        marker = tmp_path / "DONE"
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "torchx_tpu.apps.process_monitor",
+                "--poll_interval",
+                "0.1",
+                "--",
+                "sleep",
+                "30",
+            ],
+        )
+        time.sleep(1.0)
+        assert proc.poll() is None
+        marker.write_text("")
+        # no exit_on_file passed -> still running; now test with the flag
+        proc.terminate()
+        proc.wait()
+
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "torchx_tpu.apps.process_monitor",
+                "--poll_interval",
+                "0.1",
+                "--exit_on_file",
+                str(marker),
+                "--",
+                "sleep",
+                "30",
+            ],
+        )
+        assert proc.wait(timeout=15) == 0
+
+    def test_timeout(self):
+        t0 = time.monotonic()
+        rc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "torchx_tpu.apps.process_monitor",
+                "--timeout",
+                "1",
+                "--poll_interval",
+                "0.1",
+                "--",
+                "sleep",
+                "30",
+            ],
+            timeout=20,
+        ).returncode
+        assert rc == 0
+        assert time.monotonic() - t0 < 15
+
+    def test_propagates_exit_code(self):
+        rc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "torchx_tpu.apps.process_monitor",
+                "--poll_interval",
+                "0.1",
+                "--",
+                "sh",
+                "-c",
+                "exit 3",
+            ],
+            timeout=20,
+        ).returncode
+        assert rc == 3
+
+
+class TestNotebook:
+    def test_workspacefile(self, monkeypatch, tmp_path):
+        import torchx_tpu.notebook as nb
+
+        monkeypatch.setattr(nb, "_workspace_dir", str(tmp_path))
+        nb.workspacefile("sub/main.py", "print('hi')\n")
+        assert (tmp_path / "sub" / "main.py").read_text() == "print('hi')\n"
+
+    def test_empty_line_rejected(self):
+        import torchx_tpu.notebook as nb
+
+        with pytest.raises(ValueError):
+            nb.workspacefile("", "x")
+
+
+class TestDeprecations:
+    def test_deprecated_warns(self):
+        from torchx_tpu.deprecations import deprecated
+
+        @deprecated(replacement="new_fn", since="0.2")
+        def old_fn():
+            return 42
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old_fn() == 42
+        assert any("new_fn" in str(x.message) for x in w)

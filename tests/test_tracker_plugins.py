@@ -1,0 +1,205 @@
+"""Tracker backends, in-job AppRun, plugins registry, result tracking."""
+
+import os
+
+import pytest
+
+from torchx_tpu.plugins import get_registry, register
+from torchx_tpu.plugins._registration import clear_registrations
+from torchx_tpu.runtime.tracking import FsspecResultTracker
+from torchx_tpu.specs.api import Resource, TpuSlice
+from torchx_tpu.tracker.api import (
+    AppRun,
+    tracker_config_env_vars,
+    trackers_from_environ,
+)
+from torchx_tpu.tracker.backend.fsspec import FsspecTracker
+
+
+class TestFsspecTracker:
+    def test_metadata_roundtrip(self, tmp_path):
+        t = FsspecTracker(str(tmp_path))
+        t.add_metadata("run1", lr=0.1, model="llama")
+        t.add_metadata("run1", step=5)
+        md = t.metadata("run1")
+        assert md == {"lr": 0.1, "model": "llama", "step": 5}
+
+    def test_artifacts_roundtrip(self, tmp_path):
+        t = FsspecTracker(str(tmp_path))
+        t.add_artifact("run1", "ckpt", "/mnt/ckpt/100", {"step": 100})
+        arts = t.artifacts("run1")
+        assert arts["ckpt"].path == "/mnt/ckpt/100"
+        assert arts["ckpt"].metadata == {"step": 100}
+
+    def test_lineage(self, tmp_path):
+        t = FsspecTracker(str(tmp_path))
+        t.add_source("child", "parent-run", artifact_name="ckpt")
+        (src,) = list(t.sources("child"))
+        assert src.source_run_id == "parent-run"
+        assert src.artifact_name == "ckpt"
+        assert list(t.sources("child", artifact_name="other")) == []
+
+    def test_run_ids_with_handle_chars(self, tmp_path):
+        t = FsspecTracker(str(tmp_path))
+        run_id = "local://session/app_123"
+        t.add_metadata(run_id, a=1)
+        assert list(t.run_ids()) == [run_id]
+
+    def test_empty(self, tmp_path):
+        t = FsspecTracker(str(tmp_path))
+        assert t.metadata("nope") == {}
+        assert t.artifacts("nope") == {}
+
+
+class TestAppRunFromEnv:
+    def test_env_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPX_JOB_ID", "local://s/app1")
+        monkeypatch.setenv("TPX_TRACKERS", "fsspec")
+        monkeypatch.setenv("TPX_TRACKER_FSSPEC_CONFIG", str(tmp_path))
+        AppRun._instance = None
+        run = AppRun.run_from_env()
+        assert run.id == "local://s/app1"
+        run.add_metadata(objective=0.5)
+        t = FsspecTracker(str(tmp_path))
+        assert t.metadata("local://s/app1")["objective"] == 0.5
+        AppRun._instance = None
+
+    def test_no_env_is_noop(self, monkeypatch):
+        monkeypatch.delenv("TPX_JOB_ID", raising=False)
+        monkeypatch.delenv("TPX_TRACKERS", raising=False)
+        AppRun._instance = None
+        run = AppRun.run_from_env()
+        assert run.id == "<unknown_run_id>"
+        run.add_metadata(x=1)  # no backends: must not raise
+        AppRun._instance = None
+
+    def test_parent_lineage_autolink(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPX_JOB_ID", "local://s/child")
+        monkeypatch.setenv("TPX_TRACKERS", "fsspec")
+        monkeypatch.setenv("TPX_TRACKER_FSSPEC_CONFIG", str(tmp_path))
+        monkeypatch.setenv("TPX_PARENT_RUN_ID", "local://s/parent")
+        AppRun._instance = None
+        AppRun.run_from_env()
+        srcs = list(FsspecTracker(str(tmp_path)).sources("local://s/child"))
+        assert srcs[0].source_run_id == "local://s/parent"
+        AppRun._instance = None
+
+    def test_client_env_injection(self):
+        env = tracker_config_env_vars(
+            parent_run_id="p1", trackers={"fsspec": "/mnt/exp"}
+        )
+        assert env["TPX_TRACKERS"] == "fsspec"
+        assert env["TPX_TRACKER_FSSPEC_CONFIG"] == "/mnt/exp"
+        assert env["TPX_PARENT_RUN_ID"] == "p1"
+
+    def test_client_env_injection_empty(self):
+        assert tracker_config_env_vars(trackers={}) == {}
+
+
+class TestResultTracker:
+    def test_roundtrip(self, tmp_path):
+        t = FsspecResultTracker(str(tmp_path))
+        t["trial/1"] = {"loss": 0.5}
+        assert t["trial/1"] == {"loss": 0.5}
+
+    def test_missing_key(self, tmp_path):
+        with pytest.raises(KeyError):
+            FsspecResultTracker(str(tmp_path))["nope"]
+
+
+class TestPlugins:
+    def teardown_method(self):
+        clear_registrations()
+        get_registry(invalidate_cache=True)
+
+    def test_register_scheduler(self):
+        @register.scheduler("mysched", alias="ms")
+        def create(session_name, **kw):  # noqa: ANN001
+            return "sched-instance"
+
+        reg = get_registry(invalidate_cache=True)
+        assert reg.schedulers["mysched"] is create
+        assert reg.schedulers["ms"] is create
+        from torchx_tpu.schedulers import get_scheduler_factories
+
+        assert "mysched" in get_scheduler_factories()
+
+    def test_register_named_resource_with_fractions(self):
+        @register.named_resource("superpod", fractions=True)
+        def superpod():
+            return Resource(cpu=208, memMB=1000, tpu=TpuSlice("v5e", 8))
+
+        reg = get_registry(invalidate_cache=True)
+        assert set(reg.named_resources) >= {
+            "superpod",
+            "superpod_half",
+            "superpod_quarter",
+        }
+        half = reg.named_resources["superpod_half"]()
+        assert half.tpu.chips == 4
+        assert half.cpu == 104
+        assert half.tags["tpx.share"] == "half"
+        quarter = reg.named_resources["superpod_quarter"]()
+        assert quarter.tpu.chips == 2
+
+    def test_plugin_tracker_with_colon_name(self, tmp_path, monkeypatch):
+        from torchx_tpu.tracker.backend.fsspec import FsspecTracker as FT
+
+        @register.tracker("myorg:prod")
+        def create(config):  # noqa: ANN001
+            return FT(str(tmp_path))
+
+        get_registry(invalidate_cache=True)
+        monkeypatch.setenv("TPX_TRACKERS", "myorg:prod")
+        assert "myorg:prod" in trackers_from_environ()
+
+    def test_register_tracker_reachable_from_env(self, tmp_path, monkeypatch):
+        from torchx_tpu.tracker.backend.fsspec import FsspecTracker as FT
+
+        @register.tracker("custom_t")
+        def create(config):  # noqa: ANN001
+            return FT(str(tmp_path))
+
+        get_registry(invalidate_cache=True)
+        monkeypatch.setenv("TPX_TRACKERS", "custom_t")
+        trackers = trackers_from_environ()
+        assert "custom_t" in trackers
+
+    def test_namespace_package_discovery(self, tmp_path, monkeypatch):
+        ns = tmp_path / "tpx_plugins"
+        ns.mkdir()
+        (ns / "myplug.py").write_text(
+            "def register(registrar):\n"
+            "    registrar.scheduler('ns_sched', lambda session_name, **kw: 'x')\n"
+        )
+        monkeypatch.syspath_prepend(str(tmp_path))
+        reg = get_registry(invalidate_cache=True)
+        assert "ns_sched" in reg.schedulers
+
+    def test_broken_namespace_plugin_captured(self, tmp_path, monkeypatch):
+        ns = tmp_path / "tpx_plugins"
+        ns.mkdir()
+        (ns / "broken.py").write_text("raise RuntimeError('boom')\n")
+        monkeypatch.syspath_prepend(str(tmp_path))
+        reg = get_registry(invalidate_cache=True)
+        assert any("broken" in e.plugin for e in reg.errors)
+        from torchx_tpu.plugins import error_report
+
+        assert "boom" in error_report()
+
+    def test_plugins_disabled_by_env(self, tmp_path, monkeypatch):
+        @register.scheduler("always_there")
+        def create(session_name, **kw):  # noqa: ANN001
+            return "x"
+
+        ns = tmp_path / "tpx_plugins"
+        ns.mkdir()
+        (ns / "p.py").write_text(
+            "def register(r):\n    r.scheduler('ns_only', lambda **kw: 'y')\n"
+        )
+        monkeypatch.syspath_prepend(str(tmp_path))
+        monkeypatch.setenv("TPX_PLUGINS_SOURCE", "0")
+        reg = get_registry(invalidate_cache=True)
+        assert "ns_only" not in reg.schedulers
+        # programmatic registrations always apply
+        assert "always_there" in reg.schedulers

@@ -1,0 +1,48 @@
+"""Serving components (reference analog: torchx/components/serve.py:19-77)."""
+
+from __future__ import annotations
+
+import torchx_tpu.specs as specs
+from torchx_tpu.version import TORCHX_TPU_IMAGE
+
+
+def model_server(
+    model_path: str,
+    management_api: str,
+    model_name: str = "model",
+    image: str = TORCHX_TPU_IMAGE,
+    timeout: float = 60.0,
+) -> specs.AppDef:
+    """Register a model archive with a running model server's management
+    API (a one-shot registration client, not the server itself).
+
+    Args:
+        model_path: url/path of the model artifact to register
+        management_api: base URL of the server management API
+        model_name: name to register the model under
+        image: image to use
+        timeout: registration request timeout seconds
+    """
+    return specs.AppDef(
+        name="model-server-register",
+        roles=[
+            specs.Role(
+                name="register",
+                image=image,
+                entrypoint="python",
+                args=[
+                    "-m",
+                    "torchx_tpu.apps.serve_main",
+                    "--model_path",
+                    model_path,
+                    "--management_api",
+                    management_api,
+                    "--model_name",
+                    model_name,
+                    "--timeout",
+                    str(timeout),
+                ],
+                resource=specs.Resource(cpu=1, memMB=1024),
+            )
+        ],
+    )

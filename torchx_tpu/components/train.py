@@ -1,0 +1,8 @@
+"""Training component pointers (reference analog: torchx/components/train.py).
+
+There is deliberately no generic ``train`` component: training apps are too
+varied for one template. Use :py:func:`torchx_tpu.components.dist.spmd` to
+launch any JAX SPMD trainer (see ``torchx_tpu/examples/train_llama.py`` for
+the flagship example), or write a custom component
+(``tpx run ./my_component.py:my_trainer``).
+"""

@@ -1,0 +1,31 @@
+"""Plugin system public interface.
+
+Reference analog: torchx/plugins/__init__.py. Consumed by:
+
+* ``torchx_tpu.schedulers.get_scheduler_factories`` (scheduler plugins),
+* ``torchx_tpu.specs.named_resources`` (named-resource plugins),
+* ``torchx_tpu.tracker.api`` (tracker plugins).
+"""
+
+from typing import Any, Callable, Mapping, Optional
+
+from torchx_tpu.plugins._registration import Share, register  # noqa: F401
+from torchx_tpu.plugins._registry import (  # noqa: F401
+    PluginRegistrar,
+    PluginSource,
+    PluginType,
+    error_report,
+    get_registry,
+)
+
+
+def get_plugin_schedulers() -> Mapping[str, Callable[..., Any]]:
+    return dict(get_registry().schedulers)
+
+
+def get_plugin_named_resources() -> Mapping[str, Callable[[], Any]]:
+    return dict(get_registry().named_resources)
+
+
+def get_plugin_trackers() -> Mapping[str, Callable[[Optional[str]], Any]]:
+    return dict(get_registry().trackers)

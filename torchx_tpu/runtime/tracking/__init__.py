@@ -1,0 +1,4 @@
+from torchx_tpu.runtime.tracking.api import (  # noqa: F401
+    FsspecResultTracker,
+    ResultTracker,
+)

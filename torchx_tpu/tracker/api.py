@@ -108,6 +108,15 @@ def _load_tracker(name: str, config: Optional[str]) -> Optional[TrackerBase]:
                 break
     except Exception:  # noqa: BLE001
         pass
+    if factory is None:
+        # plugin registry wins over module:fn interpretation (a plugin may
+        # legitimately register a colon-containing name)
+        try:
+            from torchx_tpu.plugins import get_plugin_trackers
+
+            factory = get_plugin_trackers().get(name)
+        except ImportError:
+            pass
     if factory is None and ":" in name:
         mod_name, _, fn_name = name.partition(":")
         try:

@@ -1,0 +1,133 @@
+"""MLflow tracker backend.
+
+Reference analog: torchx/tracker/mlflow.py (376 LoC). Maps tpx runs onto
+MLflow runs: run_id -> an MLflow run tagged ``tpx.run_id``; metadata ->
+params/metrics (numeric values become metrics, the rest params); artifacts
+-> artifact URI tags; lineage sources -> ``tpx.source.<n>`` tags.
+
+The mlflow import is deferred: this module imports cleanly without mlflow
+installed and only fails when actually constructed (the environment gates
+optional deps; see create()).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping, Optional
+
+from torchx_tpu.tracker.api import TrackerArtifact, TrackerBase, TrackerSource
+
+RUN_ID_TAG = "tpx.run_id"
+ARTIFACT_TAG_PREFIX = "tpx.artifact."
+SOURCE_TAG_PREFIX = "tpx.source."
+
+
+class MLflowTracker(TrackerBase):
+    def __init__(
+        self,
+        tracking_uri: Optional[str] = None,
+        experiment_name: str = "tpx",
+    ) -> None:
+        import mlflow
+
+        self._mlflow = mlflow
+        self._client = mlflow.tracking.MlflowClient(tracking_uri=tracking_uri)
+        exp = self._client.get_experiment_by_name(experiment_name)
+        self._experiment_id = (
+            exp.experiment_id
+            if exp
+            else self._client.create_experiment(experiment_name)
+        )
+        self._run_cache: dict[str, str] = {}  # tpx run id -> mlflow run id
+
+    def _mlflow_run(self, run_id: str) -> str:
+        if run_id in self._run_cache:
+            return self._run_cache[run_id]
+        hits = self._client.search_runs(
+            [self._experiment_id], filter_string=f"tags.`{RUN_ID_TAG}` = '{run_id}'"
+        )
+        if hits:
+            mlrun_id = hits[0].info.run_id
+        else:
+            run = self._client.create_run(
+                self._experiment_id, tags={RUN_ID_TAG: run_id}, run_name=run_id
+            )
+            mlrun_id = run.info.run_id
+        self._run_cache[run_id] = mlrun_id
+        return mlrun_id
+
+    def add_artifact(
+        self,
+        run_id: str,
+        name: str,
+        path: str,
+        metadata: Optional[Mapping[str, Any]] = None,
+    ) -> None:
+        self._client.set_tag(
+            self._mlflow_run(run_id), f"{ARTIFACT_TAG_PREFIX}{name}", path
+        )
+
+    def artifacts(self, run_id: str) -> Mapping[str, TrackerArtifact]:
+        run = self._client.get_run(self._mlflow_run(run_id))
+        out = {}
+        for tag, value in run.data.tags.items():
+            if tag.startswith(ARTIFACT_TAG_PREFIX):
+                name = tag[len(ARTIFACT_TAG_PREFIX) :]
+                out[name] = TrackerArtifact(name=name, path=value)
+        return out
+
+    def add_metadata(self, run_id: str, **kwargs: Any) -> None:
+        mlrun = self._mlflow_run(run_id)
+        for key, value in kwargs.items():
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                self._client.log_param(mlrun, key, value)
+            else:
+                self._client.log_metric(mlrun, key, float(value))
+
+    def metadata(self, run_id: str) -> Mapping[str, Any]:
+        run = self._client.get_run(self._mlflow_run(run_id))
+        out: dict[str, Any] = dict(run.data.params)
+        out.update(run.data.metrics)
+        return out
+
+    def add_source(
+        self, run_id: str, source_id: str, artifact_name: Optional[str] = None
+    ) -> None:
+        existing = list(self.sources(run_id))
+        self._client.set_tag(
+            self._mlflow_run(run_id),
+            f"{SOURCE_TAG_PREFIX}{len(existing)}",
+            f"{source_id}|{artifact_name or ''}",
+        )
+
+    def sources(
+        self, run_id: str, artifact_name: Optional[str] = None
+    ) -> Iterable[TrackerSource]:
+        run = self._client.get_run(self._mlflow_run(run_id))
+        for tag, value in sorted(run.data.tags.items()):
+            if tag.startswith(SOURCE_TAG_PREFIX):
+                src, _, art = value.partition("|")
+                source = TrackerSource(source_run_id=src, artifact_name=art or None)
+                if artifact_name is None or source.artifact_name == artifact_name:
+                    yield source
+
+    def run_ids(self, **kwargs: str) -> Iterable[str]:
+        for run in self._client.search_runs([self._experiment_id]):
+            rid = run.data.tags.get(RUN_ID_TAG)
+            if rid:
+                yield rid
+
+
+def create(config: Optional[str]) -> MLflowTracker:
+    """Factory. config: ``[tracking_uri][;experiment=<name>]``."""
+    tracking_uri: Optional[str] = None
+    experiment = "tpx"
+    if config:
+        for part in config.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            if part.startswith("experiment="):
+                experiment = part.split("=", 1)[1]
+            else:
+                tracking_uri = part
+    return MLflowTracker(tracking_uri=tracking_uri, experiment_name=experiment)
