@@ -1,0 +1,228 @@
+"""tpu_vm scheduler (canned gcloud output) + pipeline DAG tests."""
+
+import json
+import subprocess
+
+import pytest
+
+from torchx_tpu.pipelines import Pipeline, topo_order
+from torchx_tpu.pipelines.kfp import pipeline_to_workflow
+from torchx_tpu.pipelines.local_runner import run_pipeline
+from torchx_tpu.runner.api import get_runner
+from torchx_tpu.schedulers.tpu_vm_scheduler import TpuVmScheduler
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppState,
+    Resource,
+    Role,
+    TpuSlice,
+)
+
+
+def completed(stdout="", rc=0, stderr=""):
+    return subprocess.CompletedProcess([], returncode=rc, stdout=stdout, stderr=stderr)
+
+
+def tpu_app(**role_kwargs) -> AppDef:
+    defaults = dict(
+        name="train",
+        image="",
+        entrypoint="python",
+        args=["-m", "train"],
+        env={"A": "1"},
+        resource=Resource(cpu=208, memMB=1000, tpu=TpuSlice("v5p", 16)),
+    )
+    defaults.update(role_kwargs)
+    return AppDef(name="train", roles=[Role(**defaults)])
+
+
+@pytest.fixture
+def sched():
+    return TpuVmScheduler("test")
+
+
+class TestTpuVmScheduler:
+    def test_dryrun_materializes_gcloud_cmd(self, sched):
+        info = sched.submit_dryrun(tpu_app(), {"zone": "us-east5-a"})
+        req = info.request
+        cmd = req.create_cmd()
+        assert "--accelerator-type=v5p-32" in cmd
+        assert "--zone=us-east5-a" in cmd
+        assert req.runtime_version == "v2-alpha-tpuv5"
+        script = req.startup_script
+        assert "TPX_NUM_REPLICAS=4" in script
+        assert "TPX_COORDINATOR_HOST" in script
+        assert "export A=1" in script
+
+    def test_spot_flag(self, sched):
+        info = sched.submit_dryrun(tpu_app(), {"zone": "z", "spot": True})
+        assert "--spot" in info.request.create_cmd()
+
+    def test_rejects_multi_role(self, sched):
+        app = tpu_app()
+        app.roles.append(Role(name="extra", image="i", entrypoint="e"))
+        with pytest.raises(ValueError, match="one role"):
+            sched.submit_dryrun(app, {"zone": "z"})
+
+    def test_rejects_cpu_role(self, sched):
+        app = AppDef(
+            name="x", roles=[Role(name="r", image="i", entrypoint="e")]
+        )
+        with pytest.raises(ValueError, match="TPU resource"):
+            sched.submit_dryrun(app, {"zone": "z"})
+
+    def test_requires_zone(self, sched):
+        from torchx_tpu.specs.api import InvalidRunConfigException
+
+        with pytest.raises(InvalidRunConfigException):
+            sched.submit_dryrun(tpu_app(), {})
+
+    def test_schedule_and_describe(self, sched, monkeypatch):
+        calls = []
+
+        def run_cmd(cmd, **kw):
+            calls.append(cmd)
+            if "create" in cmd:
+                return completed(stdout="{}")
+            if "describe" in cmd:
+                return completed(
+                    stdout=json.dumps(
+                        {"state": {"state": "ACTIVE"}, "tpu": {"nodeSpec": [{}]}}
+                    )
+                )
+            return completed()
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        info = sched.submit_dryrun(tpu_app(), {"zone": "us-east5-a"})
+        app_id = sched.schedule(info)
+        assert app_id.startswith("us-east5-a:train-")
+        resp = sched.describe(app_id)
+        assert resp.state == AppState.RUNNING
+
+    def test_describe_waiting(self, sched, monkeypatch):
+        monkeypatch.setattr(
+            sched,
+            "_run_cmd",
+            lambda cmd, **kw: completed(
+                stdout=json.dumps({"state": {"state": "WAITING_FOR_RESOURCES"}})
+            ),
+        )
+        assert sched.describe("z:n").state == AppState.PENDING
+
+    def test_describe_missing(self, sched, monkeypatch):
+        monkeypatch.setattr(sched, "_run_cmd", lambda cmd, **kw: completed(rc=1))
+        assert sched.describe("z:nope") is None
+
+    def test_cancel(self, sched, monkeypatch):
+        calls = []
+
+        def run_cmd(cmd, **kw):
+            calls.append(cmd)
+            if "describe" in cmd:
+                return completed(stdout=json.dumps({"state": {"state": "ACTIVE"}}))
+            return completed()
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        sched.cancel("z:n")
+        assert any("delete" in c for c in calls)
+
+
+class TestPipelineModel:
+    def app(self, name="a"):
+        return AppDef(
+            name=name, roles=[Role(name="r", image="", entrypoint="true")]
+        )
+
+    def test_topo_generations(self):
+        p = (
+            Pipeline("p")
+            .stage("a", self.app())
+            .stage("b", self.app(), depends_on=["a"])
+            .stage("c", self.app(), depends_on=["a"])
+            .stage("d", self.app(), depends_on=["b", "c"])
+        )
+        gens = topo_order(p)
+        names = [[s.name for s in g] for g in gens]
+        assert names[0] == ["a"]
+        assert sorted(names[1]) == ["b", "c"]
+        assert names[2] == ["d"]
+
+    def test_cycle_detected(self):
+        p = (
+            Pipeline("p")
+            .stage("a", self.app(), depends_on=["b"])
+            .stage("b", self.app(), depends_on=["a"])
+        )
+        with pytest.raises(ValueError, match="cycle"):
+            topo_order(p)
+
+    def test_unknown_dep(self):
+        p = Pipeline("p").stage("a", self.app(), depends_on=["ghost"])
+        with pytest.raises(ValueError, match="unknown"):
+            topo_order(p)
+
+    def test_duplicate_names(self):
+        p = Pipeline("p").stage("a", self.app()).stage("a", self.app())
+        with pytest.raises(ValueError, match="duplicate"):
+            topo_order(p)
+
+
+class TestLocalPipelineRun:
+    def sh_app(self, name, script):
+        return AppDef(
+            name=name,
+            roles=[Role(name=name, image="", entrypoint="sh", args=["-c", script])],
+        )
+
+    def test_three_stage_success(self, tmp_path):
+        p = (
+            Pipeline("p")
+            .stage("data", self.sh_app("data", f"echo d > {tmp_path}/data"))
+            .stage(
+                "train",
+                self.sh_app("train", f"test -f {tmp_path}/data && echo t > {tmp_path}/model"),
+                depends_on=["data"],
+            )
+            .stage(
+                "eval",
+                self.sh_app("eval", f"test -f {tmp_path}/model"),
+                depends_on=["train"],
+            )
+        )
+        with get_runner("pipe-test") as runner:
+            run = run_pipeline(
+                runner, p, "local", {"log_dir": str(tmp_path / "logs")}, wait_interval=0.1
+            )
+        assert run.state == AppState.SUCCEEDED
+        assert set(run.statuses) == {"data", "train", "eval"}
+
+    def test_failure_skips_downstream(self, tmp_path):
+        p = (
+            Pipeline("p")
+            .stage("bad", self.sh_app("bad", "exit 1"))
+            .stage("after", self.sh_app("after", "true"), depends_on=["bad"])
+        )
+        with get_runner("pipe-fail") as runner:
+            run = run_pipeline(
+                runner, p, "local", {"log_dir": str(tmp_path)}, wait_interval=0.1
+            )
+        assert run.state == AppState.FAILED
+        assert "after" not in run.handles  # never submitted
+
+
+class TestKfpAdapter:
+    def test_workflow_emission(self):
+        from torchx_tpu.examples.pipeline_data_train_eval import build_pipeline
+
+        p = build_pipeline("/tmp/w", tpu="v5p-32")
+        wf = pipeline_to_workflow(p)
+        assert wf["kind"] == "Workflow"
+        templates = {t["name"]: t for t in wf["spec"]["templates"]}
+        dag_tasks = {t["name"]: t for t in templates["dag"]["dag"]["tasks"]}
+        assert dag_tasks["train"]["dependencies"] == ["data"]
+        assert dag_tasks["eval"]["dependencies"] == ["train"]
+        # TPU multi-host train stage becomes a JobSet resource template
+        assert "resource" in templates["train"]
+        assert templates["train"]["resource"]["manifest"]["kind"] == "JobSet"
+        # single-pod stages are plain container templates
+        assert "container" in templates["data"]

@@ -142,6 +142,18 @@ class TestPlugins:
         quarter = reg.named_resources["superpod_quarter"]()
         assert quarter.tpu.chips == 2
 
+    def test_named_resource_visible_after_cache_invalidation(self):
+        from torchx_tpu.specs import named_resources
+
+        _ = named_resources["cpu_small"]  # populate the specs-level cache
+
+        @register.named_resource("late_resource")
+        def late():
+            return Resource(cpu=7, memMB=7)
+
+        get_registry(invalidate_cache=True)
+        assert named_resources["late_resource"].cpu == 7
+
     def test_plugin_tracker_with_colon_name(self, tmp_path, monkeypatch):
         from torchx_tpu.tracker.backend.fsspec import FsspecTracker as FT
 

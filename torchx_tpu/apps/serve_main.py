@@ -56,9 +56,14 @@ def main(argv: list[str] | None = None) -> None:
         "--params", default=None, help="extra query params k=v,k2=v2"
     )
     args = parser.parse_args(argv)
-    params = (
-        dict(p.split("=", 1) for p in args.params.split(",")) if args.params else None
-    )
+    params = None
+    if args.params:
+        params = {}
+        for pair in args.params.split(","):
+            if "=" not in pair:
+                parser.error(f"--params entry {pair!r} must be k=v")
+            k, _, v = pair.partition("=")
+            params[k] = v
     try:
         result = register_model(
             args.management_api,

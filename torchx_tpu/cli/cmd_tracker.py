@@ -55,24 +55,31 @@ class CmdTracker(SubCommand):
         args.tracker_fn(args)
 
     def _list(self, args: argparse.Namespace) -> None:
-        for name, tracker in _trackers().items():
+        trackers = _trackers()
+        # with multiple backends, prefix each line so outputs are attributable
+        prefix = (lambda name: f"[{name}] ") if len(trackers) > 1 else (lambda name: "")
+        for name, tracker in trackers.items():
             if args.what == "runs":
                 for run_id in tracker.run_ids():
-                    print(run_id)
+                    print(f"{prefix(name)}{run_id}")
             elif args.what == "metadata":
                 if not args.run_id:
                     print("run_id required for metadata", file=sys.stderr)
                     sys.exit(1)
+                if len(trackers) > 1:
+                    print(f"[{name}]")
                 print(json.dumps(dict(tracker.metadata(args.run_id)), indent=2))
             elif args.what == "artifacts":
                 if not args.run_id:
                     print("run_id required for artifacts", file=sys.stderr)
                     sys.exit(1)
                 for artifact in tracker.artifacts(args.run_id).values():
-                    print(f"{artifact.name}\t{artifact.path}")
+                    print(f"{prefix(name)}{artifact.name}\t{artifact.path}")
 
     def _lineage(self, args: argparse.Namespace) -> None:
-        for name, tracker in _trackers().items():
+        trackers = _trackers()
+        prefix = (lambda name: f"[{name}] ") if len(trackers) > 1 else (lambda name: "")
+        for name, tracker in trackers.items():
             for src in tracker.sources(args.run_id):
                 suffix = f" (artifact: {src.artifact_name})" if src.artifact_name else ""
-                print(f"{src.source_run_id}{suffix}")
+                print(f"{prefix(name)}{src.source_run_id}{suffix}")

@@ -37,7 +37,13 @@ def workspacefile(line: str, cell: str) -> None:
     rel = line.strip()
     if not rel:
         raise ValueError("usage: %%workspacefile <relative-path>")
-    path = os.path.join(get_workspace(), rel)
+    ws = get_workspace()
+    path = os.path.normpath(os.path.join(ws, rel))
+    if os.path.isabs(rel) or not path.startswith(ws + os.sep):
+        raise ValueError(
+            f"workspace file path must be relative and stay inside the"
+            f" workspace, got {rel!r}"
+        )
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w") as f:
         f.write(cell)

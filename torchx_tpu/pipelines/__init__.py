@@ -1,0 +1,17 @@
+"""Pipeline adapters: run component DAGs on pipeline providers.
+
+Reference analog: torchx/pipelines/__init__.py — in the reference this is
+only a namespace docstring ("transform the component into something
+understandable by the specific pipeline provider") with no concrete
+adapter in the snapshot. Here we ship a concrete data model plus two
+adapters:
+
+* :mod:`torchx_tpu.pipelines.local_runner` — executes the DAG through the
+  Runner on any registered scheduler (stage-level fan-out, fail-fast,
+  tracker lineage chaining),
+* :mod:`torchx_tpu.pipelines.kfp` — materializes the DAG as an Argo
+  Workflow spec (the engine under Kubeflow Pipelines), emitted as a plain
+  dict with no kfp dependency.
+"""
+
+from torchx_tpu.pipelines.api import Pipeline, Stage, topo_order  # noqa: F401

@@ -1,0 +1,79 @@
+"""Run a Pipeline DAG through the Runner on any registered scheduler.
+
+Generations run stage-by-stage: all stages of a generation are submitted
+concurrently, then awaited; a failed stage fails the pipeline and cancels
+its in-flight siblings (fail-fast). Each stage's run is lineage-linked to
+its dependencies via the tracker's parent-run mechanism.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Mapping, Optional
+
+from torchx_tpu.pipelines.api import Pipeline, topo_order
+from torchx_tpu.runner.api import Runner
+from torchx_tpu.specs.api import AppHandle, AppState, AppStatus, CfgVal
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PipelineRun:
+    pipeline: str
+    handles: dict[str, AppHandle] = field(default_factory=dict)
+    statuses: dict[str, AppStatus] = field(default_factory=dict)
+
+    @property
+    def state(self) -> AppState:
+        if any(
+            s.state in (AppState.FAILED, AppState.CANCELLED)
+            for s in self.statuses.values()
+        ):
+            return AppState.FAILED
+        if len(self.statuses) < len(self.handles) or not self.handles:
+            return AppState.RUNNING
+        return AppState.SUCCEEDED
+
+
+def run_pipeline(
+    runner: Runner,
+    pipeline: Pipeline,
+    scheduler: str,
+    cfg: Optional[Mapping[str, CfgVal]] = None,
+    wait_interval: float = 1.0,
+) -> PipelineRun:
+    """Execute the DAG; returns per-stage handles + terminal statuses."""
+    run = PipelineRun(pipeline=pipeline.name)
+    for generation in topo_order(pipeline):
+        # submit the whole generation
+        for stage in generation:
+            parent = (
+                run.handles.get(stage.depends_on[0]) if stage.depends_on else None
+            )
+            handle = runner.run(
+                stage.app, scheduler, cfg, parent_run_id=parent
+            )
+            run.handles[stage.name] = handle
+            logger.info("pipeline %s: stage %s -> %s", pipeline.name, stage.name, handle)
+        # await it
+        failed = False
+        for stage in generation:
+            status = runner.wait(run.handles[stage.name], wait_interval=wait_interval)
+            if status is None:
+                raise RuntimeError(
+                    f"stage {stage.name} vanished ({run.handles[stage.name]})"
+                )
+            run.statuses[stage.name] = status
+            if status.state != AppState.SUCCEEDED:
+                failed = True
+        if failed:
+            # cancel anything from this generation still running + stop
+            for stage in generation:
+                st = run.statuses.get(stage.name)
+                if st is not None and not st.is_terminal():
+                    runner.cancel(run.handles[stage.name])
+            logger.error("pipeline %s failed; skipping downstream stages", pipeline.name)
+            return run
+    return run

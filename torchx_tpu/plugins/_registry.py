@@ -122,13 +122,20 @@ def _discover_entry_points(registry: _Registry) -> None:
         for ep in eps:
             try:
                 loaded = ep.load()
-                if group == EP_GROUP_NAMED_RESOURCES and callable(loaded):
-                    # a named-resource entry may return a mapping of many
+                if group == EP_GROUP_NAMED_RESOURCES and ep.name.endswith(
+                    "named_resources"
+                ):
+                    # catalog convention: an entry named *named_resources
+                    # returns a mapping of many factories. Other entries are
+                    # single-resource factories and are NOT invoked at
+                    # discovery time (they may probe their environment).
                     result = loaded()
                     if isinstance(result, Mapping):
                         registry.named_resources.update(result)
-                        continue
-                    target[ep.name] = loaded
+                    else:
+                        raise TypeError(
+                            f"{ep.name} must return a mapping of factories"
+                        )
                 else:
                     target[ep.name] = loaded
             except Exception as e:  # noqa: BLE001
@@ -169,6 +176,14 @@ def get_registry(invalidate_cache: bool = False) -> _Registry:
     registry.named_resources.update(_registration._NAMED_RESOURCES)
     registry.trackers.update(_registration._TRACKERS)
     _registry = registry
+    if invalidate_cache:
+        # downstream caches merged from this registry must refresh too
+        try:
+            from torchx_tpu.specs import invalidate_named_resources_cache
+
+            invalidate_named_resources_cache()
+        except ImportError:
+            pass
     return registry
 
 

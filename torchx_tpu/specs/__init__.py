@@ -148,3 +148,9 @@ def resource(
 
 def get_named_resources() -> Mapping[str, Callable[[], Resource]]:
     return dict(_factories())
+
+
+def invalidate_named_resources_cache() -> None:
+    """Re-merge the registry on next access (called when plugins reload)."""
+    global _named_resource_factories
+    _named_resource_factories = None

@@ -52,12 +52,35 @@ class _LocalFS:
             return []
 
 
+class _RemoteFS:
+    """Adapts an fsspec filesystem to the _LocalFS contract (missing
+    directories list as empty instead of raising)."""
+
+    def __init__(self, fs) -> None:  # noqa: ANN001
+        self._fs = fs
+
+    def makedirs(self, path: str, exist_ok: bool = True) -> None:
+        self._fs.makedirs(path, exist_ok=exist_ok)
+
+    def open(self, path: str, mode: str):  # noqa: ANN202
+        return self._fs.open(path, mode)
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(path)
+
+    def ls(self, path: str) -> list[str]:
+        try:
+            return list(self._fs.ls(path, detail=False))
+        except FileNotFoundError:
+            return []
+
+
 def _fs_for(root: str):  # noqa: ANN202
     if "://" in root:
         import fsspec
 
         fs, _, _ = fsspec.get_fs_token_paths(root)
-        return fs
+        return _RemoteFS(fs)
     return _LocalFS()
 
 
