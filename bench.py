@@ -33,13 +33,15 @@ def main() -> None:
 
     on_tpu = platform == "tpu"
     if on_tpu:
-        cfg = llama.llama3_1b()
         seq, steps = 2048, 20
-        batch_candidates = [8, 4, 2, 1]
+        # (remat_policy, batch) in preference order; measured on v5e-1:
+        # dots@2 ~25% MFU beats full@4/8 ~24% (see docs/performance.md)
+        candidates = [("dots", 2), ("full", 8), ("full", 4), ("full", 2), ("full", 1)]
+        base_cfg = llama.llama3_1b
     else:
-        cfg = llama.llama_tiny()
         seq, steps = 128, 4
-        batch_candidates = [8]
+        candidates = [("full", 8)]
+        base_cfg = llama.llama_tiny
 
     from torchx_tpu.parallel.mesh import MeshConfig
 
@@ -47,22 +49,23 @@ def main() -> None:
 
     metrics = None
     batch_used = None
-    for batch in batch_candidates:
+    for policy, batch in candidates:
         try:
+            cfg = base_cfg(remat_policy=policy)
             metrics = train(cfg, mesh_cfg, batch=batch, seq=seq, steps=steps, log_every=4)
             batch_used = batch
             break
-        except Exception as e:  # noqa: BLE001 - OOM -> halve the batch
+        except Exception as e:  # noqa: BLE001 - OOM -> next candidate
             msg = str(e).lower()
             if any(
                 s in msg
                 for s in ("resource_exhausted", "out of memory", "hbm", "oom")
             ):
-                print(f"batch={batch} OOM, retrying smaller", file=sys.stderr)
+                print(f"{policy}@{batch} OOM, trying next", file=sys.stderr)
                 continue
             raise
     if metrics is None:
-        raise RuntimeError("all batch sizes OOMed")
+        raise RuntimeError("all bench configurations OOMed")
 
     result = {
         "metric": f"llama training tokens/sec/chip ({'llama3_1b' if on_tpu else 'tiny'},"

@@ -165,6 +165,35 @@ class TestLlama:
         )
         np.testing.assert_allclose(out, ref, atol=1e-3)
 
+    def test_chunked_loss_matches_unchunked(self):
+        cfg = llama.llama_tiny(max_seq=64, loss_chunk=16)
+        cfg_full = dataclasses.replace(cfg, loss_chunk=0)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 65), 0, 512)
+        batch = {"tokens": tokens}
+        np.testing.assert_allclose(
+            llama.loss_fn(params, batch, cfg),
+            llama.loss_fn(params, batch, cfg_full),
+            rtol=1e-5,
+        )
+        g1 = jax.grad(llama.loss_fn)(params, batch, cfg)
+        g2 = jax.grad(llama.loss_fn)(params, batch, cfg_full)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(a, b, atol=2e-5)
+
+    def test_chunked_loss_with_mask(self):
+        cfg = llama.llama_tiny(max_seq=64, loss_chunk=16)
+        cfg_full = dataclasses.replace(cfg, loss_chunk=0)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0, 512)
+        mask = jax.random.uniform(jax.random.PRNGKey(2), (2, 65)) > 0.5
+        batch = {"tokens": tokens, "loss_mask": mask}
+        np.testing.assert_allclose(
+            llama.loss_fn(params, batch, cfg),
+            llama.loss_fn(params, batch, cfg_full),
+            rtol=1e-5,
+        )
+
     def test_loss_decreases(self):
         from torchx_tpu.examples.train_llama import train
         from torchx_tpu.parallel.mesh import MeshConfig as MC

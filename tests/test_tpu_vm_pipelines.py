@@ -52,7 +52,9 @@ class TestTpuVmScheduler:
         script = req.startup_script
         assert "TPX_NUM_REPLICAS=4" in script
         assert "TPX_COORDINATOR_HOST" in script
-        assert "export A=1" in script
+        assert 'export A="1"' in script
+        # double-quoted (not single): $WORKER_ID-style macros must expand
+        assert "'" not in script.split("(")[1].split(")")[0]
 
     def test_spot_flag(self, sched):
         info = sched.submit_dryrun(tpu_app(), {"zone": "z", "spot": True})
@@ -196,6 +198,24 @@ class TestLocalPipelineRun:
         assert run.state == AppState.SUCCEEDED
         assert set(run.statuses) == {"data", "train", "eval"}
 
+    def test_fail_fast_cancels_sibling(self, tmp_path):
+        p = (
+            Pipeline("p")
+            .stage("fast-fail", self.sh_app("fastfail", "sleep 0.3; exit 1"))
+            .stage("slow", self.sh_app("slow", "sleep 60"))
+        )
+        import time as _time
+
+        t0 = _time.monotonic()
+        with get_runner("pipe-ff") as runner:
+            run = run_pipeline(
+                runner, p, "local", {"log_dir": str(tmp_path)}, wait_interval=0.1
+            )
+        assert run.state == AppState.FAILED
+        # the 60s sibling must have been cancelled promptly
+        assert _time.monotonic() - t0 < 30
+        assert run.statuses["slow"].state in (AppState.CANCELLED, AppState.FAILED)
+
     def test_failure_skips_downstream(self, tmp_path):
         p = (
             Pipeline("p")
@@ -221,8 +241,11 @@ class TestKfpAdapter:
         dag_tasks = {t["name"]: t for t in templates["dag"]["dag"]["tasks"]}
         assert dag_tasks["train"]["dependencies"] == ["data"]
         assert dag_tasks["eval"]["dependencies"] == ["train"]
-        # TPU multi-host train stage becomes a JobSet resource template
+        # TPU multi-host train stage becomes a JobSet resource template;
+        # the manifest must be a string (Argo CRD contract)
         assert "resource" in templates["train"]
-        assert templates["train"]["resource"]["manifest"]["kind"] == "JobSet"
+        manifest = templates["train"]["resource"]["manifest"]
+        assert isinstance(manifest, str)
+        assert json.loads(manifest)["kind"] == "JobSet"
         # single-pod stages are plain container templates
         assert "container" in templates["data"]

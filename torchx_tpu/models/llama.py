@@ -55,8 +55,15 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
     remat: bool = True
+    # remat policy: "full" recomputes everything (min memory);
+    # "dots" saves matmul outputs (fewer recomputes, more memory)
+    remat_policy: str = "full"
     attn_impl: str = "auto"  # auto | xla | pallas
     use_ring_attention: bool = False
+    # cross-entropy is computed in sequence chunks of this size so the
+    # [batch, seq, vocab] float32 logits never materialize (the dominant
+    # activation at 128k vocab); 0 disables chunking
+    loss_chunk: int = 512
 
     @property
     def head_dim(self) -> int:
@@ -251,39 +258,73 @@ def _layer(
     return _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
 
 
-def forward(
+def _remat(body, cfg: LlamaConfig):  # noqa: ANN001
+    if not cfg.remat:
+        return body
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    return jax.checkpoint(body)
+
+
+def forward_features(
     params: Params,
     tokens: jnp.ndarray,  # [b, s] int32
     cfg: LlamaConfig,
     mesh: Optional[Mesh] = None,
 ) -> jnp.ndarray:
-    """-> logits [b, s, vocab] float32."""
+    """-> final-norm hidden states [b, s, dim] (everything but the head)."""
     s = tokens.shape[1]
     x = params["embed"][tokens].astype(cfg.dtype)  # [b, s, d]
     x = _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
 
     cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
 
-    body = functools.partial(_layer, cfg, mesh, cos, sin)
-    if cfg.remat:
-        body = jax.checkpoint(body)  # recompute activations in backward
+    body = _remat(functools.partial(_layer, cfg, mesh, cos, sin), cfg)
 
     def scan_step(x, layer_slice):  # noqa: ANN001
         return body(x, layer_slice), None
 
     x, _ = jax.lax.scan(scan_step, x, params["layers"])
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = (
-        params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    )
+
+def lm_head(params: Params, cfg: LlamaConfig) -> jnp.ndarray:
+    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,  # [b, s] int32
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+) -> jnp.ndarray:
+    """-> logits [b, s, vocab] float32 (full materialization — use
+    :func:`loss_fn` for training, which never builds this tensor)."""
+    x = forward_features(params, tokens, cfg, mesh)
     logits = jnp.einsum(
-        "bsd,dv->bsv", x, head, preferred_element_type=jnp.float32
+        "bsd,dv->bsv", x, lm_head(params, cfg), preferred_element_type=jnp.float32
     )
     # keep the vocab axis tp-sharded: the lm_head einsum produces it that
     # way, and all-gathering [b, s, vocab] f32 logits would cost ~GBs of
     # HBM + ICI per step at 128k vocab (log_softmax is fine sharded)
     return _constraint(logits, mesh, ("dp", "fsdp"), "sp", "tp")
+
+
+def _token_nll(
+    x: jnp.ndarray,  # [b, c, d] hidden states
+    head: jnp.ndarray,  # [d, v]
+    targets: jnp.ndarray,  # [b, c]
+    mesh: Optional[Mesh] = None,
+) -> jnp.ndarray:
+    """-> per-token negative log-likelihood [b, c] float32."""
+    logits = jnp.einsum("bcd,dv->bcv", x, head, preferred_element_type=jnp.float32)
+    # keep the vocab axis tp-sharded (same guard as forward(): never
+    # all-gather [b, *, vocab] f32 on a tensor-parallel mesh)
+    logits = _constraint(logits, mesh, ("dp", "fsdp"), None, "tp")
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
 
 
 def loss_fn(
@@ -293,12 +334,41 @@ def loss_fn(
     mesh: Optional[Mesh] = None,
 ) -> jnp.ndarray:
     tokens = batch["tokens"]
-    logits = forward(params, tokens[:, :-1], cfg, mesh)
+    x = forward_features(params, tokens[:, :-1], cfg, mesh)
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    head = lm_head(params, cfg)
     mask = batch.get("loss_mask")
-    if mask is not None:
-        m = mask[:, 1:].astype(jnp.float32)
-        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
-    return -ll.mean()
+    m = mask[:, 1:].astype(jnp.float32) if mask is not None else None
+
+    s = targets.shape[1]
+    chunk = cfg.loss_chunk
+    if chunk and s % chunk == 0 and s > chunk:
+        # scan over sequence chunks with remat: only [b, chunk, vocab]
+        # logits ever exist (fwd and bwd) instead of [b, s, vocab]
+        b = targets.shape[0]
+        n = s // chunk
+        xs = x.reshape(b, n, chunk, -1).swapaxes(0, 1)  # [n, b, c, d]
+        ts = targets.reshape(b, n, chunk).swapaxes(0, 1)
+
+        def body(acc, xt):  # noqa: ANN001
+            x_c, t_c = xt
+            return acc + _token_nll(x_c, head, t_c, mesh).sum(), None
+
+        if m is None:
+            total, _ = jax.lax.scan(jax.checkpoint(body), jnp.float32(0), (xs, ts))
+            return total / (b * s)
+        ms = m.reshape(b, n, chunk).swapaxes(0, 1)
+
+        def body_masked(acc, xt):  # noqa: ANN001
+            x_c, t_c, m_c = xt
+            return acc + (_token_nll(x_c, head, t_c, mesh) * m_c).sum(), None
+
+        total, _ = jax.lax.scan(
+            jax.checkpoint(body_masked), jnp.float32(0), (xs, ts, ms)
+        )
+        return total / jnp.maximum(m.sum(), 1.0)
+
+    nll = _token_nll(x, head, targets, mesh)
+    if m is not None:
+        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return nll.mean()

@@ -17,6 +17,7 @@ submit, with success/failure conditions watching the JobSet status.
 
 from __future__ import annotations
 
+import json
 from typing import Any
 
 from torchx_tpu.pipelines.api import Pipeline, topo_order
@@ -50,7 +51,8 @@ def _stage_template(name: str, app: AppDef, namespace: str) -> dict[str, Any]:
                 "setOwnerReference": True,
                 "successCondition": "status.terminalState == Completed",
                 "failureCondition": "status.terminalState == Failed",
-                "manifest": jobset,
+                # Argo's resource.manifest field is a string (YAML/JSON)
+                "manifest": json.dumps(jobset, indent=2),
             },
         }
     pod = role_to_pod_template(
@@ -75,15 +77,17 @@ def pipeline_to_workflow(
 ) -> dict[str, Any]:
     """-> Argo Workflow resource dict implementing the DAG."""
     topo_order(pipeline)  # validates names/cycles
+    # sanitize each stage name exactly once: sanitize_name randomizes long
+    # names, so repeated calls would break template/task/dependency refs
+    names = {s.name: sanitize_name(s.name) for s in pipeline.stages}
     templates = [
-        _stage_template(sanitize_name(s.name), s.app, namespace)
-        for s in pipeline.stages
+        _stage_template(names[s.name], s.app, namespace) for s in pipeline.stages
     ]
     dag_tasks = [
         {
-            "name": sanitize_name(s.name),
-            "template": sanitize_name(s.name),
-            "dependencies": [sanitize_name(d) for d in s.depends_on],
+            "name": names[s.name],
+            "template": names[s.name],
+            "dependencies": [names[d] for d in s.depends_on],
         }
         for s in pipeline.stages
     ]

@@ -9,6 +9,7 @@ its dependencies via the tracker's parent-run mechanism.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Mapping, Optional
 
@@ -56,24 +57,57 @@ def run_pipeline(
                 stage.app, scheduler, cfg, parent_run_id=parent
             )
             run.handles[stage.name] = handle
+            _link_extra_parents(run, stage, handle)
             logger.info("pipeline %s: stage %s -> %s", pipeline.name, stage.name, handle)
-        # await it
+
+        # poll the generation concurrently: first failure cancels the
+        # still-running siblings (fail-fast — a dead stage must not let a
+        # 3-hour TPU sibling run to completion)
+        pending = {s.name for s in generation}
         failed = False
-        for stage in generation:
-            status = runner.wait(run.handles[stage.name], wait_interval=wait_interval)
-            if status is None:
-                raise RuntimeError(
-                    f"stage {stage.name} vanished ({run.handles[stage.name]})"
-                )
-            run.statuses[stage.name] = status
-            if status.state != AppState.SUCCEEDED:
-                failed = True
+        while pending:
+            for name in list(pending):
+                status = runner.status(run.handles[name])
+                if status is None:
+                    raise RuntimeError(f"stage {name} vanished ({run.handles[name]})")
+                if status.is_terminal():
+                    pending.discard(name)
+                    run.statuses[name] = status
+                    if status.state != AppState.SUCCEEDED:
+                        failed = True
+            if failed and pending:
+                for name in list(pending):
+                    logger.warning("cancelling in-flight stage %s", name)
+                    runner.cancel(run.handles[name])
+                    st = runner.status(run.handles[name])
+                    if st is not None:
+                        run.statuses[name] = st
+                    pending.discard(name)
+                break
+            if pending:
+                time.sleep(wait_interval)
         if failed:
-            # cancel anything from this generation still running + stop
-            for stage in generation:
-                st = run.statuses.get(stage.name)
-                if st is not None and not st.is_terminal():
-                    runner.cancel(run.handles[stage.name])
             logger.error("pipeline %s failed; skipping downstream stages", pipeline.name)
             return run
     return run
+
+
+def _link_extra_parents(run: PipelineRun, stage, handle: AppHandle) -> None:  # noqa: ANN001
+    """Stages with multiple dependencies get lineage to ALL parents: the
+    first rides the runner's parent_run_id env; the rest are written
+    client-side into the configured trackers (best-effort)."""
+    extra = [run.handles[d] for d in stage.depends_on[1:] if d in run.handles]
+    if not extra:
+        return
+    try:
+        from torchx_tpu.runner.config import load_tracker_sections
+        from torchx_tpu.tracker.api import _load_tracker
+
+        for name, config in load_tracker_sections().items():
+            tracker = _load_tracker(name, config)
+            if tracker is None:
+                continue
+            for parent in extra:
+                tracker.add_source(handle, parent)
+    except Exception as e:  # noqa: BLE001 - lineage is best-effort
+        logger.warning("could not record extra lineage for %s: %s", stage.name, e)

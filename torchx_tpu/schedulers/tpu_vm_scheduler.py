@@ -122,13 +122,19 @@ class TpuVmRequest:
         ) + f"\n--- startup script ---\n{self.startup_script}"
 
 
+def _dquote(s: str) -> str:
+    """Double-quote for bash: metachars are safe but ``$WORKER_ID`` (the
+    replica-id macro's runtime value) still expands."""
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"').replace("`", "\\`") + '"'
+
+
 def make_startup_script(role, app_id: str, num_hosts: int) -> str:  # noqa: ANN001
     """Per-host boot script: export gang env (worker id -> replica id,
     worker-0 hostname -> coordinator), run the entrypoint, tee logs."""
     env_exports = "\n".join(
-        f"export {k}={shlex.quote(v)}" for k, v in sorted(role.env.items())
+        f"export {k}={_dquote(v)}" for k, v in sorted(role.env.items())
     )
-    cmd = " ".join(shlex.quote(c) for c in [role.entrypoint, *role.args])
+    cmd = " ".join(_dquote(c) for c in [role.entrypoint, *role.args])
     return f"""#!/bin/bash
 mkdir -p /tmp/tpx
 # gang identity from the TPU VM metadata server (agent-worker-number) and
