@@ -100,7 +100,25 @@ def init_state(
         optimizer.init,
         out_shardings=None,  # let XLA choose opt-state shardings from params
     )(params)
-    return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+    state = TrainState(
+        params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32)
+    )
+    return normalize_state_shardings(state, mesh)
+
+
+def normalize_state_shardings(state: TrainState, mesh: Mesh) -> TrainState:
+    """Re-place any leaf committed to a single device (XLA puts optimizer
+    scalars there; orbax restores them there) as mesh-replicated, so every
+    leaf of the state lives on one consistent device set."""
+    replicated = NamedSharding(mesh, P())
+
+    def fix(x):  # noqa: ANN001
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None and len(sharding.device_set) < mesh.devices.size:
+            return jax.device_put(x, replicated)
+        return x
+
+    return jax.tree.map(fix, state)
 
 
 def make_train_step(
@@ -155,11 +173,30 @@ def train(
     log_every: int = 1,
     lr: float = 3e-4,
     warmup: int = 100,
+    ckpt_dir: Optional[str] = None,
+    ckpt_every: int = 0,
 ) -> dict[str, float]:
     cfg = dataclasses.replace(cfg, max_seq=seq)
     mesh = make_mesh(mesh_config)
     optimizer = make_optimizer(lr=lr, warmup=warmup)
     state = init_state(cfg, mesh, optimizer)
+
+    ckpt = None
+    resumed_step = 0
+    if ckpt_dir:
+        from torchx_tpu.parallel.checkpoint import Checkpointer
+
+        ckpt_every = ckpt_every or 100  # ckpt_dir alone must still checkpoint
+        ckpt = Checkpointer(ckpt_dir, save_interval_steps=ckpt_every)
+        # restore already re-places leaves onto the target shardings
+        # (init_state normalized them), so no further normalization needed
+        latest, restored = ckpt.restore_latest(state)
+        if latest is not None:
+            state = restored
+            resumed_step = latest
+            if jax.process_index() == 0:
+                print(f"resumed from checkpoint step {latest}", flush=True)
+
     train_step = make_train_step(cfg, mesh, optimizer)
     data = synthetic_batch(cfg, mesh, batch, seq)
 
@@ -197,9 +234,15 @@ def train(
 
     t0 = time.monotonic()
     timed_steps = max(steps - 1 - warmup_steps, 1)
+    # host-side global step counter: int(state.step) would force a
+    # device sync every iteration, breaking dispatch pipelining
+    global_step = resumed_step + 1 + warmup_steps
     for i in range(timed_steps):
         state, loss = train_step(state, data)
-        step_no = 1 + warmup_steps + i + 1
+        global_step += 1
+        step_no = global_step
+        if ckpt is not None and global_step % ckpt_every == 0:
+            ckpt.save(global_step, state)
         if (i + 1) % log_every == 0 or i + 1 == timed_steps:
             jax.block_until_ready(loss)
             dt = (time.monotonic() - t0) / (i + 1)
@@ -216,12 +259,18 @@ def train(
     jax.block_until_ready(state.params)
     total = time.monotonic() - t0
     tps = tokens_per_step * timed_steps / total
+    if ckpt is not None:
+        if ckpt.latest_step() != global_step:  # final state, any interval
+            ckpt.save(global_step, state, force=True)
+        ckpt.close()
     return {
         "loss": float(loss),
         "tokens_per_sec": tps,
         "tokens_per_sec_per_chip": tps / n_devices,
         "mfu": tps * flops_per_token / peak,
         "launch_to_first_step_s": first_step_s,
+        "final_step": int(state.step),
+        "resumed_from_step": resumed_step,
     }
 
 
@@ -233,13 +282,25 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--seq", type=int, default=128)
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--ring-attention", action="store_true")
+    parser.add_argument(
+        "--ckpt-dir", default=None, help="checkpoint directory (enables save+resume)"
+    )
+    parser.add_argument(
+        "--ckpt-every", type=int, default=0, help="save every N steps (default 100 when --ckpt-dir is set)"
+    )
     args = parser.parse_args(argv)
 
     cfg = llama.CONFIGS[args.config]()
     if args.ring_attention:
         cfg = dataclasses.replace(cfg, use_ring_attention=True)
     metrics = train(
-        cfg, parse_mesh_arg(args.mesh), args.batch, args.seq, args.steps
+        cfg,
+        parse_mesh_arg(args.mesh),
+        args.batch,
+        args.seq,
+        args.steps,
+        ckpt_dir=args.ckpt_dir,
+        ckpt_every=args.ckpt_every,
     )
     if jax.process_index() == 0:
         print("final:", metrics, flush=True)
