@@ -1,0 +1,81 @@
+"""AppDef JSON serialization + util module tests."""
+
+import pytest
+
+from torchx_tpu.specs.api import (
+    AppDef,
+    BindMount,
+    Resource,
+    RetryPolicy,
+    Role,
+    TpuSlice,
+)
+from torchx_tpu.specs.serialize import appdef_from_dict, appdef_to_dict
+from torchx_tpu.util.colors import colored, state_color
+from torchx_tpu.util.strings import normalize_str, truncate_middle
+
+
+class TestSerialize:
+    def make_app(self):
+        return AppDef(
+            name="train",
+            metadata={"team": "ml"},
+            roles=[
+                Role(
+                    name="trainer",
+                    image="img:1",
+                    entrypoint="python",
+                    args=["-m", "t"],
+                    env={"A": "1"},
+                    num_replicas=2,
+                    min_replicas=1,
+                    max_retries=3,
+                    retry_policy=RetryPolicy.APPLICATION,
+                    port_map={"coordinator": 8476},
+                    resource=Resource(
+                        cpu=8, memMB=1024, tpu=TpuSlice("v5p", 16, "2x2x4")
+                    ),
+                    mounts=[BindMount(src_path="/a", dst_path="/b", read_only=True)],
+                )
+            ],
+        )
+
+    def test_roundtrip(self):
+        app = self.make_app()
+        restored = appdef_from_dict(appdef_to_dict(app))
+        assert restored == app
+
+    def test_from_dict_minimal(self):
+        app = appdef_from_dict(
+            {"roles": [{"name": "r", "entrypoint": "echo", "args": ["hi"]}]}
+        )
+        assert app.roles[0].entrypoint == "echo"
+        assert app.roles[0].resource.tpu is None
+
+    def test_from_dict_no_roles(self):
+        with pytest.raises(ValueError):
+            appdef_from_dict({"name": "x"})
+
+
+class TestUtilStrings:
+    def test_normalize(self):
+        assert normalize_str("My Job!x") == "my-job-x"
+        assert len(normalize_str("x" * 100)) <= 63
+
+    def test_truncate_middle(self):
+        assert truncate_middle("abcdef", 10) == "abcdef"
+        out = truncate_middle("abcdefghijklmno", 9)
+        assert len(out) == 9 and "..." in out
+        assert out.startswith("abc") and out.endswith("o")
+
+
+class TestUtilColors:
+    def test_colored(self):
+        assert colored("x", "red") == "\x1b[31mx\x1b[0m"
+        assert colored("x", "red", enabled=False) == "x"
+        assert colored("x", "nope") == "x"
+
+    def test_state_color(self):
+        assert state_color("FAILED") == "red"
+        assert state_color("RUNNING") == "green"
+        assert state_color("???") == "gray"

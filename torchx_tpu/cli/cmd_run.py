@@ -12,13 +12,10 @@ import argparse
 import json
 import logging
 import sys
-import threading
-from typing import Optional
 
 from torchx_tpu.cli.cmd_base import SubCommand
 from torchx_tpu.runner import config as tpx_config
 from torchx_tpu.runner.api import Runner, get_runner
-from torchx_tpu.specs.api import parse_app_handle
 from torchx_tpu.specs.finder import (
     ComponentNotFoundException,
     ComponentValidationException,
@@ -68,6 +65,12 @@ class CmdRun(SubCommand):
             "--parent_run_id", type=str, default=None, help="tracker parent run id"
         )
         subparser.add_argument(
+            "--stdin",
+            action="store_true",
+            help="read an AppDef JSON job spec from stdin instead of a"
+            " component (see torchx_tpu.specs.serialize)",
+        )
+        subparser.add_argument(
             "conf_args",
             nargs=argparse.REMAINDER,
             help="component name followed by its arguments"
@@ -88,10 +91,14 @@ class CmdRun(SubCommand):
                 or get_default_scheduler_name()
             )
 
-        component, component_args = self._parse_component(args.conf_args)
-
         cfg = runner.scheduler_run_opts(scheduler).cfg_from_str(args.scheduler_args)
         tpx_config.apply(scheduler, cfg)
+
+        if args.stdin:
+            self._run_from_stdin(runner, args, scheduler, cfg)
+            return
+
+        component, component_args = self._parse_component(args.conf_args)
 
         try:
             if args.dryrun:
@@ -126,13 +133,49 @@ class CmdRun(SubCommand):
             sys.exit(1)
 
         print(app_handle)
-        # local runs auto-wait so ctrl-c cleans up children (reference
-        # cmd_run.py:321-324)
-        should_wait = args.wait or args.log or scheduler == "local"
-        if not should_wait:
-            return
+        self._maybe_wait(runner, args, scheduler, app_handle)
 
-        log_thread: Optional[threading.Thread] = None
+    def _run_from_stdin(self, runner: Runner, args, scheduler: str, cfg) -> None:  # noqa: ANN001
+        from torchx_tpu.specs.serialize import appdef_from_dict
+
+        try:
+            app = appdef_from_dict(json.load(sys.stdin))
+        except (json.JSONDecodeError, ValueError, KeyError, TypeError, AttributeError) as e:
+            print(f"error: invalid job spec on stdin: {e}", file=sys.stderr)
+            sys.exit(1)
+        try:
+            if args.dryrun:
+                info = runner.dryrun(
+                    app,
+                    scheduler,
+                    cfg,
+                    workspace=args.workspace,
+                    parent_run_id=args.parent_run_id,
+                )
+                print("=== APPLICATION ===")
+                print(_pretty_app(info._app))
+                print("=== SCHEDULER REQUEST ===")
+                print(info)
+                return
+            handle = runner.run(
+                app,
+                scheduler,
+                cfg,
+                workspace=args.workspace,
+                parent_run_id=args.parent_run_id,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            sys.exit(1)
+        print(handle)
+        self._maybe_wait(runner, args, scheduler, handle)
+
+    def _maybe_wait(self, runner: Runner, args, scheduler: str, app_handle: str) -> None:  # noqa: ANN001
+        """Local runs auto-wait (ctrl-c cleans up children); --wait/--log
+        force it elsewhere (reference cmd_run.py:321-324)."""
+        if not (args.wait or args.log or scheduler == "local"):
+            return
+        log_thread = None
         if args.log:
             from torchx_tpu.util.log_tee_helpers import tee_logs
 

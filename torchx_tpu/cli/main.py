@@ -42,13 +42,13 @@ def get_sub_cmds() -> dict[str, SubCommand]:
         "builtins": CmdBuiltins(),
         "configure": CmdConfigure(),
     }
-    try:
-        from importlib.metadata import entry_points
+    from torchx_tpu.util.entrypoints import load_group
 
-        for ep in entry_points(group=CMDS_ENTRYPOINT_GROUP):
-            cmds[ep.name] = ep.load()()
-    except Exception:  # noqa: BLE001
-        pass
+    for name, loader in load_group(CMDS_ENTRYPOINT_GROUP).items():
+        try:
+            cmds[name] = loader()()
+        except Exception:  # noqa: BLE001 - a broken plugin must not kill the CLI
+            pass
     try:
         from torchx_tpu.cli.cmd_tracker import CmdTracker
 

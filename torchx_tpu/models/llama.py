@@ -249,11 +249,16 @@ def _layer(
     x = x + attn_out
     x = _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
 
-    # mlp block (SwiGLU)
+    # mlp block: dense SwiGLU, or sparse MoE when the config carries experts
     mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(mlp_in @ layer["w_gate"])
-    up = mlp_in @ layer["w_up"]
-    down = (gate * up) @ layer["w_down"]
+    if getattr(cfg, "n_experts", 0):
+        from torchx_tpu.models.moe import moe_ffn
+
+        down = moe_ffn(cfg, layer, mlp_in)
+    else:
+        gate = jax.nn.silu(mlp_in @ layer["w_gate"])
+        up = mlp_in @ layer["w_up"]
+        down = (gate * up) @ layer["w_down"]
     x = x + down
     return _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
 

@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import random
-import re
 import string
 
 _ALPHABET = string.ascii_lowercase + string.digits  # base-36
@@ -20,5 +19,6 @@ def make_unique(name: str) -> str:
 
 def cleanup(name: str) -> str:
     """Normalize to DNS-1123-ish: lowercase alphanumerics and dashes."""
-    name = re.sub(r"[^a-z0-9\-]", "-", name.lower())
-    return re.sub(r"-+", "-", name).strip("-") or "app"
+    from torchx_tpu.util.strings import normalize_str
+
+    return normalize_str(name, max_len=10_000) or "app"

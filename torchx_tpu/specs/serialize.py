@@ -1,0 +1,129 @@
+"""AppDef <-> plain-dict (JSON) serialization.
+
+Powers ``tpx run --stdin`` (reference analog: JSON job-spec mode,
+cli/cmd_run.py:366-399) and programmatic job submission from non-Python
+clients: an AppDef round-trips through a stable JSON shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from torchx_tpu.specs.api import (
+    AppDef,
+    BindMount,
+    DeviceMount,
+    Resource,
+    RetryPolicy,
+    Role,
+    TpuSlice,
+    VolumeMount,
+)
+
+
+def appdef_to_dict(app: AppDef) -> dict[str, Any]:
+    return {
+        "name": app.name,
+        "metadata": dict(app.metadata),
+        "roles": [
+            {
+                "name": r.name,
+                "image": r.image,
+                "entrypoint": r.entrypoint,
+                "args": list(r.args),
+                "env": dict(r.env),
+                "num_replicas": r.num_replicas,
+                "min_replicas": r.min_replicas,
+                "max_retries": r.max_retries,
+                "retry_policy": r.retry_policy.value,
+                "port_map": dict(r.port_map),
+                "metadata": dict(r.metadata),
+                "resource": {
+                    "cpu": r.resource.cpu,
+                    "memMB": r.resource.memMB,
+                    "tpu": (
+                        {
+                            "accelerator": r.resource.tpu.accelerator,
+                            "chips": r.resource.tpu.chips,
+                            "topology": r.resource.tpu.topology,
+                        }
+                        if r.resource.tpu
+                        else None
+                    ),
+                    "capabilities": dict(r.resource.capabilities),
+                    "devices": dict(r.resource.devices),
+                    "tags": dict(r.resource.tags),
+                },
+                "mounts": [_mount_to_dict(m) for m in r.mounts],
+            }
+            for r in app.roles
+        ],
+    }
+
+
+def _mount_to_dict(m: Any) -> dict[str, Any]:
+    if isinstance(m, BindMount):
+        return {"type": "bind", "src": m.src_path, "dst": m.dst_path, "read_only": m.read_only}
+    if isinstance(m, VolumeMount):
+        return {"type": "volume", "src": m.src, "dst": m.dst_path, "read_only": m.read_only}
+    if isinstance(m, DeviceMount):
+        return {"type": "device", "src": m.src_path, "dst": m.dst_path, "permissions": m.permissions}
+    raise ValueError(f"unknown mount type: {m!r}")
+
+
+def _mount_from_dict(d: Mapping[str, Any]) -> Any:
+    t = d.get("type")
+    if t == "bind":
+        return BindMount(src_path=d["src"], dst_path=d["dst"], read_only=bool(d.get("read_only")))
+    if t == "volume":
+        return VolumeMount(src=d["src"], dst_path=d["dst"], read_only=bool(d.get("read_only")))
+    if t == "device":
+        return DeviceMount(src_path=d["src"], dst_path=d.get("dst", d["src"]), permissions=d.get("permissions", "rwm"))
+    raise ValueError(f"unknown mount type in {d!r}")
+
+
+def appdef_from_dict(data: Mapping[str, Any]) -> AppDef:
+    roles = []
+    for rd in data.get("roles", []):
+        res = rd.get("resource") or {}
+        tpu_d = res.get("tpu")
+        resource = Resource(
+            cpu=res.get("cpu", -1),
+            memMB=res.get("memMB", -1),
+            tpu=(
+                TpuSlice(
+                    accelerator=tpu_d["accelerator"],
+                    chips=int(tpu_d["chips"]),
+                    topology=tpu_d.get("topology"),
+                )
+                if tpu_d
+                else None
+            ),
+            capabilities=dict(res.get("capabilities") or {}),
+            devices=dict(res.get("devices") or {}),
+            tags=dict(res.get("tags") or {}),
+        )
+        roles.append(
+            Role(
+                name=rd["name"],
+                image=rd.get("image", ""),
+                entrypoint=rd.get("entrypoint", ""),
+                args=list(rd.get("args") or []),
+                env=dict(rd.get("env") or {}),
+                num_replicas=int(rd.get("num_replicas", 1)),
+                min_replicas=rd.get("min_replicas"),
+                max_retries=int(rd.get("max_retries", 0)),
+                retry_policy=RetryPolicy(rd.get("retry_policy", "APPLICATION")),
+                port_map={k: int(v) for k, v in (rd.get("port_map") or {}).items()},
+                metadata=dict(rd.get("metadata") or {}),
+                resource=resource,
+                mounts=[_mount_from_dict(m) for m in (rd.get("mounts") or [])],
+            )
+        )
+    if not roles:
+        raise ValueError("job spec has no roles")
+    return AppDef(
+        name=data.get("name", "app"),
+        roles=roles,
+        metadata=dict(data.get("metadata") or {}),
+    )

@@ -15,13 +15,13 @@ from typing import Optional, TextIO
 from torchx_tpu.runner.api import Runner
 from torchx_tpu.specs.api import AppStatus, is_started
 
-_COLORS = [36, 32, 33, 34, 35, 31]  # cyan, green, yellow, blue, magenta, red
+from torchx_tpu.util.colors import colored
+
+_COLOR_CYCLE = ["cyan", "green", "yellow", "blue", "magenta", "red"]
 
 
 def _colored(prefix: str, idx: int, enabled: bool) -> str:
-    if not enabled:
-        return prefix
-    return f"\x1b[{_COLORS[idx % len(_COLORS)]}m{prefix}\x1b[0m"
+    return colored(prefix, _COLOR_CYCLE[idx % len(_COLOR_CYCLE)], enabled)
 
 
 def find_role_replicas(
