@@ -45,6 +45,15 @@ class TestSerialize:
         restored = appdef_from_dict(appdef_to_dict(app))
         assert restored == app
 
+    def test_workspace_roundtrip(self):
+        from torchx_tpu.specs.api import Workspace
+
+        app = self.make_app()
+        app.roles[0].workspace = Workspace(projects={"./src": "app/src"})
+        restored = appdef_from_dict(appdef_to_dict(app))
+        assert restored == app
+        assert restored.roles[0].workspace.projects == {"./src": "app/src"}
+
     def test_from_dict_minimal(self):
         app = appdef_from_dict(
             {"roles": [{"name": "r", "entrypoint": "echo", "args": ["hi"]}]}

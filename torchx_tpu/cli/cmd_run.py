@@ -95,6 +95,14 @@ class CmdRun(SubCommand):
         tpx_config.apply(scheduler, cfg)
 
         if args.stdin:
+            leftover = [a for a in args.conf_args if a != "--"]
+            if leftover:
+                print(
+                    f"error: --stdin reads the job spec from stdin; remove"
+                    f" the component arguments {leftover!r}",
+                    file=sys.stderr,
+                )
+                sys.exit(1)
             self._run_from_stdin(runner, args, scheduler, cfg)
             return
 

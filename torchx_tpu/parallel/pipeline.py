@@ -1,0 +1,130 @@
+"""Pipeline parallelism: GPipe-style microbatched execution over a mesh axis.
+
+Completes the parallelism checklist (docs/architecture.md §2.8): the
+stacked-layer axis of a model's parameters shards over a ``pp`` mesh axis
+(each device owns a contiguous stage of layers), the batch splits into
+microbatches, and activations flow stage-to-stage via ``lax.ppermute``
+inside ``shard_map`` — the classic bubble schedule: step t runs microbatch
+``t - stage`` on each stage, total ``n_micro + n_stages - 1`` steps, bubble
+fraction ``(S-1)/(M+S-1)``.
+
+The primitive is generic over the layer body (the same signature
+``body(x, layer_params) -> x`` that ``llama._layer`` partials down to), and
+differentiable end-to-end (ppermute's transpose is the reverse permute;
+the scan saves per-step activations for backward — combine with
+``jax.checkpoint`` on the body for long pipelines).
+
+Usage::
+
+    mesh = make_pp_mesh(n_stages)                   # 1-axis ("pp") mesh
+    y = pipeline_apply(body, stacked_params, x, mesh, n_microbatches=8)
+
+``stacked_params`` leaves have a leading layer axis divisible by
+``n_stages``; ``x`` is [batch, ...] with batch divisible by
+``n_microbatches``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+LayerBody = Callable[[jnp.ndarray, Any], jnp.ndarray]
+
+
+def make_pp_mesh(n_stages: int, devices=None) -> Mesh:  # noqa: ANN001
+    import numpy as np
+
+    devs = list(devices) if devices is not None else jax.devices()[:n_stages]
+    if len(devs) < n_stages:
+        raise ValueError(f"need {n_stages} devices for {n_stages} stages")
+    return Mesh(np.array(devs[:n_stages]), ("pp",))
+
+
+def _stage_apply(body: LayerBody, local_layers: Any, x: jnp.ndarray) -> jnp.ndarray:
+    """Run this stage's local slice of layers (scan over the local stack)."""
+
+    def step(h, layer_slice):  # noqa: ANN001
+        return body(h, layer_slice), None
+
+    out, _ = jax.lax.scan(step, x, local_layers)
+    return out
+
+
+def _pipeline_shard(
+    body: LayerBody,
+    n_micro: int,
+    local_layers: Any,  # leaves [L/S, ...] — this stage's layers
+    x: jnp.ndarray,  # [n_micro, mb, ...] microbatched input (replicated)
+):
+    """Runs inside shard_map over ("pp",)."""
+    n_stages = jax.lax.psum(1, "pp")
+    stage = jax.lax.axis_index("pp")
+    mb_shape = x.shape[1:]
+    total_steps = n_micro + n_stages - 1
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def step(carry, t):  # noqa: ANN001
+        prev_out, outputs = carry
+        # stage 0 feeds microbatch t (clamped; garbage beyond M is masked by
+        # the output indexing), later stages receive the previous stage's
+        # output shifted forward one hop
+        x_t = jax.lax.dynamic_index_in_dim(
+            x, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False
+        )
+        incoming = jax.lax.ppermute(prev_out, "pp", fwd_perm)
+        my_in = jnp.where(stage == 0, x_t, incoming)
+        my_out = _stage_apply(body, local_layers, my_in)
+        # the last stage finished microbatch (t - (S-1)) at step t; before
+        # then, keep the existing (zero) slot so warmup garbage is masked
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        current = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+        slot = jnp.where(t >= n_stages - 1, my_out, current)
+        updated = jax.lax.dynamic_update_index_in_dim(outputs, slot, out_idx, axis=0)
+        return (my_out, updated), None
+
+    outputs0 = jnp.zeros((n_micro, *mb_shape), dtype=x.dtype)
+    prev0 = jnp.zeros(mb_shape, dtype=x.dtype)
+    (_, outputs), _ = jax.lax.scan(
+        step, (prev0, outputs0), jnp.arange(total_steps)
+    )
+    # only the last stage holds real outputs; broadcast them to all stages
+    outputs = jnp.where(stage == n_stages - 1, outputs, 0)
+    return jax.lax.psum(outputs, "pp")
+
+
+def pipeline_apply(
+    body: LayerBody,
+    stacked_params: Any,  # leaves [L, ...]
+    x: jnp.ndarray,  # [batch, ...]
+    mesh: Mesh,
+    n_microbatches: int,
+) -> jnp.ndarray:
+    """Apply L stacked layers to x, pipelined over the mesh's "pp" axis."""
+    n_stages = mesh.shape["pp"]
+    leaves = jax.tree.leaves(stacked_params)
+    n_layers = leaves[0].shape[0]
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible by {n_stages} stages")
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise ValueError(
+            f"batch {batch} not divisible by {n_microbatches} microbatches"
+        )
+    mb = batch // n_microbatches
+    x_micro = x.reshape(n_microbatches, mb, *x.shape[1:])
+
+    layer_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
+    fn = jax.shard_map(
+        functools.partial(_pipeline_shard, body, n_microbatches),
+        mesh=mesh,
+        in_specs=(layer_specs, P()),  # layers sharded by stage; x replicated
+        out_specs=P(),
+        check_vma=False,
+    )
+    out = fn(stacked_params, x_micro)
+    return out.reshape(batch, *out.shape[2:])

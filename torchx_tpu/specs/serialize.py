@@ -18,6 +18,7 @@ from torchx_tpu.specs.api import (
     Role,
     TpuSlice,
     VolumeMount,
+    Workspace,
 )
 
 
@@ -55,6 +56,9 @@ def appdef_to_dict(app: AppDef) -> dict[str, Any]:
                     "tags": dict(r.resource.tags),
                 },
                 "mounts": [_mount_to_dict(m) for m in r.mounts],
+                "workspace": (
+                    dict(r.workspace.projects) if r.workspace else None
+                ),
             }
             for r in app.roles
         ],
@@ -118,6 +122,11 @@ def appdef_from_dict(data: Mapping[str, Any]) -> AppDef:
                 metadata=dict(rd.get("metadata") or {}),
                 resource=resource,
                 mounts=[_mount_from_dict(m) for m in (rd.get("mounts") or [])],
+                workspace=(
+                    Workspace(projects=dict(rd["workspace"]))
+                    if rd.get("workspace")
+                    else None
+                ),
             )
         )
     if not roles:
