@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Run the component integration suite against a scheduler.
+
+Reference analog: torchx/scripts/component_integration_tests.py (drives the
+slurm/k8s/batch e2e CI workflows). Locally::
+
+    python scripts/component_integration_tests.py --scheduler local
+
+Against a cluster::
+
+    python scripts/component_integration_tests.py \
+        --scheduler gke -cfg namespace=ml --image us-docker.pkg.dev/p/r/img:1
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--scheduler", default="local")
+    parser.add_argument("--image", default="")
+    parser.add_argument(
+        "-cfg", "--scheduler_args", default="", help="k=v,k2=v2 scheduler cfg"
+    )
+    args = parser.parse_args()
+
+    from torchx_tpu.components.integration_tests import IntegComponentTest
+    from torchx_tpu.runner.api import get_runner
+
+    with get_runner() as runner:
+        cfg = runner.scheduler_run_opts(args.scheduler).cfg_from_str(
+            args.scheduler_args
+        )
+    suite = IntegComponentTest(scheduler=args.scheduler, image=args.image, cfg=cfg)
+    results = suite.run_components()
+    failed = False
+    for r in results:
+        mark = "PASS" if r.ok else "FAIL"
+        print(f"[{mark}] {r.provider}: state={r.state} handle={r.handle} {r.error or ''}")
+        failed = failed or not r.ok
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Data preprocessing app: text files -> packed token memmap.
+
+Reference analog: the datapreproc example (torchx/examples/apps/
+datapreproc) — a runnable data-prep stage for pipelines (see
+pipeline_data_train_eval.py). Tokenizes input text (byte-level by default;
+plugs into a HF tokenizer when --tokenizer is given) and writes one packed
+uint32 binary the trainer memory-maps.
+
+    tpx run -s local utils.python -m torchx_tpu.examples.datapreproc -- \
+        --input /data/corpus/*.txt --output /data/tokens.bin
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import sys
+
+import numpy as np
+
+
+def tokenize_bytes(text: str) -> list[int]:
+    """Byte-level tokenization (vocab 256 + BOS=256): zero-dependency
+    default so the pipeline runs anywhere."""
+    return [256] + list(text.encode("utf-8"))
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--input", required=True, nargs="+", help="text file globs")
+    parser.add_argument("--output", required=True, help="output .bin (uint32)")
+    parser.add_argument(
+        "--tokenizer",
+        default=None,
+        help="HF tokenizer name (default: byte-level)",
+    )
+    args = parser.parse_args(argv)
+
+    tokenizer = None
+    if args.tokenizer:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
+
+    paths = sorted(p for pattern in args.input for p in glob.glob(pattern))
+    if not paths:
+        print(f"no input files match {args.input}", file=sys.stderr)
+        sys.exit(1)
+
+    # stream file-by-file: memory stays bounded by the largest single file,
+    # not the corpus (the output format exists for corpora bigger than RAM)
+    total = 0
+    with open(args.output, "wb") as out:
+        for path in paths:
+            with open(path, errors="replace") as f:
+                text = f.read()
+            if tokenizer is not None:
+                arr = np.asarray(tokenizer.encode(text), dtype=np.uint32)
+            else:
+                arr = np.concatenate(
+                    [
+                        np.asarray([256], dtype=np.uint32),  # BOS per document
+                        np.frombuffer(text.encode("utf-8"), dtype=np.uint8).astype(
+                            np.uint32
+                        ),
+                    ]
+                )
+            arr.tofile(out)
+            total += len(arr)
+    print(f"wrote {total:,} tokens from {len(paths)} files -> {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -175,7 +175,12 @@ def train(
     warmup: int = 100,
     ckpt_dir: Optional[str] = None,
     ckpt_every: int = 0,
+    data_path: Optional[str] = None,
 ) -> dict[str, float]:
+    from torchx_tpu.parallel.xla_cache import setup_compilation_cache
+
+    setup_compilation_cache()  # relaunches compile in seconds, not minutes
+
     cfg = dataclasses.replace(cfg, max_seq=seq)
     mesh = make_mesh(mesh_config)
     optimizer = make_optimizer(lr=lr, warmup=warmup)
@@ -198,7 +203,16 @@ def train(
                 print(f"resumed from checkpoint step {latest}", flush=True)
 
     train_step = make_train_step(cfg, mesh, optimizer)
-    data = synthetic_batch(cfg, mesh, batch, seq)
+    if data_path:
+        from torchx_tpu.examples.data import TokenDataset, device_batches
+
+        batches = device_batches(
+            TokenDataset(data_path, seq, batch, start_step=resumed_step), mesh
+        )
+        next_batch = lambda: next(batches)  # noqa: E731
+    else:
+        data = synthetic_batch(cfg, mesh, batch, seq)
+        next_batch = lambda: data  # noqa: E731
 
     n_devices = jax.device_count()
     tokens_per_step = batch * seq
@@ -206,7 +220,7 @@ def train(
     peak = device_peak_flops() * n_devices
 
     # step 1 (compile + run) = launch-to-first-step
-    state, loss = train_step(state, data)
+    state, loss = train_step(state, next_batch())
     jax.block_until_ready(loss)
     first_step_s = time.monotonic() - _PROCESS_START
     if jax.process_index() == 0:
@@ -229,7 +243,7 @@ def train(
     # a few untimed warmup steps: dispatch pipelining + allocator settling
     warmup_steps = min(3, max(steps - 2, 0))
     for _ in range(warmup_steps):
-        state, loss = train_step(state, data)
+        state, loss = train_step(state, next_batch())
     jax.block_until_ready(loss)
 
     t0 = time.monotonic()
@@ -238,7 +252,7 @@ def train(
     # device sync every iteration, breaking dispatch pipelining
     global_step = resumed_step + 1 + warmup_steps
     for i in range(timed_steps):
-        state, loss = train_step(state, data)
+        state, loss = train_step(state, next_batch())
         global_step += 1
         step_no = global_step
         if ckpt is not None and global_step % ckpt_every == 0:
@@ -283,6 +297,9 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--ring-attention", action="store_true")
     parser.add_argument(
+        "--data", default=None, help="packed uint32 token file (see datapreproc); synthetic data when unset"
+    )
+    parser.add_argument(
         "--ckpt-dir", default=None, help="checkpoint directory (enables save+resume)"
     )
     parser.add_argument(
@@ -301,6 +318,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         args.steps,
         ckpt_dir=args.ckpt_dir,
         ckpt_every=args.ckpt_every,
+        data_path=args.data,
     )
     if jax.process_index() == 0:
         print("final:", metrics, flush=True)

@@ -1,0 +1,52 @@
+"""Persistent XLA compilation cache setup.
+
+Compilation dominates launch-to-first-step (the BASELINE north-star): the
+1B-model train step costs ~25 s to compile cold but ~4 s with a warm
+persistent cache (measured on v5e — docs/performance.md). Every relaunch
+— preemption recovery, elastic resize, hyperparameter sweeps over the
+same shapes — hits the cache, so the trainer enables it by default.
+
+Set ``TPX_XLA_CACHE_DIR=""`` (empty) to disable, or point it at a shared
+filesystem (e.g. a GCS-fused path) so all hosts of a slice — and future
+jobs — share one cache.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+ENV_TPX_XLA_CACHE_DIR = "TPX_XLA_CACHE_DIR"
+DEFAULT_CACHE_DIR = "~/.cache/tpx/xla"
+
+_configured = False
+
+
+def setup_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Enable the persistent compilation cache (idempotent).
+
+    Resolution: explicit arg > $TPX_XLA_CACHE_DIR > default under ~/.cache.
+    An empty value disables. Returns the directory used (or None).
+    """
+    global _configured
+    if _configured:
+        return None
+    import jax
+
+    if cache_dir is None:
+        cache_dir = os.environ.get(ENV_TPX_XLA_CACHE_DIR, DEFAULT_CACHE_DIR)
+    if not cache_dir:
+        return None
+    cache_dir = os.path.expanduser(cache_dir)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _configured = True
+        logger.info("persistent XLA compilation cache at %s", cache_dir)
+        return cache_dir
+    except Exception as e:  # noqa: BLE001 - cache is an optimization only
+        logger.warning("could not enable compilation cache: %s", e)
+        return None
