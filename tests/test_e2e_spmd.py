@@ -38,6 +38,30 @@ def test_spmd_mesh_formation(tmp_path):
 
 
 @pytest.mark.e2e
+def test_ddp_torchrun_world_size(tmp_path):
+    """The compat dist.ddp path: torchrun + c10d rendezvous + gloo
+    allreduce (the reference's canonical e2e, compute_world_size)."""
+    script = os.path.join(
+        os.path.dirname(torchx_tpu.__file__),
+        "examples",
+        "compute_world_size_torch.py",
+    )
+    with get_runner("ddp-e2e") as runner:
+        handle = runner.run_component(
+            "dist.ddp",
+            ["-j", "1x2", "--script", script],
+            "local",
+            {"log_dir": str(tmp_path)},
+        )
+        status = runner.wait(handle, wait_interval=0.5)
+        assert status is not None and status.state == AppState.SUCCEEDED, (
+            status and status.format()
+        )
+        lines = list(runner.log_lines(handle, "ddp", 0))
+        assert any("computed_world_size=2" in ln for ln in lines), lines
+
+
+@pytest.mark.e2e
 def test_spmd_failure_surfaces_structured_error(tmp_path):
     with get_runner("spmd-e2e-fail") as runner:
         handle = runner.run_component(

@@ -83,7 +83,15 @@ def main(argv: Optional[list[str]] = None) -> None:
     if not hasattr(args, "func"):
         parser.print_help()
         sys.exit(1)
-    args.func(args)
+    try:
+        args.func(args)
+    except BrokenPipeError:
+        # `tpx ... | head` closed the pipe; not an error
+        try:
+            sys.stdout.close()
+        except Exception:  # noqa: BLE001
+            pass
+        sys.exit(0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,35 @@
+"""Torch-side e2e probe: allreduce world size under dist.ddp.
+
+The exact analog of the reference's compute_world_size example
+(torchx/examples/apps/compute_world_size/main.py:10-28), for the compat
+``dist.ddp`` component: torchrun launches N workers, each allreduces 1
+over gloo and asserts the sum equals the world size.
+
+    tpx run -s local dist.ddp -j 1x2 --script torchx_tpu/examples/compute_world_size_torch.py
+"""
+
+from __future__ import annotations
+
+import os
+
+import torch
+import torch.distributed as dist
+
+
+def main() -> None:
+    backend = "gloo"  # CPU-safe; torchrun provides the rendezvous env
+    dist.init_process_group(backend=backend)
+    t = torch.ones(1)
+    dist.all_reduce(t)
+    world_size = int(t.item())
+    print(
+        f"rank={dist.get_rank()}/{dist.get_world_size()}"
+        f" computed_world_size={world_size}",
+        flush=True,
+    )
+    assert world_size == dist.get_world_size(), (world_size, dist.get_world_size())
+    dist.destroy_process_group()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Named-device -> mount mapping.
+
+Reference analog: torchx/schedulers/devices.py:17-54 — translates the
+named devices in ``Resource.devices`` (e.g. the EFA NIC on AWS) into the
+DeviceMounts a container backend needs. For TPU roles the docker backend
+mounts the host's accel nodes via :func:`local_tpu_device_mounts` (keyed
+on ``Resource.tpu``, not the devices dict); the mapping table covers
+named host devices like GPUs on mixed clusters.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+from torchx_tpu.specs.api import DeviceMount
+
+logger = logging.getLogger(__name__)
+
+
+def _nvidia_mounts(count: int) -> list[DeviceMount]:
+    return [
+        DeviceMount(src_path=f"/dev/nvidia{i}", dst_path=f"/dev/nvidia{i}")
+        for i in range(count)
+    ] + [
+        DeviceMount(src_path="/dev/nvidiactl", dst_path="/dev/nvidiactl"),
+        DeviceMount(src_path="/dev/nvidia-uvm", dst_path="/dev/nvidia-uvm"),
+    ]
+
+
+# NOTE: TPU chips are NOT named devices (Resource.tpu owns them; see
+# specs/api.py Resource.devices contract) — the docker backend mounts them
+# via local_tpu_device_mounts() keyed on Resource.tpu instead.
+DEVICE_MAPPINGS: dict[str, Callable[[int], list[DeviceMount]]] = {
+    "nvidia.com/gpu": _nvidia_mounts,
+}
+
+
+def local_tpu_device_mounts() -> list[DeviceMount]:
+    """Mounts for whatever accel chips THIS host actually has (used by the
+    docker scheduler for TPU roles, where the slice is the host's chips)."""
+    import glob
+
+    return [
+        DeviceMount(src_path=dev, dst_path=dev)
+        for dev in sorted(glob.glob("/dev/accel*"))
+    ]
+
+
+def get_device_mounts(devices: dict[str, int]) -> list[DeviceMount]:
+    """Resource.devices -> DeviceMounts; unknown names warn and skip
+    (backends that understand them natively, like k8s resource limits,
+    consume them from Resource.devices directly)."""
+    mounts: list[DeviceMount] = []
+    for name, count in devices.items():
+        mapper = DEVICE_MAPPINGS.get(name)
+        if mapper is None:
+            logger.warning("no device mount mapping for %r; skipping", name)
+            continue
+        mounts.extend(mapper(count))
+    return mounts

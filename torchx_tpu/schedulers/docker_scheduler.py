@@ -16,7 +16,6 @@ without a daemon.
 from __future__ import annotations
 
 import fnmatch
-import glob
 import logging
 import os
 from dataclasses import dataclass, field
@@ -195,10 +194,18 @@ class DockerScheduler(DockerWorkspaceMixin, Scheduler[DockerJob]):
                         )
                     elif isinstance(m, DeviceMount):
                         devices.append(f"{m.src_path}:{m.dst_path}:{m.permissions}")
+                # named devices (e.g. nvidia.com/gpu on mixed clusters)
+                from torchx_tpu.schedulers.devices import (
+                    get_device_mounts,
+                    local_tpu_device_mounts,
+                )
+
+                for dm in get_device_mounts(rrole.resource.devices):
+                    devices.append(f"{dm.src_path}:{dm.dst_path}:{dm.permissions}")
                 # TPU roles on a TPU-VM host need the accel device nodes
                 if rrole.resource.tpu is not None:
-                    for dev in sorted(glob.glob("/dev/accel*")):
-                        devices.append(f"{dev}:{dev}:rwm")
+                    for dm in local_tpu_device_mounts():
+                        devices.append(f"{dm.src_path}:{dm.dst_path}:{dm.permissions}")
 
                 kwargs: dict[str, Any] = {
                     "name": name,

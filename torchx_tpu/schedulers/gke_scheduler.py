@@ -45,6 +45,7 @@ from torchx_tpu.schedulers.api import (
     filter_regex,
 )
 from torchx_tpu.schedulers.ids import cleanup, make_unique, random_id
+from torchx_tpu.schedulers.structured_opts import StructuredOpts
 from torchx_tpu.specs.api import (
     AppDef,
     AppDryRunInfo,
@@ -105,6 +106,25 @@ LABEL_APP_NAME = "tpx.sh/app-name"
 LABEL_ROLE_NAME = "tpx.sh/role-name"
 LABEL_VERSION = "tpx.sh/version"
 ANNOTATION_APP = "tpx.sh/appdef"
+
+
+@dataclass
+class GKEOpts(StructuredOpts):
+    """Typed run config for the gke scheduler (StructuredOpts generates the
+    runopts schema from these fields + attribute docstrings)."""
+
+    namespace: str = "default"
+    """k8s namespace to submit into."""
+
+    queue: Optional[str] = None
+    """Kueue LocalQueue name for gang admission (jobs submit suspended and
+    Kueue unsuspends when the full slice fits)."""
+
+    service_account: Optional[str] = None
+    """k8s service account for the pods."""
+
+    coordinator_port: int = settings.TPX_COORDINATOR_PORT
+    """jax.distributed coordinator port."""
 
 
 @dataclass
@@ -410,46 +430,27 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
     # -- runopts ----------------------------------------------------------
 
     def run_opts(self) -> runopts:
-        opts = runopts()
-        opts.add("namespace", type_=str, help="k8s namespace", default="default")
-        opts.add(
-            "queue",
-            type_=str,
-            help="Kueue LocalQueue name for gang admission (jobs submit"
-            " suspended and Kueue unsuspends when the full slice fits)",
-            default=None,
-        )
-        opts.add(
-            "service_account",
-            type_=str,
-            help="k8s service account for the pods",
-            default=None,
-        )
-        opts.add(
-            "coordinator_port",
-            type_=int,
-            help="jax.distributed coordinator port",
-            default=settings.TPX_COORDINATOR_PORT,
-        )
-        return opts | self.workspace_opts()
+        return GKEOpts.to_runopts() | self.workspace_opts()
 
     # -- dryrun / schedule -------------------------------------------------
 
     def _submit_dryrun(
         self, app: AppDef, cfg: Mapping[str, CfgVal]
     ) -> AppDryRunInfo[GKEJob]:
+        opts = GKEOpts.from_cfg(cfg)
+        namespace = opts.namespace or "default"  # '' from `-cfg namespace=`
         app_name = sanitize_name(make_unique(app.name))
         images_to_push = self.dryrun_push_images(app, cfg)
         resource = app_to_jobset(
             app,
             app_name,
-            namespace=str(cfg.get("namespace") or "default"),
-            queue=cfg.get("queue"),  # type: ignore[arg-type]
-            service_account=cfg.get("service_account"),  # type: ignore[arg-type]
-            coordinator_port=int(cfg.get("coordinator_port") or settings.TPX_COORDINATOR_PORT),
+            namespace=namespace,
+            queue=opts.queue,
+            service_account=opts.service_account,
+            coordinator_port=opts.coordinator_port,
         )
         req = GKEJob(
-            namespace=str(cfg.get("namespace") or "default"),
+            namespace=namespace,
             resource=resource,
             images_to_push=images_to_push,
         )
