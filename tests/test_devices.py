@@ -1,0 +1,41 @@
+"""Named-device mount mapping tests."""
+
+import numpy as np  # noqa: F401
+
+
+class TestDeviceMounts:
+    def test_gpu_mapping(self):
+        from torchx_tpu.schedulers.devices import get_device_mounts
+
+        gpu = get_device_mounts({"nvidia.com/gpu": 1})
+        assert gpu[0].src_path == "/dev/nvidia0"
+        assert any("nvidiactl" in m.src_path for m in gpu)
+
+    def test_docker_scheduler_maps_named_devices(self):
+        from unittest import mock
+
+        from torchx_tpu.schedulers.docker_scheduler import DockerScheduler
+        from torchx_tpu.specs.api import AppDef, Resource, Role
+
+        sched = DockerScheduler("t", docker_client=mock.MagicMock())
+        app = AppDef(
+            name="g",
+            roles=[
+                Role(
+                    name="g",
+                    image="i",
+                    entrypoint="e",
+                    resource=Resource(cpu=1, memMB=1, devices={"nvidia.com/gpu": 1}),
+                )
+            ],
+        )
+        info = sched.submit_dryrun(app, {})
+        devs = info.request.containers[0].kwargs["devices"]
+        assert "/dev/nvidia0:/dev/nvidia0:rwm" in devs
+
+    def test_unknown_device_skipped(self):
+        from torchx_tpu.schedulers.devices import get_device_mounts
+
+        assert get_device_mounts({"vendor.com/thing": 1}) == []
+
+

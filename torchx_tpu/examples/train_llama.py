@@ -176,6 +176,7 @@ def train(
     ckpt_dir: Optional[str] = None,
     ckpt_every: int = 0,
     data_path: Optional[str] = None,
+    profile_dir: Optional[str] = None,
 ) -> dict[str, float]:
     from torchx_tpu.parallel.xla_cache import setup_compilation_cache
 
@@ -246,6 +247,11 @@ def train(
         state, loss = train_step(state, next_batch())
     jax.block_until_ready(loss)
 
+    if profile_dir and jax.process_index() == 0:
+        # xprof trace of the steady-state steps (view with tensorboard or
+        # xprofiler; the TPU observability hook from SURVEY §5)
+        jax.profiler.start_trace(profile_dir)
+
     t0 = time.monotonic()
     timed_steps = max(steps - 1 - warmup_steps, 1)
     # host-side global step counter: int(state.step) would force a
@@ -272,6 +278,9 @@ def train(
                 )
     jax.block_until_ready(state.params)
     total = time.monotonic() - t0
+    if profile_dir and jax.process_index() == 0:
+        jax.profiler.stop_trace()
+        print(f"profile trace written to {profile_dir}", flush=True)
     tps = tokens_per_step * timed_steps / total
     if ckpt is not None:
         if ckpt.latest_step() != global_step:  # final state, any interval
@@ -300,6 +309,9 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--data", default=None, help="packed uint32 token file (see datapreproc); synthetic data when unset"
     )
     parser.add_argument(
+        "--profile-dir", default=None, help="write an xprof trace of the timed steps here"
+    )
+    parser.add_argument(
         "--ckpt-dir", default=None, help="checkpoint directory (enables save+resume)"
     )
     parser.add_argument(
@@ -319,6 +331,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         ckpt_dir=args.ckpt_dir,
         ckpt_every=args.ckpt_every,
         data_path=args.data,
+        profile_dir=args.profile_dir,
     )
     if jax.process_index() == 0:
         print("final:", metrics, flush=True)

@@ -38,13 +38,16 @@ DEVICE_MAPPINGS: dict[str, Callable[[int], list[DeviceMount]]] = {
 
 def local_tpu_device_mounts() -> list[DeviceMount]:
     """Mounts for whatever accel chips THIS host actually has (used by the
-    docker scheduler for TPU roles, where the slice is the host's chips)."""
+    docker scheduler for TPU roles, where the slice is the host's chips).
+    Covers both exposure modes the local scheduler counts: /dev/accel*
+    and vfio (/dev/vfio/N + the container's /dev/vfio/vfio control node)."""
     import glob
 
-    return [
-        DeviceMount(src_path=dev, dst_path=dev)
-        for dev in sorted(glob.glob("/dev/accel*"))
-    ]
+    nodes = sorted(glob.glob("/dev/accel*"))
+    vfio = sorted(glob.glob("/dev/vfio/[0-9]*"))
+    if not nodes and vfio:
+        nodes = ["/dev/vfio/vfio", *vfio]
+    return [DeviceMount(src_path=dev, dst_path=dev) for dev in nodes]
 
 
 def get_device_mounts(devices: dict[str, int]) -> list[DeviceMount]:

@@ -31,6 +31,10 @@ from torchx_tpu.schedulers.api import (
     role_replica_env,
     tpu_hosts_for_role,
 )
+from torchx_tpu.schedulers.devices import (
+    get_device_mounts,
+    local_tpu_device_mounts,
+)
 from torchx_tpu.schedulers.ids import make_unique
 from torchx_tpu.specs.api import (
     AppDef,
@@ -195,11 +199,6 @@ class DockerScheduler(DockerWorkspaceMixin, Scheduler[DockerJob]):
                     elif isinstance(m, DeviceMount):
                         devices.append(f"{m.src_path}:{m.dst_path}:{m.permissions}")
                 # named devices (e.g. nvidia.com/gpu on mixed clusters)
-                from torchx_tpu.schedulers.devices import (
-                    get_device_mounts,
-                    local_tpu_device_mounts,
-                )
-
                 for dm in get_device_mounts(rrole.resource.devices):
                     devices.append(f"{dm.src_path}:{dm.dst_path}:{dm.permissions}")
                 # TPU roles on a TPU-VM host need the accel device nodes

@@ -348,13 +348,20 @@ def app_to_jobset(
             "backoffLimit": 0,  # gang: restarts are JobSet-level
             "template": pod_template,
         }
-        replicated_jobs.append(
-            {
-                "name": role_name,
-                "replicas": job_replicas,
-                "template": {"spec": job_spec},
+        rj: dict[str, Any] = {
+            "name": role_name,
+            "replicas": job_replicas,
+            "template": {"spec": job_spec},
+        }
+        if role.min_replicas is not None:
+            # elastic lower bound: SPMD worlds resize by restart (checkpoint
+            # resume + warm compile cache make that cheap), so the bound is
+            # surfaced for external autoscalers/Kueue rather than mapped to
+            # an in-place JobSet mechanism
+            rj["template"]["metadata"] = {
+                "annotations": {"tpx.sh/min-replicas": str(role.min_replicas)}
             }
-        )
+        replicated_jobs.append(rj)
 
     jobset_spec: dict[str, Any] = {
         "replicatedJobs": replicated_jobs,
