@@ -1,8 +1,5 @@
 """Named-device mount mapping tests."""
 
-import numpy as np  # noqa: F401
-
-
 class TestDeviceMounts:
     def test_gpu_mapping(self):
         from torchx_tpu.schedulers.devices import get_device_mounts

@@ -108,6 +108,31 @@ class TestRingAttention:
         np.testing.assert_allclose(g_ring, g_ref, atol=1e-4)
 
 
+class TestUlyssesAttention:
+    def test_matches_reference_fwd_bwd(self):
+        from torchx_tpu.ops.ulysses import ulysses_attention
+
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=2, tp=1, sp=4))
+        b, s, h, kvh, d = 4, 32, 8, 4, 16
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, s, kvh, d))
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, s, kvh, d))
+        ref = xla_attention(q, k, v, causal=True)
+        out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh))(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+        g1 = jax.grad(lambda q: jnp.sum(ulysses_attention(q, k, v, mesh) ** 2))(q)
+        g2 = jax.grad(lambda q: jnp.sum(xla_attention(q, k, v, True) ** 2))(q)
+        np.testing.assert_allclose(g1, g2, atol=1e-5)
+
+    def test_heads_not_divisible_raises(self):
+        from torchx_tpu.ops.ulysses import ulysses_attention
+
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=2, tp=1, sp=4))
+        q = jnp.zeros((2, 32, 6, 8))  # 6 heads % 4 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention(q, q, q, mesh)
+
+
 class TestLlama:
     def test_forward_shapes_and_dtype(self):
         cfg = llama.llama_tiny()

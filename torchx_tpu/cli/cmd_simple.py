@@ -20,22 +20,14 @@ class CmdStatus(SubCommand):
         subparser.add_argument("app_handle", help="scheduler://session/app_id")
 
     def run(self, args: argparse.Namespace) -> None:
-        from torchx_tpu.util.colors import colored, state_color, supports_color
+        from torchx_tpu.util.colors import supports_color
 
         with get_runner() as runner:
             status = runner.status(args.app_handle)
             if status is None:
                 print(f"app not found: {args.app_handle}", file=sys.stderr)
                 sys.exit(1)
-            text = status.format()
-            if supports_color():
-                name = status.state.name
-                text = text.replace(
-                    f"state: {name}",
-                    f"state: {colored(name, state_color(name))}",
-                    1,
-                )
-            print(text)
+            print(status.format(colored=supports_color()))
 
 
 class CmdDescribe(SubCommand):

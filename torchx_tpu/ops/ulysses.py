@@ -1,0 +1,86 @@
+"""Ulysses attention: all-to-all sequence parallelism.
+
+The second context-parallel strategy from the checklist (alongside
+:mod:`torchx_tpu.ops.ring_attention`): instead of rotating KV blocks,
+Ulysses **re-shards** — an all-to-all turns the sequence-sharded layout
+[b, s/P, h, d] into a head-sharded layout [b, s, h/P, d], each device runs
+ordinary full attention over its head group (any kernel: here the fused
+XLA path), and a second all-to-all transposes back.
+
+Trade-offs vs ring attention: two all-to-alls instead of P ppermute hops
+(cheaper on small meshes, and the inner attention is a single dense
+kernel), but the head count must be divisible by the mesh axis and peak
+memory holds the full sequence per device for its head group. Use ring
+for very long sequences, Ulysses when heads >> mesh axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchx_tpu.ops.attention import xla_attention
+
+
+def _ulysses_shard(
+    q: jnp.ndarray,  # [b, s/P, h, d] local sequence shard
+    k: jnp.ndarray,  # [b, s/P, kv_h, d]
+    v: jnp.ndarray,
+    axis_name: str,
+) -> jnp.ndarray:
+    def seq_to_heads(x: jnp.ndarray) -> jnp.ndarray:
+        # [b, s/P, h, d] -> [b, s, h/P, d]: tiled all-to-all splits the head
+        # axis into P groups and gathers the full sequence
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x: jnp.ndarray) -> jnp.ndarray:
+        # inverse: [b, s, h/P, d] -> [b, s/P, h, d]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    q_g = seq_to_heads(q)
+    k_g = seq_to_heads(k)
+    v_g = seq_to_heads(v)
+    out = xla_attention(q_g, k_g, v_g, causal=True)  # full seq, local heads
+    return heads_to_seq(out)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,  # [b, s, h, d] globally, s sharded over seq_axis
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    seq_axis: str = "sp",
+    batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+) -> jnp.ndarray:
+    """Causal attention over a sequence-sharded layout via all-to-all.
+
+    Requires n_heads and kv_heads divisible by seq_axis * head_axis sizes
+    (heads stay sharded over ``head_axis`` like ring_attention; the
+    all-to-all only exchanges within the seq axis).
+    """
+    n = mesh.shape[seq_axis]
+    h_shard = mesh.shape.get(head_axis, 1) if head_axis else 1
+    if q.shape[2] % (n * h_shard) or k.shape[2] % (n * h_shard):
+        raise ValueError(
+            f"ulysses needs heads divisible by mesh axes {seq_axis}={n}"
+            f" x {head_axis}={h_shard};"
+            f" got q heads {q.shape[2]}, kv heads {k.shape[2]}"
+        )
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_shard, axis_name=seq_axis),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

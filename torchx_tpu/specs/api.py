@@ -605,10 +605,17 @@ class AppStatus:
             f"  python_traceback: {ext.get('py_callstack', '<n/a>')}"
         )
 
-    def format(self) -> str:
+    def format(self, colored: bool = False) -> str:
+        def paint(state: AppState) -> str:
+            if not colored:
+                return str(state)
+            from torchx_tpu.util.colors import colored as c, state_color
+
+            return c(state.name, state_color(state.name))
+
         lines = [
             f"AppStatus:",
-            f"  state: {self.state}",
+            f"  state: {paint(self.state)}",
             f"  num_restarts: {self.num_restarts}",
         ]
         if self.msg:
@@ -623,7 +630,7 @@ class AppStatus:
             lines.append(f"  role: {rs.role}")
             for r in rs.replicas:
                 host = f" on {r.hostname}" if r.hostname else ""
-                lines.append(f"    [{r.id}] {r.state}{host}")
+                lines.append(f"    [{r.id}] {paint(r.state)}{host}")
         return "\n".join(lines)
 
     def __str__(self) -> str:
