@@ -1,0 +1,154 @@
+"""CLI unit tests (reference analog: torchx/cli/test/cmd_run_test.py)."""
+
+import io
+import json
+import sys
+from contextlib import redirect_stderr, redirect_stdout
+from unittest import mock
+
+import pytest
+
+from torchx_tpu.cli.main import create_parser, get_sub_cmds, main
+
+
+def run_cli(argv, stdin_text=None):
+    """-> (exit_code, stdout, stderr)"""
+    out, err = io.StringIO(), io.StringIO()
+    code = 0
+    stdin_patch = (
+        mock.patch.object(sys, "stdin", io.StringIO(stdin_text))
+        if stdin_text is not None
+        else mock.patch.object(sys, "stdin", sys.stdin)
+    )
+    try:
+        with redirect_stdout(out), redirect_stderr(err), stdin_patch:
+            main(argv)
+    except SystemExit as e:
+        code = e.code if isinstance(e.code, int) else 1
+    return code, out.getvalue(), err.getvalue()
+
+
+class TestParser:
+    def test_all_subcommands_registered(self):
+        cmds = get_sub_cmds()
+        for expected in (
+            "run",
+            "status",
+            "describe",
+            "list",
+            "log",
+            "cancel",
+            "delete",
+            "runopts",
+            "builtins",
+            "configure",
+            "tracker",
+        ):
+            assert expected in cmds, expected
+
+    def test_no_subcommand_prints_help(self):
+        code, out, err = run_cli([])
+        assert code == 1
+
+    def test_version(self):
+        with pytest.raises(SystemExit) as e:
+            create_parser().parse_args(["--version"])
+        assert e.value.code == 0
+
+
+class TestCmdRun:
+    def test_dryrun_echo(self):
+        code, out, _ = run_cli(
+            ["run", "-s", "local", "--dryrun", "utils.echo", "--msg", "cli-test"]
+        )
+        assert code == 0
+        assert "=== APPLICATION ===" in out
+        assert "cli-test" in out
+
+    def test_unknown_component(self):
+        code, _, err = run_cli(["run", "-s", "local", "no.such.component"])
+        assert code == 1
+        assert "not found" in err
+
+    def test_component_value_error_clean(self):
+        code, _, err = run_cli(
+            ["run", "-s", "local", "--dryrun", "dist.spmd", "-j", "zzz", "-m", "x"]
+        )
+        assert code == 1
+        assert "error:" in err and "Traceback" not in err
+
+    def test_unknown_scheduler(self):
+        code, _, err = run_cli(["run", "-s", "marscluster", "utils.echo"])
+        assert code == 1
+
+    def test_stdin_dryrun(self):
+        spec = json.dumps(
+            {
+                "name": "j",
+                "roles": [
+                    {"name": "r", "entrypoint": "echo", "args": ["hi"], "image": ""}
+                ],
+            }
+        )
+        code, out, _ = run_cli(
+            ["run", "-s", "local", "--dryrun", "--stdin"], stdin_text=spec
+        )
+        assert code == 0 and '"hi"' in out
+
+    def test_stdin_rejects_component_args(self):
+        code, _, err = run_cli(
+            ["run", "-s", "local", "--stdin", "utils.echo"], stdin_text="{}"
+        )
+        assert code == 1 and "--stdin" in err
+
+    def test_stdin_invalid_json(self):
+        code, _, err = run_cli(
+            ["run", "-s", "local", "--stdin"], stdin_text="not json"
+        )
+        assert code == 1 and "invalid job spec" in err
+
+    def test_run_and_status_roundtrip(self, tmp_path):
+        code, out, _ = run_cli(
+            [
+                "run",
+                "-s",
+                "local",
+                "-cfg",
+                f"log_dir={tmp_path}",
+                "utils.echo",
+                "--msg",
+                "roundtrip",
+            ]
+        )
+        assert code == 0
+        assert "SUCCEEDED" in out
+
+
+class TestCmdBuiltinsRunopts:
+    def test_builtins_lists_components(self):
+        code, out, _ = run_cli(["builtins"])
+        assert code == 0
+        assert "dist.spmd" in out and "utils.echo" in out
+
+    def test_builtins_print_source(self):
+        code, out, _ = run_cli(["builtins", "--print", "utils.echo"])
+        assert code == 0
+        assert "def echo(" in out
+
+    def test_runopts_single(self):
+        code, out, _ = run_cli(["runopts", "local"])
+        assert code == 0
+        assert "log_dir" in out and "tpu_simulate" in out
+
+    def test_status_missing_app(self):
+        code, _, err = run_cli(["status", "local://x/nope"])
+        assert code == 1 and "not found" in err
+
+
+class TestCmdConfigure:
+    def test_writes_config(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        code, out, _ = run_cli(["configure", "-s", "local"])
+        assert code == 0
+        text = (tmp_path / ".tpxconfig").read_text()
+        assert "[local]" in text and "log_dir" in text

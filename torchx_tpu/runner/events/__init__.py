@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import logging
 import os
-import sys
 import time
 import traceback
 from types import TracebackType
@@ -22,22 +21,16 @@ from torchx_tpu.runner.events.api import TpxEvent
 _events_logger: Optional[logging.Logger] = None
 
 
-def _get_destination_handler(dest: str) -> logging.Handler:
-    if dest == "console":
-        return logging.StreamHandler(sys.stderr)
-    if dest == "log":
-        return logging.StreamHandler(sys.stderr)
-    return logging.NullHandler()
-
-
 def get_events_logger(destination: Optional[str] = None) -> logging.Logger:
     global _events_logger
     if _events_logger is None:
+        from torchx_tpu.runner.events.handlers import get_destination_handler
+
         dest = destination or os.environ.get("TPX_EVENT_DESTINATION", "null")
         logger = logging.getLogger("torchx_tpu.events")
         logger.setLevel(logging.INFO)
         logger.propagate = False  # never leak telemetry into app logs
-        logger.addHandler(_get_destination_handler(dest))
+        logger.addHandler(get_destination_handler(dest))
         _events_logger = logger
     return _events_logger
 

@@ -1,0 +1,44 @@
+"""Pluggable telemetry destinations (reference analog:
+torchx/runner/events/handlers.py).
+
+The events logger routes through one handler chosen by
+$TPX_EVENT_DESTINATION: "null" (default — drop), "console"/"log" (stderr).
+Organizations register richer destinations (e.g. a BigQuery or Cloud
+Logging shipper) with :func:`register_destination` or the
+``tpx.event_handlers`` entry-point group.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Callable
+
+_DESTINATIONS: dict[str, Callable[[], logging.Handler]] = {
+    "null": logging.NullHandler,
+    "console": lambda: logging.StreamHandler(sys.stderr),
+    "log": lambda: logging.StreamHandler(sys.stderr),
+}
+
+
+def register_destination(name: str, factory: Callable[[], logging.Handler]) -> None:
+    _DESTINATIONS[name] = factory
+
+
+def get_destination_handler(dest: str) -> logging.Handler:
+    factory = _DESTINATIONS.get(dest)
+    if factory is None:
+        from torchx_tpu.util.entrypoints import load_group
+
+        ep = load_group("tpx.event_handlers").get(dest)
+        if ep is not None:
+            try:
+                factory = ep()
+            except Exception:  # noqa: BLE001 - fall back to null
+                factory = None
+    if factory is None:
+        factory = logging.NullHandler
+    try:
+        return factory()
+    except Exception:  # noqa: BLE001 - telemetry must never break client calls
+        return logging.NullHandler()
