@@ -91,13 +91,6 @@ class CmdRun(SubCommand):
                 or get_default_scheduler_name()
             )
 
-        if scheduler not in runner.scheduler_backends():
-            print(
-                f"error: unknown scheduler {scheduler!r};"
-                f" available: {runner.scheduler_backends()}",
-                file=sys.stderr,
-            )
-            sys.exit(1)
         cfg = runner.scheduler_run_opts(scheduler).cfg_from_str(args.scheduler_args)
         tpx_config.apply(scheduler, cfg)
 

@@ -83,8 +83,13 @@ def main(argv: Optional[list[str]] = None) -> None:
     if not hasattr(args, "func"):
         parser.print_help()
         sys.exit(1)
+    from torchx_tpu.runner.api import UnknownSchedulerError
+
     try:
         args.func(args)
+    except UnknownSchedulerError as e:
+        print(f"error: {e}", file=sys.stderr)
+        sys.exit(1)
     except BrokenPipeError:
         # `tpx ... | head` closed the pipe; not an error
         try:

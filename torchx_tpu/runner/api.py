@@ -47,6 +47,19 @@ from torchx_tpu.util.session import get_session_id_or_create_new
 logger = logging.getLogger(__name__)
 
 
+class UnknownSchedulerError(KeyError):
+    """Raised when a handle/arg names a scheduler that is not registered."""
+
+    def __init__(self, scheduler: str, available: list[str]) -> None:
+        self.message = (
+            f"unknown scheduler {scheduler!r}; available: {available}"
+        )
+        super().__init__(self.message)
+
+    def __str__(self) -> str:
+        return self.message
+
+
 class Runner:
     """A named session owning lazily-created scheduler instances."""
 
@@ -306,9 +319,8 @@ class Runner:
         if sched is None:
             factory = self._scheduler_factories.get(scheduler)
             if factory is None:
-                raise KeyError(
-                    f"scheduler {scheduler!r} not registered;"
-                    f" available: {list(self._scheduler_factories)}"
+                raise UnknownSchedulerError(
+                    scheduler, list(self._scheduler_factories)
                 )
             params = dict(self._scheduler_params)
             sched = factory(session_name=self._name, **params)

@@ -34,11 +34,22 @@ def get_destination_handler(dest: str) -> logging.Handler:
         if ep is not None:
             try:
                 factory = ep()
-            except Exception:  # noqa: BLE001 - fall back to null
+            except Exception as e:  # noqa: BLE001 - fall back to null
+                logging.getLogger(__name__).warning(
+                    "event destination %r failed to load (%s);"
+                    " telemetry will be dropped",
+                    dest,
+                    e,
+                )
                 factory = None
     if factory is None:
         factory = logging.NullHandler
     try:
         return factory()
-    except Exception:  # noqa: BLE001 - telemetry must never break client calls
+    except Exception as e:  # noqa: BLE001 - telemetry must never break clients
+        logging.getLogger(__name__).warning(
+            "event handler %r failed to construct (%s); dropping telemetry",
+            dest,
+            e,
+        )
         return logging.NullHandler()
