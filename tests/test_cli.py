@@ -123,11 +123,51 @@ class TestCmdRun:
         assert code == 0
         assert "SUCCEEDED" in out
         handle = next(ln for ln in out.splitlines() if ln.startswith("local://"))
-        code2, out2, _ = run_cli(["status", handle])
-        # local scheduler state is per-process: a fresh CLI process would
-        # miss it, but in-process the runner session differs too — accept
-        # the documented not-found contract while exercising the parse path
-        assert code2 in (0, 1)
+        # a fresh runner instance can't see another instance's local apps
+        # (LocalScheduler state is per-instance) — the deterministic contract
+        # is a clean not-found, which also exercises handle parsing
+        code2, _, err2 = run_cli(["status", handle])
+        assert code2 == 1 and "not found" in err2
+
+
+class TestCmdLogAndCopy:
+    def test_runner_log_lines_roundtrip(self, tmp_path):
+        # CmdLog's backing API (its thread fan-out needs a shared live
+        # scheduler instance, so the CLI wrapper is covered by the
+        # malformed-identifier case below + the runner path here)
+        from torchx_tpu.runner.api import get_runner
+
+        with get_runner("log-test") as runner:
+            handle = runner.run_component(
+                "utils.echo",
+                ["--msg", "log-line"],
+                "local",
+                {"log_dir": str(tmp_path)},
+            )
+            runner.wait(handle, wait_interval=0.1)
+            lines = list(runner.log_lines(handle, "echo", 0))
+            assert "log-line" in lines
+
+    def test_copy_component_e2e(self, tmp_path):
+        from torchx_tpu.runner.api import get_runner
+
+        src = tmp_path / "src.txt"
+        src.write_text("payload")
+        dst = tmp_path / "out" / "dst.txt"
+        with get_runner("copy-test") as runner:
+            handle = runner.run_component(
+                "utils.copy",
+                ["--src", str(src), "--dst", str(dst)],
+                "local",
+                {"log_dir": str(tmp_path / "logs")},
+            )
+            status = runner.wait(handle, wait_interval=0.1)
+        assert status.state.name == "SUCCEEDED"
+        assert dst.read_text() == "payload"
+
+    def test_log_identifier_parse_error(self):
+        code, _, err = run_cli(["log", "not-an-identifier"])
+        assert code == 1 and "malformed" in err
 
 
 class TestCmdBuiltinsRunopts:

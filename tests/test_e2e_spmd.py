@@ -62,6 +62,28 @@ def test_ddp_torchrun_world_size(tmp_path):
 
 
 @pytest.mark.e2e
+def test_ddp_multinode_deferred_endpoint(tmp_path):
+    """2 separate torchrun agents rendezvous through the shell-deferred
+    ${TPX_COORDINATOR_HOST:=localhost} endpoint (SURVEY hard-part (a))."""
+    script = os.path.join(
+        os.path.dirname(torchx_tpu.__file__),
+        "examples",
+        "compute_world_size_torch.py",
+    )
+    with get_runner("ddp-mn") as runner:
+        handle = runner.run_component(
+            "dist.ddp",
+            ["-j", "2x1", "--script", script],
+            "local",
+            {"log_dir": str(tmp_path)},
+        )
+        status = runner.wait(handle, wait_interval=0.5)
+        assert status.state == AppState.SUCCEEDED, status.format()
+        lines = list(runner.log_lines(handle, "ddp", 0))
+        assert any("computed_world_size=2" in ln for ln in lines), lines
+
+
+@pytest.mark.e2e
 def test_spmd_failure_surfaces_structured_error(tmp_path):
     with get_runner("spmd-e2e-fail") as runner:
         handle = runner.run_component(
