@@ -18,6 +18,7 @@ from torchx_tpu.parallel.mesh import MeshConfig, make_mesh
 class TestMeshConfig:
     def test_resolve_wildcard(self):
         assert MeshConfig(dp=2, fsdp=-1, tp=2).resolve(8) == {
+            "pp": 1,
             "dp": 2,
             "fsdp": 2,
             "tp": 2,
@@ -37,7 +38,7 @@ class TestMeshConfig:
 
     def test_make_mesh(self):
         mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2, sp=1))
-        assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
+        assert dict(mesh.shape) == {"pp": 1, "dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
 
 
 class TestOps:

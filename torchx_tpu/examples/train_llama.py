@@ -88,7 +88,7 @@ def init_state(
 ) -> TrainState:
     """Initialize params *sharded* (jit with out_shardings so the full
     fp32 model never materializes on one device)."""
-    specs = llama.param_specs(cfg)
+    specs = llama.param_specs(cfg, pp=mesh.shape.get("pp", 1) > 1)
     out_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
 
     @functools.partial(jax.jit, out_shardings=out_shardings)

@@ -10,7 +10,7 @@ Design choices for the TPU compilation model:
 * **bfloat16 params/activations, float32 softmax/norms/logits** — the
   standard TPU numerics recipe.
 * **GSPMD sharding via PartitionSpec trees** — :func:`param_specs` maps
-  every param to the canonical 4-axis mesh (dp/fsdp/tp/sp);
+  every param to the canonical 5-axis mesh (pp/dp/fsdp/tp/sp);
   :func:`forward` drops ``with_sharding_constraint`` hints on the residual
   stream so XLA places the collectives (all-gather for fsdp params,
   all-reduce for tp partials) on ICI.
@@ -64,6 +64,9 @@ class LlamaConfig:
     # [batch, seq, vocab] float32 logits never materialize (the dominant
     # activation at 128k vocab); 0 disables chunking
     loss_chunk: int = 512
+    # microbatches for pipeline parallelism (meshes with pp > 1);
+    # 0 = auto (2x the pp degree — a 2(S-1)/(2S) bubble)
+    pp_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -176,28 +179,30 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
     return params
 
 
-def param_specs(cfg: LlamaConfig) -> Params:
-    """PartitionSpec tree matching init_params, on the dp/fsdp/tp/sp mesh.
+def param_specs(cfg: LlamaConfig, pp: bool = False) -> Params:
+    """PartitionSpec tree matching init_params, on the pp/dp/fsdp/tp/sp mesh.
 
     2D sharding: the "fsdp" axis shards the model dimension (ZeRO-3-style
     weight gather per layer under the scan), "tp" shards heads/ffn
-    (Megatron-style, all-reduce after wo/w_down). Stacked layer axis is
-    never sharded.
+    (Megatron-style, all-reduce after wo/w_down). The stacked layer axis
+    shards over "pp" when pipeline parallelism is on (each stage owns a
+    contiguous run of layers), else stays unsharded.
     """
+    layer_axis = "pp" if pp else None
     specs: Params = {
         # vocab axis unsharded: a gather over a vocab-sharded table forces
         # the SPMD partitioner into full rematerialization; dim shards fine
         "embed": P(None, "fsdp"),
         "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, "fsdp", "tp"),
-            "wk": P(None, "fsdp", "tp"),
-            "wv": P(None, "fsdp", "tp"),
-            "wo": P(None, "tp", "fsdp"),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, "fsdp", "tp"),
-            "w_up": P(None, "fsdp", "tp"),
-            "w_down": P(None, "tp", "fsdp"),
+            "attn_norm": P(layer_axis, None),
+            "wq": P(layer_axis, "fsdp", "tp"),
+            "wk": P(layer_axis, "fsdp", "tp"),
+            "wv": P(layer_axis, "fsdp", "tp"),
+            "wo": P(layer_axis, "tp", "fsdp"),
+            "mlp_norm": P(layer_axis, None),
+            "w_gate": P(layer_axis, "fsdp", "tp"),
+            "w_up": P(layer_axis, "fsdp", "tp"),
+            "w_down": P(layer_axis, "tp", "fsdp"),
         },
         "final_norm": P(None),
     }
@@ -208,7 +213,7 @@ def param_specs(cfg: LlamaConfig) -> Params:
 
 def shard_params(params: Params, cfg: LlamaConfig, mesh: Mesh) -> Params:
     """Device-put params onto the mesh per param_specs."""
-    specs = param_specs(cfg)
+    specs = param_specs(cfg, pp=mesh.shape.get("pp", 1) > 1)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )
@@ -288,10 +293,35 @@ def forward_features(
 
     body = _remat(functools.partial(_layer, cfg, mesh, cos, sin), cfg)
 
-    def scan_step(x, layer_slice):  # noqa: ANN001
-        return body(x, layer_slice), None
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp > 1:
+        # pipeline the layer stack over the pp axis (embedding/head stay
+        # outside the pipeline, replicated over pp)
+        if cfg.use_ring_attention and mesh.shape.get("sp", 1) > 1:
+            raise ValueError(
+                "ring attention (sp>1) inside a pp pipeline is not supported"
+                " yet; use sp=1 with pp or pp=1 with sp"
+            )
+        import math as _math
 
-    x, _ = jax.lax.scan(scan_step, x, params["layers"])
+        from torchx_tpu.parallel.pipeline import pipeline_apply
+
+        # clamp to a DIVISOR of the batch (min() alone could pick a
+        # non-divisor and fail pipeline_apply's validation)
+        n_micro = cfg.pp_microbatches or 2 * pp
+        n_micro = _math.gcd(n_micro, x.shape[0])
+        x = pipeline_apply(
+            body,
+            params["layers"],
+            x,
+            mesh,
+            n_microbatches=n_micro,
+        )
+    else:
+        def scan_step(x, layer_slice):  # noqa: ANN001
+            return body(x, layer_slice), None
+
+        x, _ = jax.lax.scan(scan_step, x, params["layers"])
     return rms_norm(x, params["final_norm"], cfg.norm_eps)
 
 

@@ -114,14 +114,16 @@ def init_params(cfg: MoEConfig, key: jax.Array) -> llama.Params:
     return params
 
 
-def param_specs(cfg: MoEConfig) -> llama.Params:
+def param_specs(cfg: MoEConfig, pp: bool = False) -> llama.Params:
     """Expert axis shards over ``tp`` (expert parallelism); within-expert
-    dims shard over ``fsdp`` like the dense model."""
-    specs = llama.param_specs(cfg)
-    specs["layers"]["w_router"] = P(None, "fsdp", None)
-    specs["layers"]["w_gate"] = P(None, "tp", "fsdp", None)
-    specs["layers"]["w_up"] = P(None, "tp", "fsdp", None)
-    specs["layers"]["w_down"] = P(None, "tp", None, "fsdp")
+    dims shard over ``fsdp`` like the dense model; the stacked layer axis
+    shards over ``pp`` when pipeline parallelism is on."""
+    layer_axis = "pp" if pp else None
+    specs = llama.param_specs(cfg, pp=pp)
+    specs["layers"]["w_router"] = P(layer_axis, "fsdp", None)
+    specs["layers"]["w_gate"] = P(layer_axis, "tp", "fsdp", None)
+    specs["layers"]["w_up"] = P(layer_axis, "tp", "fsdp", None)
+    specs["layers"]["w_down"] = P(layer_axis, "tp", None, "fsdp")
     return specs
 
 
@@ -131,7 +133,7 @@ def shard_params(params: llama.Params, cfg: MoEConfig, mesh) -> llama.Params:  #
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params,
-        param_specs(cfg),
+        param_specs(cfg, pp=mesh.shape.get("pp", 1) > 1),
     )
 
 

@@ -1,15 +1,16 @@
 """Device mesh construction + sharding helpers for SPMD training.
 
-The canonical 4-axis mesh for TPU LLM training (scaling-book recipe: pick a
+The canonical 5-axis mesh for TPU LLM training (scaling-book recipe: pick a
 mesh, annotate shardings, let XLA insert the collectives over ICI/DCN):
 
+* ``pp``   — pipeline parallelism (layer stages; between slices, DCN),
 * ``dp``   — pure data parallelism (between slices, rides DCN),
 * ``fsdp`` — data parallelism with parameter sharding (rides ICI),
 * ``tp``   — tensor (model) parallelism within attention/MLP blocks,
 * ``sp``   — sequence/context parallelism for long sequences.
 
 Axis sizes multiply to the device count; unused axes get size 1 so
-PartitionSpecs can always name all four axes.
+PartitionSpecs can always name every axis.
 """
 
 from __future__ import annotations
@@ -22,7 +23,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "tp", "sp")
+AXES = ("pp", "dp", "fsdp", "tp", "sp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -30,6 +31,7 @@ class MeshConfig:
     """Mesh axis sizes; -1 on at most one axis means "all remaining
     devices"."""
 
+    pp: int = 1
     dp: int = 1
     fsdp: int = -1
     tp: int = 1
@@ -58,11 +60,11 @@ def make_mesh(
     config: MeshConfig = MeshConfig(),
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build the 4-axis mesh over all (or the given) devices.
+    """Build the 5-axis mesh over all (or the given) devices.
 
-    Axis order is (dp, fsdp, tp, sp) — outermost-to-innermost matches
-    slowest-to-fastest interconnect: dp between slices over DCN, tp on the
-    innermost ICI dimension where its all-reduces are cheapest.
+    Axis order is (pp, dp, fsdp, tp, sp) — outermost-to-innermost matches
+    slowest-to-fastest interconnect: pp/dp between slices over DCN, tp on
+    the innermost ICI dimension where its all-reduces are cheapest.
     """
     devs = list(devices) if devices is not None else jax.devices()
     sizes = config.resolve(len(devs))

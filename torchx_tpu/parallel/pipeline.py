@@ -119,11 +119,15 @@ def pipeline_apply(
     x_micro = x.reshape(n_microbatches, mb, *x.shape[1:])
 
     layer_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
+    # partial manualization: only the pp axis goes manual; any other mesh
+    # axes (dp/fsdp/tp) remain automatic so GSPMD keeps sharding the math
+    # inside each stage
     fn = jax.shard_map(
         functools.partial(_pipeline_shard, body, n_microbatches),
         mesh=mesh,
         in_specs=(layer_specs, P()),  # layers sharded by stage; x replicated
         out_specs=P(),
+        axis_names=frozenset({"pp"}),
         check_vma=False,
     )
     out = fn(stacked_params, x_micro)
