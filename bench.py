@@ -18,14 +18,50 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
+import subprocess
 import sys
 import time
 
 _START = time.monotonic()
 
 
+def _tpu_usable(timeout: float = 120.0) -> bool:
+    """Probe the TPU in a subprocess: a wedged device tunnel hangs backend
+    init forever, which would otherwise hang the whole bench."""
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "y = jax.jit(lambda a: a @ a)(jnp.ones((8, 8)));"
+        "jax.block_until_ready(y);"
+        "print(jax.devices()[0].platform)"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0 and "tpu" in proc.stdout
+
+
 def main() -> None:
+    probe_t0 = time.monotonic()
+    tpu_ok = _tpu_usable()
+    probe_s = time.monotonic() - probe_t0
+    if not tpu_ok:
+        # dead/absent accelerator: fall back to CPU (single device, so
+        # per-chip numbers stay comparable) with a clearly-labeled line
+        print("TPU unusable; benching on CPU", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
+
+    if not tpu_ok:
+        # env var alone suffices normally; the config update additionally
+        # overrides sandboxes whose sitecustomize force-picked a platform
+        jax.config.update("jax_platforms", "cpu")
 
     platform = jax.devices()[0].platform
     from torchx_tpu.examples.train_llama import train
@@ -77,6 +113,9 @@ def main() -> None:
         "vs_baseline": round(metrics["mfu"] / 0.45, 3),
         "mfu": round(metrics["mfu"], 4),
         "launch_to_first_step_s": round(metrics["launch_to_first_step_s"], 1),
+        # device-probe time paid before the trainer process-start stamp
+        # (launch_to_first_step_s measures the trainer in-process)
+        "probe_s": round(probe_s, 1),
         "loss": round(metrics["loss"], 4),
         "devices": jax.device_count(),
         "platform": platform,

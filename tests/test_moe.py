@@ -86,6 +86,18 @@ class TestMoEModel:
         out = jax.jit(lambda p, t: moe.forward(p, t, cfg, mesh))(sharded, tokens)
         np.testing.assert_allclose(out, ref, atol=2e-4)
 
+    def test_moe_with_pp_mesh(self):
+        cfg = moe.moe_tiny(n_experts=4, top_k=2)
+        params = moe.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 512)
+        ref = moe.forward(params, tokens, cfg)
+        mesh = make_mesh(MeshConfig(pp=2, dp=1, fsdp=2, tp=2, sp=1))
+        sharded = moe.shard_params(params, cfg, mesh)
+        # expert weights must be stage-sharded over pp
+        assert moe.param_specs(cfg, pp=True)["layers"]["w_gate"][0] == "pp"
+        out = jax.jit(lambda p, t: moe.forward(p, t, cfg, mesh))(sharded, tokens)
+        np.testing.assert_allclose(out, ref, atol=2e-4)
+
     def test_moe_trains(self):
         cfg = moe.moe_tiny()
         params = moe.init_params(cfg, jax.random.PRNGKey(0))

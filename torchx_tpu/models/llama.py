@@ -306,10 +306,11 @@ def forward_features(
 
         from torchx_tpu.parallel.pipeline import pipeline_apply
 
-        # clamp to a DIVISOR of the batch (min() alone could pick a
-        # non-divisor and fail pipeline_apply's validation)
-        n_micro = cfg.pp_microbatches or 2 * pp
-        n_micro = _math.gcd(n_micro, x.shape[0])
+        # auto mode picks the largest divisor of the batch <= 2*pp so the
+        # schedule always validates; an EXPLICIT pp_microbatches passes
+        # through untouched — pipeline_apply raises a clear error on a
+        # non-divisor rather than silently degrading the pipeline
+        n_micro = cfg.pp_microbatches or _math.gcd(2 * pp, x.shape[0])
         x = pipeline_apply(
             body,
             params["layers"],
