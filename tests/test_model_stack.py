@@ -93,6 +93,21 @@ class TestOps:
         np.testing.assert_allclose(out[0, 4, 0], v[0, 4, 0], rtol=1e-5)
 
 
+class TestAttentionBlockSanitize:
+    def test_pallas_block_sanitizer(self):
+        # mirror of pallas_attention's sanitize(): divide-seq + lane rules
+        def sanitize(requested, seq):
+            b = (min(requested, seq) // 128) * 128
+            while b >= 128 and seq % b:
+                b -= 128
+            return b if b >= 128 else 0
+
+        assert sanitize(256, 2048) == 256
+        assert sanitize(256, 1920) == 128  # must divide seq
+        assert sanitize(192, 2048) == 128  # lane multiple
+        assert sanitize(64, 2048) == 0  # below minimum -> kernel defaults
+
+
 class TestRingAttention:
     def test_matches_reference_fwd_bwd(self):
         mesh = make_mesh(MeshConfig(dp=1, fsdp=2, tp=1, sp=4))

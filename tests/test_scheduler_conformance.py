@@ -1,0 +1,110 @@
+"""Scheduler ABC conformance suite (reference analog:
+torchx/schedulers/test/api_test.py — the contract every backend honors)."""
+
+from unittest import mock
+
+import pytest
+
+from torchx_tpu.schedulers import (
+    DEFAULT_SCHEDULER_MODULES,
+    get_default_scheduler_name,
+    get_scheduler_factories,
+)
+from torchx_tpu.schedulers.api import Scheduler
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppDryRunInfo,
+    Resource,
+    Role,
+    TpuSlice,
+    runopts,
+)
+
+
+def make_scheduler(name: str) -> Scheduler:
+    factory = get_scheduler_factories()[name]
+    kwargs = {}
+    if name == "gke":
+        kwargs["client"] = object()  # never used at dryrun level
+    if name == "local_docker":
+        kwargs["docker_client"] = mock.MagicMock()
+    return factory(session_name="conformance", **kwargs)
+
+
+def sample_app(name: str) -> AppDef:
+    role = Role(
+        name="trainer",
+        image="img:1" if name in ("gke", "local_docker") else "",
+        entrypoint="python",
+        args=["-m", "train"],
+        resource=Resource(cpu=2, memMB=1024, tpu=TpuSlice("v5e", 8)),
+    )
+    return AppDef(name="conf-test", roles=[role])
+
+
+MINIMAL_CFG = {
+    "local": {},
+    "local_docker": {},
+    "gke": {},
+    "slurm": {},
+    "tpu_vm": {"zone": "us-east5-a"},
+}
+
+ALL = sorted(DEFAULT_SCHEDULER_MODULES)
+
+
+class TestSchedulerConformance:
+    @pytest.mark.parametrize("name", ALL)
+    def test_factory_and_backend_name(self, name):
+        sched = make_scheduler(name)
+        assert isinstance(sched, Scheduler)
+        assert sched.backend == name
+        assert sched.session_name == "conformance"
+        sched.close()  # idempotent
+
+    @pytest.mark.parametrize("name", ALL)
+    def test_run_opts_shape(self, name):
+        opts = make_scheduler(name).run_opts()
+        assert isinstance(opts, runopts)
+        for key, opt in opts:
+            assert opt.help, f"{name}.{key} has no help text"
+            assert not (opt.is_required and opt.default is not None)
+
+    @pytest.mark.parametrize("name", ALL)
+    def test_submit_dryrun_contract(self, name, tmp_path):
+        """submit_dryrun materializes the full request without touching any
+        backend, and stamps the dryrun info (the core testability design)."""
+        sched = make_scheduler(name)
+        cfg = dict(MINIMAL_CFG[name])
+        if name == "local":
+            cfg["log_dir"] = str(tmp_path)
+        info = sched.submit_dryrun(sample_app(name), cfg)
+        assert isinstance(info, AppDryRunInfo)
+        assert info._scheduler == name
+        assert info._app is not None and info._app.name == "conf-test"
+        assert info._cfg is not None
+        assert str(info)  # every request pretty-prints
+
+    @pytest.mark.parametrize("name", ALL)
+    def test_pre_proc_hook_applies(self, name, tmp_path):
+        marker = {}
+
+        def pre_proc(backend, dryrun_info):  # noqa: ANN001
+            marker["backend"] = backend
+            return dryrun_info
+
+        app = sample_app(name)
+        app.roles[0].pre_proc = pre_proc
+        cfg = dict(MINIMAL_CFG[name])
+        if name == "local":
+            cfg["log_dir"] = str(tmp_path)
+        make_scheduler(name).submit_dryrun(app, cfg)
+        assert marker["backend"] == name
+
+    @pytest.mark.parametrize("name", ["local"])
+    def test_cancel_nonexistent_is_noop(self, name):
+        make_scheduler(name).cancel("ghost-app-id")  # must not raise
+
+    def test_default_scheduler_is_first(self):
+        assert get_default_scheduler_name() == next(iter(DEFAULT_SCHEDULER_MODULES))
+        assert get_default_scheduler_name() == "local"

@@ -59,6 +59,11 @@ class LlamaConfig:
     # "dots" saves matmul outputs (fewer recomputes, more memory)
     remat_policy: str = "full"
     attn_impl: str = "auto"  # auto | xla | pallas
+    # flash-attention tile sizes (0 = kernel defaults); tune for head_dim
+    # (profiling: defaults underfill the MXU at head_dim 64 — see
+    # docs/performance.md)
+    attn_block_q: int = 0
+    attn_block_kv: int = 0
     use_ring_attention: bool = False
     # cross-entropy is computed in sequence chunks of this size so the
     # [batch, seq, vocab] float32 logits never materialize (the dominant
@@ -249,7 +254,15 @@ def _layer(
     if cfg.use_ring_attention and mesh is not None and mesh.shape.get("sp", 1) > 1:
         attn_out = ring_attention(q, k, v, mesh)
     else:
-        attn_out = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        attn_out = attention(
+            q,
+            k,
+            v,
+            causal=True,
+            impl=cfg.attn_impl,
+            block_q=cfg.attn_block_q,
+            block_kv=cfg.attn_block_kv,
+        )
     attn_out = attn_out.reshape(b, s, h * hd) @ layer["wo"]
     x = x + attn_out
     x = _constraint(x, mesh, ("dp", "fsdp"), "sp", None)

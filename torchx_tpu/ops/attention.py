@@ -73,15 +73,52 @@ def _pallas_ok(q: jnp.ndarray, k: jnp.ndarray) -> bool:
 
 
 def pallas_attention(
-    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = True
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    block_q: int = 0,
+    block_kv: int = 0,
 ) -> jnp.ndarray:
+    """block_q/block_kv (0 = kernel defaults) tune the flash tiling.
+    Profiling showed the default 128-blocks run the MXU half-empty at
+    head_dim 64 (docs/performance.md) — larger blocks amortize that."""
     from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
         flash_attention,
     )
 
     n_rep = q.shape[2] // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
+    def sanitize(requested: int, seq: int) -> int:
+        """Largest multiple-of-128 divisor of seq that is <= requested —
+        the kernel requires blocks to divide the sequence and be lane
+        multiples; 0 means 'no valid custom block, use defaults'."""
+        b = (min(requested, seq) // 128) * 128
+        while b >= 128 and seq % b:
+            b -= 128
+        return b if b >= 128 else 0
+
+    kwargs = {}
+    bq = bk = 0
+    if block_q or block_kv:
+        bq = sanitize(block_q or 128, q.shape[1])
+        bk = sanitize(block_kv or 128, k.shape[1])
+    if bq and bk:  # only pass tiling the kernel will accept
+        kwargs["block_sizes"] = BlockSizes(
+            block_q=bq,
+            block_k_major=bk,
+            block_k=bk,
+            block_b=1,
+            block_q_major_dkv=bq,
+            block_k_major_dkv=bk,
+            block_k_dkv=bk,
+            block_q_dkv=bq,
+            block_k_major_dq=bk,
+            block_k_dq=bk,
+            block_q_dq=bq,
+        )
     # pallas kernel takes [b, h, s, d]
     out = flash_attention(
         q.transpose(0, 2, 1, 3),
@@ -89,6 +126,7 @@ def pallas_attention(
         v.transpose(0, 2, 1, 3),
         causal=causal,
         sm_scale=q.shape[-1] ** -0.5,
+        **kwargs,
     )
     return out.transpose(0, 2, 1, 3)
 
@@ -100,6 +138,8 @@ def attention(
     causal: bool = True,
     segment_ids: Optional[jnp.ndarray] = None,
     impl: str = "auto",
+    block_q: int = 0,
+    block_kv: int = 0,
 ) -> jnp.ndarray:
     """[b, s, heads, head_dim] x3 -> [b, s, heads, head_dim]."""
     if impl == "pallas" and segment_ids is not None:
@@ -114,5 +154,7 @@ def attention(
         and _on_tpu()
         and _pallas_ok(q, k)
     ):
-        return pallas_attention(q, k, v, causal=causal)
+        return pallas_attention(
+            q, k, v, causal=causal, block_q=block_q, block_kv=block_kv
+        )
     return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
