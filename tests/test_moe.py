@@ -98,6 +98,23 @@ class TestMoEModel:
         out = jax.jit(lambda p, t: moe.forward(p, t, cfg, mesh))(sharded, tokens)
         np.testing.assert_allclose(out, ref, atol=2e-4)
 
+    def test_moe_via_trainer(self):
+        """MoE end-to-end through the shared trainer (CLI --config path)."""
+        from torchx_tpu.examples.train_llama import all_configs, train
+        from torchx_tpu.parallel.mesh import MeshConfig
+
+        assert "moe_tiny" in all_configs() and "mixtral_8x7b" in all_configs()
+        m = train(
+            moe.moe_tiny(),
+            MeshConfig(dp=1, fsdp=2, tp=4, sp=1),
+            batch=8,
+            seq=32,
+            steps=5,
+            lr=1e-2,
+            warmup=1,
+        )
+        assert m["loss"] < 6.2
+
     def test_moe_trains(self):
         cfg = moe.moe_tiny()
         params = moe.init_params(cfg, jax.random.PRNGKey(0))

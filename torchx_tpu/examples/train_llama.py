@@ -80,6 +80,15 @@ jax.tree_util.register_dataclass(
 )
 
 
+def _model_fns(cfg: llama.LlamaConfig):
+    """Dense vs MoE dispatch (MoE configs carry n_experts)."""
+    if getattr(cfg, "n_experts", 0):
+        from torchx_tpu.models import moe
+
+        return moe.init_params, moe.param_specs
+    return llama.init_params, llama.param_specs
+
+
 def init_state(
     cfg: llama.LlamaConfig,
     mesh: Mesh,
@@ -88,12 +97,13 @@ def init_state(
 ) -> TrainState:
     """Initialize params *sharded* (jit with out_shardings so the full
     fp32 model never materializes on one device)."""
-    specs = llama.param_specs(cfg, pp=mesh.shape.get("pp", 1) > 1)
+    init_fn, specs_fn = _model_fns(cfg)
+    specs = specs_fn(cfg, pp=mesh.shape.get("pp", 1) > 1)
     out_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
 
     @functools.partial(jax.jit, out_shardings=out_shardings)
     def _init(key):  # noqa: ANN001
-        return llama.init_params(cfg, key)
+        return init_fn(cfg, key)
 
     params = _init(jax.random.PRNGKey(seed))
     opt_state = jax.jit(
@@ -297,9 +307,16 @@ def train(
     }
 
 
+def all_configs() -> dict:
+    """Dense llama presets plus the MoE family (models/moe.py)."""
+    from torchx_tpu.models import moe
+
+    return {**llama.CONFIGS, **moe.CONFIGS}
+
+
 def main(argv: Optional[list[str]] = None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--config", default="tiny", choices=sorted(llama.CONFIGS))
+    parser.add_argument("--config", default="tiny", choices=sorted(all_configs()))
     parser.add_argument("--mesh", default="fsdp=-1", help="e.g. dp=2,fsdp=-1,tp=4")
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--seq", type=int, default=128)
@@ -319,7 +336,7 @@ def main(argv: Optional[list[str]] = None) -> None:
     )
     args = parser.parse_args(argv)
 
-    cfg = llama.CONFIGS[args.config]()
+    cfg = all_configs()[args.config]()
     if args.ring_attention:
         cfg = dataclasses.replace(cfg, use_ring_attention=True)
     metrics = train(
