@@ -16,7 +16,6 @@ runnable anywhere.
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import os
 import subprocess

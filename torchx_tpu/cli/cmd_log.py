@@ -16,7 +16,6 @@ from torchx_tpu.cli.cmd_base import SubCommand
 from torchx_tpu.runner.api import get_runner
 from torchx_tpu.util.log_tee_helpers import (
     find_role_replicas,
-    tee_logs,
     wait_for_app_started,
 )
 

@@ -10,8 +10,6 @@ over gloo and asserts the sum equals the world size.
 
 from __future__ import annotations
 
-import os
-
 import torch
 import torch.distributed as dist
 

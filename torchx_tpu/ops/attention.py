@@ -17,7 +17,6 @@ All paths compute softmax in float32 and accept grouped KV heads
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

@@ -24,7 +24,6 @@ partitioning.
 from __future__ import annotations
 
 import copy
-import dataclasses
 import enum
 from typing import Any, Callable, Optional
 

@@ -24,20 +24,13 @@ from torchx_tpu.schedulers import (
     SchedulerFactory,
     get_scheduler_factories,
 )
-from torchx_tpu.schedulers.api import (
-    DescribeAppResponse,
-    ListAppResponse,
-    Scheduler,
-    Stream,
-)
+from torchx_tpu.schedulers.api import ListAppResponse, Scheduler, Stream
 from torchx_tpu.specs.api import (
     AppDef,
     AppDryRunInfo,
     AppHandle,
-    AppState,
     AppStatus,
     CfgVal,
-    is_terminal,
     make_app_handle,
     parse_app_handle,
     runopts,

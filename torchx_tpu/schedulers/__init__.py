@@ -7,7 +7,7 @@ the default scheduler; plugins can override the whole table.
 from __future__ import annotations
 
 import importlib
-from typing import Any, Callable, Mapping, Optional
+from typing import Any, Callable
 
 from torchx_tpu.schedulers.api import Scheduler
 

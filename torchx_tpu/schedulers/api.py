@@ -12,16 +12,13 @@ from __future__ import annotations
 from abc import ABC, abstractmethod
 from dataclasses import dataclass
 from enum import Enum
-from typing import Any, Generic, Iterable, Mapping, Optional, TypeVar
+from typing import Generic, Iterable, Mapping, Optional, TypeVar
 
 from torchx_tpu.specs.api import (
     AppDef,
     AppDryRunInfo,
     AppState,
-    AppStatus,
     CfgVal,
-    NULL_RESOURCE,
-    ReplicaStatus,
     Role,
     RoleStatus,
     runopts,

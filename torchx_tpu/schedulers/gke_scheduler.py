@@ -54,7 +54,6 @@ from torchx_tpu.specs.api import (
     CfgVal,
     DeviceMount,
     ReplicaStatus,
-    RetryPolicy,
     Role,
     RoleStatus,
     VolumeMount,

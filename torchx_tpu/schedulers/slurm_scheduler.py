@@ -30,8 +30,7 @@ import os
 import shlex
 import subprocess
 import tempfile
-from dataclasses import dataclass, field
-from pathlib import Path
+from dataclasses import dataclass
 from typing import Any, Iterable, Mapping, Optional
 
 from torchx_tpu import settings

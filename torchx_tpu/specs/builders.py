@@ -13,7 +13,7 @@ from typing import Any, Callable, Mapping, Optional
 
 from torchx_tpu.specs.api import AppDef
 from torchx_tpu.specs.file_linter import get_fn_docstring
-from torchx_tpu.util.types import decode, is_bool
+from torchx_tpu.util.types import decode
 
 
 class ComponentArgumentError(Exception):

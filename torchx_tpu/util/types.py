@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import inspect
 import typing
-from typing import Any, Callable, Optional, Union
+from typing import Any, Optional, Union
 
 
 def none_throws(x: Optional[Any], msg: str = "unexpected None") -> Any:

@@ -12,7 +12,7 @@ import fnmatch
 import os
 import posixpath
 from abc import abstractmethod
-from typing import Any, Generic, Iterable, Mapping, Optional, TypeVar
+from typing import Any, Generic, Iterable, Mapping, TypeVar
 
 from torchx_tpu.specs.api import CfgVal, Role, Workspace, runopts
 

@@ -13,12 +13,11 @@ without a docker daemon.
 
 from __future__ import annotations
 
-import fnmatch
 import io
 import logging
 import os
 import tarfile
-from typing import Any, Iterable, Mapping, Optional, TYPE_CHECKING
+from typing import Any, Mapping, Optional, TYPE_CHECKING
 
 from torchx_tpu.specs.api import AppDef, CfgVal, Role, Workspace, runopts
 from torchx_tpu.version import __version__
