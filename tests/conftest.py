@@ -21,3 +21,22 @@ os.environ.setdefault("TPX_EVENT_DESTINATION", "null")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolated_registries(tmp_path, monkeypatch):
+    """Keep per-user registry files (~/.tpx_local_apps, ~/.tpxslurmjobdirs)
+    out of the real home during tests."""
+    monkeypatch.setattr(
+        "torchx_tpu.schedulers.local_scheduler._registry_path",
+        lambda: str(tmp_path / "tpx_local_apps"),
+        raising=False,
+    )
+    monkeypatch.setattr(
+        "torchx_tpu.schedulers.slurm_scheduler._registry_path",
+        lambda: str(tmp_path / "tpx_slurm_dirs"),
+        raising=False,
+    )

@@ -62,6 +62,67 @@ logger = logging.getLogger(__name__)
 KILL_GRACE_SECONDS = 10
 APP_CACHE_SIZE = 100
 
+# Cross-process visibility: each app's owning scheduler writes a state file
+# under its log dir and records app_id -> log_dir in a per-user registry,
+# so `tpx status`/`tpx log` from ANOTHER process can still find and read it
+# (the reference's local scheduler is in-process only; log files were
+# always on disk — this makes the metadata reachable too).
+STATE_FILE = ".tpx_state.json"
+APPS_REGISTRY = ".tpx_local_apps"
+
+
+def _registry_path() -> str:
+    return os.path.join(os.path.expanduser("~"), APPS_REGISTRY)
+
+
+def _registry_record(app_id: str, log_dir: str) -> None:
+    try:
+        path = _registry_path()
+        if os.path.exists(path) and os.path.getsize(path) > 256 * 1024:
+            _registry_compact(path)
+        with open(path, "a") as f:
+            f.write(f"{app_id} = {log_dir}\n")
+    except OSError as e:
+        logger.debug("could not record app dir: %s", e)
+
+
+def _registry_compact(path: str) -> None:
+    """Drop entries whose log dirs no longer exist (append-only growth cap)."""
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+        kept = [
+            ln
+            for ln in lines
+            if os.path.isdir(ln.partition(" = ")[2].strip())
+        ]
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.writelines(kept)
+        os.replace(tmp, path)
+    except OSError as e:
+        logger.debug("registry compaction failed: %s", e)
+
+
+def _registry_lookup(app_id: str) -> Optional[str]:
+    try:
+        with open(_registry_path()) as f:
+            for line in f:
+                aid, _, adir = line.partition(" = ")
+                if aid.strip() == app_id:
+                    return adir.strip()
+    except OSError:
+        return None
+    return None
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
 
 # =========================================================================
 # Image providers
@@ -241,6 +302,32 @@ class _LocalApp:
         self.state = AppState.PENDING
         self.last_updated = time.time()
 
+    def write_state_file(self) -> None:
+        """Snapshot for cross-process status/log (best-effort)."""
+        import json
+
+        payload = {
+            "app_id": self.app_id,
+            "state": self.state.name,
+            "log_dir": self.log_dir,
+            "roles": {
+                name: [
+                    {"id": r.replica_id, "pid": r.proc.pid}
+                    for r in replicas
+                ]
+                for name, replicas in self.roles.items()
+            },
+        }
+        try:
+            os.makedirs(self.log_dir, exist_ok=True)
+            path = os.path.join(self.log_dir, STATE_FILE)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)  # atomic: readers never see partial JSON
+        except OSError as e:
+            logger.debug("could not write state file: %s", e)
+
     def add_replica(self, role_name: str, replica: _LocalReplica) -> None:
         self.roles.setdefault(role_name, []).append(replica)
 
@@ -251,6 +338,7 @@ class _LocalApp:
     def set_state(self, state: AppState) -> None:
         self.state = state
         self.last_updated = time.time()
+        self.write_state_file()
 
     def kill(self) -> None:
         for r in self.replicas():
@@ -289,6 +377,7 @@ class LocalScheduler(Scheduler[PopenRequest]):
         super().__init__("local", session_name)
         self._image_provider = image_provider or CWDImageProvider()
         self._apps: dict[str, _LocalApp] = {}
+        self._external_dirs: dict[str, str] = {}  # app_id -> log_dir cache
         self._cache_size = cache_size
         self._extra_paths = extra_paths or []
         self._installed_signal_cleanup = False
@@ -421,6 +510,7 @@ class LocalScheduler(Scheduler[PopenRequest]):
             app.kill()
             raise
         app.set_state(AppState.RUNNING)
+        _registry_record(request.app_id, request.log_dir)
         self._apps[request.app_id] = app
         return request.app_id
 
@@ -494,7 +584,7 @@ class LocalScheduler(Scheduler[PopenRequest]):
     def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
         app = self._apps.get(app_id)
         if app is None:
-            return None
+            return self._describe_external(app_id)
         self._update_app_state(app)
         roles_statuses = []
         for role_name, replicas in app.roles.items():
@@ -535,6 +625,58 @@ class LocalScheduler(Scheduler[PopenRequest]):
             num_restarts=0,
             structured_error_msg=structured_error_msg,
             ui_url=f"file://{app.log_dir}",
+            roles_statuses=roles_statuses,
+        )
+
+    def _describe_external(self, app_id: str) -> Optional[DescribeAppResponse]:
+        """Status of an app owned by ANOTHER process, from its state file.
+
+        Terminal states are authoritative (the owner wrote them); for a
+        still-RUNNING file, pid liveness decides between RUNNING and
+        UNKNOWN (owner gone — exit codes are unknowable across processes).
+        """
+        import json
+
+        log_dir = self._external_dirs.get(app_id) or _registry_lookup(app_id)
+        if log_dir is None:
+            return None
+        self._external_dirs[app_id] = log_dir  # skip registry rescans on polls
+        try:
+            with open(os.path.join(log_dir, STATE_FILE)) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        try:
+            state = AppState[payload.get("state", "UNKNOWN")]
+        except KeyError:  # unrecognized state name (newer writer / bad file)
+            state = AppState.UNKNOWN
+        if not is_terminal(state):
+            pids = [
+                r["pid"]
+                for replicas in payload.get("roles", {}).values()
+                for r in replicas
+            ]
+            state = (
+                AppState.RUNNING
+                if any(_pid_alive(p) for p in pids)
+                else AppState.UNKNOWN
+            )
+        roles_statuses = [
+            RoleStatus(
+                role=name,
+                replicas=[
+                    ReplicaStatus(
+                        id=r["id"], state=state, role=name, hostname="localhost"
+                    )
+                    for r in replicas
+                ],
+            )
+            for name, replicas in payload.get("roles", {}).items()
+        ]
+        return DescribeAppResponse(
+            app_id=app_id,
+            state=state,
+            ui_url=f"file://{log_dir}",
             roles_statuses=roles_statuses,
         )
 
@@ -585,15 +727,20 @@ class LocalScheduler(Scheduler[PopenRequest]):
         streams: Optional[Stream] = None,
     ) -> Iterable[str]:
         app = self._apps.get(app_id)
-        if app is None:
-            raise ValueError(f"unknown app: {app_id}")
+        if app is not None:
+            log_root = app.log_dir
+        else:
+            external = _registry_lookup(app_id)
+            if external is None:
+                raise ValueError(f"unknown app: {app_id}")
+            log_root = external
         stream = streams or Stream.COMBINED
         fname = {
             Stream.STDOUT: "stdout.log",
             Stream.STDERR: "stderr.log",
             Stream.COMBINED: "combined.log",
         }[stream]
-        log_file = os.path.join(app.log_dir, role_name, str(k), fname)
+        log_file = os.path.join(log_root, role_name, str(k), fname)
         it: Iterable[str] = LogIterator(self, app_id, log_file, should_tail)
         if regex:
             it = filter_regex(regex, it)
@@ -633,7 +780,11 @@ class LogIterator:
 
     def _check_finished(self) -> None:
         resp = self._scheduler.describe(self._app_id)
-        self._app_finished = resp is None or is_terminal(resp.state)
+        self._app_finished = (
+            resp is None
+            or is_terminal(resp.state)
+            or resp.state == AppState.UNKNOWN  # owner process gone
+        )
 
     def __iter__(self):
         # wait for the file to exist (app may still be starting)
