@@ -291,6 +291,49 @@ class TestCrossProcessState:
             sched.close()
 
 
+class TestCrossProcessCancelList:
+    def test_cancel_from_other_process(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(
+            "torchx_tpu.schedulers.local_scheduler._registry_path",
+            lambda: str(tmp_path / "registry"),
+        )
+        owner = LocalScheduler(session_name="owner")
+        other = LocalScheduler(session_name="other")
+        try:
+            app = AppDef(name="xc", roles=[sh_role("r", "sleep 60")])
+            app_id = owner.submit(app, {"log_dir": str(tmp_path)})
+            time.sleep(0.3)
+            # cancel from the NON-owning scheduler
+            other.cancel(app_id)
+            desc = other.describe(app_id)
+            assert desc.state == AppState.CANCELLED
+            # the owner honors the on-disk CANCELLED mark rather than
+            # recording its SIGTERM'd children as a failure
+            assert wait_terminal(owner, app_id, timeout=15) == AppState.CANCELLED
+        finally:
+            owner.close()
+            other.close()
+
+    def test_list_includes_external(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(
+            "torchx_tpu.schedulers.local_scheduler._registry_path",
+            lambda: str(tmp_path / "registry"),
+        )
+        owner = LocalScheduler(session_name="owner")
+        try:
+            app = AppDef(name="xl", roles=[sh_role("r", "true")])
+            app_id = owner.submit(app, {"log_dir": str(tmp_path)})
+            wait_terminal(owner, app_id)
+        finally:
+            owner.close()
+        other = LocalScheduler(session_name="other")
+        try:
+            listing = other.list()
+            assert any(a.app_id == app_id for a in listing)
+        finally:
+            other.close()
+
+
 class TestTpuDeviceEnv:
     def test_partitioning(self):
         env = tpu_device_env(4, replica_id=1, replicas_on_host=2, host_chips=8, simulate=True)

@@ -1,0 +1,173 @@
+"""KV-cache autoregressive generation for the Llama family.
+
+The inference half of the model stack: prefill runs the stacked-layer scan
+once over the prompt while collecting per-layer K/V; decode steps then
+attend a single query token against the cache (O(seq) per token instead of
+O(seq²) re-forwarding). Everything is ``lax.scan``/``dynamic_update_slice``
+— static shapes, one compile for any prompt length up to ``max_seq``.
+
+Greedy decoding is exactly argmax-teacher-forcing (tested against the full
+forward), temperature>0 samples from the softmax.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchx_tpu.models import llama
+from torchx_tpu.ops.norms import rms_norm
+from torchx_tpu.ops.rope import apply_rope, rope_frequencies
+
+KVCache = dict[str, jnp.ndarray]  # {"k": [L,b,S,kvh,hd], "v": ...}
+
+
+def init_kv_cache(
+    cfg: llama.LlamaConfig, batch: int, max_seq: int
+) -> KVCache:
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype=cfg.dtype),
+        "v": jnp.zeros(shape, dtype=cfg.dtype),
+    }
+
+
+def _cached_attention(
+    q: jnp.ndarray,  # [b, t, h, d] (t = tokens this call)
+    k_cache: jnp.ndarray,  # [b, S, kvh, d] — positions >= valid_len are zeros
+    v_cache: jnp.ndarray,
+    q_pos: jnp.ndarray,  # [t] absolute positions of the query tokens
+) -> jnp.ndarray:
+    b, t, h, d = q.shape
+    S = k_cache.shape[1]
+    n_rep = h // k_cache.shape[2]
+    k = jnp.repeat(k_cache, n_rep, axis=2) if n_rep > 1 else k_cache
+    v = jnp.repeat(v_cache, n_rep, axis=2) if n_rep > 1 else v_cache
+    logits = (
+        jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
+        * d**-0.5
+    )
+    # causal vs absolute cache positions: key position s visible to query at
+    # absolute position p iff s <= p
+    mask = jnp.arange(S)[None, :] <= q_pos[:, None]  # [t, S]
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def _layer_step(
+    cfg: llama.LlamaConfig,
+    cos: jnp.ndarray,  # [t, hd/2] rope slices for these positions
+    sin: jnp.ndarray,
+    q_pos: jnp.ndarray,  # [t]
+    x: jnp.ndarray,  # [b, t, d]
+    layer: llama.Params,
+    k_cache: jnp.ndarray,  # [b, S, kvh, hd] this layer's cache
+    v_cache: jnp.ndarray,
+    start: jnp.ndarray,  # scalar: where these t tokens go in the cache
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    b, t, d = x.shape
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    attn_in = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = apply_rope((attn_in @ layer["wq"]).reshape(b, t, h, hd), cos, sin)
+    k = apply_rope((attn_in @ layer["wk"]).reshape(b, t, kvh, hd), cos, sin)
+    v = (attn_in @ layer["wv"]).reshape(b, t, kvh, hd)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, start, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, start, 0, 0))
+    attn = _cached_attention(q, k_cache, v_cache, q_pos)
+    x = x + attn.reshape(b, t, h * hd) @ layer["wo"]
+    mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(mlp_in @ layer["w_gate"])
+    up = mlp_in @ layer["w_up"]
+    x = x + (gate * up) @ layer["w_down"]
+    return x, k_cache, v_cache
+
+
+def forward_with_cache(
+    params: llama.Params,
+    tokens: jnp.ndarray,  # [b, t]
+    cache: KVCache,
+    start: jnp.ndarray,  # scalar int: absolute position of tokens[:, 0]
+    cfg: llama.LlamaConfig,
+) -> tuple[jnp.ndarray, KVCache]:
+    """-> (logits [b, t, vocab] f32, updated cache). Used for both prefill
+    (t = prompt length) and decode (t = 1)."""
+    b, t = tokens.shape
+    S = cache["k"].shape[2]
+    x = params["embed"][tokens].astype(cfg.dtype)
+    q_pos = start + jnp.arange(t)
+    cos_full, sin_full = rope_frequencies(cfg.head_dim, S, cfg.rope_theta)
+    cos = jax.lax.dynamic_slice_in_dim(cos_full, start, t, axis=0)
+    sin = jax.lax.dynamic_slice_in_dim(sin_full, start, t, axis=0)
+
+    def scan_step(carry, layer_and_cache):  # noqa: ANN001
+        x = carry
+        layer, k_c, v_c = layer_and_cache
+        x, k_c, v_c = _layer_step(cfg, cos, sin, q_pos, x, layer, k_c, v_c, start)
+        return x, (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        scan_step, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "btd,dv->btv", x, llama.lm_head(params, cfg), preferred_element_type=jnp.float32
+    )
+    return logits, {"k": k_new, "v": v_new}
+
+
+def generate(
+    params: llama.Params,
+    prompt: jnp.ndarray,  # [b, t0] int32
+    cfg: llama.LlamaConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """-> [b, t0 + max_new_tokens]; greedy when temperature == 0."""
+    b, t0 = prompt.shape
+    total = t0 + max_new_tokens
+    if total > cfg.max_seq:
+        raise ValueError(
+            f"prompt + new tokens ({total}) exceeds max_seq {cfg.max_seq}"
+        )
+    cache = init_kv_cache(cfg, b, total)
+    logits, cache = forward_with_cache(
+        params, prompt, cache, jnp.int32(0), cfg
+    )
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def sample(logits_t, key):  # noqa: ANN001
+        if temperature <= 0:
+            return jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits_t / temperature, axis=-1).astype(
+            jnp.int32
+        )
+
+    next_tok = sample(logits[:, -1], rng)
+    out = jnp.zeros((b, max_new_tokens), dtype=jnp.int32)
+    out = out.at[:, 0].set(next_tok)
+
+    def step(carry, i):  # noqa: ANN001
+        cache, tok, out, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = forward_with_cache(
+            params, tok[:, None], cache, t0 + i, cfg
+        )
+        nxt = sample(logits[:, -1], sub)
+        out = jax.lax.cond(
+            i + 1 < max_new_tokens,
+            lambda o: o.at[:, i + 1].set(nxt),
+            lambda o: o,
+            out,
+        )
+        return (cache, nxt, out, key), None
+
+    if max_new_tokens > 1:
+        (cache, _, out, _), _ = jax.lax.scan(
+            step, (cache, next_tok, out, rng), jnp.arange(max_new_tokens - 1)
+        )
+    return jnp.concatenate([prompt, out], axis=1)

@@ -76,52 +76,76 @@ def _registry_path() -> str:
 
 
 def _registry_record(app_id: str, log_dir: str) -> None:
-    try:
-        path = _registry_path()
-        if os.path.exists(path) and os.path.getsize(path) > 256 * 1024:
-            _registry_compact(path)
-        with open(path, "a") as f:
-            f.write(f"{app_id} = {log_dir}\n")
-    except OSError as e:
-        logger.debug("could not record app dir: %s", e)
+    from torchx_tpu.util import registry
+
+    # compaction drops entries whose log dirs are gone; lock-protected so
+    # concurrent submits never lose each other's lines
+    registry.record(_registry_path(), app_id, log_dir, keep=os.path.isdir)
 
 
-def _registry_compact(path: str) -> None:
-    """Drop entries whose log dirs no longer exist (append-only growth cap)."""
-    try:
-        with open(path) as f:
-            lines = f.readlines()
-        kept = [
-            ln
-            for ln in lines
-            if os.path.isdir(ln.partition(" = ")[2].strip())
-        ]
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            f.writelines(kept)
-        os.replace(tmp, path)
-    except OSError as e:
-        logger.debug("registry compaction failed: %s", e)
+def _registry_entries() -> list[tuple[str, str]]:
+    from torchx_tpu.util import registry
+
+    return registry.entries(_registry_path())
 
 
 def _registry_lookup(app_id: str) -> Optional[str]:
+    from torchx_tpu.util import registry
+
+    return registry.lookup(_registry_path(), app_id)
+
+
+def _state_file_says_cancelled(log_dir: str) -> bool:
+    import json
+
     try:
-        with open(_registry_path()) as f:
-            for line in f:
-                aid, _, adir = line.partition(" = ")
-                if aid.strip() == app_id:
-                    return adir.strip()
+        with open(os.path.join(log_dir, STATE_FILE)) as f:
+            return json.load(f).get("state") == AppState.CANCELLED.name
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    """Unique-tmp + os.replace: concurrent writers (owner vs external
+    canceller) can't truncate each other's in-flight tmp, and readers
+    never observe partial JSON."""
+    import json
+    import tempfile as _tempfile
+
+    fd, tmp = _tempfile.mkstemp(dir=os.path.dirname(path), prefix=".tpx_state_")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
     except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _pid_start_time(pid: int) -> Optional[int]:
+    """Process start time (clock ticks) from /proc — disambiguates pid
+    reuse. None where /proc is unavailable."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            fields = f.read().rsplit(") ", 1)[-1].split()
+        return int(fields[19])  # starttime is field 22 overall
+    except (OSError, ValueError, IndexError):
         return None
-    return None
 
 
-def _pid_alive(pid: int) -> bool:
+def _pid_alive(pid: int, start_time: Optional[int] = None) -> bool:
     try:
         os.kill(pid, 0)
-        return True
     except (ProcessLookupError, PermissionError):
         return False
+    if start_time is not None:
+        current = _pid_start_time(pid)
+        if current is not None and current != start_time:
+            return False  # pid was reused by an unrelated process
+    return True
 
 
 # =========================================================================
@@ -312,7 +336,11 @@ class _LocalApp:
             "log_dir": self.log_dir,
             "roles": {
                 name: [
-                    {"id": r.replica_id, "pid": r.proc.pid}
+                    {
+                        "id": r.replica_id,
+                        "pid": r.proc.pid,
+                        "pid_start": _pid_start_time(r.proc.pid),
+                    }
                     for r in replicas
                 ]
                 for name, replicas in self.roles.items()
@@ -320,11 +348,7 @@ class _LocalApp:
         }
         try:
             os.makedirs(self.log_dir, exist_ok=True)
-            path = os.path.join(self.log_dir, STATE_FILE)
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(payload, f)
-            os.replace(tmp, path)  # atomic: readers never see partial JSON
+            _atomic_write_json(os.path.join(self.log_dir, STATE_FILE), payload)
         except OSError as e:
             logger.debug("could not write state file: %s", e)
 
@@ -651,14 +675,14 @@ class LocalScheduler(Scheduler[PopenRequest]):
         except KeyError:  # unrecognized state name (newer writer / bad file)
             state = AppState.UNKNOWN
         if not is_terminal(state):
-            pids = [
-                r["pid"]
+            procs = [
+                (r["pid"], r.get("pid_start"))
                 for replicas in payload.get("roles", {}).values()
                 for r in replicas
             ]
             state = (
                 AppState.RUNNING
-                if any(_pid_alive(p) for p in pids)
+                if any(_pid_alive(p, st) for p, st in procs)
                 else AppState.UNKNOWN
             )
         roles_statuses = [
@@ -695,11 +719,16 @@ class LocalScheduler(Scheduler[PopenRequest]):
                     any_failed = True
         if any_failed:
             # fail fast: kill the rest of the gang (SPMD semantics — a dead
-            # host wedges the collective anyway)
+            # host wedges the collective anyway). If an external `tpx
+            # cancel` already marked the app CANCELLED on disk, honor that
+            # instead of recording the SIGTERM'd children as a failure.
             for r in app.replicas():
                 if r.is_alive():
                     r.terminate()
-            app.set_state(AppState.FAILED)
+            if _state_file_says_cancelled(app.log_dir):
+                app.set_state(AppState.CANCELLED)
+            else:
+                app.set_state(AppState.FAILED)
         elif not any_alive:
             app.set_state(AppState.SUCCEEDED)
             Path(app.log_dir, "SUCCESS").touch()
@@ -709,11 +738,53 @@ class LocalScheduler(Scheduler[PopenRequest]):
         for app_id, app in self._apps.items():
             self._update_app_state(app)
             out.append(ListAppResponse(app_id=app_id, state=app.state, name=app_id))
+        # apps owned by other processes, via the registry (one scan total)
+        for app_id, log_dir in dict(_registry_entries()).items():
+            if app_id in self._apps:
+                continue
+            self._external_dirs.setdefault(app_id, log_dir)
+            desc = self._describe_external(app_id)
+            if desc is not None:
+                out.append(
+                    ListAppResponse(app_id=app_id, state=desc.state, name=app_id)
+                )
         return out
 
     def _cancel_existing(self, app_id: str) -> None:
-        app = self._apps[app_id]
-        app.kill()
+        app = self._apps.get(app_id)
+        if app is not None:
+            app.kill()
+            return
+        self._cancel_external(app_id)
+
+    def _cancel_external(self, app_id: str) -> None:
+        """Kill an app owned by another process: SIGTERM its process groups
+        (replicas start_new_session, so pgid == pid) and mark the state
+        file CANCELLED for every future reader."""
+        import json
+
+        desc = self._describe_external(app_id)
+        if desc is None or is_terminal(desc.state):
+            return
+        log_dir = self._external_dirs.get(app_id) or _registry_lookup(app_id)
+        try:
+            with open(os.path.join(log_dir, STATE_FILE)) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return
+        for replicas in payload.get("roles", {}).values():
+            for r in replicas:
+                if not _pid_alive(r["pid"], r.get("pid_start")):
+                    continue  # dead or pid reused by an unrelated process
+                try:
+                    os.killpg(r["pid"], signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        payload["state"] = AppState.CANCELLED.name
+        try:
+            _atomic_write_json(os.path.join(log_dir, STATE_FILE), payload)
+        except OSError:
+            pass
 
     def log_iter(
         self,

@@ -458,23 +458,15 @@ def _registry_path() -> str:
 
 
 def _save_job_dir(job_id: str, job_dir: str) -> None:
-    try:
-        with open(_registry_path(), "a") as f:
-            f.write(f"{job_id} = {job_dir}\n")
-    except OSError as e:
-        logger.warning("could not record job dir for %s: %s", job_id, e)
+    from torchx_tpu.util import registry
+
+    registry.record(_registry_path(), job_id, job_dir, keep=os.path.isdir)
 
 
 def _load_job_dir(job_id: str) -> Optional[str]:
-    try:
-        with open(_registry_path()) as f:
-            for line in f:
-                jid, _, jdir = line.partition(" = ")
-                if jid.strip() == job_id:
-                    return jdir.strip()
-    except OSError:
-        return None
-    return None
+    from torchx_tpu.util import registry
+
+    return registry.lookup(_registry_path(), job_id)
 
 
 def create_scheduler(session_name: str, **kwargs: Any) -> SlurmScheduler:

@@ -1,0 +1,82 @@
+"""Per-user ``key = value`` registry files with safe concurrent access.
+
+Shared by the local scheduler's app registry and the slurm job-dir
+registry (one behavior to maintain). Appends and compaction hold an
+``fcntl`` exclusive lock so concurrent writers can't drop each other's
+entries; lookups are lock-free reads (the file is line-atomic).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+COMPACT_THRESHOLD_BYTES = 256 * 1024
+
+
+def record(
+    path: str,
+    key: str,
+    value: str,
+    keep: Optional[Callable[[str], bool]] = None,
+) -> None:
+    """Append ``key = value``; when the file is large, first drop entries
+    whose value fails ``keep`` (all kept when keep is None) — under an
+    exclusive lock so a concurrent append can't be lost."""
+    try:
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        try:
+            _flock(fd)
+            if keep is not None and os.fstat(fd).st_size > COMPACT_THRESHOLD_BYTES:
+                with open(path) as f:
+                    lines = f.readlines()
+                kept = [
+                    ln for ln in lines if keep(ln.partition(" = ")[2].strip())
+                ]
+                os.lseek(fd, 0, os.SEEK_SET)
+                os.ftruncate(fd, 0)
+                os.write(fd, "".join(kept).encode())
+            os.lseek(fd, 0, os.SEEK_END)
+            os.write(fd, f"{key} = {value}\n".encode())
+        finally:
+            os.close(fd)  # releases the lock
+    except OSError as e:
+        logger.debug("could not record %s in %s: %s", key, path, e)
+
+
+def lookup(path: str, key: str) -> Optional[str]:
+    try:
+        with open(path) as f:
+            for line in f:
+                k, _, v = line.partition(" = ")
+                if k.strip() == key:
+                    return v.strip()
+    except OSError:
+        return None
+    return None
+
+
+def entries(path: str) -> list[tuple[str, str]]:
+    """All (key, value) pairs, later entries last (callers may dedup)."""
+    try:
+        with open(path) as f:
+            return [
+                (k.strip(), v.strip())
+                for line in f
+                if " = " in line
+                for k, _, v in [line.partition(" = ")]
+            ]
+    except OSError:
+        return []
+
+
+def _flock(fd: int) -> None:
+    try:
+        import fcntl
+
+        fcntl.flock(fd, fcntl.LOCK_EX)
+    except (ImportError, OSError):  # non-POSIX: best-effort without lock
+        pass
