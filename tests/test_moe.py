@@ -39,9 +39,11 @@ class TestMoEFFN:
         params = moe.init_params(cfg, key)
         layer0 = jax.tree.map(lambda x: x[0], params["layers"])
         x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, cfg.dim))
-        out = moe.moe_ffn(cfg, layer0, x)
+        out, aux = moe.moe_ffn(cfg, layer0, x)
         ref = dense_reference_moe(cfg, layer0, x)
         np.testing.assert_allclose(out, ref, atol=1e-5)
+        # balanced-ish routing keeps the Switch aux loss near 1
+        assert 0.5 < float(aux) < float(cfg.n_experts)
 
     def test_capacity_drops_tokens(self):
         # capacity 1 slot per expert: most tokens dropped -> output mostly 0
@@ -49,7 +51,7 @@ class TestMoEFFN:
         params = moe.init_params(cfg, jax.random.PRNGKey(0))
         layer0 = jax.tree.map(lambda x: x[0], params["layers"])
         x = jax.random.normal(jax.random.PRNGKey(1), (1, 32, cfg.dim))
-        out = moe.moe_ffn(cfg, layer0, x)
+        out, _ = moe.moe_ffn(cfg, layer0, x)
         # some rows must be exactly zero (dropped), but not all
         row_norms = jnp.linalg.norm(out[0], axis=-1)
         assert (row_norms == 0).any()
@@ -114,6 +116,15 @@ class TestMoEModel:
             warmup=1,
         )
         assert m["loss"] < 6.2
+
+    def test_router_aux_in_loss(self):
+        cfg = moe.moe_tiny(router_aux_coef=0.0)
+        cfg_aux = moe.moe_tiny(router_aux_coef=10.0)  # exaggerated
+        params = moe.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, 512)
+        l0 = float(moe.loss_fn(params, {"tokens": tokens}, cfg))
+        l1 = float(moe.loss_fn(params, {"tokens": tokens}, cfg_aux))
+        assert l1 > l0  # aux term contributes
 
     def test_moe_trains(self):
         cfg = moe.moe_tiny()

@@ -119,7 +119,7 @@ class TestPipelineApply:
             jax.random.PRNGKey(1), (8, 16, cfg.dim), dtype=cfg.dtype
         )
         cos, sin = rope_frequencies(cfg.head_dim, 16, cfg.rope_theta)
-        body = lambda h, layer: llama._layer(cfg, None, cos, sin, h, layer)  # noqa: E731
+        body = lambda h, layer: llama._layer(cfg, None, cos, sin, h, layer)[0]  # noqa: E731
         ref = sequential(body, params["layers"], x)
         mesh = make_pp_mesh(2)
         out = jax.jit(

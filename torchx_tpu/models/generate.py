@@ -12,8 +12,7 @@ forward), temperature>0 samples from the softmax.
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -128,6 +127,11 @@ def generate(
     rng: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """-> [b, t0 + max_new_tokens]; greedy when temperature == 0."""
+    if getattr(cfg, "n_experts", 0):
+        raise NotImplementedError(
+            "KV-cache generation supports dense configs only for now"
+            " (MoE decode needs the expert dispatch in the cached layer)"
+        )
     b, t0 = prompt.shape
     total = t0 + max_new_tokens
     if total > cfg.max_seq:
@@ -158,12 +162,8 @@ def generate(
             params, tok[:, None], cache, t0 + i, cfg
         )
         nxt = sample(logits[:, -1], sub)
-        out = jax.lax.cond(
-            i + 1 < max_new_tokens,
-            lambda o: o.at[:, i + 1].set(nxt),
-            lambda o: o,
-            out,
-        )
+        # scan runs i in [0, max_new_tokens-2], so i+1 is always in range
+        out = out.at[:, i + 1].set(nxt)
         return (cache, nxt, out, key), None
 
     if max_new_tokens > 1:

@@ -240,7 +240,9 @@ def _layer(
     sin: jnp.ndarray,
     x: jnp.ndarray,  # [b, s, d]
     layer: Params,  # one layer's slice
-) -> jnp.ndarray:
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (x, aux): aux is the MoE load-balancing loss contribution of this
+    layer (0 for dense layers)."""
     b, s, d = x.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -269,16 +271,17 @@ def _layer(
 
     # mlp block: dense SwiGLU, or sparse MoE when the config carries experts
     mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    aux = jnp.float32(0)
     if getattr(cfg, "n_experts", 0):
         from torchx_tpu.models.moe import moe_ffn
 
-        down = moe_ffn(cfg, layer, mlp_in)
+        down, aux = moe_ffn(cfg, layer, mlp_in)
     else:
         gate = jax.nn.silu(mlp_in @ layer["w_gate"])
         up = mlp_in @ layer["w_up"]
         down = (gate * up) @ layer["w_down"]
     x = x + down
-    return _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
+    return _constraint(x, mesh, ("dp", "fsdp"), "sp", None), aux
 
 
 def _remat(body, cfg: LlamaConfig):  # noqa: ANN001
@@ -296,8 +299,11 @@ def forward_features(
     tokens: jnp.ndarray,  # [b, s] int32
     cfg: LlamaConfig,
     mesh: Optional[Mesh] = None,
-) -> jnp.ndarray:
-    """-> final-norm hidden states [b, s, dim] (everything but the head)."""
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (final-norm hidden states [b, s, dim], MoE aux loss total).
+
+    aux is 0 for dense models and under pipeline parallelism (the pipeline
+    body contract carries activations only)."""
     s = tokens.shape[1]
     x = params["embed"][tokens].astype(cfg.dtype)  # [b, s, d]
     x = _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
@@ -305,6 +311,7 @@ def forward_features(
     cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
 
     body = _remat(functools.partial(_layer, cfg, mesh, cos, sin), cfg)
+    aux_total = jnp.float32(0)
 
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     if pp > 1:
@@ -325,7 +332,7 @@ def forward_features(
         # non-divisor rather than silently degrading the pipeline
         n_micro = cfg.pp_microbatches or _math.gcd(2 * pp, x.shape[0])
         x = pipeline_apply(
-            body,
+            lambda h, layer: body(h, layer)[0],  # aux dropped under pp
             params["layers"],
             x,
             mesh,
@@ -333,10 +340,12 @@ def forward_features(
         )
     else:
         def scan_step(x, layer_slice):  # noqa: ANN001
-            return body(x, layer_slice), None
+            x, aux = body(x, layer_slice)
+            return x, aux
 
-        x, _ = jax.lax.scan(scan_step, x, params["layers"])
-    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+        x, aux_per_layer = jax.lax.scan(scan_step, x, params["layers"])
+        aux_total = aux_per_layer.sum()
+    return rms_norm(x, params["final_norm"], cfg.norm_eps), aux_total
 
 
 def lm_head(params: Params, cfg: LlamaConfig) -> jnp.ndarray:
@@ -351,7 +360,7 @@ def forward(
 ) -> jnp.ndarray:
     """-> logits [b, s, vocab] float32 (full materialization — use
     :func:`loss_fn` for training, which never builds this tensor)."""
-    x = forward_features(params, tokens, cfg, mesh)
+    x, _ = forward_features(params, tokens, cfg, mesh)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, lm_head(params, cfg), preferred_element_type=jnp.float32
     )
@@ -383,7 +392,8 @@ def loss_fn(
     mesh: Optional[Mesh] = None,
 ) -> jnp.ndarray:
     tokens = batch["tokens"]
-    x = forward_features(params, tokens[:, :-1], cfg, mesh)
+    x, aux = forward_features(params, tokens[:, :-1], cfg, mesh)
+    aux_term = getattr(cfg, "router_aux_coef", 0.0) * aux
     targets = tokens[:, 1:]
     head = lm_head(params, cfg)
     mask = batch.get("loss_mask")
@@ -405,7 +415,7 @@ def loss_fn(
 
         if m is None:
             total, _ = jax.lax.scan(jax.checkpoint(body), jnp.float32(0), (xs, ts))
-            return total / (b * s)
+            return total / (b * s) + aux_term
         ms = m.reshape(b, n, chunk).swapaxes(0, 1)
 
         def body_masked(acc, xt):  # noqa: ANN001
@@ -415,9 +425,9 @@ def loss_fn(
         total, _ = jax.lax.scan(
             jax.checkpoint(body_masked), jnp.float32(0), (xs, ts, ms)
         )
-        return total / jnp.maximum(m.sum(), 1.0)
+        return total / jnp.maximum(m.sum(), 1.0) + aux_term
 
     nll = _token_nll(x, head, targets, mesh)
     if m is not None:
-        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
-    return nll.mean()
+        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0) + aux_term
+    return nll.mean() + aux_term

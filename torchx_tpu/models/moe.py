@@ -30,6 +30,9 @@ class MoEConfig(llama.LlamaConfig):
     n_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 2.0
+    # Switch/GShard load-balancing auxiliary loss coefficient: pushes the
+    # router toward uniform expert utilization (0 disables)
+    router_aux_coef: float = 0.01
 
     def param_count(self) -> int:
         dense = super().param_count()
@@ -144,9 +147,13 @@ def moe_ffn(
     cfg: MoEConfig,
     layer: llama.Params,  # one layer's slice (with w_router/w_gate/w_up/w_down)
     x: jnp.ndarray,  # [b, s, d]
-) -> jnp.ndarray:
+) -> tuple[jnp.ndarray, jnp.ndarray]:
     """GShard einsum dispatch: route -> dispatch to capacity slots ->
-    per-expert SwiGLU -> combine. Static shapes throughout."""
+    per-expert SwiGLU -> combine. Static shapes throughout.
+
+    Returns (output, aux): aux is the Switch-style load-balancing loss
+    ``E * Σ_e fraction_routed_e * mean_router_prob_e`` (≈1 when balanced),
+    scaled by the caller with cfg.router_aux_coef."""
     b, s, d = x.shape
     E, k = cfg.n_experts, cfg.top_k
     capacity = max(1, int(cfg.capacity_factor * s * k / E))
@@ -180,8 +187,16 @@ def moe_ffn(
     gate = jax.nn.silu(jnp.einsum("becd,edf->becf", expert_in, layer["w_gate"]))
     up = jnp.einsum("becd,edf->becf", expert_in, layer["w_up"])
     expert_out = jnp.einsum("becf,efd->becd", gate * up, layer["w_down"])
+    # load-balancing aux: fraction of top-1 routings per expert x mean
+    # router probability per expert (Switch Transformer eq. 4-6)
+    top1_oh = choice_oh[:, :, 0, :]  # [b, s, E]
+    frac_routed = top1_oh.mean(axis=(0, 1))  # [E]
+    mean_prob = probs.mean(axis=(0, 1))  # [E]
+    aux = E * jnp.sum(frac_routed * mean_prob)
+
     # back to tokens, gate-weighted
-    return jnp.einsum("bsec,becd->bsd", combine.astype(x.dtype), expert_out)
+    out = jnp.einsum("bsec,becd->bsd", combine.astype(x.dtype), expert_out)
+    return out, aux
 
 
 # -- model glue -------------------------------------------------------------

@@ -730,8 +730,14 @@ class LocalScheduler(Scheduler[PopenRequest]):
             else:
                 app.set_state(AppState.FAILED)
         elif not any_alive:
-            app.set_state(AppState.SUCCEEDED)
-            Path(app.log_dir, "SUCCESS").touch()
+            if _state_file_says_cancelled(app.log_dir):
+                # an external cancel landed and the replicas exited 0
+                # (graceful SIGTERM handling) — a cancelled run must not
+                # report SUCCEEDED or mint a SUCCESS marker
+                app.set_state(AppState.CANCELLED)
+            else:
+                app.set_state(AppState.SUCCEEDED)
+                Path(app.log_dir, "SUCCESS").touch()
 
     def list(self) -> list[ListAppResponse]:
         out = []
@@ -772,6 +778,14 @@ class LocalScheduler(Scheduler[PopenRequest]):
                 payload = json.load(f)
         except (OSError, json.JSONDecodeError):
             return
+        # mark CANCELLED on disk FIRST: the live owner polls its children
+        # and must find the mark before it can misread their SIGTERM deaths
+        # as a failure (or a graceful exit-0 as success)
+        payload["state"] = AppState.CANCELLED.name
+        try:
+            _atomic_write_json(os.path.join(log_dir, STATE_FILE), payload)
+        except OSError:
+            pass
         for replicas in payload.get("roles", {}).values():
             for r in replicas:
                 if not _pid_alive(r["pid"], r.get("pid_start")):
@@ -780,11 +794,6 @@ class LocalScheduler(Scheduler[PopenRequest]):
                     os.killpg(r["pid"], signal.SIGTERM)
                 except (ProcessLookupError, PermissionError):
                     pass
-        payload["state"] = AppState.CANCELLED.name
-        try:
-            _atomic_write_json(os.path.join(log_dir, STATE_FILE), payload)
-        except OSError:
-            pass
 
     def log_iter(
         self,

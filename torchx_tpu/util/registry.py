@@ -1,15 +1,17 @@
 """Per-user ``key = value`` registry files with safe concurrent access.
 
 Shared by the local scheduler's app registry and the slurm job-dir
-registry (one behavior to maintain). Appends and compaction hold an
-``fcntl`` exclusive lock so concurrent writers can't drop each other's
-entries; lookups are lock-free reads (the file is line-atomic).
+registry (one behavior to maintain). Writers serialize on a sidecar
+``.lock`` file (fcntl); compaction rewrites through a temp file +
+``os.replace`` so lock-free readers only ever observe a complete old or
+new file, never a truncated one.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import tempfile
 from typing import Callable, Optional
 
 logger = logging.getLogger(__name__)
@@ -24,27 +26,39 @@ def record(
     keep: Optional[Callable[[str], bool]] = None,
 ) -> None:
     """Append ``key = value``; when the file is large, first drop entries
-    whose value fails ``keep`` (all kept when keep is None) — under an
-    exclusive lock so a concurrent append can't be lost."""
+    whose value fails ``keep`` — writers hold the sidecar lock so
+    concurrent appends/compactions cannot lose each other's entries."""
     try:
-        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
-        try:
-            _flock(fd)
-            if keep is not None and os.fstat(fd).st_size > COMPACT_THRESHOLD_BYTES:
-                with open(path) as f:
-                    lines = f.readlines()
-                kept = [
-                    ln for ln in lines if keep(ln.partition(" = ")[2].strip())
-                ]
-                os.lseek(fd, 0, os.SEEK_SET)
-                os.ftruncate(fd, 0)
-                os.write(fd, "".join(kept).encode())
-            os.lseek(fd, 0, os.SEEK_END)
-            os.write(fd, f"{key} = {value}\n".encode())
-        finally:
-            os.close(fd)  # releases the lock
+        with _locked(path):
+            if (
+                keep is not None
+                and os.path.exists(path)
+                and os.path.getsize(path) > COMPACT_THRESHOLD_BYTES
+            ):
+                _compact(path, keep)
+            with open(path, "a") as f:
+                f.write(f"{key} = {value}\n")
     except OSError as e:
         logger.debug("could not record %s in %s: %s", key, path, e)
+
+
+def _compact(path: str, keep: Callable[[str], bool]) -> None:
+    """Caller holds the lock. tmp + os.replace so readers never see a
+    partial file."""
+    with open(path) as f:
+        lines = f.readlines()
+    kept = [ln for ln in lines if keep(ln.partition(" = ")[2].strip())]
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".", prefix=".reg_")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.writelines(kept)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def lookup(path: str, key: str) -> Optional[str]:
@@ -73,10 +87,23 @@ def entries(path: str) -> list[tuple[str, str]]:
         return []
 
 
-def _flock(fd: int) -> None:
-    try:
-        import fcntl
+class _locked:
+    """Exclusive sidecar-file lock (best-effort where fcntl is missing)."""
 
-        fcntl.flock(fd, fcntl.LOCK_EX)
-    except (ImportError, OSError):  # non-POSIX: best-effort without lock
-        pass
+    def __init__(self, path: str) -> None:
+        self._lock_path = path + ".lock"
+        self._fd: Optional[int] = None
+
+    def __enter__(self) -> "_locked":
+        self._fd = os.open(self._lock_path, os.O_RDWR | os.O_CREAT, 0o600)
+        try:
+            import fcntl
+
+            fcntl.flock(self._fd, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            pass
+        return self
+
+    def __exit__(self, *exc) -> None:  # noqa: ANN002
+        if self._fd is not None:
+            os.close(self._fd)
