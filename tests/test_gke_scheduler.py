@@ -3,6 +3,7 @@ analog: kubernetes_scheduler_test.py, 1935 LoC — dryrun request checks with
 no cluster)."""
 
 import pytest
+from unittest import mock
 
 from torchx_tpu.schedulers.api import DescribeAppResponse
 from torchx_tpu.schedulers.gke_scheduler import (
@@ -189,6 +190,42 @@ class TestGKESchedulerDryrun:
             "spec"
         ]["template"]["spec"]["containers"][0]
         assert container["image"] == "gcr.io/p/r:" + "a" * 12
+
+
+class TestGKELogPodResolution:
+    def _pod(self, name, job_index, completion_index):
+        pod = mock.MagicMock()
+        pod.metadata.name = name
+        pod.metadata.labels = {"jobset.sigs.k8s.io/job-index": str(job_index)}
+        pod.metadata.annotations = {
+            "batch.kubernetes.io/job-completion-index": str(completion_index)
+        }
+        return pod
+
+    def test_resolves_kth_replica_across_slices(self):
+        sched = GKEScheduler("t", client=object())
+        pods = mock.MagicMock()
+        # two slices (job index) x two hosts (completion index), random order
+        pods.items = [
+            self._pod("app-tr-1-1-xyz", 1, 1),
+            self._pod("app-tr-0-0-abc", 0, 0),
+            self._pod("app-tr-1-0-def", 1, 0),
+            self._pod("app-tr-0-1-ghi", 0, 1),
+        ]
+        core = mock.MagicMock()
+        core.list_namespaced_pod.return_value = pods
+        with mock.patch.object(sched, "_core_api", return_value=core):
+            assert sched._resolve_pod_name("ns", "app", "tr", 0) == "app-tr-0-0-abc"
+            core.list_namespaced_pod.assert_called_with(
+                namespace="ns",
+                label_selector=(
+                    "jobset.sigs.k8s.io/jobset-name=app,"
+                    "jobset.sigs.k8s.io/replicatedjob-name=tr"
+                ),
+            )
+            assert sched._resolve_pod_name("ns", "app", "tr", 2) == "app-tr-1-0-def"
+            with pytest.raises(ValueError, match="not found"):
+                sched._resolve_pod_name("ns", "app", "tr", 4)
 
 
 class TestJobSetStateMapping:

@@ -129,6 +129,28 @@ class TestTpuVmScheduler:
         assert any("delete" in c for c in calls)
 
 
+class TestTpuVmLogs:
+    def test_log_fetch_over_ssh(self, sched, monkeypatch):
+        calls = []
+
+        def run_cmd(cmd, **kw):
+            calls.append(cmd)
+            return completed(stdout="line-a\nline-b\n")
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        lines = list(sched.log_iter("us-east5-a:node1", "tpu", k=1))
+        assert lines == ["line-a", "line-b"]
+        (cmd,) = calls
+        assert "ssh" in cmd and "--worker=1" in cmd and "--zone=us-east5-a" in cmd
+
+    def test_log_fetch_failure(self, sched, monkeypatch):
+        monkeypatch.setattr(
+            sched, "_run_cmd", lambda cmd, **kw: completed(rc=255, stderr="no ssh")
+        )
+        with pytest.raises(RuntimeError, match="log fetch"):
+            sched.log_iter("z:n", "tpu", 0)
+
+
 class TestPipelineModel:
     def app(self, name="a"):
         return AppDef(
