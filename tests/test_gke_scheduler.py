@@ -100,6 +100,81 @@ class TestJobSetMaterialization:
         env = {e["name"]: e.get("value") for e in container["env"]}
         assert env["MEGASCALE_NUM_SLICES"] == "2"
 
+    def test_multislice_global_gang_identity(self):
+        """All slices form ONE jax.distributed world: global world size,
+        slice decomposition from the JobSet job index, one coordinator."""
+        js = make_jobset(AppDef(name="a", roles=[tpu_role(num_replicas=2)]))
+        (rj,) = js["spec"]["replicatedJobs"]
+        container = rj["template"]["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e for e in container["env"]}
+        # v5p-16 -> 4 hosts/slice, 2 slices -> world of 8 processes
+        assert env["TPX_NUM_REPLICAS"]["value"] == "8"
+        # the global id is derived at bootstrap from the decomposition;
+        # the pod template must NOT pin a per-slice TPX_REPLICA_ID
+        assert "TPX_REPLICA_ID" not in env
+        assert env["TPX_SLICE_ID"]["value"] == "$(JOB_INDEX)"
+        assert env["TPX_HOST_ID"]["value"] == "$(JOB_COMPLETION_INDEX)"
+        assert env["TPX_HOSTS_PER_SLICE"]["value"] == "4"
+        assert env["JOB_INDEX"]["valueFrom"]["fieldRef"]["fieldPath"].endswith(
+            "jobset.sigs.k8s.io/job-index']"
+        )
+        assert env["MEGASCALE_SLICE_ID"]["value"] == "$(JOB_INDEX)"
+        # every slice points at the same coordinator (slice 0 host 0) and
+        # the megascale DCN coordinator rides the next port
+        assert env["TPX_COORDINATOR_HOST"]["value"] == "app-x-trainer-0-0.app-x"
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"]["value"].startswith(
+            "app-x-trainer-0-0.app-x:"
+        )
+        # an AppDef "replica" is a slice: the macro resolves to the slice id
+        assert "--replica=$(TPX_SLICE_ID)" in container["command"]
+
+    def test_gang_info_derives_global_id_from_decomposition(self, monkeypatch):
+        from torchx_tpu.distributed import gang_info
+
+        for var in ("TPX_REPLICA_ID", "TPU_WORKER_ID"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("TPX_SLICE_ID", "1")
+        monkeypatch.setenv("TPX_HOST_ID", "2")
+        monkeypatch.setenv("TPX_HOSTS_PER_SLICE", "4")
+        monkeypatch.setenv("TPX_NUM_REPLICAS", "8")
+        monkeypatch.setenv("TPX_COORDINATOR_HOST", "h0")
+        assert gang_info() == (6, 8, "h0")
+        # an explicit global id always wins over the decomposition
+        monkeypatch.setenv("TPX_REPLICA_ID", "5")
+        assert gang_info() == (5, 8, "h0")
+
+    def test_min_replicas_elastic_mapping(self):
+        # CPU role: Kueue partial admission on the child Job
+        role = Role(
+            name="reader",
+            image="img",
+            entrypoint="python",
+            num_replicas=4,
+            min_replicas=2,
+            resource=Resource(cpu=2, memMB=4096),
+        )
+        js = make_jobset(AppDef(name="a", roles=[role]))
+        (rj,) = js["spec"]["replicatedJobs"]
+        ann = rj["template"]["metadata"]["annotations"]
+        assert ann["kueue.x-k8s.io/job-min-parallelism"] == "2"
+        assert ann["tpx.sh/min-replicas"] == "2"
+        container = rj["template"]["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["TPX_MIN_REPLICAS"] == "2"
+
+        # TPU role: no Job-level partial admission (JobSet children), but the
+        # floor is surfaced to autoscalers and the in-job bootstrap
+        js = make_jobset(
+            AppDef(name="a", roles=[tpu_role(num_replicas=2, min_replicas=1)])
+        )
+        (rj,) = js["spec"]["replicatedJobs"]
+        ann = rj["template"]["metadata"]["annotations"]
+        assert ann["tpx.sh/min-replicas"] == "1"
+        assert "kueue.x-k8s.io/job-min-parallelism" not in ann
+        container = rj["template"]["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["TPX_MIN_REPLICAS"] == "1"
+
     def test_cpu_role(self):
         role = Role(
             name="reader",
@@ -269,3 +344,59 @@ class TestJobSetStateMapping:
         (rs,) = resp.roles_statuses
         assert rs.replicas[0].id == 1
         assert rs.replicas[0].hostname == "10.0.0.7"
+
+
+# =========================================================================
+# Recorded-fixture tests: degraded/malformed JobSet status payloads
+# (reference analog: kubernetes_scheduler_test.py describe fixtures)
+# =========================================================================
+
+import json
+import os
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def load_fixture(name: str):
+    with open(os.path.join(FIXTURES, name)) as f:
+        return json.load(f)
+
+
+class TestDescribeJobsetFixtures:
+    def test_degraded_multislice(self):
+        """2-slice JobSet mid-failure: restarts as a string, mixed pod
+        phases, a garbage completion-index, and global replica ids folding
+        in the slice index."""
+        fx = load_fixture("jobset_degraded.json")
+        resp = describe_jobset(fx["jobset"], fx["pods"])
+        assert resp.state == AppState.RUNNING  # no terminal condition yet
+        assert resp.num_restarts == 1  # "1" parsed
+        (rs,) = resp.roles_statuses
+        pairs = sorted((r.id, r.state) for r in rs.replicas)
+        # slice 0 hosts -> ids 0,1; slice 1 hosts -> ids 2,3; the garbage
+        # completion-index degrades to host 0 of slice 1 -> a second id 2
+        assert pairs == [
+            (0, AppState.RUNNING),
+            (1, AppState.FAILED),
+            (2, AppState.PENDING),
+            (2, AppState.RUNNING),
+        ]
+        hostnames = {r.id: r.hostname for r in rs.replicas if r.state == AppState.RUNNING}
+        assert hostnames[0] == "10.0.0.1"  # podIP
+        assert hostnames[2] == "10.0.0.3"  # pod_ip variant
+
+    def test_malformed_payload_never_crashes(self):
+        """Future/partial payloads: null restarts, unknown condition types,
+        condition without type/status, null pod metadata, unknown phase."""
+        fx = load_fixture("jobset_malformed.json")
+        resp = describe_jobset(fx["jobset"], fx["pods"])
+        assert resp.state == AppState.PENDING  # nothing definitive
+        assert resp.num_restarts == 0
+        roles = {r.role: r for r in resp.roles_statuses}
+        assert roles["unknown"].replicas[0].state == AppState.UNKNOWN
+        assert roles["w"].replicas[0].state == AppState.SUCCEEDED
+
+    def test_empty_everything(self):
+        resp = describe_jobset({}, [])
+        assert resp.state == AppState.SUBMITTED
+        assert resp.roles_statuses == []

@@ -211,3 +211,89 @@ class TestStateMap:
         assert slurm_state("CANCELLED by 1000") == AppState.CANCELLED
         assert slurm_state("NODE_FAIL") == AppState.FAILED
         assert slurm_state("WEIRD") == AppState.UNKNOWN
+
+
+# =========================================================================
+# Recorded-fixture tests: format generations the parsers must survive
+# (reference analog: slurm-squeue-output.json, slurm_scheduler.py:661-810)
+# =========================================================================
+
+import os
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def fixture(name: str) -> str:
+    with open(os.path.join(FIXTURES, name)) as f:
+        return f.read()
+
+
+class TestSqueueFormatGenerations:
+    def test_v24_object_nodes(self, sched, monkeypatch):
+        """24.05 squeue: job_state is a list, job_resources.nodes an object."""
+        monkeypatch.setattr(
+            sched, "_run_cmd",
+            lambda cmd, **kw: completed(stdout=fixture("squeue_v24.json")),
+        )
+        resp = sched.describe("4001")
+        assert resp.state == AppState.RUNNING
+        (rs,) = resp.roles_statuses
+        by_id = {r.id: r for r in rs.replicas}
+        assert by_id[0].hostname == "tpu-node-3"
+        assert by_id[1].state == AppState.PENDING
+        assert by_id[1].hostname == ""  # job_resources: null (pending)
+
+    def test_v22_string_nodes_and_allocated_nodes(self, sched, monkeypatch):
+        """pre-23.02: job_state is a string; nodes is a string or
+        allocated_nodes a list of {nodename}."""
+        monkeypatch.setattr(
+            sched, "_run_cmd",
+            lambda cmd, **kw: completed(stdout=fixture("squeue_v22.json")),
+        )
+        resp = sched.describe("1234")
+        (rs,) = resp.roles_statuses
+        by_id = {r.id: r for r in rs.replicas}
+        assert by_id[0].hostname == "gpu-compute-[01-02]"
+        assert by_id[1].hostname == "gpu-compute-03"
+
+    def test_truncated_payload_falls_through(self, sched, monkeypatch):
+        """A half-written/truncated squeue JSON must not crash describe —
+        it falls through to sacct (which here has nothing)."""
+
+        def run_cmd(cmd, **kw):
+            if cmd[0] == "squeue":
+                return completed(stdout='{"jobs": [{"job_id": 1, "na')
+            return completed(stdout="")
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        assert sched.describe("1") is None
+
+
+class TestSacctFormatVariants:
+    def test_het_offsets_steps_and_blank_state(self, sched, monkeypatch):
+        """sacct rows: het-job `+N` ids, `.batch`/`.0` step rows (skipped),
+        'CANCELLED by uid' states, and a blank state column."""
+
+        def run_cmd(cmd, **kw):
+            if cmd[0] == "squeue":
+                return completed(rc=1)  # job left the queue
+            return completed(stdout=fixture("sacct_variants.txt"))
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        resp = sched.describe("777")
+        assert resp is not None
+        assert resp.state == AppState.CANCELLED
+        (rs,) = [r for r in resp.roles_statuses if r.role == "spmd"]
+        assert {r.id: r.state for r in rs.replicas} == {
+            0: AppState.CANCELLED,
+            1: AppState.SUCCEEDED,
+        }
+
+    def test_sacct_header_only(self, sched, monkeypatch):
+        def run_cmd(cmd, **kw):
+            if cmd[0] == "squeue":
+                return completed(rc=1)
+            return completed(stdout="JobID|JobName|State\n")
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        assert sched.describe("777") is None

@@ -65,6 +65,9 @@ def _assert_platform() -> None:
 def initialize_distributed(port: int) -> None:
     _assert_platform()
     process_id, num_processes, coordinator = _gang()
+    # surface the resolved GLOBAL id to user code even when the backend
+    # injected only the (slice, host) decomposition (e.g. GKE multi-slice)
+    os.environ.setdefault(settings.ENV_TPX_REPLICA_ID, str(process_id))
     if num_processes <= 1:
         return  # single process: jax works without a coordinator
     from torchx_tpu import distributed as tpx_dist

@@ -29,11 +29,16 @@ def gang_info() -> tuple[int, int, str]:
     falling back to GKE's TPU_WORKER_* variables when the launcher vars are
     absent (e.g. hand-rolled podslice jobs). The single source of truth —
     the spmd bootstrap uses this same parser."""
-    process_id = int(
-        os.environ.get(settings.ENV_TPX_REPLICA_ID)
-        or os.environ.get(settings.ENV_TPU_WORKER_ID)
-        or 0
-    )
+    raw = os.environ.get(settings.ENV_TPX_REPLICA_ID)
+    if raw is None:
+        # multi-slice backends that can't do arithmetic at pod start inject
+        # the (slice_id, host_id, hosts_per_slice) decomposition instead
+        slice_id = os.environ.get(settings.ENV_TPX_SLICE_ID)
+        host_id = os.environ.get(settings.ENV_TPX_HOST_ID)
+        per_slice = os.environ.get(settings.ENV_TPX_HOSTS_PER_SLICE)
+        if slice_id is not None and host_id is not None and per_slice is not None:
+            raw = str(int(slice_id) * int(per_slice) + int(host_id))
+    process_id = int(raw or os.environ.get(settings.ENV_TPU_WORKER_ID) or 0)
     num = int(os.environ.get(settings.ENV_TPX_NUM_REPLICAS) or 0)
     coordinator = os.environ.get(settings.ENV_TPX_COORDINATOR_HOST, "")
     if not coordinator:

@@ -225,6 +225,12 @@ def role_replica_env(
             from torchx_tpu import settings as s
 
             slice_id = replica_id // tpu.hosts
+            # same surface as the GKE pod template's decomposition (there
+            # the bootstrap derives the global id from these; here both
+            # forms are present and TPX_REPLICA_ID wins)
+            env[s.ENV_TPX_SLICE_ID] = str(slice_id)
+            env[s.ENV_TPX_HOST_ID] = str(replica_id % tpu.hosts)
+            env[s.ENV_TPX_HOSTS_PER_SLICE] = str(tpu.hosts)
             env[s.ENV_MEGASCALE_NUM_SLICES] = str(role.num_replicas)
             env[s.ENV_MEGASCALE_SLICE_ID] = str(slice_id)
             env[s.ENV_MEGASCALE_COORDINATOR_ADDRESS] = (

@@ -214,15 +214,26 @@ def role_to_pod_template(
     coordinator_host: str,
     coordinator_port: int,
     service_account: Optional[str],
+    num_slices: int = 1,
 ) -> dict[str, Any]:
-    """Pod template for one TPU-VM host (or CPU replica) of the role."""
+    """Pod template for one TPU-VM host (or CPU replica) of the role.
+
+    Gang identity follows the canonical JobSet multi-slice pattern: the pod
+    template is shared by all child Jobs (slices), so per-slice identity must
+    come from fieldRefs at pod start. Kubelet env expansion is
+    substitution-only, so for ``num_slices > 1`` we inject the
+    (TPX_SLICE_ID, TPX_HOST_ID, TPX_HOSTS_PER_SLICE) decomposition and the
+    spmd bootstrap derives the global TPX_REPLICA_ID — matching
+    ``role_replica_env`` so every backend forms one global world of
+    ``hosts * num_slices`` processes.
+    """
     tpu = role.resource.tpu
     num_hosts = tpu.hosts if tpu else role.num_replicas
 
     container = role_to_container(role)
-    # gang identity: completion index -> TPX_REPLICA_ID; kubelet expands
+    # gang identity: completion index -> host index; kubelet expands
     # $(JOB_COMPLETION_INDEX) references in env/args at pod start
-    container["env"] = [
+    identity: list[dict[str, Any]] = [
         {
             "name": "JOB_COMPLETION_INDEX",
             "valueFrom": {
@@ -231,9 +242,37 @@ def role_to_pod_template(
                 }
             },
         },
-        {"name": settings.ENV_TPX_REPLICA_ID, "value": "$(JOB_COMPLETION_INDEX)"},
+    ]
+    if tpu is not None and num_slices > 1:
+        identity += [
+            {
+                "name": "JOB_INDEX",
+                "valueFrom": {
+                    "fieldRef": {
+                        "fieldPath": "metadata.annotations['jobset.sigs.k8s.io/job-index']"
+                    }
+                },
+            },
+            {"name": settings.ENV_TPX_SLICE_ID, "value": "$(JOB_INDEX)"},
+            {"name": settings.ENV_TPX_HOST_ID, "value": "$(JOB_COMPLETION_INDEX)"},
+            {"name": settings.ENV_TPX_HOSTS_PER_SLICE, "value": str(num_hosts)},
+            {
+                "name": settings.ENV_TPX_NUM_REPLICAS,
+                "value": str(num_hosts * num_slices),
+            },
+            {"name": settings.ENV_MEGASCALE_SLICE_ID, "value": "$(JOB_INDEX)"},
+        ]
+    else:
+        identity += [
+            {
+                "name": settings.ENV_TPX_REPLICA_ID,
+                "value": "$(JOB_COMPLETION_INDEX)",
+            },
+            {"name": settings.ENV_TPX_NUM_REPLICAS, "value": str(num_hosts)},
+        ]
+    container["env"] = [
+        *identity,
         {"name": settings.ENV_TPX_ROLE_NAME, "value": role.name},
-        {"name": settings.ENV_TPX_NUM_REPLICAS, "value": str(num_hosts)},
         {"name": settings.ENV_TPX_COORDINATOR_HOST, "value": coordinator_host},
         {"name": settings.ENV_TPX_APP_ID, "value": app_name},
         {"name": settings.ENV_TPX_ERROR_FILE, "value": "/tmp/tpx_error.json"},
@@ -317,17 +356,22 @@ def app_to_jobset(
         role0 = sanitize_name(app.roles[0].name)
         coordinator_host = f"{app_name}-{role0}-0-0.{app_name}"
 
+        multislice = bool(tpu) and role.num_replicas > 1
         values = macros.Values(
             img_root="",
             app_id=app_name,
-            replica_id=f"$({settings.ENV_TPX_REPLICA_ID})",
-            num_replicas=str(completions),
+            # multi-slice: an AppDef "replica" is a slice, so the macro is
+            # the slice id (TPX_SLICE_ID resolves from the JobSet job index)
+            replica_id=f"$({settings.ENV_TPX_SLICE_ID})"
+            if multislice
+            else f"$({settings.ENV_TPX_REPLICA_ID})",
+            num_replicas=str(role.num_replicas) if multislice else str(completions),
             coordinator_env=settings.ENV_TPX_COORDINATOR_HOST,
         )
         srole = values.apply(role)
-        if tpu and role.num_replicas > 1:
-            # multi-slice: every job gets DCN identity via the jobset-level
-            # env JobSet injects (JOB_INDEX); megascale coordinator = slice 0
+        if multislice:
+            # DCN identity: slice id comes from the JobSet job-index fieldRef
+            # in the pod template; megascale coordinator = slice 0's host 0
             srole.env.setdefault(
                 settings.ENV_MEGASCALE_NUM_SLICES, str(role.num_replicas)
             )
@@ -337,7 +381,12 @@ def app_to_jobset(
             )
 
         pod_template = role_to_pod_template(
-            srole, app_name, coordinator_host, coordinator_port, service_account
+            srole,
+            app_name,
+            coordinator_host,
+            coordinator_port,
+            service_account,
+            num_slices=role.num_replicas if multislice else 1,
         )
 
         job_spec: dict[str, Any] = {
@@ -353,13 +402,31 @@ def app_to_jobset(
             "template": {"spec": job_spec},
         }
         if role.min_replicas is not None:
-            # elastic lower bound: SPMD worlds resize by restart (checkpoint
-            # resume + warm compile cache make that cheap), so the bound is
-            # surfaced for external autoscalers/Kueue rather than mapped to
-            # an in-place JobSet mechanism
-            rj["template"]["metadata"] = {
-                "annotations": {"tpx.sh/min-replicas": str(role.min_replicas)}
-            }
+            # elastic lower bound. SPMD worlds resize by restart (checkpoint
+            # resume + warm compile cache make that cheap); the bound maps to
+            # the real admission mechanism available per role shape:
+            #  - CPU roles are one Indexed Job over num_replicas pods -> Kueue
+            #    partial admission (job-min-parallelism) can admit the Job
+            #    with fewer pods when the queue is tight
+            #  - TPU roles are one Job per slice; Kueue has no partial
+            #    admission for JobSet children, so the floor rides
+            #    tpx.sh/min-replicas for external autoscalers AND is injected
+            #    as TPX_MIN_REPLICAS so in-job bootstrap logic knows how far
+            #    the world may legally shrink on restart
+            annotations = {"tpx.sh/min-replicas": str(role.min_replicas)}
+            if not tpu:
+                annotations["kueue.x-k8s.io/job-min-parallelism"] = str(
+                    role.min_replicas
+                )
+            rj["template"]["metadata"] = {"annotations": annotations}
+            container = pod_template["spec"]["containers"][0]
+            container["env"].insert(
+                0,
+                {
+                    "name": settings.ENV_TPX_MIN_REPLICAS,
+                    "value": str(role.min_replicas),
+                },
+            )
         replicated_jobs.append(rj)
 
     jobset_spec: dict[str, Any] = {
@@ -641,11 +708,33 @@ def jobset_state(jobset: Mapping[str, Any]) -> AppState:
     return AppState.PENDING if status else AppState.SUBMITTED
 
 
+def _safe_int(value: Any, default: int = 0) -> int:
+    """Version-drift tolerance: annotations/status fields arrive as strings,
+    numbers, None, or garbage across JobSet/k8s versions — never crash
+    describe() on one bad field."""
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return default
+
+
+def _role_completions(jobset: Mapping[str, Any]) -> dict[str, int]:
+    """replicatedJob name -> completions (hosts per slice), from the spec."""
+    out: dict[str, int] = {}
+    for rj in (jobset.get("spec") or {}).get("replicatedJobs") or []:
+        name = rj.get("name")
+        spec = ((rj.get("template") or {}).get("spec")) or {}
+        if name:
+            out[str(name)] = _safe_int(spec.get("completions"), 1) or 1
+    return out
+
+
 def describe_jobset(
     jobset: Mapping[str, Any], pods: list[Mapping[str, Any]]
 ) -> DescribeAppResponse:
     state = jobset_state(jobset)
     status = jobset.get("status") or {}
+    completions = _role_completions(jobset)
     roles: dict[str, RoleStatus] = {}
     for pod in pods:
         meta = pod.get("metadata") or {}
@@ -653,11 +742,17 @@ def describe_jobset(
         role = labels.get(LABEL_ROLE_NAME) or labels.get(
             "jobset.sigs.k8s.io/replicatedjob-name", "unknown"
         )
-        idx = int(
-            (meta.get("annotations") or {}).get(
-                "batch.kubernetes.io/job-completion-index", 0
-            )
+        annotations = meta.get("annotations") or {}
+        host_idx = _safe_int(
+            annotations.get("batch.kubernetes.io/job-completion-index")
         )
+        # multi-slice: two slices' pods share completion indexes; the global
+        # replica id folds in the JobSet job index (slice) when present
+        slice_idx = _safe_int(
+            labels.get("jobset.sigs.k8s.io/job-index")
+            or annotations.get("jobset.sigs.k8s.io/job-index")
+        )
+        idx = slice_idx * completions.get(str(role), 1) + host_idx
         phase = ((pod.get("status") or {}).get("phase")) or "Unknown"
         pod_ip = (pod.get("status") or {}).get("pod_ip") or (
             pod.get("status") or {}
@@ -670,7 +765,9 @@ def describe_jobset(
                 hostname=pod_ip or meta.get("name", ""),
             )
         )
-    restarts = int(status.get("restarts", 0) or 0)
+    for rs in roles.values():
+        rs.replicas.sort(key=lambda r: r.id)
+    restarts = _safe_int(status.get("restarts"))
     return DescribeAppResponse(
         app_id=f"{jobset.get('metadata', {}).get('namespace')}:"
         f"{jobset.get('metadata', {}).get('name')}",

@@ -91,8 +91,11 @@ def _dquote(s: str) -> str:
 
 
 def slurm_state(state_str: str) -> AppState:
-    # sacct can report "CANCELLED by 12345"
-    return SLURM_STATE_MAP.get(state_str.split()[0].rstrip("+"), AppState.UNKNOWN)
+    # sacct can report "CANCELLED by 12345"; pending rows can be blank
+    parts = state_str.split()
+    if not parts:
+        return AppState.UNKNOWN
+    return SLURM_STATE_MAP.get(parts[0].rstrip("+"), AppState.UNKNOWN)
 
 
 @dataclass
@@ -408,6 +411,28 @@ def _is_worse(a: AppState, b: AppState) -> bool:
     return _STATE_BADNESS.get(a, 0) > _STATE_BADNESS.get(b, 0)
 
 
+def _squeue_job_nodes(job: Mapping[str, Any]) -> str:
+    """Allocated node list across squeue --json format generations:
+    pre-23.02 ``job_resources.nodes`` is a string; 24.05 made it an object
+    (``{"count": .., "list": [..]}``); some builds use ``allocated_nodes``
+    or omit job_resources entirely for pending jobs."""
+    res = job.get("job_resources") or {}
+    if not isinstance(res, Mapping):
+        return ""
+    nodes = res.get("nodes", res.get("allocated_nodes", ""))
+    if isinstance(nodes, Mapping):
+        node_list = nodes.get("list")
+        if isinstance(node_list, list):
+            return ",".join(str(n) for n in node_list)
+        return str(nodes.get("nodes", "") or "")
+    if isinstance(nodes, list):  # allocated_nodes: [{"nodename": ...}]
+        return ",".join(
+            str(n.get("nodename", n) if isinstance(n, Mapping) else n)
+            for n in nodes
+        )
+    return str(nodes or "")
+
+
 def _squeue_job_state(job: Mapping[str, Any]) -> AppState:
     js = job.get("job_state")
     if isinstance(js, list):
@@ -427,13 +452,12 @@ def _describe_from_squeue_jobs(
         name = str(job.get("name", ""))
         role, _, rep = name.rpartition("-")
         if role and rep.isdigit():
-            nodes = job.get("job_resources", {}) or {}
             roles.setdefault(role, RoleStatus(role=role)).replicas.append(
                 ReplicaStatus(
                     id=int(rep),
                     state=state,
                     role=role,
-                    hostname=str(nodes.get("nodes", "")),
+                    hostname=_squeue_job_nodes(job),
                 )
             )
     if not roles:

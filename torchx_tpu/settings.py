@@ -41,10 +41,23 @@ ENV_TPX_PARAMS_PREFIX = "TPX_PARAMS_"
 ENV_TPX_APP_ID = "TPX_APP_ID"
 ENV_TPX_JOB_ID = "TPX_JOB_ID"  # full handle scheme://session/app_id
 
-# Replica identity within the role's gang.
+# Replica identity within the role's gang. TPX_REPLICA_ID, when present, is
+# the GLOBAL process id across all slices of the role (0..TPX_NUM_REPLICAS-1).
 ENV_TPX_REPLICA_ID = "TPX_REPLICA_ID"
 ENV_TPX_ROLE_NAME = "TPX_ROLE_NAME"
 ENV_TPX_NUM_REPLICAS = "TPX_NUM_REPLICAS"
+
+# Multi-slice decomposition of the global id. Backends that cannot compute
+# arithmetic at pod start (kubelet env expansion is substitution-only) inject
+# these three instead of TPX_REPLICA_ID and the bootstrap derives
+# ``replica_id = slice_id * hosts_per_slice + host_id``.
+ENV_TPX_SLICE_ID = "TPX_SLICE_ID"
+ENV_TPX_HOST_ID = "TPX_HOST_ID"  # host index within the slice
+ENV_TPX_HOSTS_PER_SLICE = "TPX_HOSTS_PER_SLICE"
+
+# Elastic lower bound of the gang (replicas may legally shrink to this on
+# restart after host loss; see local_scheduler._try_elastic_restart).
+ENV_TPX_MIN_REPLICAS = "TPX_MIN_REPLICAS"
 
 # Host that replica 0 of role 0 runs on -- the SPMD coordinator. The *name*
 # of the env var holding it is what ``macros.coordinator_env`` substitutes
