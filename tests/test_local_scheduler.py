@@ -53,6 +53,140 @@ def wait_terminal(sched: LocalScheduler, app_id: str, timeout: float = 30) -> Ap
     raise TimeoutError(f"app {app_id} did not finish")
 
 
+class TestElasticRestart:
+    """Elastic gangs (min_replicas) shrink-and-restart on replica death,
+    resuming from the app's own checkpoint with a resized world
+    (BASELINE config 4: elastic min/max rendezvous under preemption)."""
+
+    def elastic_script(self, ckpt_dir: str) -> str:
+        # replica 2 "is preempted" (exit 1) before the checkpoint reaches
+        # step 5; after the elastic restart the world is smaller, replica 2
+        # no longer exists, and survivors resume from the checkpoint
+        return (
+            f"CK={ckpt_dir}/progress; start=0; "
+            '[ -f "$CK" ] && start=$(cat "$CK"); '
+            'if [ "$TPX_REPLICA_ID" = "2" ] && [ "$start" -lt 5 ]; then '
+            'echo 5 > "$CK"; exit 1; fi; '
+            'echo "world=$TPX_NUM_REPLICAS start=$start"; '
+            "sleep 0.5; "
+            '[ "$TPX_REPLICA_ID" = "0" ] && echo 10 > "$CK"; exit 0'
+        )
+
+    def test_shrink_restart_resumes_from_checkpoint(self, sched, tmp_path):
+        ckpt = tmp_path / "ckpt"
+        ckpt.mkdir()
+        app = AppDef(
+            name="elastic",
+            roles=[
+                sh_role(
+                    "w",
+                    self.elastic_script(str(ckpt)),
+                    num_replicas=3,
+                    min_replicas=1,
+                    max_retries=2,
+                )
+            ],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        assert wait_terminal(sched, app_id, timeout=30) == AppState.SUCCEEDED
+        desc = sched.describe(app_id)
+        assert desc.num_restarts == 1
+        # the relaunched gang is 2 wide and resumed from the checkpoint
+        out0 = (tmp_path / app_id / "w" / "0" / "stdout.log").read_text()
+        assert "world=2 start=5" in out0
+        # attempt-0 logs were rotated aside, not clobbered
+        assert (tmp_path / app_id / "w" / "0" / "stdout.log.0").exists()
+        # only 2 replicas in the final gang
+        (rs,) = desc.roles_statuses
+        assert len(rs.replicas) == 2
+        assert (ckpt / "progress").read_text().strip() == "10"
+
+    def test_no_restart_below_min(self, sched, tmp_path):
+        ckpt = tmp_path / "ckpt"
+        ckpt.mkdir()
+        app = AppDef(
+            name="floor",
+            roles=[
+                sh_role(
+                    "w",
+                    self.elastic_script(str(ckpt)),
+                    num_replicas=3,
+                    min_replicas=3,  # can't shrink below the floor
+                    max_retries=2,
+                )
+            ],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        assert wait_terminal(sched, app_id, timeout=30) == AppState.FAILED
+        assert sched.describe(app_id).num_restarts == 0
+
+    def test_rigid_gang_fails_without_min_replicas(self, sched, tmp_path):
+        ckpt = tmp_path / "ckpt"
+        ckpt.mkdir()
+        app = AppDef(
+            name="rigid",
+            roles=[
+                sh_role(
+                    "w",
+                    self.elastic_script(str(ckpt)),
+                    num_replicas=3,
+                    max_retries=2,  # retries budget alone is not elastic
+                )
+            ],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        assert wait_terminal(sched, app_id, timeout=30) == AppState.FAILED
+
+    def test_tpu_gang_shrinks_whole_slices(self, sched, tmp_path):
+        """A TPU gang (2 slices x 2 hosts) losing one host must shrink to
+        ONE whole slice (2 hosts), not 3 — and the relaunched world's env
+        must be internally consistent (no stale multi-slice megascale env)."""
+        script = (
+            'if [ "$TPX_REPLICA_ID" = "3" ] && [ ! -f %s/died ]; then '
+            "touch %s/died; exit 1; fi; "
+            'echo "world=$TPX_NUM_REPLICAS slices=${MEGASCALE_NUM_SLICES:-none}'
+            ' slice=${TPX_SLICE_ID:-none}"; sleep 0.5; exit 0'
+        ) % (tmp_path, tmp_path)
+        role = Role(
+            name="w",
+            image="",
+            entrypoint="sh",
+            args=["-c", script],
+            num_replicas=2,  # slices
+            min_replicas=1,
+            max_retries=2,
+            resource=Resource(cpu=1, memMB=256, tpu=TpuSlice("v5p", 8)),
+        )
+        app_id = sched.submit(AppDef(name="tpu-elastic", roles=[role]),
+                              {"log_dir": str(tmp_path)})
+        assert wait_terminal(sched, app_id, timeout=30) == AppState.SUCCEEDED
+        desc = sched.describe(app_id)
+        assert desc.num_restarts == 1
+        (rs,) = desc.roles_statuses
+        assert len(rs.replicas) == 2  # one whole slice, not 3 hosts
+        out0 = (tmp_path / app_id / "w" / "0" / "stdout.log").read_text()
+        assert "world=2 slices=none slice=none" in out0
+
+    def test_restart_budget_exhausted(self, sched, tmp_path):
+        # every attempt fails (replica 0 always dies) -> FAILED after
+        # max_retries restarts
+        app = AppDef(
+            name="burn",
+            roles=[
+                sh_role(
+                    "w",
+                    'if [ "$TPX_REPLICA_ID" = "0" ]; then exit 1; fi; sleep 20',
+                    num_replicas=3,
+                    min_replicas=1,
+                    max_retries=1,
+                )
+            ],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        assert wait_terminal(sched, app_id, timeout=30) == AppState.FAILED
+        assert sched.describe(app_id).num_restarts == 1
+
+
 class TestLocalScheduler:
     def test_submit_success(self, sched, tmp_path):
         app = AppDef(name="ok", roles=[sh_role("r", "echo hello")])

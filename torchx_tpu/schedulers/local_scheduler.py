@@ -51,6 +51,7 @@ from torchx_tpu.specs.api import (
     CfgVal,
     NONE,
     ReplicaStatus,
+    Role,
     RoleStatus,
     is_terminal,
     macros,
@@ -258,6 +259,10 @@ class PopenRequest:
     app_id: str
     log_dir: str
     role_params: dict[str, list[ReplicaParam]] = field(default_factory=dict)
+    # retained for elastic restarts: rebuilding a SMALLER gang needs the
+    # original roles (min_replicas/max_retries) and submit-time cfg
+    app: Optional[AppDef] = None
+    cfg: dict[str, CfgVal] = field(default_factory=dict)
 
 
 # =========================================================================
@@ -319,12 +324,19 @@ class _LocalReplica:
 
 
 class _LocalApp:
-    def __init__(self, app_id: str, log_dir: str) -> None:
+    def __init__(
+        self,
+        app_id: str,
+        log_dir: str,
+        request: Optional[PopenRequest] = None,
+    ) -> None:
         self.app_id = app_id
         self.log_dir = log_dir
         self.roles: dict[str, list[_LocalReplica]] = {}
         self.state = AppState.PENDING
         self.last_updated = time.time()
+        self.request = request  # for elastic gang rebuilds
+        self.num_restarts = 0
 
     def write_state_file(self) -> None:
         """Snapshot for cross-process status/log (best-effort)."""
@@ -449,75 +461,108 @@ class LocalScheduler(Scheduler[PopenRequest]):
             tempfile.gettempdir(), "torchx_tpu", self.session_name
         )
         log_dir = os.path.join(str(base_log_dir), app_id)
-        request = PopenRequest(app_id=app_id, log_dir=log_dir)
-        host_chips = local_tpu_chip_count()
-
+        request = PopenRequest(
+            app_id=app_id, log_dir=log_dir, app=app, cfg=dict(cfg)
+        )
         for role in app.roles:
-            img_root = self._image_provider.fetch(role.image)
-            replicas: list[ReplicaParam] = []
-            num_replicas = tpu_hosts_for_role(role)
-            for replica_id in range(num_replicas):
-                values = macros.Values(
-                    img_root=img_root,
-                    app_id=app_id,
-                    replica_id=str(replica_id),
-                    num_replicas=str(num_replicas),
-                    coordinator_env=settings.ENV_TPX_COORDINATOR_HOST,
-                )
-                rrole = values.apply(role)
-                replica_log_dir = os.path.join(log_dir, role.name, str(replica_id))
-
-                env = dict(os.environ)
-                env.update(rrole.env)
-                env["PYTHONUNBUFFERED"] = "1"
-                env[settings.ENV_TPX_APP_ID] = app_id
-                env[settings.ENV_TPX_JOB_ID] = f"{self.backend}://{self.session_name}/{app_id}"
-                env[settings.ENV_TPX_LOG_DIR] = replica_log_dir
-                error_file = os.path.join(replica_log_dir, "error.json")
-                env[settings.ENV_TPX_ERROR_FILE] = error_file
-                env.update(
-                    role_replica_env(
-                        role,
-                        replica_id,
-                        coordinator_host="localhost",
-                        coordinator_port=settings.TPX_COORDINATOR_PORT,
-                    )
-                )
-                if role.resource is not None and role.resource.tpu is not None:
-                    env.update(
-                        tpu_device_env(
-                            role.resource.tpu.chips_per_host,
-                            replica_id,
-                            replicas_on_host=num_replicas,
-                            host_chips=host_chips,
-                            simulate=bool(cfg.get("tpu_simulate", True)),
-                            partition=bool(cfg.get("auto_set_tpu_chips", True)),
-                        )
-                    )
-                paths = [p for p in self._extra_paths]
-                if cfg.get("prepend_cwd"):
-                    paths.insert(0, os.getcwd())
-                if img_root:
-                    paths.append(img_root)
-                if paths:
-                    env["PATH"] = os.pathsep.join(paths + [env.get("PATH", "")])
-
-                entrypoint = self._image_provider.get_entrypoint(
-                    img_root, rrole.entrypoint
-                )
-                replicas.append(
-                    ReplicaParam(
-                        args=[entrypoint, *rrole.args],
-                        env=env,
-                        stdout=os.path.join(replica_log_dir, "stdout.log"),
-                        stderr=os.path.join(replica_log_dir, "stderr.log"),
-                        combined=os.path.join(replica_log_dir, "combined.log"),
-                        cwd=img_root or None,
-                    )
-                )
-            request.role_params[role.name] = replicas
-
+            request.role_params[role.name] = self._build_role_replicas(
+                role, app_id, log_dir, cfg
+            )
         return AppDryRunInfo(request, fmt=_pretty_request)
+
+    def _build_role_replicas(
+        self,
+        role: Role,
+        app_id: str,
+        log_dir: str,
+        cfg: Mapping[str, CfgVal],
+        num_replicas: Optional[int] = None,
+    ) -> list[ReplicaParam]:
+        """Materialize the Popen params for one role's gang.
+
+        ``num_replicas`` overrides the role-derived gang size — the elastic
+        restart path rebuilds a SMALLER world after host loss (every replica
+        gets fresh TPX_NUM_REPLICAS / TPX_REPLICA_ID for the resized mesh).
+        """
+        host_chips = local_tpu_chip_count()
+        img_root = self._image_provider.fetch(role.image)
+        replicas: list[ReplicaParam] = []
+        if num_replicas is None:
+            num_replicas = tpu_hosts_for_role(role)
+        else:
+            # elastic resize: rebuild the role at the new world size so
+            # EVERY derived env agrees (TPX_NUM_REPLICAS, megascale slice
+            # count, slice decomposition) — not just a patched world size.
+            # For TPU roles num_replicas is in host units and the caller
+            # guarantees it is a whole-slice multiple.
+            hosts = (
+                role.resource.tpu.hosts
+                if role.resource is not None and role.resource.tpu is not None
+                else 1
+            )
+            import dataclasses as _dc
+
+            role = _dc.replace(role, num_replicas=num_replicas // hosts)
+        for replica_id in range(num_replicas):
+            values = macros.Values(
+                img_root=img_root,
+                app_id=app_id,
+                replica_id=str(replica_id),
+                num_replicas=str(num_replicas),
+                coordinator_env=settings.ENV_TPX_COORDINATOR_HOST,
+            )
+            rrole = values.apply(role)
+            replica_log_dir = os.path.join(log_dir, role.name, str(replica_id))
+
+            env = dict(os.environ)
+            env.update(rrole.env)
+            env["PYTHONUNBUFFERED"] = "1"
+            env[settings.ENV_TPX_APP_ID] = app_id
+            env[settings.ENV_TPX_JOB_ID] = f"{self.backend}://{self.session_name}/{app_id}"
+            env[settings.ENV_TPX_LOG_DIR] = replica_log_dir
+            error_file = os.path.join(replica_log_dir, "error.json")
+            env[settings.ENV_TPX_ERROR_FILE] = error_file
+            env.update(
+                role_replica_env(
+                    role,
+                    replica_id,
+                    coordinator_host="localhost",
+                    coordinator_port=settings.TPX_COORDINATOR_PORT,
+                )
+            )
+            if role.resource is not None and role.resource.tpu is not None:
+                env.update(
+                    tpu_device_env(
+                        role.resource.tpu.chips_per_host,
+                        replica_id,
+                        replicas_on_host=num_replicas,
+                        host_chips=host_chips,
+                        simulate=bool(cfg.get("tpu_simulate", True)),
+                        partition=bool(cfg.get("auto_set_tpu_chips", True)),
+                    )
+                )
+            paths = [p for p in self._extra_paths]
+            if cfg.get("prepend_cwd"):
+                paths.insert(0, os.getcwd())
+            if img_root:
+                paths.append(img_root)
+            if paths:
+                env["PATH"] = os.pathsep.join(paths + [env.get("PATH", "")])
+
+            entrypoint = self._image_provider.get_entrypoint(
+                img_root, rrole.entrypoint
+            )
+            replicas.append(
+                ReplicaParam(
+                    args=[entrypoint, *rrole.args],
+                    env=env,
+                    stdout=os.path.join(replica_log_dir, "stdout.log"),
+                    stderr=os.path.join(replica_log_dir, "stderr.log"),
+                    combined=os.path.join(replica_log_dir, "combined.log"),
+                    cwd=img_root or None,
+                )
+            )
+        return replicas
 
     # -- schedule ---------------------------------------------------------
 
@@ -525,7 +570,7 @@ class LocalScheduler(Scheduler[PopenRequest]):
         request = dryrun_info.request
         self._evict_lru()
         self._install_signal_cleanup()
-        app = _LocalApp(request.app_id, request.log_dir)
+        app = _LocalApp(request.app_id, request.log_dir, request=request)
         try:
             for role_name, replicas in request.role_params.items():
                 for replica_id, rp in enumerate(replicas):
@@ -646,7 +691,7 @@ class LocalScheduler(Scheduler[PopenRequest]):
         return DescribeAppResponse(
             app_id=app_id,
             state=app.state,
-            num_restarts=0,
+            num_restarts=app.num_restarts,
             structured_error_msg=structured_error_msg,
             ui_url=f"file://{app.log_dir}",
             roles_statuses=roles_statuses,
@@ -722,12 +767,17 @@ class LocalScheduler(Scheduler[PopenRequest]):
             # host wedges the collective anyway). If an external `tpx
             # cancel` already marked the app CANCELLED on disk, honor that
             # instead of recording the SIGTERM'd children as a failure.
-            for r in app.replicas():
-                if r.is_alive():
-                    r.terminate()
             if _state_file_says_cancelled(app.log_dir):
+                for r in app.replicas():
+                    if r.is_alive():
+                        r.terminate()
                 app.set_state(AppState.CANCELLED)
+            elif self._try_elastic_restart(app):
+                return
             else:
+                for r in app.replicas():
+                    if r.is_alive():
+                        r.terminate()
                 app.set_state(AppState.FAILED)
         elif not any_alive:
             if _state_file_says_cancelled(app.log_dir):
@@ -738,6 +788,84 @@ class LocalScheduler(Scheduler[PopenRequest]):
             else:
                 app.set_state(AppState.SUCCEEDED)
                 Path(app.log_dir, "SUCCESS").touch()
+
+    def _try_elastic_restart(self, app: _LocalApp) -> bool:
+        """Shrink-and-restart a failed elastic gang (BASELINE config 4).
+
+        SPMD worlds resize by restart: when a replica of a role with
+        ``min_replicas`` dies, the surviving budget (``max_retries``)
+        relaunches the WHOLE gang with a smaller world — every replica gets
+        fresh TPX_REPLICA_ID / TPX_NUM_REPLICAS so ``spmd_main`` re-forms
+        ``jax.distributed`` over the resized mesh and user code resumes from
+        its last checkpoint. The analog of torchrun's ``--nnodes min:max``
+        elastic rendezvous (reference components/dist.py:294-296), mapped to
+        the TPU model where world size is fixed per jax.distributed world.
+        """
+        request = app.request
+        if request is None or request.app is None:
+            return False
+        budget = max((r.max_retries for r in request.app.roles), default=0)
+        if app.num_restarts >= budget:
+            return False
+        # compute the shrunken per-role gang sizes
+        new_sizes: dict[str, int] = {}
+        for role in request.app.roles:
+            replicas = app.roles.get(role.name, [])
+            n_failed = sum(1 for r in replicas if r.failed())
+            cur = len(replicas)
+            if n_failed == 0:
+                new_sizes[role.name] = cur  # healthy role: relaunch as-is
+                continue
+            if role.min_replicas is None:
+                return False  # rigid gang: a death is fatal
+            hosts = (
+                role.resource.tpu.hosts
+                if role.resource is not None and role.resource.tpu is not None
+                else 1
+            )
+            # TPU gangs shrink in whole slices: a partial slice can never
+            # form a valid ICI topology
+            new_n = ((cur - n_failed) // hosts) * hosts
+            if new_n < max(1, role.min_replicas * hosts):
+                return False  # below the elastic floor
+            new_sizes[role.name] = new_n
+        attempt = app.num_restarts + 1
+        logger.warning(
+            "elastic restart #%d of %s: resizing %s",
+            attempt,
+            app.app_id,
+            {
+                r: f"{len(app.roles.get(r, []))} -> {n}"
+                for r, n in new_sizes.items()
+            },
+        )
+        for r in app.replicas():
+            if r.is_alive():
+                r.terminate()
+            else:
+                r._close_files()
+        app.roles = {}
+        app.num_restarts = attempt
+        try:
+            for role in request.app.roles:
+                params = self._build_role_replicas(
+                    role,
+                    app.app_id,
+                    app.log_dir,
+                    request.cfg,
+                    num_replicas=new_sizes[role.name],
+                )
+                for replica_id, rp in enumerate(params):
+                    _rotate_attempt_logs(rp, attempt)
+                    app.add_replica(
+                        role.name, self._popen(role.name, replica_id, rp)
+                    )
+        except Exception:
+            app.kill()
+            app.set_state(AppState.FAILED)
+            return True  # state handled (failed during relaunch)
+        app.set_state(AppState.RUNNING)
+        return True
 
     def list(self) -> list[ListAppResponse]:
         out = []
@@ -836,6 +964,19 @@ class LocalScheduler(Scheduler[PopenRequest]):
             self.close()
         except Exception:
             pass
+
+
+def _rotate_attempt_logs(rp: ReplicaParam, attempt: int) -> None:
+    """Move the previous attempt's log files aside (``stdout.log`` ->
+    ``stdout.log.<attempt-1>``) so log paths stay stable for ``log_iter``
+    while history is preserved."""
+    error_file = os.path.join(os.path.dirname(rp.stdout), "error.json")
+    for path in (rp.stdout, rp.stderr, rp.combined, error_file):
+        if os.path.exists(path):
+            try:
+                os.replace(path, f"{path}.{attempt - 1}")
+            except OSError:
+                pass
 
 
 class LogIterator:
