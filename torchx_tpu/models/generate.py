@@ -143,6 +143,9 @@ def generate(
         params, prompt, cache, jnp.int32(0), cfg
     )
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    # consume a fresh subkey for token 0 and carry the unconsumed key into
+    # the scan, so step 0's draw is independent of step 1's
+    rng, first_key = jax.random.split(rng)
 
     def sample(logits_t, key):  # noqa: ANN001
         if temperature <= 0:
@@ -151,7 +154,7 @@ def generate(
             jnp.int32
         )
 
-    next_tok = sample(logits[:, -1], rng)
+    next_tok = sample(logits[:, -1], first_key)
     out = jnp.zeros((b, max_new_tokens), dtype=jnp.int32)
     out = out.at[:, 0].set(next_tok)
 

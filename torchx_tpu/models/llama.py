@@ -302,8 +302,13 @@ def forward_features(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """-> (final-norm hidden states [b, s, dim], MoE aux loss total).
 
-    aux is 0 for dense models and under pipeline parallelism (the pipeline
-    body contract carries activations only)."""
+    aux is 0 for dense models; under pipeline parallelism the per-layer aux
+    is threaded through the pipeline (summed over stages, averaged over
+    microbatches). The MoE balancing term is nonlinear in token statistics,
+    so the microbatch-averaged value differs slightly from the full-batch
+    pp=1 value when routing varies across microbatches — the standard
+    group-wise aux (GShard computes it per dispatch group the same way);
+    router balancing pressure is preserved, exact loss parity is not."""
     s = tokens.shape[1]
     x = params["embed"][tokens].astype(cfg.dtype)  # [b, s, d]
     x = _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
@@ -331,12 +336,13 @@ def forward_features(
         # through untouched — pipeline_apply raises a clear error on a
         # non-divisor rather than silently degrading the pipeline
         n_micro = cfg.pp_microbatches or _math.gcd(2 * pp, x.shape[0])
-        x = pipeline_apply(
-            lambda h, layer: body(h, layer)[0],  # aux dropped under pp
+        x, aux_total = pipeline_apply(
+            body,
             params["layers"],
             x,
             mesh,
             n_microbatches=n_micro,
+            with_aux=True,
         )
     else:
         def scan_step(x, layer_slice):  # noqa: ANN001

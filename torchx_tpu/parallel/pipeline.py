@@ -45,19 +45,35 @@ def make_pp_mesh(n_stages: int, devices=None) -> Mesh:  # noqa: ANN001
     return Mesh(np.array(devs[:n_stages]), ("pp",))
 
 
-def _stage_apply(body: LayerBody, local_layers: Any, x: jnp.ndarray) -> jnp.ndarray:
-    """Run this stage's local slice of layers (scan over the local stack)."""
+def _stage_apply(
+    body: LayerBody, local_layers: Any, x: jnp.ndarray, with_aux: bool = False
+):
+    """Run this stage's local slice of layers (scan over the local stack).
 
-    def step(h, layer_slice):  # noqa: ANN001
-        return body(h, layer_slice), None
+    With ``with_aux`` the body returns ``(x, aux_scalar)`` and the per-layer
+    aux values are summed over the stage's local stack.
+    """
+    if not with_aux:
 
-    out, _ = jax.lax.scan(step, x, local_layers)
-    return out
+        def step(h, layer_slice):  # noqa: ANN001
+            return body(h, layer_slice), None
+
+        out, _ = jax.lax.scan(step, x, local_layers)
+        return out
+
+    def step_aux(carry, layer_slice):  # noqa: ANN001
+        h, acc = carry
+        h, aux = body(h, layer_slice)
+        return (h, acc + jnp.float32(aux)), None
+
+    (out, aux_sum), _ = jax.lax.scan(step_aux, (x, jnp.float32(0)), local_layers)
+    return out, aux_sum
 
 
 def _pipeline_shard(
     body: LayerBody,
     n_micro: int,
+    with_aux: bool,
     local_layers: Any,  # leaves [L/S, ...] — this stage's layers
     x: jnp.ndarray,  # [n_micro, mb, ...] microbatched input (replicated)
 ):
@@ -69,7 +85,7 @@ def _pipeline_shard(
     fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
     def step(carry, t):  # noqa: ANN001
-        prev_out, outputs = carry
+        prev_out, outputs, aux_acc = carry
         # stage 0 feeds microbatch t (clamped; garbage beyond M is masked by
         # the output indexing), later stages receive the previous stage's
         # output shifted forward one hop
@@ -78,23 +94,37 @@ def _pipeline_shard(
         )
         incoming = jax.lax.ppermute(prev_out, "pp", fwd_perm)
         my_in = jnp.where(stage == 0, x_t, incoming)
-        my_out = _stage_apply(body, local_layers, my_in)
+        if with_aux:
+            my_out, aux_t = _stage_apply(body, local_layers, my_in, with_aux=True)
+            # this stage holds real data only for steps in [stage,
+            # stage + n_micro); aux from warmup/drain garbage is masked out
+            valid = (t >= stage) & (t - stage < n_micro)
+            aux_acc = aux_acc + jnp.where(valid, aux_t, 0.0)
+        else:
+            my_out = _stage_apply(body, local_layers, my_in)
         # the last stage finished microbatch (t - (S-1)) at step t; before
         # then, keep the existing (zero) slot so warmup garbage is masked
         out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
         current = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
         slot = jnp.where(t >= n_stages - 1, my_out, current)
         updated = jax.lax.dynamic_update_index_in_dim(outputs, slot, out_idx, axis=0)
-        return (my_out, updated), None
+        return (my_out, updated, aux_acc), None
 
     outputs0 = jnp.zeros((n_micro, *mb_shape), dtype=x.dtype)
     prev0 = jnp.zeros(mb_shape, dtype=x.dtype)
-    (_, outputs), _ = jax.lax.scan(
-        step, (prev0, outputs0), jnp.arange(total_steps)
+    (_, outputs, aux_acc), _ = jax.lax.scan(
+        step, (prev0, outputs0, jnp.float32(0)), jnp.arange(total_steps)
     )
     # only the last stage holds real outputs; broadcast them to all stages
     outputs = jnp.where(stage == n_stages - 1, outputs, 0)
-    return jax.lax.psum(outputs, "pp")
+    outputs = jax.lax.psum(outputs, "pp")
+    if with_aux:
+        # sum per-layer aux across stages; each microbatch's aux is a mean
+        # over its own tokens, so average over microbatches to match the
+        # non-pp semantics (per-layer aux = mean over the full batch)
+        aux_total = jax.lax.psum(aux_acc, "pp") / n_micro
+        return outputs, aux_total
+    return outputs
 
 
 def pipeline_apply(
@@ -103,8 +133,17 @@ def pipeline_apply(
     x: jnp.ndarray,  # [batch, ...]
     mesh: Mesh,
     n_microbatches: int,
-) -> jnp.ndarray:
-    """Apply L stacked layers to x, pipelined over the mesh's "pp" axis."""
+    with_aux: bool = False,
+):
+    """Apply L stacked layers to x, pipelined over the mesh's "pp" axis.
+
+    With ``with_aux`` the body returns ``(x, aux_scalar)`` per layer (e.g.
+    the MoE load-balancing loss) and the call returns ``(out, aux_total)``
+    where aux_total sums layers and averages microbatches. For aux linear
+    in the microbatch mean this equals the non-pipelined scan exactly; for
+    nonlinear aux (MoE balancing) it is the group-wise variant computed per
+    microbatch — equivalent balancing pressure, not bitwise loss parity.
+    """
     n_stages = mesh.shape["pp"]
     leaves = jax.tree.leaves(stacked_params)
     n_layers = leaves[0].shape[0]
@@ -123,12 +162,15 @@ def pipeline_apply(
     # axes (dp/fsdp/tp) remain automatic so GSPMD keeps sharding the math
     # inside each stage
     fn = jax.shard_map(
-        functools.partial(_pipeline_shard, body, n_microbatches),
+        functools.partial(_pipeline_shard, body, n_microbatches, with_aux),
         mesh=mesh,
         in_specs=(layer_specs, P()),  # layers sharded by stage; x replicated
-        out_specs=P(),
+        out_specs=(P(), P()) if with_aux else P(),
         axis_names=frozenset({"pp"}),
         check_vma=False,
     )
+    if with_aux:
+        out, aux_total = fn(stacked_params, x_micro)
+        return out.reshape(batch, *out.shape[2:]), aux_total
     out = fn(stacked_params, x_micro)
     return out.reshape(batch, *out.shape[2:])

@@ -40,6 +40,10 @@ class Tee:
     def close(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            # pump still draining: leave the files to it (daemon thread dies
+            # with the process) rather than closing them out from under it
+            return
         for src in self._sources:
             src.close()
         self._combined.close()
