@@ -1,6 +1,7 @@
 """Overlays, StructuredOpts, and workspace-layer tests."""
 
 import io
+import os
 import tarfile
 from dataclasses import dataclass, field
 from typing import Optional
@@ -164,3 +165,116 @@ class TestWorkspaceWalk:
         with tarfile.open(fileobj=buf) as tar:
             df = tar.extractfile("Dockerfile").read().decode()
             assert df == "FROM custom\n"
+
+
+class TestDockerBuildCache:
+    """Skip-if-unchanged: the second build of an identical workspace reuses
+    the labeled image with ZERO docker build calls (reference analog:
+    torchx/workspace/api.py:97-154)."""
+
+    def make_tree(self, tmp_path):
+        (tmp_path / "src").mkdir()
+        (tmp_path / "src" / "main.py").write_text("print('v1')")
+        return tmp_path
+
+    def make_mixin(self):
+        from unittest import mock
+
+        from torchx_tpu.workspace.docker_workspace import (
+            DockerWorkspaceMixin,
+            LABEL_CONTENT_HASH,
+        )
+
+        client = mock.MagicMock()
+        built = mock.MagicMock()
+        built.id = "sha256:" + "a" * 64
+        client.images.build.return_value = (built, iter(()))
+        # image store: return cached images only for digests seen by build
+        store: dict[str, object] = {}
+
+        def record_build(**kwargs):
+            digest = kwargs["labels"][LABEL_CONTENT_HASH]
+            store[digest] = built
+            return (built, iter(()))
+
+        def list_images(filters):
+            label = filters["label"]
+            digest = label.split("=", 1)[1]
+            return [store[digest]] if digest in store else []
+
+        client.images.build.side_effect = record_build
+        client.images.list.side_effect = list_images
+
+        class WS(DockerWorkspaceMixin):
+            pass
+
+        return WS(docker_client=client), client
+
+    def test_digest_stable_and_content_sensitive(self, tmp_path):
+        from torchx_tpu.workspace.docker_workspace import workspace_digest
+
+        root = self.make_tree(tmp_path)
+        ws = Workspace(projects={str(root): ""})
+        d1 = workspace_digest("base:1", ws)
+        assert d1 == workspace_digest("base:1", ws)  # deterministic
+        assert d1 != workspace_digest("base:2", ws)  # base image matters
+        (root / "src" / "main.py").write_text("print('v2')")
+        assert d1 != workspace_digest("base:1", ws)  # content matters
+
+    def test_second_build_skipped_when_unchanged(self, tmp_path):
+        from torchx_tpu.specs.api import Resource, Role
+
+        root = self.make_tree(tmp_path)
+        ws = Workspace(projects={str(root): ""})
+        mixin, client = self.make_mixin()
+
+        def fresh_role():
+            return Role(
+                name="r", image="base:1", entrypoint="python",
+                resource=Resource(cpu=1, memMB=1024),
+            )
+
+        r1 = fresh_role()
+        mixin.build_workspace_and_update_role(r1, ws, {})
+        assert client.images.build.call_count == 1
+        assert r1.image.startswith("sha256:")
+
+        r2 = fresh_role()
+        mixin.build_workspace_and_update_role(r2, ws, {})
+        assert client.images.build.call_count == 1  # no second build
+        assert r2.image == r1.image
+
+        # an edit invalidates the cache and rebuilds
+        (root / "src" / "main.py").write_text("print('v2')")
+        r3 = fresh_role()
+        mixin.build_workspace_and_update_role(r3, ws, {})
+        assert client.images.build.call_count == 2
+
+    def test_digest_tolerates_symlinks_and_fifos(self, tmp_path):
+        """Dangling symlinks and FIFOs must neither crash nor hang the
+        digest (they are archived as entries, never opened)."""
+        from torchx_tpu.workspace.docker_workspace import workspace_digest
+
+        root = self.make_tree(tmp_path)
+        os.symlink("/nonexistent/target", root / "dangling")
+        os.mkfifo(root / "pipe")
+        ws = Workspace(projects={str(root): ""})
+        d1 = workspace_digest("base:1", ws)
+        # the symlink target participates in the digest
+        os.remove(root / "dangling")
+        os.symlink("/other/target", root / "dangling")
+        assert workspace_digest("base:1", ws) != d1
+
+    def test_cache_probe_failure_falls_back_to_build(self, tmp_path):
+        from torchx_tpu.specs.api import Resource, Role
+
+        root = self.make_tree(tmp_path)
+        ws = Workspace(projects={str(root): ""})
+        mixin, client = self.make_mixin()
+        client.images.list.side_effect = RuntimeError("daemon unreachable")
+        role = Role(
+            name="r", image="base:1", entrypoint="python",
+            resource=Resource(cpu=1, memMB=1024),
+        )
+        mixin.build_workspace_and_update_role(role, ws, {})
+        assert client.images.build.call_count == 1
