@@ -28,13 +28,15 @@ def make_scheduler(name: str) -> Scheduler:
         kwargs["client"] = object()  # never used at dryrun level
     if name == "local_docker":
         kwargs["docker_client"] = mock.MagicMock()
+    if name == "vertex":
+        kwargs["client"] = mock.MagicMock()
     return factory(session_name="conformance", **kwargs)
 
 
 def sample_app(name: str) -> AppDef:
     role = Role(
         name="trainer",
-        image="img:1" if name in ("gke", "local_docker") else "",
+        image="img:1" if name in ("gke", "local_docker", "vertex") else "",
         entrypoint="python",
         args=["-m", "train"],
         resource=Resource(cpu=2, memMB=1024, tpu=TpuSlice("v5e", 8)),
@@ -48,6 +50,7 @@ MINIMAL_CFG = {
     "gke": {},
     "slurm": {},
     "tpu_vm": {"zone": "us-east5-a"},
+    "vertex": {"project": "test-proj"},
 }
 
 ALL = sorted(DEFAULT_SCHEDULER_MODULES)

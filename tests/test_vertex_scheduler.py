@@ -1,0 +1,170 @@
+"""Vertex AI scheduler tests: assert on the materialized CustomJob dict
+(reference analog: aws_sagemaker_scheduler_test.py — dryrun request checks
+with no cloud project)."""
+
+from unittest import mock
+
+import pytest
+
+from torchx_tpu.schedulers.vertex_scheduler import (
+    VertexScheduler,
+    app_to_custom_job,
+    cpu_machine_spec,
+    describe_custom_job,
+    tpu_machine_spec,
+)
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppState,
+    Resource,
+    Role,
+    TpuSlice,
+    macros,
+)
+
+
+def tpu_role(chips=16, accelerator="v5p", **kwargs) -> Role:
+    defaults = dict(
+        name="trainer",
+        image="gcr.io/proj/img:1",
+        entrypoint="python",
+        args=["-m", "train", f"--app={macros.app_id}"],
+        resource=Resource(cpu=208, memMB=448 * 1024, tpu=TpuSlice(accelerator, chips)),
+    )
+    defaults.update(kwargs)
+    return Role(**defaults)
+
+
+@pytest.fixture
+def sched():
+    return VertexScheduler("test", client=mock.MagicMock())
+
+
+class TestCustomJobMaterialization:
+    def test_tpu_machine_spec_multihost(self):
+        spec = tpu_machine_spec(tpu_role())  # v5p-32: 16 chips, 4 hosts
+        assert spec["machineType"] == "ct5p-hightpu-4t"
+        assert spec["tpuTopology"] == "2x2x4"
+
+    def test_tpu_machine_spec_single_host(self):
+        spec = tpu_machine_spec(tpu_role(chips=8, accelerator="v5e"))
+        assert spec["machineType"] == "ct5lp-hightpu-8t"
+        assert "tpuTopology" not in spec  # single host: no topology field
+
+    def test_unknown_generation_raises(self):
+        with pytest.raises(ValueError, match="no Vertex AI machine type"):
+            tpu_machine_spec(tpu_role(accelerator="v2", chips=8))
+
+    def test_cpu_machine_spec_covers_ask(self):
+        role = Role(
+            name="r", image="i", entrypoint="python",
+            resource=Resource(cpu=6, memMB=40 * 1024),
+        )
+        assert cpu_machine_spec(role) == {"machineType": "n2-standard-16"}
+
+    def test_worker_pools_and_env(self):
+        app = AppDef(name="train", roles=[tpu_role()])
+        job = app_to_custom_job(app, "train-abc12", "sess")
+        assert job["displayName"] == "train-abc12"
+        (pool,) = job["jobSpec"]["workerPoolSpecs"]
+        assert pool["replicaCount"] == 1  # one slice = one logical replica
+        cs = pool["containerSpec"]
+        assert cs["imageUri"] == "gcr.io/proj/img:1"
+        assert "--app=train-abc12" in cs["args"]  # macro substituted
+        env = {e["name"]: e["value"] for e in cs["env"]}
+        assert env["TPX_APP_ID"] == "train-abc12"
+        assert env["TPX_NUM_REPLICAS"] == "4"  # per-host procs in the slice
+        assert job["labels"]["tpx-session"] == "sess"
+
+    def test_retries_enable_restart_scheduling(self):
+        app = AppDef(name="t", roles=[tpu_role(max_retries=2)])
+        job = app_to_custom_job(app, "t-x", "s")
+        assert job["jobSpec"]["scheduling"] == {"restartJobOnWorkerRestart": True}
+
+    def test_replica_retry_policy_never_restarts_the_job(self):
+        from torchx_tpu.specs.api import RetryPolicy
+
+        app = AppDef(
+            name="t",
+            roles=[tpu_role(max_retries=2, retry_policy=RetryPolicy.REPLICA)],
+        )
+        job = app_to_custom_job(app, "t-x", "s")
+        assert "scheduling" not in job["jobSpec"]
+
+    def test_multislice_rejected_on_submit_path(self, sched):
+        # Scheduler.submit()/submit_dryrun() must hit the validation too,
+        # not just the Runner path
+        app = AppDef(name="t", roles=[tpu_role(num_replicas=2)])
+        with pytest.raises(ValueError, match="multi-slice"):
+            sched.submit_dryrun(app, {"project": "p"})
+
+    def test_optional_infra_fields(self):
+        app = AppDef(name="t", roles=[tpu_role()])
+        job = app_to_custom_job(
+            app, "t-x", "s",
+            service_account="sa@proj.iam.gserviceaccount.com",
+            network="projects/1/global/networks/vpc",
+            staging_bucket="gs://bucket/out",
+        )
+        js = job["jobSpec"]
+        assert js["serviceAccount"].startswith("sa@")
+        assert js["network"].endswith("/vpc")
+        assert js["baseOutputDirectory"] == {"outputUriPrefix": "gs://bucket/out"}
+
+    def test_dryrun_materializes_full_request(self, sched):
+        app = AppDef(name="t", roles=[tpu_role()])
+        info = sched.submit_dryrun(app, {"project": "my-proj", "region": "us-east5"})
+        req = info.request
+        assert req.parent == "projects/my-proj/locations/us-east5"
+        assert req.custom_job["jobSpec"]["workerPoolSpecs"]
+
+    def test_multislice_rejected(self, sched):
+        app = AppDef(name="t", roles=[tpu_role(num_replicas=2)])
+        with pytest.raises(ValueError, match="multi-slice"):
+            sched._validate(app, {})
+
+
+class TestVertexLifecycle:
+    def make_sched(self, tmp_path, monkeypatch, state="JOB_STATE_RUNNING"):
+        monkeypatch.setattr(
+            "torchx_tpu.schedulers.vertex_scheduler._registry_path",
+            lambda: str(tmp_path / "jobs"),
+        )
+        client = mock.MagicMock()
+        created = mock.MagicMock()
+        created.name = "projects/p/locations/r/customJobs/123"
+        client.create_custom_job.return_value = created
+        got = mock.MagicMock()
+        got.state.name = state
+        got.error = None
+        client.get_custom_job.return_value = got
+        return VertexScheduler("test", client=client), client
+
+    def test_schedule_describe_cancel(self, tmp_path, monkeypatch):
+        sched, client = self.make_sched(tmp_path, monkeypatch)
+        app = AppDef(name="t", roles=[tpu_role()])
+        app_id = sched.submit(app, {"project": "p", "region": "r"})
+        assert app_id.startswith("t-")
+        kwargs = client.create_custom_job.call_args.kwargs
+        assert kwargs["parent"] == "projects/p/locations/r"
+        resp = sched.describe(app_id)
+        assert resp.state == AppState.RUNNING
+        sched.cancel(app_id)
+        client.cancel_custom_job.assert_called_once_with(
+            name="projects/p/locations/r/customJobs/123"
+        )
+
+    def test_describe_unknown_app(self, tmp_path, monkeypatch):
+        sched, _ = self.make_sched(tmp_path, monkeypatch)
+        assert sched.describe("nope") is None
+
+    def test_state_map_and_error_surface(self):
+        resp = describe_custom_job(
+            "a",
+            {"state": "JOB_STATE_FAILED", "error": {"message": "OOM on host 2"}},
+        )
+        assert resp.state == AppState.FAILED
+        assert "OOM" in resp.structured_error_msg
+        assert describe_custom_job("a", {"state": "JOB_STATE_WEIRD"}).state == (
+            AppState.UNKNOWN
+        )

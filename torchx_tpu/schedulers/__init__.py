@@ -20,6 +20,7 @@ DEFAULT_SCHEDULER_MODULES: dict[str, str] = {
     "slurm": "torchx_tpu.schedulers.slurm_scheduler:create_scheduler",
     "local_docker": "torchx_tpu.schedulers.docker_scheduler:create_scheduler",
     "tpu_vm": "torchx_tpu.schedulers.tpu_vm_scheduler:create_scheduler",
+    "vertex": "torchx_tpu.schedulers.vertex_scheduler:create_scheduler",
 }
 
 
