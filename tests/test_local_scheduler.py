@@ -120,7 +120,10 @@ class TestElasticRestart:
         assert wait_terminal(sched, app_id, timeout=30) == AppState.FAILED
         assert sched.describe(app_id).num_restarts == 0
 
-    def test_rigid_gang_fails_without_min_replicas(self, sched, tmp_path):
+    def test_rigid_gang_restarts_full_size(self, sched, tmp_path):
+        """No min_replicas, but max_retries with the default APPLICATION
+        retry policy: the gang restarts at FULL size (the local analog of
+        JobSet maxRestarts / slurm requeue)."""
         ckpt = tmp_path / "ckpt"
         ckpt.mkdir()
         app = AppDef(
@@ -130,7 +133,50 @@ class TestElasticRestart:
                     "w",
                     self.elastic_script(str(ckpt)),
                     num_replicas=3,
-                    max_retries=2,  # retries budget alone is not elastic
+                    max_retries=2,
+                )
+            ],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        assert wait_terminal(sched, app_id, timeout=30) == AppState.SUCCEEDED
+        desc = sched.describe(app_id)
+        assert desc.num_restarts == 1
+        (rs,) = desc.roles_statuses
+        assert len(rs.replicas) == 3  # full size, not shrunk
+        out0 = (tmp_path / app_id / "w" / "0" / "stdout.log").read_text()
+        assert "world=3 start=5" in out0  # resumed from checkpoint
+
+    def test_rigid_gang_fatal_without_retries(self, sched, tmp_path):
+        ckpt = tmp_path / "ckpt"
+        ckpt.mkdir()
+        app = AppDef(
+            name="rigid0",
+            roles=[
+                sh_role(
+                    "w",
+                    self.elastic_script(str(ckpt)),
+                    num_replicas=3,  # max_retries defaults to 0
+                )
+            ],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        assert wait_terminal(sched, app_id, timeout=30) == AppState.FAILED
+        assert sched.describe(app_id).num_restarts == 0
+
+    def test_replica_retry_policy_is_fatal_for_gang(self, sched, tmp_path):
+        from torchx_tpu.specs.api import RetryPolicy
+
+        ckpt = tmp_path / "ckpt"
+        ckpt.mkdir()
+        app = AppDef(
+            name="rep",
+            roles=[
+                sh_role(
+                    "w",
+                    self.elastic_script(str(ckpt)),
+                    num_replicas=3,
+                    max_retries=2,
+                    retry_policy=RetryPolicy.REPLICA,
                 )
             ],
         )
@@ -166,6 +212,52 @@ class TestElasticRestart:
         assert len(rs.replicas) == 2  # one whole slice, not 3 hosts
         out0 = (tmp_path / app_id / "w" / "0" / "stdout.log").read_text()
         assert "world=2 slices=none slice=none" in out0
+
+    def test_role_scoped_restart_keeps_healthy_roles_running(self, sched, tmp_path):
+        """RetryPolicy.ROLE: only the failed role relaunches; the healthy
+        role's processes are left untouched (same pid across the restart)."""
+        from torchx_tpu.specs.api import RetryPolicy
+
+        flaky = (
+            f"if [ ! -f {tmp_path}/fired ]; then touch {tmp_path}/fired;"
+            ' exit 1; fi; echo "recovered"; exit 0'
+        )
+        steady = f'echo "pid=$$" >> {tmp_path}/steady.pids; sleep 3; exit 0'
+        app = AppDef(
+            name="rolescope",
+            roles=[
+                sh_role(
+                    "flaky", flaky, num_replicas=1, max_retries=1,
+                    retry_policy=RetryPolicy.ROLE,
+                ),
+                sh_role("steady", steady, num_replicas=1),
+            ],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        assert wait_terminal(sched, app_id, timeout=30) == AppState.SUCCEEDED
+        assert sched.describe(app_id).num_restarts == 1
+        # the steady role ran exactly once — it was never killed/relaunched
+        pids = (tmp_path / "steady.pids").read_text().strip().splitlines()
+        assert len(pids) == 1
+        # only the flaky role's logs were rotated
+        assert (tmp_path / app_id / "flaky" / "0" / "stdout.log.0").exists()
+        assert not (tmp_path / app_id / "steady" / "0" / "stdout.log.0").exists()
+
+    def test_per_role_budget_not_pooled(self, sched, tmp_path):
+        """A role's own max_retries bounds ITS restarts even when another
+        role in the app carries a bigger budget."""
+        always_fails = 'exit 1'
+        app = AppDef(
+            name="pooled",
+            roles=[
+                sh_role("a", always_fails, num_replicas=1, max_retries=1),
+                sh_role("b", "sleep 5", num_replicas=1, max_retries=3),
+            ],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        assert wait_terminal(sched, app_id, timeout=30) == AppState.FAILED
+        # role a restarted once (its budget), NOT three times (b's budget)
+        assert sched.describe(app_id).num_restarts == 1
 
     def test_restart_budget_exhausted(self, sched, tmp_path):
         # every attempt fails (replica 0 always dies) -> FAILED after

@@ -36,9 +36,30 @@ def compute_mesh_size() -> int:
     return mesh_size
 
 
+def maybe_inject_fault() -> None:
+    """Fault-injection hook (reference analog: compute_world_size
+    main.py:38-40): ``TPX_EXAMPLE_THROWS=1`` always throws;
+    ``TPX_EXAMPLE_THROWS=once:/path/marker`` throws only on the first
+    attempt (creates the marker), which lets retry/elastic-restart e2e
+    tests prove a gang recovers. ``TPX_EXAMPLE_THROWS_REPLICA=N`` scopes
+    the fault to one replica of the gang."""
+    spec = os.environ.get("TPX_EXAMPLE_THROWS")
+    if not spec:
+        return
+    want = os.environ.get("TPX_EXAMPLE_THROWS_REPLICA")
+    if want is not None and os.environ.get("TPX_REPLICA_ID", "0") != want:
+        return
+    if spec.startswith("once:"):
+        marker = spec[len("once:"):]
+        if os.path.exists(marker):
+            return
+        with open(marker, "w"):
+            pass
+    raise RuntimeError(f"injected failure (TPX_EXAMPLE_THROWS={spec})")
+
+
 def main() -> None:
-    if os.environ.get("TPX_EXAMPLE_THROWS"):  # fault-injection hook for tests
-        raise RuntimeError("injected failure (TPX_EXAMPLE_THROWS)")
+    maybe_inject_fault()
     size = compute_mesh_size()
     print(f"mesh size: {size}", flush=True)
 

@@ -51,6 +51,7 @@ from torchx_tpu.specs.api import (
     CfgVal,
     NONE,
     ReplicaStatus,
+    RetryPolicy,
     Role,
     RoleStatus,
     is_terminal,
@@ -804,20 +805,35 @@ class LocalScheduler(Scheduler[PopenRequest]):
         request = app.request
         if request is None or request.app is None:
             return False
-        budget = max((r.max_retries for r in request.app.roles), default=0)
-        if app.num_restarts >= budget:
-            return False
-        # compute the shrunken per-role gang sizes
+        # plan per-role: every FAILED role must be restartable within ITS
+        # OWN budget, and decides its new size; healthy roles restart as-is
+        # only when some failed role is APPLICATION-scoped (ROLE-scoped
+        # failures leave healthy roles running untouched)
         new_sizes: dict[str, int] = {}
+        role_scoped_only = True
         for role in request.app.roles:
             replicas = app.roles.get(role.name, [])
             n_failed = sum(1 for r in replicas if r.failed())
             cur = len(replicas)
             if n_failed == 0:
-                new_sizes[role.name] = cur  # healthy role: relaunch as-is
-                continue
+                continue  # planned below once the restart scope is known
+            if app.num_restarts >= role.max_retries and role.min_replicas is None:
+                return False  # this role's own budget is spent
             if role.min_replicas is None:
-                return False  # rigid gang: a death is fatal
+                # rigid gang: APPLICATION restarts the whole app, ROLE
+                # restarts just this role, both at FULL size (the local
+                # analog of JobSet maxRestarts / slurm requeue);
+                # REPLICA-scoped retries are fatal for a gang
+                if role.retry_policy == RetryPolicy.REPLICA:
+                    return False
+                if role.retry_policy == RetryPolicy.APPLICATION:
+                    role_scoped_only = False
+                new_sizes[role.name] = cur
+                continue
+            # elastic: shrink, budgeted by max_retries as well
+            if app.num_restarts >= max(1, role.max_retries):
+                return False
+            role_scoped_only = False  # a resized world needs a full restart
             hosts = (
                 role.resource.tpu.hosts
                 if role.resource is not None and role.resource.tpu is not None
@@ -829,25 +845,36 @@ class LocalScheduler(Scheduler[PopenRequest]):
             if new_n < max(1, role.min_replicas * hosts):
                 return False  # below the elastic floor
             new_sizes[role.name] = new_n
+        if not new_sizes:
+            return False  # nothing actually failed
+        if not role_scoped_only:
+            # APPLICATION/elastic scope: healthy roles restart at full size
+            for role in request.app.roles:
+                if role.name not in new_sizes:
+                    new_sizes[role.name] = len(app.roles.get(role.name, []))
         attempt = app.num_restarts + 1
         logger.warning(
-            "elastic restart #%d of %s: resizing %s",
+            "gang restart #%d of %s (%s-scoped): %s",
             attempt,
             app.app_id,
+            "role" if role_scoped_only else "app",
             {
                 r: f"{len(app.roles.get(r, []))} -> {n}"
                 for r, n in new_sizes.items()
             },
         )
-        for r in app.replicas():
-            if r.is_alive():
-                r.terminate()
-            else:
-                r._close_files()
-        app.roles = {}
+        for role_name in new_sizes:
+            for r in app.roles.get(role_name, []):
+                if r.is_alive():
+                    r.terminate()
+                else:
+                    r._close_files()
+            app.roles.pop(role_name, None)
         app.num_restarts = attempt
         try:
             for role in request.app.roles:
+                if role.name not in new_sizes:
+                    continue  # ROLE-scoped restart: healthy role kept alive
                 params = self._build_role_replicas(
                     role,
                     app.app_id,
