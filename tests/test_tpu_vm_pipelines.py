@@ -130,25 +130,199 @@ class TestTpuVmScheduler:
 
 
 class TestTpuVmLogs:
-    def test_log_fetch_over_ssh(self, sched, monkeypatch):
+    def fake_ssh(self, sched, monkeypatch, file_contents, exitcode="0"):
+        """Fake the batched remote reader: serves per-file windows from
+        canned contents, honoring offsets, one 'ssh' per poll."""
+        calls = []
+
+        def fetch(app_id, worker, offsets):
+            calls.append((app_id, worker, dict(offsets)))
+            chunks = {
+                p: file_contents.get(p, "")[off - 1:]
+                for p, off in offsets.items()
+            }
+            return {p: c for p, c in chunks.items() if c}, exitcode
+
+        monkeypatch.setattr(sched, "_fetch_log_windows", fetch)
+        return calls
+
+    def test_parse_log_frames_roundtrip(self):
+        from torchx_tpu.schedulers.tpu_vm_scheduler import _parse_log_frames
+
+        payload = (
+            "Warning: Permanently added 'host' to known hosts.\n"  # ssh noise
+            "/tmp/tpx/stdout.log 21\n"
+            "1722000100.000 hello\n"
+            "/tmp/tpx/stderr.log 0\n"
+            "__exitcode__ 0\n"
+        )
+        chunks, ec = _parse_log_frames(
+            payload, ["/tmp/tpx/stdout.log", "/tmp/tpx/stderr.log"]
+        )
+        assert chunks == {"/tmp/tpx/stdout.log": "1722000100.000 hello\n"}
+        assert ec == "0"
+
+    def test_parse_log_frames_running_job(self):
+        from torchx_tpu.schedulers.tpu_vm_scheduler import _parse_log_frames
+
+        chunks, ec = _parse_log_frames(
+            "/tmp/tpx/stdout.log 2\nhi__exitcode__ \n", ["/tmp/tpx/stdout.log"]
+        )
+        assert chunks == {"/tmp/tpx/stdout.log": "hi"}
+        assert ec is None  # no exitcode file yet: job still running
+
+    def test_fetch_builds_one_ssh_command(self, sched, monkeypatch):
+        """The whole multi-file window fetch is ONE ssh invocation."""
         calls = []
 
         def run_cmd(cmd, **kw):
             calls.append(cmd)
-            return completed(stdout="line-a\nline-b\n")
+            return completed(stdout="__exitcode__ \n")
 
         monkeypatch.setattr(sched, "_run_cmd", run_cmd)
-        lines = list(sched.log_iter("us-east5-a:node1", "tpu", k=1))
-        assert lines == ["line-a", "line-b"]
+        chunks, ec = sched._fetch_log_windows(
+            "us-east5-a:n1", 1, {"/tmp/tpx/stdout.log": 1, "/tmp/tpx/stderr.log": 5}
+        )
         (cmd,) = calls
         assert "ssh" in cmd and "--worker=1" in cmd and "--zone=us-east5-a" in cmd
+        assert chunks == {} and ec is None
+
+    def test_stamp_parsing_is_strict(self):
+        from torchx_tpu.schedulers.tpu_vm_scheduler import _parse_stamp
+
+        assert _parse_stamp("1722333444.123 payload") == (1722333444.123, "payload")
+        # numeric-leading content lines are NOT stamps
+        assert _parse_stamp("3 retries left") == (None, "3 retries left")
+        assert _parse_stamp("42.5 degrees") == (None, "42.5 degrees")
+        assert _parse_stamp("plain line") == (None, "plain line")
+
+    def test_stream_selection_and_stamp_stripping(self, sched, monkeypatch):
+        from torchx_tpu.schedulers.tpu_vm_scheduler import REMOTE_STDOUT
+        from torchx_tpu.schedulers.api import Stream
+
+        calls = self.fake_ssh(
+            sched, monkeypatch,
+            {REMOTE_STDOUT: "1722000100.000 line-a\n1722000101.000 line-b\n"},
+        )
+        lines = list(
+            sched.log_iter("us-east5-a:node1", "tpu", k=1, streams=Stream.STDOUT)
+        )
+        assert lines == ["line-a", "line-b"]
+        ((app_id, worker, offsets),) = calls
+        assert app_id == "us-east5-a:node1" and worker == 1
+        assert list(offsets) == [REMOTE_STDOUT]
+
+    def test_combined_merges_streams_chronologically(self, sched, monkeypatch):
+        from torchx_tpu.schedulers.tpu_vm_scheduler import (
+            REMOTE_STDERR,
+            REMOTE_STDOUT,
+        )
+
+        self.fake_ssh(
+            sched, monkeypatch,
+            {
+                REMOTE_STDOUT: "1722000100.000 out-1\n1722000102.000 out-2\n",
+                REMOTE_STDERR: "1722000101.000 err-1\n",
+            },
+        )
+        lines = list(sched.log_iter("z:n", "tpu", 0))
+        assert lines == ["out-1", "err-1", "out-2"]
+
+    def test_since_until_window(self, sched, monkeypatch):
+        from torchx_tpu.schedulers.tpu_vm_scheduler import REMOTE_STDOUT
+        from torchx_tpu.schedulers.api import Stream
+
+        self.fake_ssh(
+            sched, monkeypatch,
+            {REMOTE_STDOUT: "1722000100.000 early\n1722000200.000 mid\n1722000300.000 late\n"},
+        )
+        lines = list(
+            sched.log_iter(
+                "z:n", "tpu", 0, since=1722000150.0, until=1722000250.0, streams=Stream.STDOUT
+            )
+        )
+        assert lines == ["mid"]
+
+    def test_legacy_unstamped_lines_pass_through(self, sched, monkeypatch):
+        from torchx_tpu.schedulers.tpu_vm_scheduler import REMOTE_LOG
+
+        self.fake_ssh(
+            sched, monkeypatch, {REMOTE_LOG: "raw-line-1\nraw-line-2\n"}
+        )
+        lines = list(sched.log_iter("z:n", "tpu", 0))
+        assert lines == ["raw-line-1", "raw-line-2"]
+
+    def test_tail_advances_offset_and_stops_on_exitcode(self, sched, monkeypatch):
+        """Tailing fetches only NEW bytes each poll and stops after a
+        final drain once the remote exitcode file appears — even though
+        the queued resource itself stays ACTIVE after the job exits."""
+        from torchx_tpu.schedulers.api import DescribeAppResponse, Stream
+        from torchx_tpu.schedulers.tpu_vm_scheduler import REMOTE_STDOUT
+        from torchx_tpu.specs.api import AppState
+
+        content = {REMOTE_STDOUT: "1722000100.000 first\n"}
+        state = {"polls": 0}
+        offsets_seen = []
+
+        def fetch(app_id, worker, offsets):
+            state["polls"] += 1
+            off = offsets[REMOTE_STDOUT]
+            offsets_seen.append(off)
+            chunk = content[REMOTE_STDOUT][off - 1:]
+            # the job "finishes" (writes exitcode) on the second poll
+            ec = "0" if state["polls"] >= 2 else None
+            if state["polls"] == 1:
+                content[REMOTE_STDOUT] += "1722000101.000 second\n"
+            return ({REMOTE_STDOUT: chunk} if chunk else {}), ec
+
+        monkeypatch.setattr(sched, "_fetch_log_windows", fetch)
+        # queued resource stays ACTIVE (RUNNING) forever — must NOT hang
+        monkeypatch.setattr(
+            sched,
+            "describe",
+            lambda a: DescribeAppResponse(app_id=a, state=AppState.RUNNING),
+        )
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        lines = list(
+            sched.log_iter(
+                "z:n", "tpu", 0, should_tail=True, streams=Stream.STDOUT
+            )
+        )
+        assert lines[0] == "first" and "second" in lines
+        assert offsets_seen[0] == 1 and offsets_seen[-1] > 1
+
+    def test_tail_survives_transient_describe_failures(self, sched, monkeypatch):
+        """One flaky gcloud describe must not end a live tail; repeated
+        failures eventually do (no infinite loop on a deleted resource)."""
+        from torchx_tpu.schedulers.api import Stream
+        from torchx_tpu.schedulers.tpu_vm_scheduler import REMOTE_STDOUT
+
+        state = {"polls": 0}
+
+        def fetch(app_id, worker, offsets):
+            state["polls"] += 1
+            if state["polls"] == 1:
+                return {REMOTE_STDOUT: "1722000100.000 only-line\n"}, None
+            return {}, None
+
+        monkeypatch.setattr(sched, "_fetch_log_windows", fetch)
+        monkeypatch.setattr(sched, "describe", lambda a: None)  # always fails
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        lines = list(
+            sched.log_iter(
+                "z:n", "tpu", 0, should_tail=True, streams=Stream.STDOUT
+            )
+        )
+        assert lines == ["only-line"]
+        # tolerated 3 describe failures (4 polls: initial + 3 retries)
+        assert state["polls"] >= 4
 
     def test_log_fetch_failure(self, sched, monkeypatch):
         monkeypatch.setattr(
             sched, "_run_cmd", lambda cmd, **kw: completed(rc=255, stderr="no ssh")
         )
         with pytest.raises(RuntimeError, match="log fetch"):
-            sched.log_iter("z:n", "tpu", 0)
+            list(sched.log_iter("z:n", "tpu", 0))
 
 
 class TestPipelineModel:

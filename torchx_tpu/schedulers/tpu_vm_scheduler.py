@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 import shlex
 import subprocess
 from dataclasses import dataclass
@@ -55,7 +56,22 @@ from torchx_tpu.specs.api import (
 
 logger = logging.getLogger(__name__)
 
-REMOTE_LOG = "/tmp/tpx/job.log"
+REMOTE_LOG_DIR = "/tmp/tpx"
+REMOTE_STDOUT = f"{REMOTE_LOG_DIR}/stdout.log"
+REMOTE_STDERR = f"{REMOTE_LOG_DIR}/stderr.log"
+# legacy combined path (pre-timestamped-stream layout); still read as a
+# fallback so logs of jobs launched by older launchers stay reachable
+REMOTE_LOG = f"{REMOTE_LOG_DIR}/job.log"
+
+# each log line is prefixed "<epoch.millis> " by the stamper below, which
+# is what makes since/until filtering and combined-stream merging possible
+# without a cloud logging dependency
+_STAMPER = (
+    "import sys,time\n"
+    "for line in sys.stdin:\n"
+    "    sys.stdout.write(f'{time.time():.3f} '+line)\n"
+    "    sys.stdout.flush()\n"
+)
 
 QR_STATE_MAP: dict[str, AppState] = {
     "CREATING": AppState.PENDING,
@@ -148,7 +164,10 @@ export {settings.ENV_TPX_APP_ID}={shlex.quote(app_id)}
 export {settings.ENV_TPX_ROLE_NAME}={shlex.quote(role.name)}
 export {settings.ENV_TPX_ERROR_FILE}=/tmp/tpx/error.json
 {env_exports}
-({cmd}) >> {REMOTE_LOG} 2>&1
+STAMP={shlex.quote(_STAMPER)}
+({cmd}) \
+  > >(python3 -u -c "$STAMP" >> {REMOTE_STDOUT}) \
+  2> >(python3 -u -c "$STAMP" >> {REMOTE_STDERR})
 echo $? > /tmp/tpx/exitcode
 """
 
@@ -310,7 +329,52 @@ class TpuVmScheduler(Scheduler[TpuVmRequest]):
         should_tail: bool = False,
         streams: Optional[Stream] = None,
     ) -> Iterable[str]:
+        """Worker logs over ssh, properly: per-stream files with epoch
+        prefixes enable since/until windows and a merged COMBINED view,
+        and tailing advances a byte offset per file across repeated ssh
+        invocations instead of re-fetching the whole log each poll — this
+        is what survives a multi-hour job."""
+        stream = streams or Stream.COMBINED
+        files = {
+            Stream.STDOUT: [REMOTE_STDOUT],
+            Stream.STDERR: [REMOTE_STDERR],
+            Stream.COMBINED: [REMOTE_STDOUT, REMOTE_STDERR, REMOTE_LOG],
+        }[stream]
+        it: Iterable[str] = _RemoteLogIterator(
+            self, app_id, k, files, since, until, should_tail
+        )
+        if regex:
+            it = filter_regex(regex, it)
+        return it
+
+    def _fetch_log_windows(
+        self, app_id: str, worker: int, offsets: Mapping[str, int]
+    ) -> tuple[dict[str, str], Optional[str]]:
+        """ONE ssh round-trip for all files: -> ({path: new bytes},
+        exitcode-or-None). Byte-exact framing ("<path> <nbytes>" header
+        lines followed by exactly nbytes of payload) makes the protocol
+        immune to log-content collisions; missing files read as empty
+        (workers boot at different times). The exitcode file is the
+        authoritative job-finished signal — the queued resource itself
+        stays ACTIVE after the startup script exits."""
         zone, name = self._parse_app_id(app_id)
+        spec = ";".join(f"{p}:{o}" for p, o in offsets.items())
+        remote = (
+            "import os,sys\n"
+            f"spec={spec!r}\n"
+            "out=sys.stdout\n"
+            "for item in spec.split(';'):\n"
+            "    p,_,off=item.rpartition(':')\n"
+            "    try:\n"
+            "        f=open(p,'rb'); f.seek(int(off)-1); data=f.read(); f.close()\n"
+            "    except OSError: data=b''\n"
+            "    out.write(f'{p} {len(data)}\\n'); out.flush()\n"
+            "    out.buffer.write(data); out.buffer.flush()\n"
+            "ec=''\n"
+            "try: ec=open('/tmp/tpx/exitcode').read().strip()\n"
+            "except OSError: pass\n"
+            "out.write(f'__exitcode__ {ec}\\n')\n"
+        )
         proc = self._run_cmd(
             [
                 "gcloud",
@@ -320,17 +384,166 @@ class TpuVmScheduler(Scheduler[TpuVmRequest]):
                 "ssh",
                 name,
                 f"--zone={zone}",
-                f"--worker={k}",
+                f"--worker={worker}",
                 "--command",
-                f"cat {REMOTE_LOG}",
+                f"python3 -c {shlex.quote(remote)}",
             ]
         )
         if proc.returncode != 0:
             raise RuntimeError(f"log fetch failed: {proc.stderr}")
-        lines: Iterable[str] = proc.stdout.splitlines()
-        if regex:
-            lines = filter_regex(regex, lines)
-        return lines
+        return _parse_log_frames(proc.stdout, list(offsets))
+
+
+_STAMP_RE = re.compile(r"^\d{9,12}\.\d{3}$")
+
+
+def _parse_stamp(line: str) -> tuple[Optional[float], str]:
+    """-> (epoch or None, payload). Lines from the stamper lead with
+    '<epoch.millis> '; anything else (legacy combined log, raw writes,
+    lines that merely START with a number like '3 retries left') passes
+    through unstamped — the stamp must look like a real epoch."""
+    head, sep, rest = line.partition(" ")
+    if sep and _STAMP_RE.match(head):
+        return float(head), rest
+    return None, line
+
+
+def _parse_log_frames(
+    raw: str, paths: list[str]
+) -> tuple[dict[str, str], Optional[str]]:
+    """Decode the byte-framed multi-file payload from the remote reader."""
+    data = raw.encode()
+    chunks: dict[str, str] = {}
+    exitcode: Optional[str] = None
+    pos = 0
+    while pos < len(data):
+        nl = data.find(b"\n", pos)
+        if nl < 0:
+            break
+        header = data[pos:nl].decode(errors="replace")
+        pos = nl + 1
+        name, _, arg = header.rpartition(" ")
+        if name == "__exitcode__":
+            exitcode = arg or None
+            continue
+        if name in paths and arg.isdigit():
+            n = int(arg)
+            if n > 0:
+                chunks[name] = data[pos : pos + n].decode(errors="replace")
+            pos += n
+        # anything else (ssh banners/warnings) is skipped line-by-line
+    return chunks, exitcode
+
+
+class _RemoteLogIterator:
+    """Merged, windowed, incrementally-tailed view of remote log files.
+
+    Tracks a byte offset and a partial-line buffer per file; each poll
+    fetches only NEW bytes (one ssh per file), merges complete lines by
+    their epoch stamp, applies the since/until window, and strips the
+    stamp before yielding. Tailing stops after one final drain once the
+    queued resource reaches a terminal state.
+    """
+
+    def __init__(
+        self,
+        scheduler: "TpuVmScheduler",
+        app_id: str,
+        worker: int,
+        files: list[str],
+        since: Optional[float],
+        until: Optional[float],
+        should_tail: bool,
+        poll_interval: float = 10.0,
+    ) -> None:
+        self._sched = scheduler
+        self._app_id = app_id
+        self._worker = worker
+        self._files = files
+        self._since = since
+        self._until = until
+        self._should_tail = should_tail
+        self._poll = poll_interval
+        self._offsets = {f: 1 for f in files}  # seek offsets are 1-based
+        self._buffers = {f: "" for f in files}
+        self._exited = False  # remote exitcode file observed
+        self._describe_failures = 0
+
+    def _poll_once(self) -> list[tuple[Optional[float], str]]:
+        """ONE ssh round-trip for every file + the exitcode sentinel."""
+        chunks, exitcode = self._sched._fetch_log_windows(
+            self._app_id, self._worker, dict(self._offsets)
+        )
+        if exitcode is not None:
+            self._exited = True
+        out: list[tuple[Optional[float], str]] = []
+        for f in self._files:
+            chunk = chunks.get(f, "")
+            if not chunk:
+                continue
+            self._offsets[f] += len(chunk.encode())
+            data = self._buffers[f] + chunk
+            lines = data.split("\n")
+            self._buffers[f] = lines.pop()  # possibly-partial tail
+            out.extend(_parse_stamp(ln) for ln in lines)
+        # merge streams chronologically; unstamped lines sort first, which
+        # keeps legacy logs in file order
+        out.sort(key=lambda p: p[0] if p[0] is not None else float("-inf"))
+        return out
+
+    def _drain_buffers(self) -> list[tuple[Optional[float], str]]:
+        out = [
+            _parse_stamp(buf) for buf in self._buffers.values() if buf
+        ]
+        self._buffers = {f: "" for f in self._files}
+        return out
+
+    def _in_window(self, ts: Optional[float]) -> bool:
+        if ts is None:
+            return True
+        if self._since is not None and ts < self._since:
+            return False
+        if self._until is not None and ts > self._until:
+            return False
+        return True
+
+    def _app_finished(self) -> bool:
+        """The worker's exitcode file is the primary signal (the queued
+        resource stays ACTIVE after the startup script exits). Queued-
+        resource state is the backstop; one failed describe is a transient
+        (network blip), only repeated failures end the tail."""
+        if self._exited:
+            return True
+        from torchx_tpu.specs.api import is_terminal
+
+        try:
+            desc = self._sched.describe(self._app_id)
+        except Exception:
+            desc = None
+        if desc is None:
+            self._describe_failures += 1
+            return self._describe_failures >= 3
+        self._describe_failures = 0
+        return is_terminal(desc.state)
+
+    def __iter__(self):
+        import time as _time
+
+        while True:
+            batch = self._poll_once()
+            if not self._should_tail:
+                batch.extend(self._drain_buffers())
+            for ts, line in batch:
+                if self._in_window(ts):
+                    yield line
+            if not self._should_tail:
+                return
+            if self._app_finished():
+                for ts, line in self._poll_once() + self._drain_buffers():
+                    if self._in_window(ts):
+                        yield line
+                return
+            _time.sleep(self._poll)
 
 
 def describe_queued_resource(
