@@ -259,6 +259,36 @@ class TestElasticRestart:
         # role a restarted once (its budget), NOT three times (b's budget)
         assert sched.describe(app_id).num_restarts == 1
 
+    def test_budgets_are_per_role_both_directions(self, sched, tmp_path):
+        """Role A's restart must not consume role B's budget: after A
+        restarts once (its budget), B's FIRST failure still gets B's own
+        retry. Both roles are ROLE-scoped with max_retries=1."""
+        from torchx_tpu.specs.api import RetryPolicy
+
+        a = (
+            f"if [ ! -f {tmp_path}/a-fired ]; then touch {tmp_path}/a-fired;"
+            " exit 1; fi; exit 0"
+        )
+        # b fails AFTER a recovered (ordering via marker file), once
+        b = (
+            f"while [ ! -f {tmp_path}/a-fired ]; do sleep 0.1; done; "
+            f"if [ ! -f {tmp_path}/b-fired ]; then sleep 0.5;"
+            f" touch {tmp_path}/b-fired; exit 1; fi; exit 0"
+        )
+        app = AppDef(
+            name="two-budgets",
+            roles=[
+                sh_role("a", a, num_replicas=1, max_retries=1,
+                        retry_policy=RetryPolicy.ROLE),
+                sh_role("b", b, num_replicas=1, max_retries=1,
+                        retry_policy=RetryPolicy.ROLE),
+            ],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        assert wait_terminal(sched, app_id, timeout=30) == AppState.SUCCEEDED
+        # each role consumed exactly its own single retry
+        assert sched.describe(app_id).num_restarts == 2
+
     def test_restart_budget_exhausted(self, sched, tmp_path):
         # every attempt fails (replica 0 always dies) -> FAILED after
         # max_retries restarts

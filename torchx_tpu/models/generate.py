@@ -79,9 +79,18 @@ def _layer_step(
     attn = _cached_attention(q, k_cache, v_cache, q_pos)
     x = x + attn.reshape(b, t, h * hd) @ layer["wo"]
     mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(mlp_in @ layer["w_gate"])
-    up = mlp_in @ layer["w_up"]
-    x = x + (gate * up) @ layer["w_down"]
+    if getattr(cfg, "n_experts", 0):
+        # same GShard dispatch as training (static shapes hold at t=1:
+        # each token routes to top_k experts, every expert sees <= t*k
+        # tokens, capacity >= 1); the balancing aux is a training-only term
+        from torchx_tpu.models.moe import moe_ffn
+
+        down, _aux = moe_ffn(cfg, layer, mlp_in)
+    else:
+        gate = jax.nn.silu(mlp_in @ layer["w_gate"])
+        up = mlp_in @ layer["w_up"]
+        down = (gate * up) @ layer["w_down"]
+    x = x + down
     return x, k_cache, v_cache
 
 
@@ -126,12 +135,12 @@ def generate(
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
-    """-> [b, t0 + max_new_tokens]; greedy when temperature == 0."""
-    if getattr(cfg, "n_experts", 0):
-        raise NotImplementedError(
-            "KV-cache generation supports dense configs only for now"
-            " (MoE decode needs the expert dispatch in the cached layer)"
-        )
+    """-> [b, t0 + max_new_tokens]; greedy when temperature == 0.
+
+    Works for dense and MoE configs alike (the cached layer dispatches to
+    the GShard expert FFN when the config carries experts). Note MoE
+    capacity is computed per call width, so aggressive ``capacity_factor``
+    settings can drop different tokens at prefill vs full forward."""
     b, t0 = prompt.shape
     total = t0 + max_new_tokens
     if total > cfg.max_seq:

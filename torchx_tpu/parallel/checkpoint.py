@@ -34,11 +34,20 @@ class Checkpointer:
         directory: str,
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
+        async_save: bool = True,
     ) -> None:
+        """``async_save`` (default on) makes ``save()`` return as soon as
+        the on-device state is snapshotted to host memory; serialization
+        and writes proceed in orbax's background thread so the train step
+        never blocks on checkpoint I/O (the HBM-bandwidth win: a 1B-param
+        sharded save overlaps entirely with the next steps). ``wait()`` /
+        ``close()`` are the synchronization points; restore paths wait
+        automatically."""
         self.directory = os.path.abspath(os.path.expanduser(directory))
         self._mgr = None
         self._max_to_keep = max_to_keep
         self._save_interval = save_interval_steps
+        self._async = async_save
         try:
             import orbax.checkpoint as ocp
 
@@ -49,7 +58,7 @@ class Checkpointer:
                 options=ocp.CheckpointManagerOptions(
                     max_to_keep=max_to_keep,
                     save_interval_steps=save_interval_steps,
-                    enable_async_checkpointing=False,
+                    enable_async_checkpointing=async_save,
                 ),
             )
         except ImportError:
@@ -61,17 +70,25 @@ class Checkpointer:
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Save if the interval policy says so (or ``force``, e.g. the final
-        state regardless of interval); returns whether saved."""
+        state regardless of interval); returns whether a save was STARTED
+        (async mode) or completed (sync mode)."""
         if self._mgr is not None:
             saved = self._mgr.save(
                 step, args=self._ocp.args.StandardSave(state), force=force
             )
-            self._mgr.wait_until_finished()
+            if not self._async:
+                self._mgr.wait_until_finished()
             return bool(saved)
         return self._pickle_save(step, state, force=force)
 
+    def wait(self) -> None:
+        """Block until in-flight async saves are durably on disk."""
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
+
     def latest_step(self) -> Optional[int]:
         if self._mgr is not None:
+            self.wait()  # an in-flight save IS the latest once finalized
             return self._mgr.latest_step()
         steps = [
             int(m.group(1))
@@ -84,6 +101,7 @@ class Checkpointer:
         """Restore onto the shardings/dtypes of ``abstract_state`` (a pytree
         of jax.ShapeDtypeStruct with shardings, or a live donated state)."""
         if self._mgr is not None:
+            self.wait()
             target = jax.tree.map(
                 lambda x: (
                     jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
@@ -116,6 +134,7 @@ class Checkpointer:
 
     def close(self) -> None:
         if self._mgr is not None:
+            self.wait()
             self._mgr.close()
 
     # -- pickle fallback ---------------------------------------------------

@@ -337,7 +337,8 @@ class _LocalApp:
         self.state = AppState.PENDING
         self.last_updated = time.time()
         self.request = request  # for elastic gang rebuilds
-        self.num_restarts = 0
+        self.num_restarts = 0  # app-wide total (surfaced in describe)
+        self.role_restarts: dict[str, int] = {}  # per-role budget tracking
 
     def write_state_file(self) -> None:
         """Snapshot for cross-process status/log (best-effort)."""
@@ -810,6 +811,7 @@ class LocalScheduler(Scheduler[PopenRequest]):
         # only when some failed role is APPLICATION-scoped (ROLE-scoped
         # failures leave healthy roles running untouched)
         new_sizes: dict[str, int] = {}
+        failed_roles: set[str] = set()
         role_scoped_only = True
         for role in request.app.roles:
             replicas = app.roles.get(role.name, [])
@@ -817,7 +819,11 @@ class LocalScheduler(Scheduler[PopenRequest]):
             cur = len(replicas)
             if n_failed == 0:
                 continue  # planned below once the restart scope is known
-            if app.num_restarts >= role.max_retries and role.min_replicas is None:
+            failed_roles.add(role.name)
+            # each role consumes ITS OWN budget: a restart triggered by
+            # role A must not burn role B's retries (and vice versa)
+            spent = app.role_restarts.get(role.name, 0)
+            if spent >= role.max_retries and role.min_replicas is None:
                 return False  # this role's own budget is spent
             if role.min_replicas is None:
                 # rigid gang: APPLICATION restarts the whole app, ROLE
@@ -831,7 +837,7 @@ class LocalScheduler(Scheduler[PopenRequest]):
                 new_sizes[role.name] = cur
                 continue
             # elastic: shrink, budgeted by max_retries as well
-            if app.num_restarts >= max(1, role.max_retries):
+            if spent >= max(1, role.max_retries):
                 return False
             role_scoped_only = False  # a resized world needs a full restart
             hosts = (
@@ -871,6 +877,8 @@ class LocalScheduler(Scheduler[PopenRequest]):
                     r._close_files()
             app.roles.pop(role_name, None)
         app.num_restarts = attempt
+        for role_name in failed_roles:
+            app.role_restarts[role_name] = app.role_restarts.get(role_name, 0) + 1
         try:
             for role in request.app.roles:
                 if role.name not in new_sizes:
