@@ -134,6 +134,44 @@ class TestPipelineApply:
         out = jax.jit(lambda p, t: llama.forward(p, t, cfg, mesh))(sharded, tokens)
         np.testing.assert_allclose(out, ref, atol=1e-4)
 
+    def test_ring_attention_inside_pp(self):
+        """Long-context composition: ring attention over sp NESTED inside a
+        pp pipeline stage (shard_map within partial-manual shard_map) —
+        forward matches the unsharded dense reference."""
+        import dataclasses
+
+        from torchx_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        cfg = llama.llama_tiny(n_layers=4)
+        cfg = dataclasses.replace(cfg, use_ring_attention=True)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 100)
+        ref = llama.forward(
+            params, tokens, dataclasses.replace(cfg, use_ring_attention=False)
+        )
+        mesh = make_mesh(MeshConfig(pp=2, dp=1, fsdp=2, tp=1, sp=2))
+        sharded = llama.shard_params(params, cfg, mesh)
+        out = jax.jit(lambda p, t: llama.forward(p, t, cfg, mesh))(sharded, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    def test_ring_attention_inside_pp_trains(self):
+        """Grads flow through the nested shard_map (GSPMD fallback) and the
+        loss decreases."""
+        from torchx_tpu.examples.train_llama import train
+        from torchx_tpu.parallel.mesh import MeshConfig
+
+        cfg = llama.llama_tiny(use_ring_attention=True)
+        m = train(
+            cfg,
+            MeshConfig(pp=2, dp=1, fsdp=2, tp=1, sp=2),
+            batch=4,
+            seq=64,
+            steps=5,
+            lr=1e-2,
+            warmup=1,
+        )
+        assert m["loss"] < 6.2
+
     def test_pp_train_step_loss_decreases(self):
         from torchx_tpu.examples.train_llama import train
         from torchx_tpu.parallel.mesh import MeshConfig
@@ -148,18 +186,6 @@ class TestPipelineApply:
             warmup=1,
         )
         assert m["loss"] < 6.0
-
-    def test_pp_with_ring_attention_rejected(self):
-        from torchx_tpu.parallel.mesh import MeshConfig, make_mesh
-
-        cfg = llama.llama_tiny(n_layers=4, use_ring_attention=True)
-        mesh = make_mesh(MeshConfig(pp=2, dp=1, fsdp=2, tp=1, sp=2))
-        params = llama.shard_params(
-            llama.init_params(cfg, jax.random.PRNGKey(0)), cfg, mesh
-        )
-        tokens = jnp.zeros((8, 32), jnp.int32)
-        with pytest.raises(ValueError, match="ring attention"):
-            llama.forward(params, tokens, cfg, mesh)
 
     def test_llama_layers_pipelined(self):
         """The real model body (attention + SwiGLU) through the pipeline."""

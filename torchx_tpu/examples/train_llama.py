@@ -137,6 +137,17 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
 ):
     """The jitted SPMD training step: grads + AdamW update, donated state."""
+    # ring attention nested inside a pipeline stage (shard_map in
+    # shard_map) lowers fine for forward, but the backward transpose trips
+    # Shardy's nested-manual-computation verifier ("axis pp already bound
+    # by parent"); GSPMD handles it. Scope the partitioner override to
+    # each call (trace + execute) rather than flipping the global flag —
+    # other models built in this process keep their partitioner.
+    needs_gspmd = (
+        cfg.use_ring_attention
+        and mesh.shape.get("pp", 1) > 1
+        and mesh.shape.get("sp", 1) > 1
+    )
 
     def step(state: TrainState, batch: dict[str, jnp.ndarray]):
         loss, grads = jax.value_and_grad(llama.loss_fn)(
@@ -151,7 +162,19 @@ def make_train_step(
             loss,
         )
 
-    return jax.jit(step, donate_argnums=(0,))
+    jitted = jax.jit(step, donate_argnums=(0,))
+    if not needs_gspmd:
+        return jitted
+
+    def step_under_gspmd(state, batch):  # noqa: ANN001
+        prev = jax.config.jax_use_shardy_partitioner
+        jax.config.update("jax_use_shardy_partitioner", False)
+        try:
+            return jitted(state, batch)
+        finally:
+            jax.config.update("jax_use_shardy_partitioner", prev)
+
+    return step_under_gspmd
 
 
 def synthetic_batch(

@@ -79,17 +79,9 @@ def _layer_step(
     attn = _cached_attention(q, k_cache, v_cache, q_pos)
     x = x + attn.reshape(b, t, h * hd) @ layer["wo"]
     mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    if getattr(cfg, "n_experts", 0):
-        # same GShard dispatch as training (static shapes hold at t=1:
-        # each token routes to top_k experts, every expert sees <= t*k
-        # tokens, capacity >= 1); the balancing aux is a training-only term
-        from torchx_tpu.models.moe import moe_ffn
-
-        down, _aux = moe_ffn(cfg, layer, mlp_in)
-    else:
-        gate = jax.nn.silu(mlp_in @ layer["w_gate"])
-        up = mlp_in @ layer["w_up"]
-        down = (gate * up) @ layer["w_down"]
+    # the SAME dispatch as the training forward (dense SwiGLU or GShard
+    # MoE — static shapes hold at t=1); the balancing aux is training-only
+    down, _aux = llama.ffn(cfg, layer, mlp_in)
     x = x + down
     return x, k_cache, v_cache
 

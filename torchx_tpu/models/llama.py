@@ -233,6 +233,21 @@ def _constraint(x: jnp.ndarray, mesh: Optional[Mesh], *spec) -> jnp.ndarray:
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
+def ffn(
+    cfg: LlamaConfig, layer: Params, mlp_in: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The FFN half of a layer: dense SwiGLU, or the GShard MoE dispatch
+    when the config carries experts. -> (down, aux). Shared by the training
+    forward and the KV-cache decode path so the two can never diverge."""
+    if getattr(cfg, "n_experts", 0):
+        from torchx_tpu.models.moe import moe_ffn
+
+        return moe_ffn(cfg, layer, mlp_in)
+    gate = jax.nn.silu(mlp_in @ layer["w_gate"])
+    up = mlp_in @ layer["w_up"]
+    return (gate * up) @ layer["w_down"], jnp.float32(0)
+
+
 def _layer(
     cfg: LlamaConfig,
     mesh: Optional[Mesh],
@@ -271,15 +286,7 @@ def _layer(
 
     # mlp block: dense SwiGLU, or sparse MoE when the config carries experts
     mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    aux = jnp.float32(0)
-    if getattr(cfg, "n_experts", 0):
-        from torchx_tpu.models.moe import moe_ffn
-
-        down, aux = moe_ffn(cfg, layer, mlp_in)
-    else:
-        gate = jax.nn.silu(mlp_in @ layer["w_gate"])
-        up = mlp_in @ layer["w_up"]
-        down = (gate * up) @ layer["w_down"]
+    down, aux = ffn(cfg, layer, mlp_in)
     x = x + down
     return _constraint(x, mesh, ("dp", "fsdp"), "sp", None), aux
 
@@ -322,11 +329,6 @@ def forward_features(
     if pp > 1:
         # pipeline the layer stack over the pp axis (embedding/head stay
         # outside the pipeline, replicated over pp)
-        if cfg.use_ring_attention and mesh.shape.get("sp", 1) > 1:
-            raise ValueError(
-                "ring attention (sp>1) inside a pp pipeline is not supported"
-                " yet; use sp=1 with pp or pp=1 with sp"
-            )
         import math as _math
 
         from torchx_tpu.parallel.pipeline import pipeline_apply
@@ -334,8 +336,12 @@ def forward_features(
         # auto mode picks the largest divisor of the batch <= 2*pp so the
         # schedule always validates; an EXPLICIT pp_microbatches passes
         # through untouched — pipeline_apply raises a clear error on a
-        # non-divisor rather than silently degrading the pipeline
-        n_micro = cfg.pp_microbatches or _math.gcd(2 * pp, x.shape[0])
+        # non-divisor rather than silently degrading the pipeline. When the
+        # batch also splits over dp*fsdp, keep each microbatch divisible by
+        # that product so in-stage batch sharding (ring attention) holds.
+        data_div = max(mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1), 1)
+        div = x.shape[0] // data_div if x.shape[0] % data_div == 0 else x.shape[0]
+        n_micro = cfg.pp_microbatches or _math.gcd(2 * pp, div)
         x, aux_total = pipeline_apply(
             body,
             params["layers"],

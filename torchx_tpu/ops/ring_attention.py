@@ -119,14 +119,46 @@ def ring_attention(
     """Causal attention over a sequence-sharded [b, s, h, d] layout.
 
     q/k/v are global arrays whose ``s`` axis is sharded over ``seq_axis``;
-    returns output in the same layout. Works inside jit.
+    returns output in the same layout. Works inside jit, including nested
+    inside another partial-manual shard_map region (e.g. a pp pipeline
+    stage): when an ambient abstract mesh is active — some axes already
+    manual — shard_map must take the CONTEXT mesh, not the concrete one.
     """
-    spec = P(batch_axes, seq_axis, head_axis, None)
+    # shapes are static at trace time: drop the batch sharding when the
+    # (micro)batch is too small to split over dp/fsdp — e.g. inside a
+    # pipeline stage where microbatching shrank the batch axis
+    batch_div = 1
+    for a in batch_axes:
+        batch_div *= mesh.shape.get(a, 1)
+    eff_batch_axes = batch_axes if q.shape[0] % max(batch_div, 1) == 0 else ()
+    spec = P(eff_batch_axes, seq_axis, head_axis, None)
+    ctx = jax.sharding.get_abstract_mesh()
+    # "nested" means inside another shard_map's MANUAL region — a bare
+    # `with jax.sharding.use_mesh(...)` also sets the abstract mesh but has
+    # no manual axes and must take the standalone path
+    nested = (
+        not ctx.empty
+        and bool(ctx.manual_axes)
+        and dict(ctx.shape) == dict(mesh.shape)
+    )
+    if nested:
+        # inside another partial-manual region: take the CONTEXT mesh and
+        # manualize only our own axes (the parent keeps its own, e.g. pp)
+        kwargs: dict = dict(
+            mesh=None,
+            axis_names=frozenset(
+                {a for a in (seq_axis, *eff_batch_axes, head_axis) if a}
+            ),
+        )
+    else:
+        # standalone: full-manual over the concrete mesh (also keeps eager
+        # calls working — partial-auto shard_map requires jit)
+        kwargs = dict(mesh=mesh)
     fn = jax.shard_map(
         functools.partial(_ring_attention_shard, axis_name=seq_axis),
-        mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
+        **kwargs,
     )
     return fn(q, k, v)
