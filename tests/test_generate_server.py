@@ -1,0 +1,94 @@
+"""Generation server tests: real HTTP round-trips against a tiny model."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from torchx_tpu.apps.generate_server import GenerateService, serve
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    srv = serve("tiny", port=0)  # OS-assigned port
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def post(url, payload):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestGenerateServer:
+    def test_healthz(self, server_url):
+        with urllib.request.urlopen(f"{server_url}/healthz", timeout=30) as r:
+            body = json.loads(r.read())
+        assert body["status"] == "ok"
+        assert body["model"] == "tiny"
+
+    def test_token_generation(self, server_url):
+        code, body = post(
+            f"{server_url}/v1/generate",
+            {"tokens": [[1, 2, 3, 4]], "max_new_tokens": 4},
+        )
+        assert code == 200
+        (seq,) = body["tokens"]
+        assert len(seq) == 8 and seq[:4] == [1, 2, 3, 4]
+
+    def test_mixed_lengths_batch(self, server_url):
+        code, body = post(
+            f"{server_url}/v1/generate",
+            {"tokens": [[1, 2, 3], [4, 5, 6, 7, 8]], "max_new_tokens": 2},
+        )
+        assert code == 200
+        a, b = body["tokens"]
+        assert len(a) == 5 and a[:3] == [1, 2, 3]
+        assert len(b) == 7 and b[:5] == [4, 5, 6, 7, 8]
+
+    def test_text_mode_byte_codec(self, server_url):
+        code, body = post(
+            f"{server_url}/v1/generate",
+            {"text": "hi", "max_new_tokens": 3},
+        )
+        assert code == 200
+        (text,) = body["text"]
+        assert text.startswith("hi")
+
+    def test_errors_are_4xx(self, server_url):
+        code, body = post(f"{server_url}/v1/generate", {"tokens": [[]]})
+        assert code == 400 and "error" in body
+        code, body = post(
+            f"{server_url}/v1/generate",
+            {"tokens": [[1]], "max_new_tokens": 10_000},
+        )
+        assert code == 400 and "max_seq" in body["error"]
+        code, _ = post(f"{server_url}/nope", {})
+        assert code == 404
+
+    def test_unknown_config_rejected(self):
+        with pytest.raises(ValueError, match="unknown config"):
+            GenerateService("not-a-model")
+
+    def test_component_materializes(self):
+        from torchx_tpu.components.serve import generate_server
+
+        app = generate_server(
+            "llama3_1b", port=9000, int8=True, tpu="v5litepod-8"
+        )
+        (role,) = app.roles
+        assert "--int8" in role.args
+        assert role.port_map == {"http": 9000}
+        assert role.resource.tpu is not None

@@ -1,0 +1,89 @@
+"""Int8 weight-only quantization tests (ops/quant.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchx_tpu.models import generate as gen
+from torchx_tpu.models import llama
+from torchx_tpu.ops import quant
+
+
+class TestQuantOps:
+    def test_roundtrip_error_small(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+        q, scale = quant.quantize(w)
+        back = quant.dequantize(q, scale, dtype=jnp.float32)
+        rel = float(jnp.abs(back - w).max() / jnp.abs(w).max())
+        assert rel < 0.01  # 127-level symmetric grid
+
+    def test_per_layer_scales_on_stacked_weights(self):
+        # two layers with wildly different magnitudes must not share scales
+        w = jnp.stack(
+            [jnp.ones((8, 4)) * 0.01, jnp.ones((8, 4)) * 100.0]
+        )  # [L=2, in, out]
+        q, scale = quant.quantize(w)
+        assert scale.shape == (2, 1, 4)
+        back = quant.dequantize(q, scale, dtype=jnp.float32)
+        np.testing.assert_allclose(back, w, rtol=0.01)
+
+    def test_int8_matmul_matches_dequant(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 64), dtype=jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(2), (64, 32))
+        q, scale = quant.quantize(w)
+        got = quant.int8_matmul(x, q, scale)
+        want = x @ quant.dequantize(q, scale, dtype=jnp.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_maybe_matmul_both_forms(self):
+        x = jnp.ones((2, 8))
+        w = jax.random.normal(jax.random.PRNGKey(3), (8, 4))
+        q, scale = quant.quantize(w)
+        plain = quant.maybe_matmul(x, w)
+        quantized = quant.maybe_matmul(x, {"q": q, "scale": scale})
+        np.testing.assert_allclose(plain, quantized, rtol=0.02, atol=0.02)
+
+
+class TestQuantizedModel:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        cfg = llama.llama_tiny(max_seq=64)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 512)
+        return cfg, params, prompt
+
+    def test_quantize_params_halves_projection_bytes(self, setup):
+        cfg, params, _ = setup
+        qparams = quant.quantize_params(params)
+        # projections dominate; total must shrink substantially
+        assert quant.size_bytes(qparams) < 0.75 * quant.size_bytes(params)
+        # embeddings/norms stay exact
+        assert qparams["embed"].dtype == params["embed"].dtype
+
+    def test_quantized_decode_close_to_fp(self, setup):
+        cfg, params, prompt = setup
+        qparams = quant.quantize_params(params)
+        cache = gen.init_kv_cache(cfg, 2, 16)
+        logits_fp, _ = gen.forward_with_cache(
+            params, prompt, cache, jnp.int32(0), cfg
+        )
+        cache2 = gen.init_kv_cache(cfg, 2, 16)
+        logits_q, _ = gen.forward_with_cache(
+            qparams, prompt, cache2, jnp.int32(0), cfg
+        )
+        # int8 weight-only: logits track fp closely at tiny scale
+        err = float(
+            jnp.abs(logits_q - logits_fp).mean() / jnp.abs(logits_fp).mean()
+        )
+        assert err < 0.05, err
+
+    def test_quantized_generate_runs(self, setup):
+        cfg, params, prompt = setup
+        qparams = quant.quantize_params(params)
+        out = gen.generate(params, prompt, cfg, max_new_tokens=4)
+        qout = gen.generate(qparams, prompt, cfg, max_new_tokens=4)
+        assert qout.shape == out.shape
+        # greedy decode from near-identical logits: most tokens agree
+        agree = float((qout == out).mean())
+        assert agree > 0.8, agree

@@ -1,0 +1,255 @@
+"""Generation server: HTTP inference over the KV-cache decode loop.
+
+The serving half the reference delegates to TorchServe, rebuilt
+TPU-native (JetStream-style, minimal): load a model family config (+
+optional orbax checkpoint, optional int8 weight-only quantization), jit
+the prefill+decode loop once per shape bucket, and serve token-in/
+token-out generation over plain HTTP — no framework dependencies, so the
+same binary runs under every scheduler backend.
+
+    python -m torchx_tpu.apps.generate_server \
+        --config llama_tiny [--ckpt-dir DIR] [--int8] [--port 8000]
+
+API (JSON):
+    GET  /healthz            -> {"status": "ok", "model": ..., "requests": N}
+    POST /v1/generate        {"tokens": [[...]], "max_new_tokens": 16,
+                              "temperature": 0.0}
+                          or {"text": "...", ...} (byte-level codec, the
+                              same tokenization datapreproc defaults to)
+                          -> {"tokens": [[...]]} / {"text": [...]}
+
+Same-length prompts batch together; each distinct (prompt_len,
+max_new_tokens) pair compiles once and is then served from the jit cache.
+Requests run under a lock — one chip, one model, sequential batches
+(continuous batching is the next rung; see docs/ROADMAP.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _assert_platform() -> None:
+    """Make the launcher's JAX_PLATFORMS choice stick even when a site
+    hook programmatically forced another platform (the same defense as
+    spmd_main — this app is launched directly, not through the spmd
+    bootstrap)."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        jax.config.update("jax_platforms", platforms)
+
+
+class GenerateService:
+    """Model + jitted decode, shared by all handler threads."""
+
+    def __init__(
+        self,
+        config: str,
+        ckpt_dir: Optional[str] = None,
+        int8: bool = False,
+        seed: int = 0,
+    ) -> None:
+        from torchx_tpu.examples.train_llama import all_configs
+
+        configs = all_configs()
+        if config not in configs:
+            raise ValueError(f"unknown config {config!r}; have {sorted(configs)}")
+        self.cfg = configs[config]()
+        self.name = config
+        from torchx_tpu.models import llama
+
+        if ckpt_dir:
+            from torchx_tpu.parallel.checkpoint import Checkpointer
+
+            abstract = llama.init_params(self.cfg, jax.random.PRNGKey(seed))
+            ckpt = Checkpointer(ckpt_dir)
+            step, params = ckpt.restore_latest(abstract)
+            ckpt.close()
+            if params is None:
+                raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+            self.params = params
+            self.ckpt_step = step
+        else:
+            self.params = llama.init_params(self.cfg, jax.random.PRNGKey(seed))
+            self.ckpt_step = None
+        if int8:
+            from torchx_tpu.ops.quant import quantize_params
+
+            self.params = quantize_params(self.params)
+        self.int8 = int8
+        self._lock = threading.Lock()
+        self._jit_cache: dict[tuple, Any] = {}
+        self.requests = 0
+
+    def _decode_fn(self, max_new_tokens: int, temperature: float):
+        """One jitted generate per (max_new, temperature); jax's own cache
+        handles distinct (batch, prompt_len) shapes under each entry."""
+        from torchx_tpu.models import generate as gen
+
+        key = (max_new_tokens, temperature)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda p, b, rng: gen.generate(
+                    p,
+                    b,
+                    self.cfg,
+                    max_new_tokens=max_new_tokens,
+                    temperature=temperature,
+                    rng=rng,
+                )
+            )
+            self._jit_cache[key] = fn
+        return fn
+
+    def generate(
+        self,
+        tokens: list[list[int]],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> list[list[int]]:
+        if not tokens or any(not t for t in tokens):
+            raise ValueError("tokens must be non-empty sequences")
+        longest = max(len(t) for t in tokens)
+        if longest + max_new_tokens > self.cfg.max_seq:
+            raise ValueError(
+                f"prompt length {longest} + {max_new_tokens} new tokens"
+                f" exceeds max_seq {self.cfg.max_seq}"
+            )
+        # batch EXACT-length groups (padding would pollute the causal
+        # context — correctness over cleverness; one compile per distinct
+        # (length, max_new) pair, cached by jit)
+        groups: dict[int, list[int]] = {}
+        for i, t in enumerate(tokens):
+            groups.setdefault(len(t), []).append(i)
+        result: list[list[int]] = [[] for _ in tokens]
+        fn = self._decode_fn(max_new_tokens, temperature)
+        with self._lock:
+            self.requests += 1
+            for length, idxs in groups.items():
+                batch = jnp.asarray(
+                    [tokens[i] for i in idxs], dtype=jnp.int32
+                )
+                out = jax.device_get(
+                    fn(self.params, batch, jax.random.PRNGKey(seed))
+                )
+                for row, i in enumerate(idxs):
+                    result[i] = [int(x) for x in out[row]]
+        return result
+
+
+def _make_handler(service: GenerateService):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt: str, *args: Any) -> None:  # quiet
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802
+            if self.path == "/healthz":
+                self._reply(
+                    200,
+                    {
+                        "status": "ok",
+                        "model": service.name,
+                        "int8": service.int8,
+                        "ckpt_step": service.ckpt_step,
+                        "requests": service.requests,
+                    },
+                )
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self) -> None:  # noqa: N802
+            if self.path != "/v1/generate":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                text_mode = "text" in req and "tokens" not in req
+                if text_mode:
+                    texts = req["text"]
+                    if isinstance(texts, str):
+                        texts = [texts]
+                    tokens = [list(t.encode("utf-8")) for t in texts]
+                else:
+                    tokens = req["tokens"]
+                out = service.generate(
+                    tokens,
+                    max_new_tokens=int(req.get("max_new_tokens", 16)),
+                    temperature=float(req.get("temperature", 0.0)),
+                    seed=int(req.get("seed", 0)),
+                )
+                if text_mode:
+                    self._reply(
+                        200,
+                        {
+                            "text": [
+                                bytes(
+                                    b for b in seq if 0 <= b < 256
+                                ).decode("utf-8", errors="replace")
+                                for seq in out
+                            ]
+                        },
+                    )
+                else:
+                    self._reply(200, {"tokens": out})
+            except (KeyError, ValueError, TypeError) as e:
+                self._reply(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 - surface, don't kill the server
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    return Handler
+
+
+def serve(
+    config: str,
+    port: int = 8000,
+    ckpt_dir: Optional[str] = None,
+    int8: bool = False,
+    ready_event: Optional[threading.Event] = None,
+) -> ThreadingHTTPServer:
+    service = GenerateService(config, ckpt_dir=ckpt_dir, int8=int8)
+    server = ThreadingHTTPServer(("", port), _make_handler(service))
+    if ready_event is not None:
+        ready_event.set()
+    return server
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="generate_server", description=__doc__)
+    parser.add_argument("--config", required=True, help="model config name")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--ckpt-dir", default=None)
+    parser.add_argument("--int8", action="store_true", help="int8 weight-only")
+    args = parser.parse_args(argv)
+    _assert_platform()
+    t0 = time.monotonic()
+    server = serve(args.config, args.port, args.ckpt_dir, args.int8)
+    print(
+        f"generate_server: {args.config} on :{args.port}"
+        f" (loaded in {time.monotonic() - t0:.1f}s)",
+        flush=True,
+    )
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

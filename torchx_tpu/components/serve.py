@@ -1,6 +1,10 @@
-"""Serving components (reference analog: torchx/components/serve.py:19-77)."""
+"""Serving components (reference analog: torchx/components/serve.py:19-77;
+``generate_server`` goes beyond the reference — an actual TPU inference
+server, not just a registration client)."""
 
 from __future__ import annotations
+
+from typing import Optional
 
 import torchx_tpu.specs as specs
 from torchx_tpu.version import TORCHX_TPU_IMAGE
@@ -43,6 +47,58 @@ def model_server(
                     str(timeout),
                 ],
                 resource=specs.Resource(cpu=1, memMB=1024),
+            )
+        ],
+    )
+
+
+def generate_server(
+    config: str,
+    port: int = 8000,
+    ckpt_dir: Optional[str] = None,
+    int8: bool = False,
+    image: str = TORCHX_TPU_IMAGE,
+    tpu: Optional[str] = None,
+    cpu: int = 4,
+    memMB: int = 16384,
+) -> specs.AppDef:
+    """Serve KV-cache generation for a model family over HTTP
+    (POST /v1/generate, GET /healthz) — the TPU-native serving half the
+    reference delegates to TorchServe.
+
+    Args:
+        config: model config name (e.g. ``llama3_1b``)
+        port: HTTP port to listen on
+        ckpt_dir: orbax checkpoint directory to restore weights from
+        int8: serve int8 weight-only quantized (2x MXU, half weight HBM)
+        image: container image
+        tpu: TPU accelerator type (e.g. ``v5litepod-8``); CPU when unset
+        cpu: cpu count for CPU serving
+        memMB: memory for CPU serving
+    """
+    args = [
+        "-m",
+        "torchx_tpu.apps.generate_server",
+        "--config",
+        config,
+        "--port",
+        str(port),
+    ]
+    if ckpt_dir:
+        args += ["--ckpt-dir", ckpt_dir]
+    if int8:
+        args += ["--int8"]
+    resource = specs.resource(cpu=cpu, memMB=memMB, tpu=tpu)
+    return specs.AppDef(
+        name=f"generate-{config}",
+        roles=[
+            specs.Role(
+                name="server",
+                image=image,
+                entrypoint="python",
+                args=args,
+                port_map={"http": port},
+                resource=resource,
             )
         ],
     )

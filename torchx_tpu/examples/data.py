@@ -70,22 +70,63 @@ class TokenDataset:
 
 
 def device_batches(
-    dataset: TokenDataset, mesh: Mesh
+    dataset: TokenDataset, mesh: Mesh, prefetch: int = 2
 ) -> Iterator[dict[str, jax.Array]]:
-    """Yield sharded device batches with one transfer prefetched ahead.
+    """Yield sharded device batches with host production AND the
+    host->device transfer running ahead of the consumer.
 
+    A daemon thread assembles up to ``prefetch`` host batches (memmap
+    reads + crop stacking) while the device runs the current step; the
+    consumer side additionally keeps one async device transfer in flight.
     Each process contributes only its local rows
     (``jax.make_array_from_process_local_data``) — no duplicated host IO
-    across the slice.
+    across the slice. Ordering (and therefore the seeded, resumable
+    stream) is preserved: one producer, FIFO queue.
     """
+    import queue
+    import threading
+
     sharding = NamedSharding(mesh, BATCH_SPEC)
 
     def put(local_rows: np.ndarray) -> jax.Array:
         return jax.make_array_from_process_local_data(sharding, local_rows)
 
-    it = iter(dataset)
-    pending = put(next(it))
-    while True:
-        nxt = put(next(it))  # async: overlaps the running step
-        yield {"tokens": pending}
-        pending = nxt
+    q: "queue.Queue[object]" = queue.Queue(maxsize=max(1, prefetch))
+    stop = threading.Event()
+
+    def producer() -> None:
+        try:
+            for rows in dataset:
+                while not stop.is_set():
+                    try:
+                        q.put(rows, timeout=0.5)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 - re-raised on the consumer side
+            while not stop.is_set():
+                try:
+                    q.put(e, timeout=0.5)
+                    return
+                except queue.Full:
+                    continue
+
+    threading.Thread(target=producer, daemon=True, name="tpx-data-prefetch").start()
+
+    def take() -> np.ndarray:
+        item = q.get()
+        if isinstance(item, BaseException):
+            # a data error must fail the job loudly, not hang the loop
+            raise item
+        return item  # type: ignore[return-value]
+
+    try:
+        pending = put(take())
+        while True:
+            nxt = put(take())  # async: overlaps the running step
+            yield {"tokens": pending}
+            pending = nxt
+    finally:
+        stop.set()  # generator closed/GC'd: release the producer thread

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from torchx_tpu.models import llama
 from torchx_tpu.ops.norms import rms_norm
+from torchx_tpu.ops.quant import maybe_matmul as mm
 from torchx_tpu.ops.rope import apply_rope, rope_frequencies
 
 KVCache = dict[str, jnp.ndarray]  # {"k": [L,b,S,kvh,hd], "v": ...}
@@ -71,13 +72,13 @@ def _layer_step(
     b, t, d = x.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     attn_in = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = apply_rope((attn_in @ layer["wq"]).reshape(b, t, h, hd), cos, sin)
-    k = apply_rope((attn_in @ layer["wk"]).reshape(b, t, kvh, hd), cos, sin)
-    v = (attn_in @ layer["wv"]).reshape(b, t, kvh, hd)
+    q = apply_rope(mm(attn_in, layer["wq"]).reshape(b, t, h, hd), cos, sin)
+    k = apply_rope(mm(attn_in, layer["wk"]).reshape(b, t, kvh, hd), cos, sin)
+    v = mm(attn_in, layer["wv"]).reshape(b, t, kvh, hd)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, start, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, start, 0, 0))
     attn = _cached_attention(q, k_cache, v_cache, q_pos)
-    x = x + attn.reshape(b, t, h * hd) @ layer["wo"]
+    x = x + mm(attn.reshape(b, t, h * hd), layer["wo"])
     mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     # the SAME dispatch as the training forward (dense SwiGLU or GShard
     # MoE — static shapes hold at t=1); the balancing aux is training-only
@@ -113,9 +114,13 @@ def forward_with_cache(
         scan_step, x, (params["layers"], cache["k"], cache["v"])
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum(
-        "btd,dv->btv", x, llama.lm_head(params, cfg), preferred_element_type=jnp.float32
-    )
+    head = llama.lm_head(params, cfg)
+    if isinstance(head, dict):  # int8-quantized lm_head: keep f32 accum
+        logits = mm(x, head, out_dtype=jnp.float32)
+    else:
+        logits = jnp.einsum(
+            "btd,dv->btv", x, head, preferred_element_type=jnp.float32
+        )
     return logits, {"k": k_new, "v": v_new}
 
 

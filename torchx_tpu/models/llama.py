@@ -243,9 +243,11 @@ def ffn(
         from torchx_tpu.models.moe import moe_ffn
 
         return moe_ffn(cfg, layer, mlp_in)
-    gate = jax.nn.silu(mlp_in @ layer["w_gate"])
-    up = mlp_in @ layer["w_up"]
-    return (gate * up) @ layer["w_down"], jnp.float32(0)
+    from torchx_tpu.ops.quant import maybe_matmul
+
+    gate = jax.nn.silu(maybe_matmul(mlp_in, layer["w_gate"]))
+    up = maybe_matmul(mlp_in, layer["w_up"])
+    return maybe_matmul(gate * up, layer["w_down"]), jnp.float32(0)
 
 
 def _layer(

@@ -1,0 +1,121 @@
+"""Int8 quantization for TPU inference (weight-only, AQT-style).
+
+TPU MXUs execute int8 matmuls at 2x the bf16 rate and HBM traffic halves,
+so weight-only int8 is the standard first rung of the quantization ladder
+(the approach AQT and JetStream take; reference torchx has no quantization
+story — this is beyond-parity). Symmetric per-output-channel scales keep
+the matmul a pure ``int8 x bf16`` contraction followed by one rescale:
+
+    y = (x @ w_int8) * scale          # scale: [out] f32
+
+Accuracy: per-channel symmetric int8 on transformer weights costs well
+under 0.1 nats of perplexity at 1-8B scale; activations stay bf16 (the
+risky part of full int8 is activation outliers, deferred).
+
+Everything here is shape-polymorphic and jit-safe; tests validate
+numerics on CPU, the dtype plumbing is what the TPU path needs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+# weights quantized by quantize_params: every 2D+ float leaf whose name is
+# a projection matrix (the FFN/attention/head matmuls carry ~all weight
+# bytes; norms/embeddings stay exact)
+_QUANT_KEYS = {
+    "wq", "wk", "wv", "wo",
+    "w_gate", "w_up", "w_down",
+    "lm_head",
+}
+
+
+def quantize(w: jnp.ndarray, axis: int = -1) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (int8 weights, f32 scale) with symmetric per-channel scales.
+
+    ``axis`` is the OUTPUT-channel axis (kept exact). For stacked weights
+    (ndim >= 3, e.g. scan-over-layers ``[L, in, out]``) the leading axis is
+    preserved too, so every layer gets its own scales; the remaining axes
+    form the quantization group.
+    """
+    keep = {axis % w.ndim}
+    if w.ndim >= 3:
+        keep.add(0)  # leading layer-stack axis: per-layer scales
+    reduce_axes = tuple(i for i in range(w.ndim) if i not in keep)
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(
+        jnp.int8
+    )
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16):  # noqa: ANN001
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def int8_matmul(
+    x: jnp.ndarray,  # [..., in] bf16/f32
+    q: jnp.ndarray,  # [in, out] int8
+    scale: jnp.ndarray,  # [1, out] f32
+    out_dtype: Any = None,  # default: x.dtype
+) -> jnp.ndarray:
+    """x @ dequant(q) with the rescale folded AFTER the contraction, so XLA
+    lowers the inner product onto the int8 MXU path where available.
+    Pass ``out_dtype=jnp.float32`` to keep the f32 accumulation (e.g. the
+    lm_head, where logits must not round-trip through bf16)."""
+    y = jax.lax.dot_general(
+        x,
+        q,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return (y * scale.reshape(-1)).astype(out_dtype or x.dtype)
+
+
+def quantize_params(params: Params) -> Params:
+    """Quantize every projection matrix in a Llama/MoE param tree.
+
+    Returns a tree of the same structure where each quantized leaf ``k``
+    becomes a dict ``{"q": int8, "scale": f32}``; everything else is
+    untouched. ~2x smaller checkpoints/HBM for the weight-dominated parts.
+    """
+
+    def walk(node: Any) -> Any:
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if (
+                    k in _QUANT_KEYS
+                    and isinstance(v, jnp.ndarray)
+                    and jnp.issubdtype(v.dtype, jnp.floating)
+                    # 2D proj or [L, in, out] layer stack; expert-stacked
+                    # MoE weights (ndim >= 4) keep their einsum path exact
+                    and v.ndim in (2, 3)
+                ):
+                    q, scale = quantize(v)
+                    out[k] = {"q": q, "scale": scale}
+                else:
+                    out[k] = walk(v)
+            return out
+        return node
+
+    return walk(params)
+
+
+def maybe_matmul(x: jnp.ndarray, w: Any, out_dtype: Any = None) -> jnp.ndarray:
+    """``x @ w`` that accepts either a plain matrix or a quantized
+    ``{"q", "scale"}`` record — lets one model body serve both."""
+    if isinstance(w, dict) and "q" in w:
+        return int8_matmul(x, w["q"], w["scale"], out_dtype=out_dtype)
+    y = x @ w
+    return y.astype(out_dtype) if out_dtype is not None else y
+
+
+def size_bytes(params: Params) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
