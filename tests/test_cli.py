@@ -168,6 +168,12 @@ class TestCmdLogAndCopy:
         code, _, err = run_cli(["log", "not-an-identifier"])
         assert code == 1 and "malformed" in err
 
+    def test_log_bad_since_errors(self):
+        code, _, err = run_cli(
+            ["log", "--since", "yesterdayish", "local://s/app/role/0"]
+        )
+        assert code == 1 and "cannot parse time" in err
+
 
 class TestCmdBuiltinsRunopts:
     def test_builtins_lists_components(self):

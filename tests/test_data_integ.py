@@ -155,3 +155,36 @@ def test_prefetch_preserves_seeded_order(tmp_path):
         got.append(np.asarray(next(stream)["tokens"]))
     for w, g in zip(want, got):
         np.testing.assert_array_equal(w, g)
+
+
+def test_prefetch_finite_iterable_ends_cleanly(tmp_path):
+    """A finite dataset must END the stream, not hang the consumer."""
+    import numpy as np
+
+    from torchx_tpu.examples.data import device_batches
+    from torchx_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=-1, tp=1, sp=1))
+    finite = [np.zeros((8, 17), dtype=np.int32) for _ in range(3)]
+    got = list(device_batches(finite, mesh, prefetch=2))
+    assert len(got) == 3
+
+
+def test_prefetch_propagates_producer_errors(tmp_path):
+    import numpy as np
+    import pytest
+
+    from torchx_tpu.examples.data import device_batches
+    from torchx_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=-1, tp=1, sp=1))
+
+    def bad():
+        yield np.zeros((8, 17), dtype=np.int32)
+        raise OSError("disk went away")
+
+    stream = device_batches(bad(), mesh, prefetch=2)
+    # the producer may race ahead, so the error can surface on any pull
+    with pytest.raises(OSError, match="disk went away"):
+        for _ in stream:
+            pass

@@ -88,3 +88,59 @@ class TestUtilColors:
         assert state_color("FAILED") == "red"
         assert state_color("RUNNING") == "green"
         assert state_color("???") == "gray"
+
+
+class TestUtilModules:
+    def test_load_module_plain_and_attr(self):
+        from torchx_tpu.util.modules import load_module
+
+        mod = load_module("torchx_tpu.util.strings")
+        assert mod is not None
+        fn = load_module("torchx_tpu.util.modules:load_module")
+        assert fn is load_module
+        assert load_module("no.such.module") is None
+        assert load_module("torchx_tpu.util.modules:nope") is None
+
+    def test_import_attr_optional_dependency(self):
+        from torchx_tpu.util.modules import import_attr
+
+        assert import_attr("not_installed_pkg", "X", default=42) == 42
+        got = import_attr("torchx_tpu.util.modules", "import_attr", default=None)
+        assert got is import_attr
+        # module exists but attr missing: a bug, not an absent dep
+        import pytest
+
+        with pytest.raises(AttributeError):
+            import_attr("torchx_tpu.util.modules", "nope", default=1)
+
+
+class TestUtilIO:
+    def test_copy_and_read(self, tmp_path):
+        from torchx_tpu.util.io import copy_path, exists, read_text
+
+        src = tmp_path / "a.txt"
+        src.write_text("payload")
+        dst = tmp_path / "sub" / "b.txt"
+        copy_path(str(src), str(dst))
+        assert read_text(str(dst)) == "payload"
+        assert exists(str(dst)) and not exists(str(tmp_path / "nope"))
+
+
+class TestUtilTimes:
+    def test_parse_when_forms(self):
+        from torchx_tpu.util.times import parse_when
+
+        assert parse_when(None) is None
+        assert parse_when("") is None
+        assert parse_when("1722333444.5") == 1722333444.5
+        assert parse_when("2h", now=10_000.0) == 10_000.0 - 7200
+        assert parse_when("30m", now=10_000.0) == 10_000.0 - 1800
+        assert parse_when("1w", now=700_000.0) == 700_000.0 - 604800
+        from datetime import datetime
+
+        iso = "2026-07-29T10:00:00"
+        assert parse_when(iso) == datetime.fromisoformat(iso).timestamp()
+        import pytest
+
+        with pytest.raises(ValueError, match="cannot parse"):
+            parse_when("yesterdayish")

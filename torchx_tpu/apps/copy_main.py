@@ -3,8 +3,6 @@
 from __future__ import annotations
 
 import argparse
-import os
-import shutil
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -12,19 +10,9 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--src", required=True)
     parser.add_argument("--dst", required=True)
     args = parser.parse_args(argv)
-    try:
-        import fsspec
+    from torchx_tpu.util.io import copy_path
 
-        with fsspec.open(args.src, "rb") as r:
-            with fsspec.open(args.dst, "wb") as w:
-                shutil.copyfileobj(r, w)
-    except ImportError:
-        # plain filesystem fallback
-        if os.path.isdir(args.src):
-            shutil.copytree(args.src, args.dst, dirs_exist_ok=True)
-        else:
-            os.makedirs(os.path.dirname(os.path.abspath(args.dst)), exist_ok=True)
-            shutil.copyfile(args.src, args.dst)
+    copy_path(args.src, args.dst)
     print(f"copied {args.src} -> {args.dst}")
 
 

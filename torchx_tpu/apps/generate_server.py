@@ -90,21 +90,30 @@ class GenerateService:
         self._jit_cache: dict[tuple, Any] = {}
         self.requests = 0
 
+    _JIT_CACHE_MAX = 32
+
     def _decode_fn(self, max_new_tokens: int, temperature: float):
         """One jitted generate per (max_new, temperature); jax's own cache
-        handles distinct (batch, prompt_len) shapes under each entry."""
+        handles distinct (batch, prompt_len) shapes under each entry.
+
+        Request-supplied floats key the cache, so temperature is rounded
+        (1e-3 is far below sampling noise) and the cache is FIFO-bounded —
+        adversarial parameter sweeps cannot grow compile state without
+        bound."""
         from torchx_tpu.models import generate as gen
 
-        key = (max_new_tokens, temperature)
+        key = (max_new_tokens, round(temperature, 3))
         fn = self._jit_cache.get(key)
         if fn is None:
+            if len(self._jit_cache) >= self._JIT_CACHE_MAX:
+                self._jit_cache.pop(next(iter(self._jit_cache)))
             fn = jax.jit(
                 lambda p, b, rng: gen.generate(
                     p,
                     b,
                     self.cfg,
                     max_new_tokens=max_new_tokens,
-                    temperature=temperature,
+                    temperature=key[1],
                     rng=rng,
                 )
             )

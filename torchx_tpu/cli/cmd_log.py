@@ -32,6 +32,22 @@ class CmdLog(SubCommand):
         )
         subparser.add_argument("-t", "--tail", action="store_true", help="follow logs")
         subparser.add_argument("--regex", default=None, help="filter lines by regex")
+        subparser.add_argument(
+            "--since",
+            default=None,
+            help="window start: epoch seconds, relative (2h/30m/7d), or ISO time",
+        )
+        subparser.add_argument(
+            "--until",
+            default=None,
+            help="window end: epoch seconds, relative (2h/30m/7d), or ISO time",
+        )
+        subparser.add_argument(
+            "--streams",
+            choices=["stdout", "stderr", "combined"],
+            default=None,
+            help="which stream to read (backend-dependent; default combined)",
+        )
 
     def run(self, args: argparse.Namespace) -> None:
         m = _ID_RE.match(args.identifier)
@@ -49,6 +65,25 @@ class CmdLog(SubCommand):
             if m.group("replicas")
             else None
         )
+        from datetime import datetime
+
+        from torchx_tpu.schedulers.api import Stream
+        from torchx_tpu.util.times import parse_when
+
+        try:
+            since_ts = parse_when(args.since)
+            until_ts = parse_when(args.until)
+            since = (
+                datetime.fromtimestamp(since_ts) if since_ts is not None else None
+            )
+            until = (
+                datetime.fromtimestamp(until_ts) if until_ts is not None else None
+            )
+        except (ValueError, OverflowError, OSError) as e:
+            print(f"cannot parse time window: {e}", file=sys.stderr)
+            sys.exit(1)
+        streams = Stream(args.streams) if args.streams else None
+
         app_handle = f"{scheduler}://{session}/{app_id}"
         with get_runner() as runner:
             status = wait_for_app_started(runner, app_handle)
@@ -66,7 +101,14 @@ class CmdLog(SubCommand):
             for r, i in pairs:
                 def stream(r=r, i=i):  # noqa: ANN001
                     for line in runner.log_lines(
-                        app_handle, r, i, regex=args.regex, should_tail=args.tail
+                        app_handle,
+                        r,
+                        i,
+                        regex=args.regex,
+                        since=since,
+                        until=until,
+                        should_tail=args.tail,
+                        streams=streams,
                     ):
                         with lock:
                             print(f"{r}/{i} {line}", flush=True)

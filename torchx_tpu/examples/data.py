@@ -93,40 +93,48 @@ def device_batches(
 
     q: "queue.Queue[object]" = queue.Queue(maxsize=max(1, prefetch))
     stop = threading.Event()
+    done = object()  # exhaustion sentinel (TokenDataset is infinite, but
+    # the helper accepts any iterable — ending must not hang the consumer)
+
+    def _offer(item: object) -> None:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return
+            except queue.Full:
+                continue
 
     def producer() -> None:
         try:
             for rows in dataset:
-                while not stop.is_set():
-                    try:
-                        q.put(rows, timeout=0.5)
-                        break
-                    except queue.Full:
-                        continue
+                _offer(rows)
                 if stop.is_set():
                     return
+            _offer(done)
         except BaseException as e:  # noqa: BLE001 - re-raised on the consumer side
-            while not stop.is_set():
-                try:
-                    q.put(e, timeout=0.5)
-                    return
-                except queue.Full:
-                    continue
+            _offer(e)
 
     threading.Thread(target=producer, daemon=True, name="tpx-data-prefetch").start()
 
-    def take() -> np.ndarray:
+    def take() -> Optional[np.ndarray]:
         item = q.get()
+        if item is done:
+            return None
         if isinstance(item, BaseException):
             # a data error must fail the job loudly, not hang the loop
             raise item
         return item  # type: ignore[return-value]
 
     try:
-        pending = put(take())
+        first = take()
+        if first is None:
+            return
+        pending = put(first)
         while True:
-            nxt = put(take())  # async: overlaps the running step
+            nxt = take()  # host batch; None = dataset exhausted
             yield {"tokens": pending}
-            pending = nxt
+            if nxt is None:
+                return
+            pending = put(nxt)  # async: overlaps the running step
     finally:
         stop.set()  # generator closed/GC'd: release the producer thread

@@ -1,0 +1,45 @@
+"""Human time parsing for log windows (reference analog:
+torchx/util/datetime.py — generalized from day-granularity to the
+``--since 2h`` style every log CLI actually needs).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from datetime import datetime
+from typing import Optional
+
+_REL = re.compile(r"^(\d+)([smhdw])$")
+_UNITS = {"s": 1, "m": 60, "h": 3600, "d": 86400, "w": 604800}
+
+
+def parse_when(value: Optional[str], now: Optional[float] = None) -> Optional[float]:
+    """-> epoch seconds for ``None``/''/relative/''ISO''/epoch inputs.
+
+    Accepted forms:
+      - ``"300"`` / ``"1722333444.5"``  absolute epoch seconds
+      - ``"2h"`` ``"30m"`` ``"45s"`` ``"7d"`` ``"1w"``  ago-from-now
+      - ``"2026-07-29T10:00:00"`` (any ``datetime.fromisoformat`` string)
+    """
+    if not value:
+        return None
+    ts = now if now is not None else datetime.now().timestamp()
+    m = _REL.match(value)
+    if m:
+        return ts - int(m.group(1)) * _UNITS[m.group(2)]
+    try:
+        f = float(value)
+    except ValueError:
+        f = None
+    if f is not None:
+        if not math.isfinite(f):
+            raise ValueError(f"non-finite time {value!r}")
+        return f
+    try:
+        return datetime.fromisoformat(value).timestamp()
+    except ValueError:
+        raise ValueError(
+            f"cannot parse time {value!r}; use epoch seconds, a relative"
+            " window like 2h/30m/7d, or an ISO timestamp"
+        ) from None
