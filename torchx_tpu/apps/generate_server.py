@@ -87,6 +87,7 @@ class GenerateService:
             self.params = quantize_params(self.params)
         self.int8 = int8
         self._lock = threading.Lock()
+        self._cache_lock = threading.Lock()  # handlers run concurrently
         self._jit_cache: dict[tuple, Any] = {}
         self.requests = 0
 
@@ -103,22 +104,23 @@ class GenerateService:
         from torchx_tpu.models import generate as gen
 
         key = (max_new_tokens, round(temperature, 3))
-        fn = self._jit_cache.get(key)
-        if fn is None:
-            if len(self._jit_cache) >= self._JIT_CACHE_MAX:
-                self._jit_cache.pop(next(iter(self._jit_cache)))
-            fn = jax.jit(
-                lambda p, b, rng: gen.generate(
-                    p,
-                    b,
-                    self.cfg,
-                    max_new_tokens=max_new_tokens,
-                    temperature=key[1],
-                    rng=rng,
+        with self._cache_lock:
+            fn = self._jit_cache.get(key)
+            if fn is None:
+                if len(self._jit_cache) >= self._JIT_CACHE_MAX:
+                    self._jit_cache.pop(next(iter(self._jit_cache)))
+                fn = jax.jit(
+                    lambda p, b, rng: gen.generate(
+                        p,
+                        b,
+                        self.cfg,
+                        max_new_tokens=max_new_tokens,
+                        temperature=key[1],
+                        rng=rng,
+                    )
                 )
-            )
-            self._jit_cache[key] = fn
-        return fn
+                self._jit_cache[key] = fn
+            return fn
 
     def generate(
         self,

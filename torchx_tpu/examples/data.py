@@ -131,10 +131,13 @@ def device_batches(
             return
         pending = put(first)
         while True:
+            # dispatch batch N+1's host->device copy BEFORE yielding batch
+            # N, so the transfer overlaps the consumer's running step
             nxt = take()  # host batch; None = dataset exhausted
+            nxt_dev = put(nxt) if nxt is not None else None
             yield {"tokens": pending}
-            if nxt is None:
+            if nxt_dev is None:
                 return
-            pending = put(nxt)  # async: overlaps the running step
+            pending = nxt_dev
     finally:
         stop.set()  # generator closed/GC'd: release the producer thread

@@ -150,7 +150,7 @@ def make_train_step(
     )
 
     def step(state: TrainState, batch: dict[str, jnp.ndarray]):
-        loss, grads = jax.value_and_grad(llama.loss_fn)(
+        (loss, aux), grads = jax.value_and_grad(llama.loss_and_aux, has_aux=True)(
             state.params, batch, cfg, mesh
         )
         updates, opt_state = optimizer.update(
@@ -160,6 +160,7 @@ def make_train_step(
         return (
             TrainState(params=params, opt_state=opt_state, step=state.step + 1),
             loss,
+            aux,  # raw MoE balancing aux (router health; 0 for dense)
         )
 
     jitted = jax.jit(step, donate_argnums=(0,))
@@ -254,7 +255,7 @@ def train(
     peak = device_peak_flops() * n_devices
 
     # step 1 (compile + run) = launch-to-first-step
-    state, loss = train_step(state, next_batch())
+    state, loss, aux = train_step(state, next_batch())
     jax.block_until_ready(loss)
     first_step_s = time.monotonic() - _PROCESS_START
     if jax.process_index() == 0:
@@ -277,7 +278,7 @@ def train(
     # a few untimed warmup steps: dispatch pipelining + allocator settling
     warmup_steps = min(3, max(steps - 2, 0))
     for _ in range(warmup_steps):
-        state, loss = train_step(state, next_batch())
+        state, loss, aux = train_step(state, next_batch())
     jax.block_until_ready(loss)
 
     if profile_dir and jax.process_index() == 0:
@@ -291,7 +292,7 @@ def train(
     # device sync every iteration, breaking dispatch pipelining
     global_step = resumed_step + 1 + warmup_steps
     for i in range(timed_steps):
-        state, loss = train_step(state, next_batch())
+        state, loss, aux = train_step(state, next_batch())
         global_step += 1
         step_no = global_step
         if ckpt is not None and global_step % ckpt_every == 0:
@@ -302,11 +303,16 @@ def train(
             tps = tokens_per_step / dt
             mfu = tps * flops_per_token / peak
             if jax.process_index() == 0:
+                moe_note = (
+                    f" router_aux={float(aux):.3f}"
+                    if getattr(cfg, "n_experts", 0)
+                    else ""
+                )
                 print(
                     f"step {step_no} loss={float(loss):.4f}"
                     f" tokens/sec={tps:,.0f}"
                     f" tokens/sec/chip={tps / n_devices:,.0f}"
-                    f" MFU={mfu:.1%}",
+                    f" MFU={mfu:.1%}{moe_note}",
                     flush=True,
                 )
     jax.block_until_ready(state.params)

@@ -405,6 +405,18 @@ def loss_fn(
     cfg: LlamaConfig,
     mesh: Optional[Mesh] = None,
 ) -> jnp.ndarray:
+    return loss_and_aux(params, batch, cfg, mesh)[0]
+
+
+def loss_and_aux(
+    params: Params,
+    batch: dict[str, jnp.ndarray],
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (total loss, raw MoE balancing aux) — the aux (pre-coefficient)
+    surfaces in trainer logs as the router-health signal (≈1 when experts
+    are balanced, grows as routing collapses; 0 for dense models)."""
     tokens = batch["tokens"]
     x, aux = forward_features(params, tokens[:, :-1], cfg, mesh)
     aux_term = getattr(cfg, "router_aux_coef", 0.0) * aux
@@ -429,7 +441,7 @@ def loss_fn(
 
         if m is None:
             total, _ = jax.lax.scan(jax.checkpoint(body), jnp.float32(0), (xs, ts))
-            return total / (b * s) + aux_term
+            return total / (b * s) + aux_term, aux
         ms = m.reshape(b, n, chunk).swapaxes(0, 1)
 
         def body_masked(acc, xt):  # noqa: ANN001
@@ -439,9 +451,9 @@ def loss_fn(
         total, _ = jax.lax.scan(
             jax.checkpoint(body_masked), jnp.float32(0), (xs, ts, ms)
         )
-        return total / jnp.maximum(m.sum(), 1.0) + aux_term
+        return total / jnp.maximum(m.sum(), 1.0) + aux_term, aux
 
     nll = _token_nll(x, head, targets, mesh)
     if m is not None:
-        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0) + aux_term
-    return nll.mean() + aux_term
+        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0) + aux_term, aux
+    return nll.mean() + aux_term, aux

@@ -285,7 +285,17 @@ class Runner:
     ) -> Iterable[str]:
         scheduler, _, app_id = parse_app_handle(app_handle)
         with log_event("log_lines", scheduler, app_id, session=self._name):
-            return self._scheduler(scheduler).log_iter(
+            sched = self._scheduler(scheduler)
+            if (since or until) and not getattr(
+                sched, "supports_log_windows", False
+            ):
+                logger.warning(
+                    "the %s scheduler does not apply --since/--until"
+                    " windows (its log files carry no per-line"
+                    " timestamps); showing the full log",
+                    scheduler,
+                )
+            return sched.log_iter(
                 app_id,
                 role_name,
                 k,

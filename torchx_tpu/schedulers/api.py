@@ -152,6 +152,12 @@ class Scheduler(ABC, Generic[T]):
             f"{self.backend} scheduler does not support app deletion"
         )
 
+    # True when this backend's log_iter actually applies since/until
+    # windows (docker: daemon-side; tpu_vm: stamped log lines). Backends
+    # whose log files carry no per-line timestamps leave it False and the
+    # Runner warns rather than silently showing an unwindowed log.
+    supports_log_windows: bool = False
+
     def log_iter(
         self,
         app_id: str,

@@ -97,6 +97,7 @@ class DockerJob:
 
 
 class DockerScheduler(DockerWorkspaceMixin, Scheduler[DockerJob]):
+    supports_log_windows = True  # docker daemon applies since/until
     def __init__(
         self,
         session_name: str,

@@ -173,6 +173,7 @@ echo $? > /tmp/tpx/exitcode
 
 
 class TpuVmScheduler(Scheduler[TpuVmRequest]):
+    supports_log_windows = True  # stamped remote log lines
     def __init__(self, session_name: str) -> None:
         super().__init__("tpu_vm", session_name)
 

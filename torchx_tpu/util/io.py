@@ -12,6 +12,9 @@ import shutil
 
 def copy_path(src: str, dst: str) -> None:
     """Copy a file (any fsspec URL) or a local directory tree."""
+    if os.path.isdir(src):  # local tree: byte-stream open would fail
+        shutil.copytree(src, dst, dirs_exist_ok=True)
+        return
     try:
         import fsspec
 
@@ -20,11 +23,8 @@ def copy_path(src: str, dst: str) -> None:
         return
     except ImportError:
         pass
-    if os.path.isdir(src):
-        shutil.copytree(src, dst, dirs_exist_ok=True)
-    else:
-        os.makedirs(os.path.dirname(os.path.abspath(dst)) or ".", exist_ok=True)
-        shutil.copyfile(src, dst)
+    os.makedirs(os.path.dirname(os.path.abspath(dst)) or ".", exist_ok=True)
+    shutil.copyfile(src, dst)
 
 
 def read_text(path: str) -> str:
