@@ -232,6 +232,31 @@ class TestJobSetMaterialization:
         long = sanitize_name("x" * 100)
         assert len(long) <= 53
 
+    def test_pod_names_fit_63_chars_multislice(self):
+        """JobSet pod names are {jobset}-{job}-{jobIndex}-{podIndex}; with
+        worst-case app AND role names plus multi-slice double-digit
+        suffixes, every derived pod name must fit the k8s 63-char limit."""
+        role = tpu_role(num_replicas=12)  # 2-digit job index
+        role.name = "a-very-long-role-name-that-will-be-truncated-somewhere"
+        # the scheduler budgets app names to 40 chars (gke_scheduler.py
+        # _submit_dryrun) so role + index suffixes fit the 63 cap
+        app_name = sanitize_name("overlong-app-name-" + "y" * 80, max_len=40)
+        js = app_to_jobset(
+            AppDef(name="a", roles=[role]),
+            app_name=app_name,
+            namespace="default",
+            queue=None,
+            service_account=None,
+        )
+        (rj,) = js["spec"]["replicatedJobs"]
+        hosts = rj["template"]["spec"]["completions"]
+        worst = f"{app_name}-{rj['name']}-{role.num_replicas - 1}-{hosts - 1}"
+        assert len(worst) <= 63, worst
+        # the coordinator DNS name derives from the same parts
+        container = rj["template"]["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["TPX_COORDINATOR_HOST"].startswith(f"{app_name}-")
+
 
 class TestGKESchedulerDryrun:
     def test_submit_dryrun(self):

@@ -340,8 +340,23 @@ def app_to_jobset(
     replicated_jobs = []
     max_retries = max((r.max_retries for r in app.roles), default=0)
 
+    # Pod names are {jobset}-{replicatedJob}-{jobIndex}-{podIndex}, capped
+    # at 63 chars by k8s — budget each role's sanitized name against the
+    # app name AND its index suffixes, and compute it ONCE (sanitize_name
+    # appends a random suffix when truncating, so repeated calls would
+    # yield different names and break the coordinator DNS derivation).
+    role_names: dict[str, str] = {}
     for role in app.roles:
-        role_name = sanitize_name(role.name)
+        r_tpu = role.resource.tpu
+        r_hosts = r_tpu.hosts if r_tpu else 1
+        n_jobs = role.num_replicas if r_tpu else 1
+        n_pods = r_hosts if r_tpu else role.num_replicas
+        suffix = len(str(max(n_jobs, 1) - 1)) + len(str(max(n_pods, 1) - 1)) + 3
+        budget = max(63 - len(app_name) - suffix, 8)
+        role_names[role.name] = sanitize_name(role.name, max_len=min(53, budget))
+
+    for role in app.roles:
+        role_name = role_names[role.name]
         tpu = role.resource.tpu
         hosts = tpu.hosts if tpu else 1
         # For TPU roles: one Job per slice (replicas=num_replicas), each an
@@ -353,7 +368,7 @@ def app_to_jobset(
             job_replicas, completions = 1, role.num_replicas
 
         # JobSet DNS: {jobset}-{replicatedJob}-{jobIndex}-{podIndex}.{jobset}
-        role0 = sanitize_name(app.roles[0].name)
+        role0 = role_names[app.roles[0].name]
         coordinator_host = f"{app_name}-{role0}-0-0.{app_name}"
 
         multislice = bool(tpu) and role.num_replicas > 1
@@ -512,7 +527,9 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
     ) -> AppDryRunInfo[GKEJob]:
         opts = GKEOpts.from_cfg(cfg)
         namespace = opts.namespace or "default"  # '' from `-cfg namespace=`
-        app_name = sanitize_name(make_unique(app.name))
+        # 40-char app budget leaves room in the 63-char pod-name cap for a
+        # >=8-char role name plus multi-digit job/pod index suffixes
+        app_name = sanitize_name(make_unique(app.name), max_len=40)
         images_to_push = self.dryrun_push_images(app, cfg)
         resource = app_to_jobset(
             app,
