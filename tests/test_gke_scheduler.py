@@ -231,6 +231,11 @@ class TestJobSetMaterialization:
         assert sanitize_name("My Job!") == "my-job"
         long = sanitize_name("x" * 100)
         assert len(long) <= 53
+        # truncation must be deterministic: selectors, container names, and
+        # coordinator DNS all re-derive the same string
+        assert sanitize_name("x" * 100) == long
+        # ...and distinct long names must not collide after truncation
+        assert sanitize_name("x" * 99) != long
 
     def test_pod_names_fit_63_chars_multislice(self):
         """JobSet pod names are {jobset}-{job}-{jobIndex}-{podIndex}; with
@@ -256,6 +261,22 @@ class TestJobSetMaterialization:
         container = rj["template"]["spec"]["template"]["spec"]["containers"][0]
         env = {e["name"]: e.get("value") for e in container["env"]}
         assert env["TPX_COORDINATOR_HOST"].startswith(f"{app_name}-")
+        # the pod label keeps the UN-truncated role name so log/describe can
+        # find pods without re-deriving the budgeted replicatedJob name
+        labels = rj["template"]["spec"]["template"]["metadata"]["labels"]
+        assert labels["tpx.sh/role-name"] == role.name
+        assert len(rj["name"]) < len(role.name)  # rj name was budgeted
+
+    def test_app_name_over_budget_raises(self):
+        role = tpu_role()
+        with pytest.raises(ValueError, match="63-char"):
+            app_to_jobset(
+                AppDef(name="a", roles=[role]),
+                app_name="z" * 60,  # leaves < 8 chars for the role
+                namespace="default",
+                queue=None,
+                service_account=None,
+            )
 
 
 class TestGKESchedulerDryrun:
@@ -316,11 +337,13 @@ class TestGKELogPodResolution:
         core.list_namespaced_pod.return_value = pods
         with mock.patch.object(sched, "_core_api", return_value=core):
             assert sched._resolve_pod_name("ns", "app", "tr", 0) == "app-tr-0-0-abc"
+            # selects by the tpx role label, NOT the replicatedJob name: the
+            # rj name may carry a budget-truncation suffix that cannot be
+            # recomputed from the role name alone
             core.list_namespaced_pod.assert_called_with(
                 namespace="ns",
                 label_selector=(
-                    "jobset.sigs.k8s.io/jobset-name=app,"
-                    "jobset.sigs.k8s.io/replicatedjob-name=tr"
+                    "jobset.sigs.k8s.io/jobset-name=app,tpx.sh/role-name=tr"
                 ),
             )
             assert sched._resolve_pod_name("ns", "app", "tr", 2) == "app-tr-1-0-def"

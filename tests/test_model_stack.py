@@ -93,19 +93,50 @@ class TestOps:
         np.testing.assert_allclose(out[0, 4, 0], v[0, 4, 0], rtol=1e-5)
 
 
-class TestAttentionBlockSanitize:
-    def test_pallas_block_sanitizer(self):
-        # mirror of pallas_attention's sanitize(): divide-seq + lane rules
-        def sanitize(requested, seq):
-            b = (min(requested, seq) // 128) * 128
-            while b >= 128 and seq % b:
-                b -= 128
-            return b if b >= 128 else 0
+class TestSplashAttention:
+    def test_matches_reference_fwd(self):
+        # pallas interpreter on CPU: GQA shapes (4 q-heads over 2 kv)
+        from torchx_tpu.ops.attention import splash_attention
 
-        assert sanitize(256, 2048) == 256
-        assert sanitize(256, 1920) == 128  # must divide seq
-        assert sanitize(192, 2048) == 128  # lane multiple
-        assert sanitize(64, 2048) == 0  # below minimum -> kernel defaults
+        b, s, h, kvh, d = 1, 256, 4, 2, 64
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d), jnp.float32)
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, s, kvh, d), jnp.float32)
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, s, kvh, d), jnp.float32)
+        ref = xla_attention(q, k, v, causal=True)
+        out = splash_attention(q, k, v, causal=True, interpret=True)
+        np.testing.assert_allclose(out, ref, rtol=5e-3, atol=5e-3)
+
+    def test_segment_ids(self):
+        from torchx_tpu.ops.attention import splash_attention
+
+        b, s, h, d = 1, 256, 2, 64
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d), jnp.float32)
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h, d), jnp.float32)
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d), jnp.float32)
+        seg = jnp.concatenate(
+            [jnp.zeros((b, s // 2), jnp.int32), jnp.ones((b, s // 2), jnp.int32)],
+            axis=1,
+        )
+        ref = xla_attention(q, k, v, causal=True, segment_ids=seg)
+        out = splash_attention(
+            q, k, v, causal=True, segment_ids=seg, interpret=True
+        )
+        np.testing.assert_allclose(out, ref, rtol=5e-3, atol=5e-3)
+
+
+class TestAttentionBlockSanitize:
+    def test_fit_block(self):
+        # shared by the pallas and splash paths: divide-seq + lane rules
+        from torchx_tpu.ops.attention import _fit_block
+
+        assert _fit_block(256, 2048) == 256
+        assert _fit_block(256, 1920) == 128  # must divide seq
+        assert _fit_block(192, 2048) == 128  # lane multiple
+        assert _fit_block(64, 2048) == 128  # clamped up to the lane minimum
+        assert _fit_block(1024, 1536) == 768  # largest divisor <= requested
+        assert _fit_block(512, 640) == 128
+        assert _fit_block(256, 320) == 0  # seq not a multiple of 128
+        assert _fit_block(128, 64) == 0  # seq below one lane tile
 
 
 class TestRingAttention:

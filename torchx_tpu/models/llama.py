@@ -58,7 +58,7 @@ class LlamaConfig:
     # remat policy: "full" recomputes everything (min memory);
     # "dots" saves matmul outputs (fewer recomputes, more memory)
     remat_policy: str = "full"
-    attn_impl: str = "auto"  # auto | xla | pallas
+    attn_impl: str = "auto"  # auto | xla | pallas | splash
     # flash-attention tile sizes (0 = kernel defaults); tune for head_dim
     # (profiling: defaults underfill the MXU at head_dim 64 — see
     # docs/performance.md)
@@ -112,7 +112,13 @@ def llama3_8b(**overrides: Any) -> LlamaConfig:
 
 
 def llama3_1b(**overrides: Any) -> LlamaConfig:
-    """Llama-3.2-1B shape (tied embeddings)."""
+    """Llama-3.2-1B shape (tied embeddings).
+
+    attn_block_q/kv defaults come from the hardware sweep
+    (``scripts/tune_attention_blocks.py`` on v5e-1, seq 2048: 39.6% MFU
+    vs 23.9% at kernel-default 128 tiles — head_dim 64 underfills the
+    MXU, larger kv tiles amortize it; full table in docs/performance.md).
+    """
     defaults = dict(
         dim=2048,
         n_layers=16,
@@ -120,6 +126,8 @@ def llama3_1b(**overrides: Any) -> LlamaConfig:
         n_kv_heads=8,
         ffn_dim=8192,
         tie_embeddings=True,
+        attn_block_q=256,
+        attn_block_kv=512,
     )
     defaults.update(overrides)
     return LlamaConfig(**defaults)

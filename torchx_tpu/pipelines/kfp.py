@@ -39,7 +39,10 @@ def _stage_template(name: str, app: AppDef, namespace: str) -> dict[str, Any]:
     if multi_host:
         jobset = app_to_jobset(
             app,
-            app_name=sanitize_name(f"{name}-{app.name}"),
+            # same 40-char budget as GKEScheduler._submit_dryrun: leaves
+            # room in the 63-char pod-name cap for the role name plus
+            # job/pod index suffixes
+            app_name=sanitize_name(f"{name}-{app.name}", max_len=40),
             namespace=namespace,
             queue=None,
             service_account=None,

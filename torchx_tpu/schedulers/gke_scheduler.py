@@ -31,6 +31,7 @@ materialization is plain dicts, so dryrun tests run with no cluster
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 from dataclasses import dataclass, field
@@ -44,7 +45,7 @@ from torchx_tpu.schedulers.api import (
     Stream,
     filter_regex,
 )
-from torchx_tpu.schedulers.ids import cleanup, make_unique, random_id
+from torchx_tpu.schedulers.ids import cleanup, make_unique
 from torchx_tpu.schedulers.structured_opts import StructuredOpts
 from torchx_tpu.specs.api import (
     AppDef,
@@ -146,10 +147,16 @@ class GKEJob:
 def sanitize_name(name: str, max_len: int = 53) -> str:
     """DNS-1123 subdomain, shortened to leave room for JobSet suffixes
     (jobset adds -{job}-{index}-{podindex}; the 63-char pod-name check the
-    reference does at :862-889 is enforced here by budgeting upfront)."""
+    reference does at :862-889 is enforced here by budgeting upfront).
+
+    Truncation appends a suffix derived from a *hash* of the full name so
+    repeated calls agree — pod-name selectors, container names, and the
+    coordinator DNS derivation must all resolve to the same string.
+    """
     name = cleanup(name)
     if len(name) > max_len:
-        name = name[: max_len - 6].rstrip("-") + "-" + random_id(5)
+        digest = hashlib.sha1(name.encode()).hexdigest()[:5]
+        name = name[: max_len - 6].rstrip("-") + "-" + digest
     return name
 
 
@@ -321,7 +328,12 @@ def role_to_pod_template(
         "metadata": {
             "labels": {
                 LABEL_APP_NAME: app_name,
-                LABEL_ROLE_NAME: sanitize_name(role.name),
+                # the UN-truncated cleaned role name: pod-name selectors and
+                # describe() key off this label, so it must be derivable from
+                # role.name alone (the replicatedJob name may carry a
+                # budget-truncation suffix that cannot be recomputed without
+                # the whole AppDef)
+                LABEL_ROLE_NAME: cleanup(role.name)[:63],
             },
         },
         "spec": spec,
@@ -352,7 +364,17 @@ def app_to_jobset(
         n_jobs = role.num_replicas if r_tpu else 1
         n_pods = r_hosts if r_tpu else role.num_replicas
         suffix = len(str(max(n_jobs, 1) - 1)) + len(str(max(n_pods, 1) - 1)) + 3
-        budget = max(63 - len(app_name) - suffix, 8)
+        budget = 63 - len(app_name) - suffix
+        if budget < 8:
+            # both in-tree callers cap app_name at 40 chars, which always
+            # leaves >= 8; a silent floor here would emit pods k8s rejects
+            # at admission (the failure mode the reference checks for at
+            # kubernetes_scheduler.py:862-889)
+            raise ValueError(
+                f"app name {app_name!r} ({len(app_name)} chars) leaves"
+                f" {budget} chars for role {role.name!r} in the 63-char"
+                " pod-name cap; shorten the app name to <= 40 chars"
+            )
         role_names[role.name] = sanitize_name(role.name, max_len=min(53, budget))
 
     for role in app.roles:
@@ -681,11 +703,14 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
         computed — resolve replica ``k`` by listing the jobset's pods for
         the role and ordering by (job index, completion index); across
         multi-slice jobs ``k`` counts hosts globally."""
+        # select by our own role label, not the replicatedJob name: that
+        # name is budget-truncated against the 63-char pod cap inside
+        # app_to_jobset and cannot be recomputed from role_name alone
         pods = self._core_api().list_namespaced_pod(
             namespace=namespace,
             label_selector=(
                 f"jobset.sigs.k8s.io/jobset-name={name},"
-                f"jobset.sigs.k8s.io/replicatedjob-name={sanitize_name(role_name)}"
+                f"{LABEL_ROLE_NAME}={cleanup(role_name)[:63]}"
             ),
         )
         indexed: list[tuple[int, int, str]] = []
@@ -759,6 +784,10 @@ def describe_jobset(
         role = labels.get(LABEL_ROLE_NAME) or labels.get(
             "jobset.sigs.k8s.io/replicatedjob-name", "unknown"
         )
+        # completions are keyed by replicatedJob name in the spec, which can
+        # be a budget-truncated variant of the display role name — look up
+        # via the pod's jobset-controller label, not the display name
+        rj_name = labels.get("jobset.sigs.k8s.io/replicatedjob-name", str(role))
         annotations = meta.get("annotations") or {}
         host_idx = _safe_int(
             annotations.get("batch.kubernetes.io/job-completion-index")
@@ -769,7 +798,7 @@ def describe_jobset(
             labels.get("jobset.sigs.k8s.io/job-index")
             or annotations.get("jobset.sigs.k8s.io/job-index")
         )
-        idx = slice_idx * completions.get(str(role), 1) + host_idx
+        idx = slice_idx * completions.get(rj_name, 1) + host_idx
         phase = ((pod.get("status") or {}).get("phase")) or "Unknown"
         pod_ip = (pod.get("status") or {}).get("pod_ip") or (
             pod.get("status") or {}
