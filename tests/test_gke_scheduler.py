@@ -448,3 +448,172 @@ class TestDescribeJobsetFixtures:
         resp = describe_jobset({}, [])
         assert resp.state == AppState.SUBMITTED
         assert resp.roles_statuses == []
+
+
+# =========================================================================
+# Client lifecycle paths (schedule / describe / cancel / delete / list /
+# log_iter) against an injected fake kubernetes module — the reference
+# pattern of mock-client tests (kubernetes_scheduler_test.py), no cluster
+# =========================================================================
+
+import sys
+import types
+
+
+class _FakeApiException(Exception):
+    def __init__(self, status):
+        self.status = status
+
+
+@pytest.fixture
+def fake_k8s(monkeypatch):
+    """Install a stub `kubernetes` package so the scheduler's deferred
+    `from kubernetes.client.rest import ApiException` resolves."""
+    root = types.ModuleType("kubernetes")
+    client = types.ModuleType("kubernetes.client")
+    rest = types.ModuleType("kubernetes.client.rest")
+    rest.ApiException = _FakeApiException
+    client.rest = rest
+    root.client = client
+    monkeypatch.setitem(sys.modules, "kubernetes", root)
+    monkeypatch.setitem(sys.modules, "kubernetes.client", client)
+    monkeypatch.setitem(sys.modules, "kubernetes.client.rest", rest)
+    return _FakeApiException
+
+
+class TestGKELifecycle:
+    def _sched_with_api(self, monkeypatch, custom=None, core=None):
+        sched = GKEScheduler("t", client=object())
+        if custom is not None:
+            monkeypatch.setattr(sched, "_custom_objects_api", lambda: custom)
+        if core is not None:
+            monkeypatch.setattr(sched, "_core_api", lambda: core)
+        return sched
+
+    def test_schedule_creates_jobset_and_returns_app_id(
+        self, monkeypatch, fake_k8s
+    ):
+        custom = mock.MagicMock()
+        sched = self._sched_with_api(monkeypatch, custom=custom)
+        app = AppDef(name="train", roles=[tpu_role()])
+        info = sched.submit_dryrun(app, {"namespace": "ml"})
+        app_id = sched.schedule(info)
+        ns, name = app_id.split(":")
+        assert ns == "ml" and name.startswith("train-")
+        kwargs = custom.create_namespaced_custom_object.call_args.kwargs
+        assert kwargs["namespace"] == "ml"
+        assert kwargs["plural"] == "jobsets"
+        assert kwargs["body"]["kind"] == "JobSet"
+
+    def test_schedule_conflict_raises_value_error(self, monkeypatch, fake_k8s):
+        custom = mock.MagicMock()
+        custom.create_namespaced_custom_object.side_effect = fake_k8s(409)
+        sched = self._sched_with_api(monkeypatch, custom=custom)
+        info = sched.submit_dryrun(AppDef(name="t", roles=[tpu_role()]), {})
+        with pytest.raises(ValueError, match="already exists"):
+            sched.schedule(info)
+
+    def test_schedule_other_api_errors_propagate(self, monkeypatch, fake_k8s):
+        custom = mock.MagicMock()
+        custom.create_namespaced_custom_object.side_effect = fake_k8s(503)
+        sched = self._sched_with_api(monkeypatch, custom=custom)
+        info = sched.submit_dryrun(AppDef(name="t", roles=[tpu_role()]), {})
+        with pytest.raises(_FakeApiException):
+            sched.schedule(info)
+
+    def test_describe_404_returns_none(self, monkeypatch, fake_k8s):
+        custom = mock.MagicMock()
+        custom.get_namespaced_custom_object.side_effect = fake_k8s(404)
+        sched = self._sched_with_api(monkeypatch, custom=custom)
+        assert sched.describe("ml:gone") is None
+
+    def test_describe_fetches_jobset_and_pods(self, monkeypatch, fake_k8s):
+        custom = mock.MagicMock()
+        custom.get_namespaced_custom_object.return_value = {
+            "status": {
+                "conditions": [{"type": "Completed", "status": "True"}]
+            }
+        }
+        core = mock.MagicMock()
+        core.list_namespaced_pod.return_value.items = []
+        sched = self._sched_with_api(monkeypatch, custom=custom, core=core)
+        resp = sched.describe("ml:app1")
+        assert resp.state == AppState.SUCCEEDED
+        sel = core.list_namespaced_pod.call_args.kwargs["label_selector"]
+        assert sel == "jobset.sigs.k8s.io/jobset-name=app1"
+
+    def test_describe_pod_listing_is_best_effort(self, monkeypatch, fake_k8s):
+        custom = mock.MagicMock()
+        custom.get_namespaced_custom_object.return_value = {"status": {}}
+        core = mock.MagicMock()
+        core.list_namespaced_pod.side_effect = RuntimeError("rbac denied")
+        sched = self._sched_with_api(monkeypatch, custom=custom, core=core)
+        assert sched.describe("ml:app1") is not None  # pods degrade to []
+
+    def test_cancel_suspends_preserving_spec(self, monkeypatch, fake_k8s):
+        custom = mock.MagicMock()
+        # cancel() checks liveness via describe first
+        custom.get_namespaced_custom_object.return_value = {
+            "status": {"replicatedJobsStatus": [{"name": "r"}]}
+        }
+        core = mock.MagicMock()
+        core.list_namespaced_pod.return_value.items = []
+        sched = self._sched_with_api(monkeypatch, custom=custom, core=core)
+        sched.cancel("ml:app1")
+        kwargs = custom.patch_namespaced_custom_object.call_args.kwargs
+        assert kwargs["body"] == {"spec": {"suspend": True}}
+        custom.delete_namespaced_custom_object.assert_not_called()
+
+    def test_delete_tolerates_404(self, monkeypatch, fake_k8s):
+        custom = mock.MagicMock()
+        custom.delete_namespaced_custom_object.side_effect = fake_k8s(404)
+        sched = self._sched_with_api(monkeypatch, custom=custom)
+        sched.delete("ml:gone")  # no raise
+
+    def test_delete_other_errors_propagate(self, monkeypatch, fake_k8s):
+        custom = mock.MagicMock()
+        custom.delete_namespaced_custom_object.side_effect = fake_k8s(500)
+        sched = self._sched_with_api(monkeypatch, custom=custom)
+        with pytest.raises(_FakeApiException):
+            sched.delete("ml:app")
+
+    def test_list_cluster_jobsets(self, monkeypatch, fake_k8s):
+        custom = mock.MagicMock()
+        custom.list_cluster_custom_object.return_value = {
+            "items": [
+                {
+                    "metadata": {"namespace": "ml", "name": "a"},
+                    "status": {"replicatedJobsStatus": [{}]},
+                },
+                {
+                    "metadata": {"namespace": "dev", "name": "b"},
+                    "spec": {"suspend": True},
+                },
+            ]
+        }
+        sched = self._sched_with_api(monkeypatch, custom=custom)
+        apps = sched.list()
+        assert [(a.app_id, a.state) for a in apps] == [
+            ("ml:a", AppState.RUNNING),
+            ("dev:b", AppState.PENDING),
+        ]
+
+    def test_log_iter_streams_pod_log(self, monkeypatch, fake_k8s):
+        core = mock.MagicMock()
+        pod = mock.MagicMock()
+        pod.metadata.name = "app1-w-0-0-xyz"
+        pod.metadata.labels = {}
+        pod.metadata.annotations = {}
+        core.list_namespaced_pod.return_value.items = [pod]
+        core.read_namespaced_pod_log.return_value = [b"l1\n", b"l2 match\n"]
+        sched = self._sched_with_api(monkeypatch, core=core)
+        lines = list(sched.log_iter("ml:app1", "w", 0, regex="match"))
+        assert lines == ["l2 match"]
+        kwargs = core.read_namespaced_pod_log.call_args.kwargs
+        assert kwargs["name"] == "app1-w-0-0-xyz"
+        assert kwargs["follow"] is False
+
+    def test_invalid_app_id(self, monkeypatch, fake_k8s):
+        sched = GKEScheduler("t", client=object())
+        with pytest.raises(ValueError, match="expected namespace:name"):
+            sched.describe("no-colon-here")

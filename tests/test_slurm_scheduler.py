@@ -297,3 +297,153 @@ class TestSacctFormatVariants:
 
         monkeypatch.setattr(sched, "_run_cmd", run_cmd)
         assert sched.describe("777") is None
+
+    def test_multi_role_rows_grouped_and_worst_state_wins(self, sched, monkeypatch):
+        """sacct rows for a two-role hetjob (trainer-0/1, tb-0): replicas
+        group under their role and one FAILED row fails the app even when
+        later rows completed."""
+        sacct_out = (
+            "JobID|JobName|State\n"
+            "900+0|trainer-0|FAILED\n"
+            "900+1|trainer-1|COMPLETED\n"
+            "900+2|tb-0|COMPLETED\n"
+        )
+
+        def run_cmd(cmd, **kw):
+            if cmd[0] == "squeue":
+                return completed(rc=1)
+            return completed(stdout=sacct_out)
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        resp = sched.describe("900")
+        assert resp.state == AppState.FAILED
+        roles = {r.role: r for r in resp.roles_statuses}
+        assert set(roles) == {"trainer", "tb"}
+        assert len(roles["trainer"].replicas) == 2
+        assert len(roles["tb"].replicas) == 1
+
+    def test_preempted_and_timeout_map_to_failed(self):
+        # requeue-able terminal states must read as failures (retry machinery
+        # keys off FAILED), not unknowns
+        assert slurm_state("PREEMPTED") == AppState.FAILED
+        assert slurm_state("TIMEOUT") == AppState.FAILED
+        assert slurm_state("COMPLETING") == AppState.RUNNING
+        assert slurm_state("REQUEUED") == AppState.PENDING
+        assert slurm_state("CANCELLED+") == AppState.CANCELLED  # federation '+'
+        assert slurm_state("") == AppState.UNKNOWN
+
+
+class TestSlurmList:
+    def test_list_me(self, sched, monkeypatch):
+        payload = {
+            "jobs": [
+                {"job_id": 11, "name": "a-x1", "job_state": ["RUNNING"]},
+                {"job_id": 12, "name": "b-x2", "job_state": "PENDING"},
+            ]
+        }
+        calls = []
+
+        def run_cmd(cmd, **kw):
+            calls.append(cmd)
+            return completed(stdout=json.dumps(payload))
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        apps = sched.list()
+        assert [a.app_id for a in apps] == ["11", "12"]
+        assert apps[0].state == AppState.RUNNING
+        assert apps[1].state == AppState.PENDING
+        assert ["squeue", "--json", "--me"] in calls
+
+    def test_list_squeue_failure_raises(self, sched, monkeypatch):
+        monkeypatch.setattr(
+            sched, "_run_cmd", lambda cmd, **kw: completed(rc=1, stderr="down")
+        )
+        with pytest.raises(RuntimeError, match="squeue failed"):
+            sched.list()
+
+
+class TestSlurmLogIter:
+    @pytest.fixture
+    def job_dir(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(
+            "torchx_tpu.schedulers.slurm_scheduler._registry_path",
+            lambda: str(tmp_path / "jobdirs"),
+        )
+        (tmp_path / "jobdirs").write_text(f"55 = {tmp_path}\n")
+        return tmp_path
+
+    def test_stderr_stream(self, sched, job_dir):
+        from torchx_tpu.schedulers.api import Stream
+
+        (job_dir / "slurm-55-trainer-0.err").write_text("E1\nE2\n")
+        lines = list(sched.log_iter("55", "trainer", 0, streams=Stream.STDERR))
+        assert lines == ["E1", "E2"]
+
+    def test_non_het_fallback_filename(self, sched, job_dir):
+        # single-replica jobs write slurm-{id}.out without role/replica parts
+        (job_dir / "slurm-55.out").write_text("solo\n")
+        assert list(sched.log_iter("55", "trainer", 0)) == ["solo"]
+
+    def test_regex_filter(self, sched, job_dir):
+        (job_dir / "slurm-55-trainer-0.out").write_text("keep 1\ndrop\nkeep 2\n")
+        assert list(sched.log_iter("55", "trainer", 0, regex="keep")) == [
+            "keep 1",
+            "keep 2",
+        ]
+
+    def test_unknown_job_dir_raises(self, sched, job_dir):
+        with pytest.raises(RuntimeError, match="no job dir recorded"):
+            sched.log_iter("66", "trainer", 0)
+
+    def test_missing_file_yields_nothing(self, sched, job_dir):
+        assert list(sched.log_iter("55", "trainer", 3)) == []
+
+
+class TestSqueueNodeFormats:
+    """_squeue_job_nodes across the format generations the parsers must
+    survive (reference parses 3 SLURM JSON formats, :661-810)."""
+
+    def test_object_with_list(self):
+        from torchx_tpu.schedulers.slurm_scheduler import _squeue_job_nodes
+
+        job = {"job_resources": {"nodes": {"count": 2, "list": ["n1", "n2"]}}}
+        assert _squeue_job_nodes(job) == "n1,n2"
+
+    def test_object_with_nodes_string(self):
+        from torchx_tpu.schedulers.slurm_scheduler import _squeue_job_nodes
+
+        job = {"job_resources": {"nodes": {"nodes": "n[01-04]"}}}
+        assert _squeue_job_nodes(job) == "n[01-04]"
+
+    def test_allocated_nodes_dicts(self):
+        from torchx_tpu.schedulers.slurm_scheduler import _squeue_job_nodes
+
+        job = {
+            "job_resources": {
+                "allocated_nodes": [{"nodename": "a"}, {"nodename": "b"}]
+            }
+        }
+        assert _squeue_job_nodes(job) == "a,b"
+
+    def test_null_and_garbage(self):
+        from torchx_tpu.schedulers.slurm_scheduler import _squeue_job_nodes
+
+        assert _squeue_job_nodes({}) == ""
+        assert _squeue_job_nodes({"job_resources": None}) == ""
+        assert _squeue_job_nodes({"job_resources": "weird"}) == ""
+
+
+class TestCancelFailure:
+    def test_scancel_error_raises(self, sched, monkeypatch):
+        def run_cmd(cmd, **kw):
+            if cmd[0] == "squeue":
+                return completed(
+                    stdout=json.dumps(
+                        {"jobs": [{"job_id": 1, "name": "x", "job_state": "RUNNING"}]}
+                    )
+                )
+            return completed(rc=1, stderr="Access denied")
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        with pytest.raises(RuntimeError, match="scancel failed"):
+            sched.cancel("1")

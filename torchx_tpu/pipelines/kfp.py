@@ -80,8 +80,8 @@ def pipeline_to_workflow(
 ) -> dict[str, Any]:
     """-> Argo Workflow resource dict implementing the DAG."""
     topo_order(pipeline)  # validates names/cycles
-    # sanitize each stage name exactly once: sanitize_name randomizes long
-    # names, so repeated calls would break template/task/dependency refs
+    # sanitize each stage name once and reuse the result so template/task/
+    # dependency refs all carry the identical string
     names = {s.name: sanitize_name(s.name) for s in pipeline.stages}
     templates = [
         _stage_template(names[s.name], s.app, namespace) for s in pipeline.stages

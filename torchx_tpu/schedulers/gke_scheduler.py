@@ -46,6 +46,7 @@ from torchx_tpu.schedulers.api import (
     filter_regex,
 )
 from torchx_tpu.schedulers.ids import cleanup, make_unique
+from torchx_tpu.util.strings import normalize_str
 from torchx_tpu.schedulers.structured_opts import StructuredOpts
 from torchx_tpu.specs.api import (
     AppDef,
@@ -333,7 +334,7 @@ def role_to_pod_template(
                 # role.name alone (the replicatedJob name may carry a
                 # budget-truncation suffix that cannot be recomputed without
                 # the whole AppDef)
-                LABEL_ROLE_NAME: cleanup(role.name)[:63],
+                LABEL_ROLE_NAME: normalize_str(cleanup(role.name)),
             },
         },
         "spec": spec,
@@ -354,9 +355,8 @@ def app_to_jobset(
 
     # Pod names are {jobset}-{replicatedJob}-{jobIndex}-{podIndex}, capped
     # at 63 chars by k8s — budget each role's sanitized name against the
-    # app name AND its index suffixes, and compute it ONCE (sanitize_name
-    # appends a random suffix when truncating, so repeated calls would
-    # yield different names and break the coordinator DNS derivation).
+    # app name AND its index suffixes, and compute it ONCE so every
+    # consumer (rj name, coordinator DNS) sees the same budgeted string.
     role_names: dict[str, str] = {}
     for role in app.roles:
         r_tpu = role.resource.tpu
@@ -710,7 +710,7 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
             namespace=namespace,
             label_selector=(
                 f"jobset.sigs.k8s.io/jobset-name={name},"
-                f"{LABEL_ROLE_NAME}={cleanup(role_name)[:63]}"
+                f"{LABEL_ROLE_NAME}={normalize_str(cleanup(role_name))}"
             ),
         )
         indexed: list[tuple[int, int, str]] = []
