@@ -111,7 +111,10 @@ def main() -> None:
     if on_tpu:
         seq, steps = 2048, 20
         # (remat_policy, batch) in preference order; measured on v5e-1:
-        # dots@2 ~25% MFU beats full@4/8 ~24% (see docs/performance.md)
+        # dots@2 with the splash kernel + 512/512 tiles (the llama3_1b
+        # defaults) hits ~47% MFU; larger batches crash the remote-compile
+        # helper on this tunnel and OOM-risk elsewhere, so they trail
+        # (see docs/performance.md)
         candidates = [("dots", 2), ("full", 8), ("full", 4), ("full", 2), ("full", 1)]
         base_cfg = llama.llama3_1b
     else:

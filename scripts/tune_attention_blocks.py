@@ -31,6 +31,12 @@ def main() -> None:
         help="comma list of candidate block sizes (0 = kernel default)",
     )
     parser.add_argument("--remat-policy", default="dots")
+    parser.add_argument(
+        "--impl",
+        default="splash",
+        choices=["pallas", "splash"],
+        help="attention kernel to sweep (splash won the v5e sweep)",
+    )
     args = parser.parse_args()
 
     from torchx_tpu.examples.train_llama import all_configs, train
@@ -42,7 +48,7 @@ def main() -> None:
     for bq, bkv in itertools.product(candidates, candidates):
         cfg = all_configs()[args.config](
             remat_policy=args.remat_policy,
-            attn_impl="pallas",
+            attn_impl=args.impl,
             attn_block_q=bq,
             attn_block_kv=bkv,
         )

@@ -617,3 +617,136 @@ class TestGKELifecycle:
         sched = GKEScheduler("t", client=object())
         with pytest.raises(ValueError, match="expected namespace:name"):
             sched.describe("no-colon-here")
+
+
+# =========================================================================
+# Resize (Kueue-driven shrink-to-fit / manual gang resize)
+# =========================================================================
+
+from torchx_tpu.schedulers.gke_scheduler import resize_jobset
+
+
+class TestResizeJobset:
+    def _multislice_jobset(self, **role_kwargs):
+        role_kwargs.setdefault("num_replicas", 4)
+        return make_jobset(
+            AppDef(name="a", roles=[tpu_role(**role_kwargs)])
+        )
+
+    def test_tpu_shrink_rewrites_world(self):
+        js = self._multislice_jobset(min_replicas=2)
+        body = resize_jobset(js, "trainer", 2)
+        (rj,) = body["spec"]["replicatedJobs"]
+        assert rj["replicas"] == 2
+        hosts = rj["template"]["spec"]["completions"]
+        container = rj["template"]["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["TPX_NUM_REPLICAS"] == str(hosts * 2)
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        # the floor wiring is untouched
+        assert env["TPX_MIN_REPLICAS"] == "2"
+
+    def test_floor_enforced(self):
+        js = self._multislice_jobset(min_replicas=2)
+        with pytest.raises(ValueError, match="below its declared min_replicas"):
+            resize_jobset(js, "trainer", 1)
+
+    def test_single_slice_growth_rejected(self):
+        # a single-slice pod template has no slice-id fieldRef wiring, so a
+        # grown gang could not derive global replica ids
+        js = make_jobset(AppDef(name="a", roles=[tpu_role(num_replicas=1)]))
+        with pytest.raises(ValueError, match="only shrink"):
+            resize_jobset(js, "trainer", 3)
+
+    def test_multislice_shrink_to_one(self):
+        js = self._multislice_jobset()
+        body = resize_jobset(js, "trainer", 1)
+        (rj,) = body["spec"]["replicatedJobs"]
+        assert rj["replicas"] == 1
+        container = rj["template"]["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        hosts = rj["template"]["spec"]["completions"]
+        assert env["TPX_NUM_REPLICAS"] == str(hosts)
+        assert env["MEGASCALE_NUM_SLICES"] == "1"
+
+    def test_cpu_role_resizes_parallelism(self):
+        role = Role(
+            name="reader",
+            image="img",
+            entrypoint="python",
+            num_replicas=4,
+            min_replicas=1,
+            resource=Resource(cpu=2, memMB=4096),
+        )
+        js = make_jobset(AppDef(name="a", roles=[role]))
+        body = resize_jobset(js, "reader", 2)
+        spec = body["spec"]["replicatedJobs"][0]["template"]["spec"]
+        assert spec["parallelism"] == 2 and spec["completions"] == 2
+        container = spec["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["TPX_NUM_REPLICAS"] == "2"
+
+    def test_unknown_role_raises(self):
+        js = self._multislice_jobset()
+        with pytest.raises(ValueError, match="not found in jobset"):
+            resize_jobset(js, "ghost", 2)
+
+    def test_server_fields_stripped_and_kueue_resuspended(self):
+        js = make_jobset(
+            AppDef(name="a", roles=[tpu_role(num_replicas=2)]),
+            queue="tpu-queue",
+        )
+        # simulate a live object: server-managed fields + running state
+        js["metadata"]["resourceVersion"] = "123"
+        js["metadata"]["uid"] = "abc"
+        js["status"] = {"conditions": []}
+        js["spec"]["suspend"] = False  # Kueue admitted it
+        body = resize_jobset(js, "trainer", 1)
+        assert "status" not in body
+        assert "resourceVersion" not in body["metadata"]
+        assert "uid" not in body["metadata"]
+        # goes back suspended so Kueue re-admits the resized gang
+        assert body["spec"]["suspend"] is True
+        # the original fetched object is untouched (deep copy)
+        assert js["spec"]["replicatedJobs"][0]["replicas"] == 2
+
+
+class TestResizeLifecycle:
+    def test_resize_replaces_jobset(self, monkeypatch, fake_k8s):
+        js = make_jobset(
+            AppDef(name="a", roles=[tpu_role(num_replicas=4, min_replicas=1)]),
+            namespace="ml",
+        )
+        js["metadata"]["resourceVersion"] = "9"
+        custom = mock.MagicMock()
+        # get: live jobset, then 404 after deletion
+        custom.get_namespaced_custom_object.side_effect = [js, fake_k8s(404)]
+        sched = GKEScheduler("t", client=object())
+        sched.resize_poll_interval = 0
+        monkeypatch.setattr(sched, "_custom_objects_api", lambda: custom)
+        sched.resize("ml:app-x", "trainer", 2)
+        custom.delete_namespaced_custom_object.assert_called_once()
+        body = custom.create_namespaced_custom_object.call_args.kwargs["body"]
+        assert body["spec"]["replicatedJobs"][0]["replicas"] == 2
+        assert "resourceVersion" not in body["metadata"]
+
+    def test_resize_missing_app_raises(self, monkeypatch, fake_k8s):
+        custom = mock.MagicMock()
+        custom.get_namespaced_custom_object.side_effect = fake_k8s(404)
+        sched = GKEScheduler("t", client=object())
+        monkeypatch.setattr(sched, "_custom_objects_api", lambda: custom)
+        with pytest.raises(ValueError, match="does not exist"):
+            sched.resize("ml:gone", "trainer", 2)
+
+    def test_resize_aborts_if_deletion_never_lands(self, monkeypatch, fake_k8s):
+        js = make_jobset(
+            AppDef(name="a", roles=[tpu_role(num_replicas=4)]), namespace="ml"
+        )
+        custom = mock.MagicMock()
+        custom.get_namespaced_custom_object.return_value = js  # never 404s
+        sched = GKEScheduler("t", client=object())
+        sched.resize_poll_interval = 0
+        monkeypatch.setattr(sched, "_custom_objects_api", lambda: custom)
+        with pytest.raises(RuntimeError, match="not deleted in time"):
+            sched.resize("ml:app-x", "trainer", 2)
+        custom.create_namespaced_custom_object.assert_not_called()

@@ -615,3 +615,117 @@ class TestTpuDeviceEnv:
 
     def test_no_sim_no_chips(self):
         assert tpu_device_env(4, 0, 1, host_chips=0, simulate=False) == {}
+
+
+class TestManualResize:
+    """Operator-driven `resize` (the manual counterpart of the elastic
+    shrink-on-failure path): the role gang restarts with a coherent world
+    and resumes from its checkpoint."""
+
+    def resize_script(self, tmp_path) -> str:
+        # each attempt logs its world, then waits long enough for the test
+        # to resize mid-flight (the resized attempt exits promptly)
+        return (
+            f'echo "world=$TPX_NUM_REPLICAS id=$TPX_REPLICA_ID"; '
+            f'if [ -f {tmp_path}/resized ]; then exit 0; fi; '
+            "sleep 30"
+        )
+
+    def test_shrink_and_grow(self, sched, tmp_path):
+        app = AppDef(
+            name="manual",
+            roles=[
+                sh_role(
+                    "w",
+                    self.resize_script(tmp_path),
+                    num_replicas=4,
+                    min_replicas=2,
+                )
+            ],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        # shrink 4 -> 2
+        sched.resize(app_id, "w", 2)
+        desc = sched.describe(app_id)
+        (rs,) = desc.roles_statuses
+        assert len(rs.replicas) == 2
+        assert desc.num_restarts == 1
+        # grow 2 -> 3 (local gangs can grow: they are just processes)
+        (tmp_path / "resized").touch()
+        sched.resize(app_id, "w", 3)
+        assert wait_terminal(sched, app_id, timeout=30) == AppState.SUCCEEDED
+        out0 = (tmp_path / app_id / "w" / "0" / "stdout.log").read_text()
+        assert "world=3 id=0" in out0
+        # both earlier attempts' logs were rotated aside
+        assert (tmp_path / app_id / "w" / "0" / "stdout.log.0").exists()
+        assert (tmp_path / app_id / "w" / "0" / "stdout.log.1").exists()
+
+    def test_floor_enforced(self, sched, tmp_path):
+        app = AppDef(
+            name="floor",
+            roles=[
+                sh_role(
+                    "w",
+                    self.resize_script(tmp_path),
+                    num_replicas=3,
+                    min_replicas=2,
+                )
+            ],
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        with pytest.raises(ValueError, match="below its declared min_replicas"):
+            sched.resize(app_id, "w", 1)
+        sched.cancel(app_id)
+
+    def test_tpu_role_resizes_in_slice_units(self, sched, tmp_path):
+        script = (
+            'echo "world=$TPX_NUM_REPLICAS slices=${MEGASCALE_NUM_SLICES:-none}"; '
+            f'if [ -f {tmp_path}/resized ]; then exit 0; fi; sleep 30'
+        )
+        role = Role(
+            name="w",
+            image="",
+            entrypoint="sh",
+            args=["-c", script],
+            num_replicas=3,  # slices of 2 hosts each
+            min_replicas=1,
+            resource=Resource(cpu=1, memMB=256, tpu=TpuSlice("v5p", 8)),
+        )
+        app_id = sched.submit(
+            AppDef(name="tpu-resize", roles=[role]), {"log_dir": str(tmp_path)}
+        )
+        (tmp_path / "resized").touch()
+        sched.resize(app_id, "w", 2)  # 3 slices -> 2 slices = 4 hosts
+        desc = sched.describe(app_id)
+        (rs,) = desc.roles_statuses
+        assert len(rs.replicas) == 4
+        assert wait_terminal(sched, app_id, timeout=30) == AppState.SUCCEEDED
+        out0 = (tmp_path / app_id / "w" / "0" / "stdout.log").read_text()
+        assert "world=4 slices=2" in out0
+
+    def test_resize_unknown_app_or_role(self, sched, tmp_path):
+        with pytest.raises(ValueError, match="unknown app"):
+            sched.resize("ghost", "w", 2)
+        app = AppDef(
+            name="r", roles=[sh_role("w", "sleep 30", num_replicas=2)]
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        with pytest.raises(ValueError, match="has no role"):
+            sched.resize(app_id, "ghost", 2)
+        sched.cancel(app_id)
+
+    def test_resize_terminal_app_raises(self, sched, tmp_path):
+        app = AppDef(name="done", roles=[sh_role("w", "exit 0")])
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        assert wait_terminal(sched, app_id, timeout=30) == AppState.SUCCEEDED
+        with pytest.raises(ValueError, match="terminal"):
+            sched.resize(app_id, "w", 2)
+
+    def test_noop_resize_keeps_gang(self, sched, tmp_path):
+        app = AppDef(
+            name="noop", roles=[sh_role("w", "sleep 30", num_replicas=2)]
+        )
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        sched.resize(app_id, "w", 2)  # same size: no restart
+        assert sched.describe(app_id).num_restarts == 0
+        sched.cancel(app_id)

@@ -98,6 +98,13 @@ class TestRunner:
         runner.cancel(handle)
         assert runner.status(handle).state == AppState.CANCELLED
 
+    def test_resize_routes_to_scheduler(self, runner):
+        handle = runner.run(simple_app(), "stub")
+        # the stub does not implement resize: the optional-capability
+        # default must raise a clear NotImplementedError
+        with pytest.raises(NotImplementedError, match="does not support resizing"):
+            runner.resize(handle, "r", 2)
+
     def test_status_unknown_app(self, runner):
         assert runner.status("stub://test/ghost") is None
 

@@ -75,6 +75,25 @@ class CmdDelete(SubCommand):
             print(f"deleted {args.app_handle}")
 
 
+class CmdResize(SubCommand):
+    """Resize a running role's gang: `tpx resize <handle> <role> <n>`
+    (n in AppDef units — slices for TPU roles). The gang restarts with a
+    coherent world size and resumes from its checkpoint."""
+
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument("app_handle")
+        subparser.add_argument("role_name")
+        subparser.add_argument("num_replicas", type=int)
+
+    def run(self, args: argparse.Namespace) -> None:
+        with get_runner() as runner:
+            runner.resize(args.app_handle, args.role_name, args.num_replicas)
+            print(
+                f"resized {args.app_handle}/{args.role_name}"
+                f" to {args.num_replicas}"
+            )
+
+
 class CmdRunopts(SubCommand):
     def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
         subparser.add_argument(

@@ -21,6 +21,7 @@ from torchx_tpu.cli.cmd_simple import (
     CmdDelete,
     CmdDescribe,
     CmdList,
+    CmdResize,
     CmdRunopts,
     CmdStatus,
 )
@@ -38,6 +39,7 @@ def get_sub_cmds() -> dict[str, SubCommand]:
         "log": CmdLog(),
         "cancel": CmdCancel(),
         "delete": CmdDelete(),
+        "resize": CmdResize(),
         "runopts": CmdRunopts(),
         "builtins": CmdBuiltins(),
         "configure": CmdConfigure(),

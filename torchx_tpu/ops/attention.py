@@ -228,7 +228,15 @@ def attention(
             " use impl='xla' (or 'auto', which falls back) for packed"
             " cross-document masking"
         )
-    if impl == "splash":
+    if impl == "splash" or (
+        # measured fastest on TPU (v5e sweep, docs/performance.md): splash
+        # beats the flash kernel at GQA shapes (no KV repeat) — 46.9% vs
+        # 39.6% MFU at llama3_1b — so "auto" prefers it when shapes allow
+        impl == "auto"
+        and segment_ids is None
+        and _on_tpu()
+        and _pallas_ok(q, k)
+    ):
         return splash_attention(
             q,
             k,
@@ -238,12 +246,7 @@ def attention(
             block_kv=block_kv,
             segment_ids=segment_ids,
         )
-    if impl == "pallas" or (
-        impl == "auto"
-        and segment_ids is None
-        and _on_tpu()
-        and _pallas_ok(q, k)
-    ):
+    if impl == "pallas":
         return pallas_attention(
             q, k, v, causal=causal, block_q=block_q, block_kv=block_kv
         )

@@ -259,6 +259,16 @@ class Runner:
         with log_event("delete", scheduler, app_id, session=self._name):
             self._scheduler(scheduler).delete(app_id)
 
+    def resize(
+        self, app_handle: AppHandle, role_name: str, num_replicas: int
+    ) -> None:
+        """Resize a running role's gang (AppDef units: slices for TPU
+        roles). The gang restarts with a coherent world and resumes from
+        its checkpoint; backends without resize support raise."""
+        scheduler, _, app_id = parse_app_handle(app_handle)
+        with log_event("resize", scheduler, app_id, session=self._name):
+            self._scheduler(scheduler).resize(app_id, role_name, num_replicas)
+
     def describe(self, app_handle: AppHandle) -> Optional[AppDef]:
         """Best-effort reconstruction of the AppDef from the backend."""
         scheduler, _, app_id = parse_app_handle(app_handle)

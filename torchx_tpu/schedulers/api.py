@@ -152,6 +152,20 @@ class Scheduler(ABC, Generic[T]):
             f"{self.backend} scheduler does not support app deletion"
         )
 
+    def resize(self, app_id: str, role_name: str, num_replicas: int) -> None:
+        """Resize a running role's gang to ``num_replicas`` (AppDef units:
+        slices for TPU roles, replicas for CPU roles). Optional.
+
+        SPMD worlds resize by restart: implementations relaunch the gang
+        with a coherent world (fresh TPX_NUM_REPLICAS / replica ids /
+        megascale slice counts) and user code resumes from its checkpoint.
+        The manual counterpart of the automatic shrink-on-failure elastic
+        path; honors ``Role.min_replicas`` as the floor.
+        """
+        raise NotImplementedError(
+            f"{self.backend} scheduler does not support resizing apps"
+        )
+
     # True when this backend's log_iter actually applies since/until
     # windows (docker: daemon-side; tpu_vm: stamped log lines). Backends
     # whose log files carry no per-line timestamps leave it False and the

@@ -31,9 +31,11 @@ materialization is plain dicts, so dryrun tests run with no cluster
 
 from __future__ import annotations
 
+import copy
 import hashlib
 import json
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Mapping, Optional, TYPE_CHECKING
 
@@ -497,6 +499,86 @@ def app_to_jobset(
     return resource
 
 
+def resize_jobset(
+    jobset: Mapping[str, Any], role_name: str, num_replicas: int
+) -> dict[str, Any]:
+    """Rewrite a live JobSet to a coherent ``num_replicas``-sized world for
+    one role; returns a fresh body ready for re-creation.
+
+    AppDef units: slices for TPU roles, pod replicas for CPU roles. Every
+    world-size-derived value is rewritten together (Job replicas or
+    parallelism/completions, TPX_NUM_REPLICAS, MEGASCALE_NUM_SLICES) so the
+    restarted gang agrees on its size — the GKE analog of the local
+    scheduler's elastic rebuild, where env is re-derived rather than
+    patched piecemeal. Floors declared via the ``tpx.sh/min-replicas``
+    annotation are enforced.
+    """
+    if num_replicas < 1:
+        raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+    body = copy.deepcopy(dict(jobset))
+    # strip server-managed fields so the body is valid for re-creation
+    body.pop("status", None)
+    meta = body.get("metadata", {})
+    for k in ("resourceVersion", "uid", "creationTimestamp", "generation", "managedFields"):
+        meta.pop(k, None)
+
+    want = normalize_str(cleanup(role_name))
+    for rj in body.get("spec", {}).get("replicatedJobs", []):
+        job_spec = rj.get("template", {}).get("spec", {})
+        pod_template = job_spec.get("template", {})
+        labels = pod_template.get("metadata", {}).get("labels", {})
+        if labels.get(LABEL_ROLE_NAME) != want:
+            continue
+        annotations = rj.get("template", {}).get("metadata", {}).get("annotations", {})
+        floor = annotations.get("tpx.sh/min-replicas")
+        if floor is not None and num_replicas < int(floor):
+            raise ValueError(
+                f"cannot resize role {role_name!r} to {num_replicas}:"
+                f" below its declared min_replicas floor of {floor}"
+            )
+        container = pod_template.get("spec", {}).get("containers", [{}])[0]
+        limits = container.get("resources", {}).get("limits", {})
+        is_tpu = "google.com/tpu" in limits
+        if is_tpu:
+            # slice units: one child Job per slice; hosts-per-slice fixed
+            if num_replicas > int(rj.get("replicas", 1)) and not any(
+                e.get("name") == settings.ENV_TPX_SLICE_ID
+                for e in container.get("env", [])
+            ):
+                # a single-slice template carries no slice-id fieldRef
+                # decomposition, so pods of a grown set could not derive
+                # global replica ids — growth needs a fresh submit
+                raise ValueError(
+                    f"role {role_name!r} was submitted single-slice; its pod"
+                    " template has no multi-slice identity wiring, so it can"
+                    " only shrink (resubmit the app to grow)"
+                )
+            hosts = int(job_spec.get("completions", 1))
+            rj["replicas"] = num_replicas
+            world = hosts * num_replicas
+        else:
+            job_spec["parallelism"] = num_replicas
+            job_spec["completions"] = num_replicas
+            world = num_replicas
+        for env in container.get("env", []):
+            if env.get("name") == settings.ENV_TPX_NUM_REPLICAS:
+                env["value"] = str(world)
+            elif env.get("name") == settings.ENV_MEGASCALE_NUM_SLICES:
+                env["value"] = str(num_replicas)
+        break
+    else:
+        raise ValueError(
+            f"role {role_name!r} not found in jobset"
+            f" {meta.get('name', '<unnamed>')}"
+        )
+
+    if (body.get("metadata", {}).get("labels", {})).get("kueue.x-k8s.io/queue-name"):
+        # resubmit suspended: Kueue re-admits when the resized gang fits —
+        # this is what makes shrink-to-fit under queue pressure work
+        body["spec"]["suspend"] = True
+    return body
+
+
 # =========================================================================
 # Scheduler
 # =========================================================================
@@ -670,6 +752,57 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
         except ApiException as e:
             if e.status != 404:
                 raise
+
+    # seconds between deletion polls during resize (tests set this to 0)
+    resize_poll_interval: float = 1.0
+
+    def resize(self, app_id: str, role_name: str, num_replicas: int) -> None:
+        """Resize one role's gang by replace: JobSet pod templates are
+        immutable and a JobSet-level restart would reuse the stale world
+        env, so the resize primitive is delete + re-create of the rewritten
+        set under the same name. With a Kueue queue the new set goes back
+        suspended and Kueue re-admits when the resized gang fits; user code
+        resumes from its checkpoint (warm compile cache makes the restart
+        cheap — docs/performance.md)."""
+        namespace, name = self._parse_app_id(app_id)
+        from kubernetes.client.rest import ApiException
+
+        api = self._custom_objects_api()
+        common = dict(
+            group=JOBSET_GROUP,
+            version=JOBSET_VERSION,
+            namespace=namespace,
+            plural=JOBSET_PLURAL,
+            name=name,
+        )
+        try:
+            jobset = api.get_namespaced_custom_object(**common)
+        except ApiException as e:
+            if e.status == 404:
+                raise ValueError(f"app {app_id} does not exist") from e
+            raise
+        body = resize_jobset(jobset, role_name, num_replicas)
+        api.delete_namespaced_custom_object(**common)
+        for _ in range(120):
+            try:
+                api.get_namespaced_custom_object(**common)
+            except ApiException as e:
+                if e.status == 404:
+                    break
+                raise
+            time.sleep(self.resize_poll_interval)
+        else:
+            raise RuntimeError(
+                f"jobset {name} was not deleted in time; resize aborted"
+                " before re-creation (re-run once the deletion finishes)"
+            )
+        api.create_namespaced_custom_object(
+            group=JOBSET_GROUP,
+            version=JOBSET_VERSION,
+            namespace=namespace,
+            plural=JOBSET_PLURAL,
+            body=body,
+        )
 
     def log_iter(
         self,

@@ -958,6 +958,78 @@ class LocalScheduler(Scheduler[PopenRequest]):
                 except (ProcessLookupError, PermissionError):
                     pass
 
+    def resize(self, app_id: str, role_name: str, num_replicas: int) -> None:
+        """Manual gang resize (grow or shrink) — the operator-driven
+        counterpart of ``_try_elastic_restart``'s shrink-on-failure. The
+        whole role gang restarts with a coherent world: every replica gets
+        fresh TPX_NUM_REPLICAS / TPX_REPLICA_ID / slice decomposition, and
+        user code resumes from its checkpoint."""
+        app = self._apps.get(app_id)
+        if app is None:
+            registered = _registry_lookup(app_id)
+            raise ValueError(
+                f"unknown app: {app_id}"
+                if registered is None
+                else f"app {app_id} is owned by another process; resize from"
+                " the session that submitted it"
+            )
+        self._update_app_state(app)
+        if is_terminal(app.state):
+            raise ValueError(f"cannot resize terminal app {app_id} ({app.state.name})")
+        request = app.request
+        if request is None or request.app is None:
+            raise ValueError(f"app {app_id} has no retained request; cannot resize")
+        role = next((r for r in request.app.roles if r.name == role_name), None)
+        if role is None:
+            raise ValueError(f"app {app_id} has no role {role_name!r}")
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+        if role.min_replicas is not None and num_replicas < role.min_replicas:
+            raise ValueError(
+                f"cannot resize role {role_name!r} to {num_replicas}: below"
+                f" its declared min_replicas floor of {role.min_replicas}"
+            )
+        hosts = (
+            role.resource.tpu.hosts
+            if role.resource is not None and role.resource.tpu is not None
+            else 1
+        )
+        new_hosts = num_replicas * hosts  # whole slices only, by construction
+        if new_hosts == len(app.roles.get(role_name, [])):
+            return  # already at the requested size
+        attempt = app.num_restarts + 1
+        logger.warning(
+            "manual resize of %s role %s: %d -> %d replicas (gang restart #%d)",
+            app_id,
+            role_name,
+            len(app.roles.get(role_name, [])),
+            new_hosts,
+            attempt,
+        )
+        for r in app.roles.get(role_name, []):
+            if r.is_alive():
+                r.terminate()
+            else:
+                r._close_files()
+        app.roles.pop(role_name, None)
+        app.num_restarts = attempt
+        try:
+            params = self._build_role_replicas(
+                role,
+                app.app_id,
+                app.log_dir,
+                request.cfg,
+                num_replicas=new_hosts,
+            )
+            for replica_id, rp in enumerate(params):
+                _rotate_attempt_logs(rp, attempt)
+                app.add_replica(role_name, self._popen(role_name, replica_id, rp))
+        except Exception:
+            app.kill()
+            app.set_state(AppState.FAILED)
+            raise
+        app.set_state(AppState.RUNNING)
+
     def log_iter(
         self,
         app_id: str,
