@@ -691,6 +691,30 @@ class TestResizeJobset:
         with pytest.raises(ValueError, match="not found in jobset"):
             resize_jobset(js, "ghost", 2)
 
+    def test_same_size_returns_none(self):
+        js = self._multislice_jobset(num_replicas=4)
+        assert resize_jobset(js, "trainer", 4) is None
+
+    def test_macro_args_follow_resize_via_env_expansion(self):
+        # materialization defers macros.num_replicas to kubelet $(VAR)
+        # expansion, so args stay coherent across a resize without any
+        # string rewriting
+        role = tpu_role(num_replicas=4)
+        role.args.append(f"--world={macros.num_replicas}")
+        js = make_jobset(AppDef(name="a", roles=[role]))
+        (rj,) = js["spec"]["replicatedJobs"]
+        container = rj["template"]["spec"]["template"]["spec"]["containers"][0]
+        assert "--world=$(MEGASCALE_NUM_SLICES)" in container["command"]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["MEGASCALE_NUM_SLICES"] == "4"
+        body = resize_jobset(js, "trainer", 2)
+        container = body["spec"]["replicatedJobs"][0]["template"]["spec"][
+            "template"
+        ]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert "--world=$(MEGASCALE_NUM_SLICES)" in container["command"]
+
     def test_server_fields_stripped_and_kueue_resuspended(self):
         js = make_jobset(
             AppDef(name="a", roles=[tpu_role(num_replicas=2)]),
@@ -725,7 +749,8 @@ class TestResizeLifecycle:
         sched.resize_poll_interval = 0
         monkeypatch.setattr(sched, "_custom_objects_api", lambda: custom)
         sched.resize("ml:app-x", "trainer", 2)
-        custom.delete_namespaced_custom_object.assert_called_once()
+        del_kwargs = custom.delete_namespaced_custom_object.call_args.kwargs
+        assert del_kwargs["propagation_policy"] == "Foreground"
         body = custom.create_namespaced_custom_object.call_args.kwargs["body"]
         assert body["spec"]["replicatedJobs"][0]["replicas"] == 2
         assert "resourceVersion" not in body["metadata"]
@@ -749,4 +774,16 @@ class TestResizeLifecycle:
         monkeypatch.setattr(sched, "_custom_objects_api", lambda: custom)
         with pytest.raises(RuntimeError, match="not deleted in time"):
             sched.resize("ml:app-x", "trainer", 2)
+        custom.create_namespaced_custom_object.assert_not_called()
+
+    def test_resize_same_size_is_noop(self, monkeypatch, fake_k8s):
+        js = make_jobset(
+            AppDef(name="a", roles=[tpu_role(num_replicas=4)]), namespace="ml"
+        )
+        custom = mock.MagicMock()
+        custom.get_namespaced_custom_object.return_value = js
+        sched = GKEScheduler("t", client=object())
+        monkeypatch.setattr(sched, "_custom_objects_api", lambda: custom)
+        sched.resize("ml:app-x", "trainer", 4)
+        custom.delete_namespaced_custom_object.assert_not_called()
         custom.create_namespaced_custom_object.assert_not_called()

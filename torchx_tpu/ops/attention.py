@@ -14,8 +14,10 @@ Kernel selection (``impl``):
 * ``"xla"`` — plain einsum softmax attention. XLA fuses this well for short
   sequences and it runs everywhere (CPU tests); also the numerical
   reference the pallas path is tested against.
-* ``"auto"`` — pallas on TPU when shapes allow (head_dim multiple of 128,
-  seq multiple of the block size), else xla.
+* ``"auto"`` — splash on TPU when shapes allow (head_dim in {64, 128, 256},
+  seq a multiple of 128 and >= 512, no packed segment_ids — the v5e sweep
+  measured splash fastest at GQA shapes, docs/performance.md), else xla.
+  The flash kernel is explicit-opt-in via ``"pallas"``.
 
 All paths compute softmax in float32 and accept grouped KV heads
 (n_kv_heads <= n_heads, Llama-3 GQA).

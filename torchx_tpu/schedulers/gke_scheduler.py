@@ -107,6 +107,8 @@ POD_STATE_MAP = {
 
 LABEL_APP_NAME = "tpx.sh/app-name"
 LABEL_ROLE_NAME = "tpx.sh/role-name"
+# elastic floor, surfaced to autoscalers and enforced by resize
+ANNOTATION_MIN_REPLICAS = "tpx.sh/min-replicas"
 LABEL_VERSION = "tpx.sh/version"
 ANNOTATION_APP = "tpx.sh/appdef"
 
@@ -272,6 +274,15 @@ def role_to_pod_template(
             },
             {"name": settings.ENV_MEGASCALE_SLICE_ID, "value": "$(JOB_INDEX)"},
         ]
+        if settings.ENV_MEGASCALE_NUM_SLICES not in role.env:
+            # early in the env list so later $() references expand; a
+            # user-provided override in role.env still wins downstream
+            identity.append(
+                {
+                    "name": settings.ENV_MEGASCALE_NUM_SLICES,
+                    "value": str(num_slices),
+                }
+            )
     else:
         identity += [
             {
@@ -404,16 +415,22 @@ def app_to_jobset(
             replica_id=f"$({settings.ENV_TPX_SLICE_ID})"
             if multislice
             else f"$({settings.ENV_TPX_REPLICA_ID})",
-            num_replicas=str(role.num_replicas) if multislice else str(completions),
+            # deferred to kubelet env expansion rather than baked as a
+            # literal so a `resize` that rewrites the env var propagates to
+            # every arg that referenced the macro (for multislice roles the
+            # convention is that the macro means the slice count, which
+            # resize keeps equal to MEGASCALE_NUM_SLICES)
+            num_replicas=f"$({settings.ENV_MEGASCALE_NUM_SLICES})"
+            if multislice
+            else f"$({settings.ENV_TPX_NUM_REPLICAS})",
             coordinator_env=settings.ENV_TPX_COORDINATOR_HOST,
         )
         srole = values.apply(role)
         if multislice:
             # DCN identity: slice id comes from the JobSet job-index fieldRef
-            # in the pod template; megascale coordinator = slice 0's host 0
-            srole.env.setdefault(
-                settings.ENV_MEGASCALE_NUM_SLICES, str(role.num_replicas)
-            )
+            # in the pod template (MEGASCALE_NUM_SLICES itself is injected
+            # early in the identity env block so $() references expand);
+            # megascale coordinator = slice 0's host 0
             srole.env.setdefault(
                 settings.ENV_MEGASCALE_COORDINATOR_ADDRESS,
                 f"{coordinator_host}:{coordinator_port + 1}",
@@ -452,7 +469,7 @@ def app_to_jobset(
             #    tpx.sh/min-replicas for external autoscalers AND is injected
             #    as TPX_MIN_REPLICAS so in-job bootstrap logic knows how far
             #    the world may legally shrink on restart
-            annotations = {"tpx.sh/min-replicas": str(role.min_replicas)}
+            annotations = {ANNOTATION_MIN_REPLICAS: str(role.min_replicas)}
             if not tpu:
                 annotations["kueue.x-k8s.io/job-min-parallelism"] = str(
                     role.min_replicas
@@ -501,17 +518,20 @@ def app_to_jobset(
 
 def resize_jobset(
     jobset: Mapping[str, Any], role_name: str, num_replicas: int
-) -> dict[str, Any]:
+) -> Optional[dict[str, Any]]:
     """Rewrite a live JobSet to a coherent ``num_replicas``-sized world for
-    one role; returns a fresh body ready for re-creation.
+    one role; returns a fresh body ready for re-creation, or ``None`` when
+    the role is already at the requested size (no restart warranted).
 
     AppDef units: slices for TPU roles, pod replicas for CPU roles. Every
     world-size-derived value is rewritten together (Job replicas or
-    parallelism/completions, TPX_NUM_REPLICAS, MEGASCALE_NUM_SLICES) so the
-    restarted gang agrees on its size — the GKE analog of the local
-    scheduler's elastic rebuild, where env is re-derived rather than
-    patched piecemeal. Floors declared via the ``tpx.sh/min-replicas``
-    annotation are enforced.
+    parallelism/completions, TPX_NUM_REPLICAS, MEGASCALE_NUM_SLICES — and
+    args that referenced ``macros.num_replicas`` follow automatically,
+    since materialization defers that macro to kubelet ``$(VAR)``
+    expansion of these env vars) so the restarted gang agrees on its size
+    — the GKE analog of the local scheduler's elastic rebuild, where env
+    is re-derived rather than patched piecemeal. Floors declared via the
+    ``tpx.sh/min-replicas`` annotation are enforced.
     """
     if num_replicas < 1:
         raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
@@ -530,7 +550,7 @@ def resize_jobset(
         if labels.get(LABEL_ROLE_NAME) != want:
             continue
         annotations = rj.get("template", {}).get("metadata", {}).get("annotations", {})
-        floor = annotations.get("tpx.sh/min-replicas")
+        floor = annotations.get(ANNOTATION_MIN_REPLICAS)
         if floor is not None and num_replicas < int(floor):
             raise ValueError(
                 f"cannot resize role {role_name!r} to {num_replicas}:"
@@ -539,6 +559,13 @@ def resize_jobset(
         container = pod_template.get("spec", {}).get("containers", [{}])[0]
         limits = container.get("resources", {}).get("limits", {})
         is_tpu = "google.com/tpu" in limits
+        current = (
+            int(rj.get("replicas", 1))
+            if is_tpu
+            else int(job_spec.get("parallelism", 1))
+        )
+        if num_replicas == current:
+            return None  # already at the requested size: no restart
         if is_tpu:
             # slice units: one child Job per slice; hosts-per-slice fixed
             if num_replicas > int(rj.get("replicas", 1)) and not any(
@@ -782,7 +809,20 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
                 raise ValueError(f"app {app_id} does not exist") from e
             raise
         body = resize_jobset(jobset, role_name, num_replicas)
-        api.delete_namespaced_custom_object(**common)
+        if body is None:
+            logger.info(
+                "%s role %s is already %d wide; not restarting the gang",
+                app_id,
+                role_name,
+                num_replicas,
+            )
+            return
+        # foreground propagation: the JobSet object only 404s once its
+        # child Jobs/pods are gone too, so the poll below doubles as
+        # waiting for the old gang's TPU capacity to actually free up
+        api.delete_namespaced_custom_object(
+            **common, propagation_policy="Foreground"
+        )
         for _ in range(120):
             try:
                 api.get_namespaced_custom_object(**common)
@@ -796,13 +836,32 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
                 f"jobset {name} was not deleted in time; resize aborted"
                 " before re-creation (re-run once the deletion finishes)"
             )
-        api.create_namespaced_custom_object(
-            group=JOBSET_GROUP,
-            version=JOBSET_VERSION,
-            namespace=namespace,
-            plural=JOBSET_PLURAL,
-            body=body,
-        )
+        try:
+            api.create_namespaced_custom_object(
+                group=JOBSET_GROUP,
+                version=JOBSET_VERSION,
+                namespace=namespace,
+                plural=JOBSET_PLURAL,
+                body=body,
+            )
+        except Exception:
+            # the old set is gone; losing the rewritten body too would
+            # leave the operator with nothing to resubmit
+            import tempfile
+
+            fd, path = tempfile.mkstemp(
+                prefix=f"tpx-resize-{name}-", suffix=".json"
+            )
+            with open(fd, "w") as f:
+                json.dump(body, f, indent=2, default=str)
+            logger.error(
+                "re-creation of jobset %s failed AFTER deletion; the"
+                " resized body was saved to %s — fix the rejection and"
+                " `kubectl apply -f` it",
+                name,
+                path,
+            )
+            raise
 
     def log_iter(
         self,

@@ -870,12 +870,7 @@ class LocalScheduler(Scheduler[PopenRequest]):
             },
         )
         for role_name in new_sizes:
-            for r in app.roles.get(role_name, []):
-                if r.is_alive():
-                    r.terminate()
-                else:
-                    r._close_files()
-            app.roles.pop(role_name, None)
+            self._teardown_role_gang(app, role_name)
         app.num_restarts = attempt
         for role_name in failed_roles:
             app.role_restarts[role_name] = app.role_restarts.get(role_name, 0) + 1
@@ -883,24 +878,46 @@ class LocalScheduler(Scheduler[PopenRequest]):
             for role in request.app.roles:
                 if role.name not in new_sizes:
                     continue  # ROLE-scoped restart: healthy role kept alive
-                params = self._build_role_replicas(
-                    role,
-                    app.app_id,
-                    app.log_dir,
-                    request.cfg,
-                    num_replicas=new_sizes[role.name],
+                self._launch_role_gang(
+                    app, role, new_sizes[role.name], attempt, request.cfg
                 )
-                for replica_id, rp in enumerate(params):
-                    _rotate_attempt_logs(rp, attempt)
-                    app.add_replica(
-                        role.name, self._popen(role.name, replica_id, rp)
-                    )
         except Exception:
             app.kill()
             app.set_state(AppState.FAILED)
             return True  # state handled (failed during relaunch)
         app.set_state(AppState.RUNNING)
         return True
+
+    def _teardown_role_gang(self, app: _LocalApp, role_name: str) -> None:
+        """Stop one role's replicas and drop them from the app (shared by
+        elastic restart and manual resize)."""
+        for r in app.roles.get(role_name, []):
+            if r.is_alive():
+                r.terminate()
+            else:
+                r._close_files()
+        app.roles.pop(role_name, None)
+
+    def _launch_role_gang(
+        self,
+        app: _LocalApp,
+        role: Role,
+        num_replicas: int,
+        attempt: int,
+        cfg: Mapping[str, CfgVal],
+    ) -> None:
+        """(Re)launch one role's gang ``num_replicas`` hosts wide, rotating
+        the previous attempt's logs aside."""
+        params = self._build_role_replicas(
+            role,
+            app.app_id,
+            app.log_dir,
+            cfg,
+            num_replicas=num_replicas,
+        )
+        for replica_id, rp in enumerate(params):
+            _rotate_attempt_logs(rp, attempt)
+            app.add_replica(role.name, self._popen(role.name, replica_id, rp))
 
     def list(self) -> list[ListAppResponse]:
         out = []
@@ -1006,24 +1023,10 @@ class LocalScheduler(Scheduler[PopenRequest]):
             new_hosts,
             attempt,
         )
-        for r in app.roles.get(role_name, []):
-            if r.is_alive():
-                r.terminate()
-            else:
-                r._close_files()
-        app.roles.pop(role_name, None)
+        self._teardown_role_gang(app, role_name)
         app.num_restarts = attempt
         try:
-            params = self._build_role_replicas(
-                role,
-                app.app_id,
-                app.log_dir,
-                request.cfg,
-                num_replicas=new_hosts,
-            )
-            for replica_id, rp in enumerate(params):
-                _rotate_attempt_logs(rp, attempt)
-                app.add_replica(role_name, self._popen(role_name, replica_id, rp))
+            self._launch_role_gang(app, role, new_hosts, attempt, request.cfg)
         except Exception:
             app.kill()
             app.set_state(AppState.FAILED)
