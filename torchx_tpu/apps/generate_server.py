@@ -20,15 +20,19 @@ API (JSON):
 
 Same-length prompts batch together; each distinct (prompt_len,
 max_new_tokens) pair compiles once and is then served from the jit cache.
-Requests run under a lock — one chip, one model, sequential batches
-(continuous batching is the next rung; see docs/ROADMAP.md).
+Concurrent requests are coalesced by a batcher thread (JetStream-style):
+compatible sequences from different clients merge into one device batch
+within a few-ms window, so serving throughput scales with concurrency up
+to ``--max-batch`` instead of serializing forward passes.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
+import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -48,8 +52,27 @@ def _assert_platform() -> None:
         jax.config.update("jax_platforms", platforms)
 
 
+@dataclasses.dataclass
+class _Pending:
+    """One sequence awaiting decode, owned by a handler thread until the
+    batcher thread fills ``result`` (or ``error``) and sets ``done``."""
+
+    tokens: list[int]
+    key: tuple  # (prompt_len, max_new_tokens, temperature, seed)
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    result: Optional[list[int]] = None
+    error: Optional[Exception] = None
+
+
 class GenerateService:
-    """Model + jitted decode, shared by all handler threads."""
+    """Model + jitted decode, shared by all handler threads.
+
+    Decode requests are coalesced JetStream-style: handler threads enqueue
+    sequences and a single batcher thread drains the queue in a short
+    window, merging compatible sequences (same prompt length / max_new /
+    temperature / seed) into ONE device batch — concurrent clients share
+    MXU work instead of serializing whole forward passes behind a lock.
+    """
 
     def __init__(
         self,
@@ -57,6 +80,8 @@ class GenerateService:
         ckpt_dir: Optional[str] = None,
         int8: bool = False,
         seed: int = 0,
+        batch_window_ms: float = 3.0,
+        max_batch: int = 16,
     ) -> None:
         from torchx_tpu.examples.train_llama import all_configs
 
@@ -86,10 +111,87 @@ class GenerateService:
 
             self.params = quantize_params(self.params)
         self.int8 = int8
-        self._lock = threading.Lock()
         self._cache_lock = threading.Lock()  # handlers run concurrently
         self._jit_cache: dict[tuple, Any] = {}
         self.requests = 0
+        self.batches = 0  # device dispatches (< enqueued seqs when coalesced)
+        self.batched_sequences = 0
+        self.batch_window_s = batch_window_ms / 1000.0
+        self.max_batch = max_batch
+        self._closed = False
+        self._count_lock = threading.Lock()
+        self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
+        self._batcher = threading.Thread(
+            target=self._batch_loop, name="tpx-batcher", daemon=True
+        )
+        self._batcher.start()
+
+    def close(self) -> None:
+        """Stop the batcher thread (idempotent; pending items drain first,
+        and anything enqueued while shutting down is failed, not stranded)."""
+        self._closed = True
+        if self._batcher.is_alive():
+            self._queue.put(None)
+            self._batcher.join(timeout=5)
+        while True:  # fail stragglers that raced the shutdown
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if p is not None:
+                p.error = RuntimeError("generate service is closed")
+                p.done.set()
+
+    # -- batcher thread ----------------------------------------------------
+
+    def _batch_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            group = [item]
+            deadline = time.monotonic() + self.batch_window_s
+            incompatible: list[_Pending] = []
+            shutdown = False
+            while len(group) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    shutdown = True
+                    break
+                if nxt.key == item.key:
+                    group.append(nxt)
+                else:
+                    incompatible.append(nxt)  # next loop iteration's work
+            for p in incompatible:
+                self._queue.put(p)
+            if shutdown:
+                # re-arm AFTER the incompatible re-queue so those pendings
+                # still drain before the thread exits
+                self._queue.put(None)
+            self._dispatch(group)
+
+    def _dispatch(self, group: list[_Pending]) -> None:
+        _, max_new, temperature, seed = group[0].key
+        try:
+            fn = self._decode_fn(max_new, temperature)
+            batch = jnp.asarray([p.tokens for p in group], dtype=jnp.int32)
+            out = jax.device_get(fn(self.params, batch, jax.random.PRNGKey(seed)))
+            self.batches += 1
+            self.batched_sequences += len(group)
+            for row, p in enumerate(group):
+                p.result = [int(x) for x in out[row]]
+        except Exception as e:  # noqa: BLE001 - surfaced per-request
+            for p in group:
+                p.error = e
+        finally:
+            for p in group:
+                p.done.set()
 
     _JIT_CACHE_MAX = 32
 
@@ -137,26 +239,30 @@ class GenerateService:
                 f"prompt length {longest} + {max_new_tokens} new tokens"
                 f" exceeds max_seq {self.cfg.max_seq}"
             )
-        # batch EXACT-length groups (padding would pollute the causal
-        # context — correctness over cleverness; one compile per distinct
-        # (length, max_new) pair, cached by jit)
-        groups: dict[int, list[int]] = {}
-        for i, t in enumerate(tokens):
-            groups.setdefault(len(t), []).append(i)
-        result: list[list[int]] = [[] for _ in tokens]
-        fn = self._decode_fn(max_new_tokens, temperature)
-        with self._lock:
+        if self._closed:
+            raise RuntimeError("generate service is closed")
+        # one _Pending per sequence, keyed by EXACT length (padding would
+        # pollute the causal context — correctness over cleverness; one
+        # compile per distinct (length, max_new) pair, cached by jit). The
+        # batcher thread merges compatible sequences ACROSS requests into
+        # single device batches.
+        with self._count_lock:
             self.requests += 1
-            for length, idxs in groups.items():
-                batch = jnp.asarray(
-                    [tokens[i] for i in idxs], dtype=jnp.int32
-                )
-                out = jax.device_get(
-                    fn(self.params, batch, jax.random.PRNGKey(seed))
-                )
-                for row, i in enumerate(idxs):
-                    result[i] = [int(x) for x in out[row]]
-        return result
+        pendings = [
+            _Pending(
+                tokens=list(t),
+                key=(len(t), max_new_tokens, round(temperature, 3), seed),
+            )
+            for t in tokens
+        ]
+        for p in pendings:
+            self._queue.put(p)
+        for p in pendings:
+            p.done.wait()
+        errors = [p.error for p in pendings if p.error is not None]
+        if errors:
+            raise errors[0]
+        return [p.result for p in pendings]
 
 
 def _make_handler(service: GenerateService):
@@ -182,6 +288,8 @@ def _make_handler(service: GenerateService):
                         "int8": service.int8,
                         "ckpt_step": service.ckpt_step,
                         "requests": service.requests,
+                        "batches": service.batches,
+                        "batched_sequences": service.batched_sequences,
                     },
                 )
             else:
@@ -236,9 +344,18 @@ def serve(
     ckpt_dir: Optional[str] = None,
     int8: bool = False,
     ready_event: Optional[threading.Event] = None,
+    batch_window_ms: float = 3.0,
+    max_batch: int = 16,
 ) -> ThreadingHTTPServer:
-    service = GenerateService(config, ckpt_dir=ckpt_dir, int8=int8)
+    service = GenerateService(
+        config,
+        ckpt_dir=ckpt_dir,
+        int8=int8,
+        batch_window_ms=batch_window_ms,
+        max_batch=max_batch,
+    )
     server = ThreadingHTTPServer(("", port), _make_handler(service))
+    server.service = service  # for tests / shutdown hooks
     if ready_event is not None:
         ready_event.set()
     return server
@@ -250,10 +367,26 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument("--ckpt-dir", default=None)
     parser.add_argument("--int8", action="store_true", help="int8 weight-only")
+    parser.add_argument(
+        "--batch-window-ms",
+        type=float,
+        default=3.0,
+        help="how long the batcher waits to coalesce concurrent requests",
+    )
+    parser.add_argument(
+        "--max-batch", type=int, default=16, help="max sequences per device batch"
+    )
     args = parser.parse_args(argv)
     _assert_platform()
     t0 = time.monotonic()
-    server = serve(args.config, args.port, args.ckpt_dir, args.int8)
+    server = serve(
+        args.config,
+        args.port,
+        args.ckpt_dir,
+        args.int8,
+        batch_window_ms=args.batch_window_ms,
+        max_batch=args.max_batch,
+    )
     print(
         f"generate_server: {args.config} on :{args.port}"
         f" (loaded in {time.monotonic() - t0:.1f}s)",

@@ -61,10 +61,13 @@ def generate_server(
     tpu: Optional[str] = None,
     cpu: int = 4,
     memMB: int = 16384,
+    batch_window_ms: float = 3.0,
+    max_batch: int = 16,
 ) -> specs.AppDef:
     """Serve KV-cache generation for a model family over HTTP
     (POST /v1/generate, GET /healthz) — the TPU-native serving half the
-    reference delegates to TorchServe.
+    reference delegates to TorchServe. Concurrent requests coalesce into
+    shared device batches (JetStream-style batcher thread).
 
     Args:
         config: model config name (e.g. ``llama3_1b``)
@@ -75,6 +78,8 @@ def generate_server(
         tpu: TPU accelerator type (e.g. ``v5litepod-8``); CPU when unset
         cpu: cpu count for CPU serving
         memMB: memory for CPU serving
+        batch_window_ms: how long the batcher waits to coalesce requests
+        max_batch: max sequences per coalesced device batch
     """
     args = [
         "-m",
@@ -83,6 +88,10 @@ def generate_server(
         config,
         "--port",
         str(port),
+        "--batch-window-ms",
+        str(batch_window_ms),
+        "--max-batch",
+        str(max_batch),
     ]
     if ckpt_dir:
         args += ["--ckpt-dir", ckpt_dir]
