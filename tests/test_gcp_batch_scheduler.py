@@ -1,0 +1,313 @@
+"""GCP Batch scheduler tests: assert on the materialized Batch job config
+and drive the lifecycle with canned gcloud output (reference analog:
+aws_batch_scheduler_test.py — mock-client node-group assertions)."""
+
+import json
+import subprocess
+from unittest import mock
+
+import pytest
+
+from torchx_tpu.schedulers.gcp_batch_scheduler import (
+    GCPBatchOpts,
+    GCPBatchScheduler,
+    app_to_batch_job,
+    describe_batch_job,
+    role_to_task_group,
+)
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppState,
+    Resource,
+    Role,
+    TpuSlice,
+    macros,
+)
+
+
+def tpu_role(chips=16, accelerator="v5p", num_replicas=1, **kwargs) -> Role:
+    return Role(
+        name="trainer",
+        image="gcr.io/proj/img:1",
+        entrypoint="python",
+        args=["-m", "train", f"--replica={macros.replica_id}"],
+        num_replicas=num_replicas,
+        resource=Resource(
+            cpu=208, memMB=448 * 1024, tpu=TpuSlice(accelerator, chips)
+        ),
+        **kwargs,
+    )
+
+
+def cpu_role(**kwargs) -> Role:
+    defaults = dict(
+        name="reader",
+        image="",
+        entrypoint="sh",
+        args=["-c", "echo hi"],
+        num_replicas=2,
+        resource=Resource(cpu=2, memMB=4096),
+    )
+    defaults.update(kwargs)
+    return Role(**defaults)
+
+
+class TestMaterialization:
+    def test_tpu_role_task_group(self):
+        group = role_to_task_group(tpu_role(), "app-1")
+        # v5p-16 = 4 hosts, one task per VM, gang parallelism
+        assert group["taskCount"] == 4
+        assert group["parallelism"] == 4
+        assert group["taskCountPerNode"] == 1
+        assert group["requireHostsFile"] is True
+        (runnable,) = group["taskSpec"]["runnables"]
+        script = runnable["container"]["commands"][1]
+        assert "export TPX_NUM_REPLICAS=4" in script
+        assert 'TPX_REPLICA_ID="${BATCH_TASK_INDEX:-0}"' in script
+        assert "cloudbatch-taskgroup-hosts" in script  # coordinator source
+        # the replica-id macro rides the exported env var, double-quoted so
+        # the shell expands it at runtime
+        assert '"--replica=$TPX_REPLICA_ID"' in script
+
+    def test_container_runnable_mounts_hosts_file(self):
+        group = role_to_task_group(tpu_role(), "app-1")
+        (runnable,) = group["taskSpec"]["runnables"]
+        assert runnable["container"]["imageUri"] == "gcr.io/proj/img:1"
+        assert (
+            "/etc/cloudbatch-taskgroup-hosts:/etc/cloudbatch-taskgroup-hosts:ro"
+            in runnable["container"]["volumes"]
+        )
+
+    def test_imageless_role_uses_script_runnable(self):
+        group = role_to_task_group(cpu_role(), "app-1")
+        (runnable,) = group["taskSpec"]["runnables"]
+        assert "script" in runnable
+        assert "echo hi" in runnable["script"]["text"]
+
+    def test_cpu_role_compute_resource(self):
+        group = role_to_task_group(cpu_role(), "app-1")
+        assert group["taskSpec"]["computeResource"] == {
+            "cpuMilli": 2000,
+            "memoryMib": 4096,
+        }
+        assert group["taskCount"] == 2
+
+    def test_retries(self):
+        group = role_to_task_group(cpu_role(max_retries=3), "app-1")
+        assert group["taskSpec"]["maxRetryCount"] == 3
+
+    def test_multislice_hosts(self):
+        group = role_to_task_group(tpu_role(num_replicas=2), "app-1")
+        assert group["taskCount"] == 8  # 2 slices x 4 hosts
+
+    def test_tpu_machine_type(self):
+        cfg = app_to_batch_job(
+            AppDef(name="a", roles=[tpu_role(accelerator="v5e", chips=8)]),
+            "app-1",
+            GCPBatchOpts(),
+        )
+        (inst,) = cfg["allocationPolicy"]["instances"]
+        assert inst["policy"]["machineType"] == "ct5lp-hightpu-4t"
+
+    def test_unknown_accelerator_raises(self):
+        # v7x is a valid slice generation but has no Batch machine family
+        with pytest.raises(ValueError, match="no Batch TPU-VM machine family"):
+            app_to_batch_job(
+                AppDef(name="a", roles=[tpu_role(accelerator="v7x")]),
+                "app-1",
+                GCPBatchOpts(),
+            )
+
+    def test_cpu_machine_type_from_opts(self):
+        cfg = app_to_batch_job(
+            AppDef(name="a", roles=[cpu_role()]),
+            "app-1",
+            GCPBatchOpts(machine_type="n2-standard-8"),
+        )
+        (inst,) = cfg["allocationPolicy"]["instances"]
+        assert inst["policy"]["machineType"] == "n2-standard-8"
+
+    def test_labels_and_logging(self):
+        cfg = app_to_batch_job(
+            AppDef(name="a", roles=[cpu_role()]), "app-1", GCPBatchOpts()
+        )
+        assert cfg["labels"]["tpx-app-name"] == "app-1"
+        assert cfg["labels"]["tpx-role-name"] == "reader"
+        assert cfg["logsPolicy"]["destination"] == "CLOUD_LOGGING"
+
+    def test_multi_role_rejected(self):
+        # the Batch API takes exactly one taskGroup per job
+        with pytest.raises(ValueError, match="single-role"):
+            app_to_batch_job(
+                AppDef(name="a", roles=[tpu_role(), cpu_role()]),
+                "app-1",
+                GCPBatchOpts(),
+            )
+
+
+class TestDescribeMapping:
+    def test_running_with_counts(self):
+        payload = {
+            "status": {
+                "state": "RUNNING",
+                "taskGroups": {
+                    "group0": {"counts": {"RUNNING": 3, "SUCCEEDED": 1}}
+                },
+            }
+        }
+        resp = describe_batch_job("loc:app", payload, ["trainer"])
+        assert resp.state == AppState.RUNNING
+        (rs,) = resp.roles_statuses
+        states = sorted(r.state.name for r in rs.replicas)
+        assert states == ["RUNNING", "RUNNING", "RUNNING", "SUCCEEDED"]
+
+    def test_malformed_payload_never_crashes(self):
+        resp = describe_batch_job(
+            "loc:app",
+            {"status": {"state": "FAILED", "taskGroups": {"group0": {"counts": {"FAILED": "x"}}}}},
+            ["w"],
+        )
+        assert resp.state == AppState.FAILED
+        (rs,) = resp.roles_statuses
+        assert rs.replicas == []
+
+    def test_empty_payload(self):
+        resp = describe_batch_job("loc:app", {}, ["w"])
+        assert resp.state == AppState.UNKNOWN
+
+
+def proc(rc=0, stdout="", stderr=""):
+    return subprocess.CompletedProcess([], rc, stdout=stdout, stderr=stderr)
+
+
+class TestLifecycle:
+    def _sched(self, run_cmd):
+        sched = GCPBatchScheduler("test")
+        sched._run_cmd = run_cmd
+        return sched
+
+    def test_schedule_submits_config_on_stdin(self):
+        calls = []
+
+        def run_cmd(cmd, **kwargs):
+            calls.append((cmd, kwargs))
+            return proc()
+
+        sched = self._sched(run_cmd)
+        app = AppDef(name="train", roles=[cpu_role()])
+        info = sched.submit_dryrun(app, {"location": "us-east1"})
+        app_id = sched.schedule(info)
+        assert app_id.startswith("us-east1:train-")
+        (cmd, kwargs) = calls[0]
+        assert cmd[:4] == ["gcloud", "batch", "jobs", "submit"]
+        assert "--location" in cmd and "us-east1" in cmd
+        config = json.loads(kwargs["input"])
+        assert config["taskGroups"][0]["taskCount"] == 2
+
+    def test_schedule_failure_raises(self):
+        sched = self._sched(lambda cmd, **kw: proc(rc=1, stderr="quota"))
+        info = sched.submit_dryrun(AppDef(name="t", roles=[cpu_role()]), {})
+        with pytest.raises(RuntimeError, match="quota"):
+            sched.schedule(info)
+
+    def test_describe_parses_state(self):
+        payload = json.dumps(
+            {
+                "taskGroups": [{}],
+                "labels": {"tpx-role-name": "trainer"},
+                "status": {
+                    "state": "SUCCEEDED",
+                    "taskGroups": {"group0": {"counts": {"SUCCEEDED": 2}}},
+                },
+            }
+        )
+        sched = self._sched(lambda cmd, **kw: proc(stdout=payload))
+        resp = sched.describe("us-central1:app-1")
+        assert resp.state == AppState.SUCCEEDED
+        # the real role name is recovered from the job label
+        (rs,) = resp.roles_statuses
+        assert rs.role == "trainer"
+
+    def test_project_qualified_app_id_routes_project(self):
+        calls = []
+
+        def run_cmd(cmd, **kwargs):
+            calls.append(cmd)
+            return proc()
+
+        sched = self._sched(run_cmd)
+        app = AppDef(name="train", roles=[cpu_role()])
+        info = sched.submit_dryrun(
+            app, {"location": "us-east1", "project": "my-proj"}
+        )
+        app_id = sched.schedule(info)
+        assert app_id.startswith("my-proj:us-east1:train-")
+        sched.delete(app_id)
+        delete_cmd = calls[-1]
+        assert "--project" in delete_cmd and "my-proj" in delete_cmd
+
+    def test_describe_missing_returns_none(self):
+        sched = self._sched(lambda cmd, **kw: proc(rc=1, stderr="NOT_FOUND"))
+        assert sched.describe("us-central1:gone") is None
+
+    def test_list(self):
+        payload = json.dumps(
+            [
+                {
+                    "name": "projects/p/locations/l/jobs/app-1",
+                    "status": {"state": "RUNNING"},
+                }
+            ]
+        )
+        sched = self._sched(lambda cmd, **kw: proc(stdout=payload))
+        (item,) = sched.list()
+        assert item.name == "app-1"
+        assert item.state == AppState.RUNNING
+
+    def test_cancel_falls_back_to_delete(self):
+        calls = []
+
+        def run_cmd(cmd, **kwargs):
+            calls.append(cmd)
+            # `cancel` unsupported on this gcloud -> rc 2, then delete ok
+            return proc(rc=2 if "cancel" in cmd else 0, stdout="{}")
+
+        sched = self._sched(run_cmd)
+        # exists() check hits describe first; feed it a running job
+        sched.describe = lambda app_id: describe_batch_job(
+            app_id, {"status": {"state": "RUNNING"}}, ["w"]
+        )
+        sched.cancel("us-central1:app-1")
+        assert any("cancel" in c for c in calls)
+        assert any("delete" in c for c in calls)
+
+    def test_invalid_app_id(self):
+        sched = self._sched(lambda cmd, **kw: proc())
+        with pytest.raises(ValueError, match="location:name"):
+            sched.describe("nocolon")
+        with pytest.raises(ValueError, match="location:name"):
+            sched.describe("a:b:c:d")
+
+    def test_log_iter_reads_cloud_logging(self):
+        entries = json.dumps(
+            [{"textPayload": "step 1\n"}, {"textPayload": "step 2 done\n"}]
+        )
+        calls = []
+
+        def run_cmd(cmd, **kwargs):
+            calls.append(cmd)
+            return proc(stdout=entries)
+
+        sched = self._sched(run_cmd)
+        lines = list(sched.log_iter("us-central1:app-1", "w", 1, regex="done"))
+        assert lines == ["step 2 done"]
+        (cmd,) = calls
+        assert cmd[:3] == ["gcloud", "logging", "read"]
+        assert 'labels.task_index="1"' in cmd[3]
+
+
+class TestRegistry:
+    def test_gcp_batch_registered(self):
+        from torchx_tpu.schedulers import get_scheduler_factories
+
+        assert "gcp_batch" in get_scheduler_factories()

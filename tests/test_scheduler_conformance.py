@@ -30,13 +30,17 @@ def make_scheduler(name: str) -> Scheduler:
         kwargs["docker_client"] = mock.MagicMock()
     if name == "vertex":
         kwargs["client"] = mock.MagicMock()
+    if name == "gcp_batch":
+        kwargs["docker_client"] = mock.MagicMock()
     return factory(session_name="conformance", **kwargs)
 
 
 def sample_app(name: str) -> AppDef:
     role = Role(
         name="trainer",
-        image="img:1" if name in ("gke", "local_docker", "vertex") else "",
+        image="img:1"
+        if name in ("gke", "local_docker", "vertex", "gcp_batch")
+        else "",
         entrypoint="python",
         args=["-m", "train"],
         resource=Resource(cpu=2, memMB=1024, tpu=TpuSlice("v5e", 8)),
@@ -51,6 +55,7 @@ MINIMAL_CFG = {
     "slurm": {},
     "tpu_vm": {"zone": "us-east5-a"},
     "vertex": {"project": "test-proj"},
+    "gcp_batch": {"project": "test-proj"},
 }
 
 ALL = sorted(DEFAULT_SCHEDULER_MODULES)

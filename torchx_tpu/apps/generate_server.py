@@ -31,6 +31,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import logging
 import os
 import queue
 import threading
@@ -40,6 +41,8 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
 
 
 def _assert_platform() -> None:
@@ -120,6 +123,7 @@ class GenerateService:
         self.max_batch = max_batch
         self._closed = False
         self._count_lock = threading.Lock()
+        self._submit_lock = threading.Lock()  # orders enqueue vs close
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
         self._batcher = threading.Thread(
             target=self._batch_loop, name="tpx-batcher", daemon=True
@@ -127,33 +131,51 @@ class GenerateService:
         self._batcher.start()
 
     def close(self) -> None:
-        """Stop the batcher thread (idempotent; pending items drain first,
-        and anything enqueued while shutting down is failed, not stranded)."""
-        self._closed = True
-        if self._batcher.is_alive():
+        """Stop the batcher thread (idempotent). Work enqueued before close
+        drains to completion; work racing close fails fast — never hangs."""
+        with self._submit_lock:
+            # under the same lock generate() enqueues with, so every put
+            # either lands before the sentinel (drained by the batcher) or
+            # observes _closed and raises
+            self._closed = True
             self._queue.put(None)
-            self._batcher.join(timeout=5)
-        while True:  # fail stragglers that raced the shutdown
-            try:
-                p = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if p is not None:
-                p.error = RuntimeError("generate service is closed")
-                p.done.set()
+        self._batcher.join(timeout=60)
+        if self._batcher.is_alive():
+            # a dispatch (e.g. cold compile) outlived the join budget; the
+            # loop will finish it, drain its backlog, and exit on the
+            # sentinel — nothing is stranded, we just stop waiting
+            logger.warning("batcher still draining at close(); detaching")
 
     # -- batcher thread ----------------------------------------------------
 
     def _batch_loop(self) -> None:
+        """Single dispatcher: groups compatible pendings, keeps a local
+        backlog for incompatible ones so the OLDEST deferred key becomes
+        the next group head (no starvation under a sustained stream of one
+        key), and on shutdown drains queue + backlog before exiting."""
+        from collections import deque
+
+        backlog: "deque[_Pending]" = deque()
+        shutdown = False
         while True:
-            item = self._queue.get()
-            if item is None:
+            if backlog:
+                item = backlog.popleft()
+            elif shutdown:
                 return
+            else:
+                item = self._queue.get()
+                if item is None:
+                    return
             group = [item]
             deadline = time.monotonic() + self.batch_window_s
-            incompatible: list[_Pending] = []
-            shutdown = False
-            while len(group) < self.max_batch:
+            # adopt compatible backlog items first (they are oldest)
+            for p in list(backlog):
+                if len(group) >= self.max_batch:
+                    break
+                if p.key == item.key:
+                    backlog.remove(p)
+                    group.append(p)
+            while not shutdown and len(group) < self.max_batch:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
@@ -162,18 +184,12 @@ class GenerateService:
                 except queue.Empty:
                     break
                 if nxt is None:
-                    shutdown = True
+                    shutdown = True  # drain backlog, then exit above
                     break
                 if nxt.key == item.key:
                     group.append(nxt)
                 else:
-                    incompatible.append(nxt)  # next loop iteration's work
-            for p in incompatible:
-                self._queue.put(p)
-            if shutdown:
-                # re-arm AFTER the incompatible re-queue so those pendings
-                # still drain before the thread exits
-                self._queue.put(None)
+                    backlog.append(nxt)
             self._dispatch(group)
 
     def _dispatch(self, group: list[_Pending]) -> None:
@@ -255,8 +271,11 @@ class GenerateService:
             )
             for t in tokens
         ]
-        for p in pendings:
-            self._queue.put(p)
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("generate service is closed")
+            for p in pendings:
+                self._queue.put(p)
         for p in pendings:
             p.done.wait()
         errors = [p.error for p in pendings if p.error is not None]

@@ -21,6 +21,7 @@ DEFAULT_SCHEDULER_MODULES: dict[str, str] = {
     "local_docker": "torchx_tpu.schedulers.docker_scheduler:create_scheduler",
     "tpu_vm": "torchx_tpu.schedulers.tpu_vm_scheduler:create_scheduler",
     "vertex": "torchx_tpu.schedulers.vertex_scheduler:create_scheduler",
+    "gcp_batch": "torchx_tpu.schedulers.gcp_batch_scheduler:create_scheduler",
 }
 
 

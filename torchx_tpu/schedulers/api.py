@@ -12,7 +12,7 @@ from __future__ import annotations
 from abc import ABC, abstractmethod
 from dataclasses import dataclass
 from enum import Enum
-from typing import Generic, Iterable, Mapping, Optional, TypeVar
+from typing import Any, Generic, Iterable, Mapping, Optional, TypeVar
 
 from torchx_tpu.specs.api import (
     AppDef,
@@ -58,6 +58,21 @@ class ListAppResponse:
     app_id: str
     state: AppState
     name: str = ""
+
+
+def dquote(s: str) -> str:
+    """Double-quote a string for bash: metachars are safe but ``$VAR``
+    references (runtime macro values like the replica id) still expand.
+    Shared by every scheduler that materializes shell scripts."""
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"').replace("`", "\\`") + '"'
+
+
+def safe_int(value: Any, default: int = 0) -> int:
+    """int() that never raises (scheduler payloads are untrusted JSON)."""
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return default
 
 
 def filter_regex(regex: str, data: Iterable[str]) -> Iterable[str]:

@@ -41,6 +41,7 @@ from typing import Any, Iterable, Mapping, Optional, TYPE_CHECKING
 
 from torchx_tpu import settings
 from torchx_tpu.schedulers.api import (
+    safe_int as _safe_int,
     DescribeAppResponse,
     ListAppResponse,
     Scheduler,
@@ -940,16 +941,6 @@ def jobset_state(jobset: Mapping[str, Any]) -> AppState:
     if status.get("replicatedJobsStatus"):
         return AppState.RUNNING
     return AppState.PENDING if status else AppState.SUBMITTED
-
-
-def _safe_int(value: Any, default: int = 0) -> int:
-    """Version-drift tolerance: annotations/status fields arrive as strings,
-    numbers, None, or garbage across JobSet/k8s versions — never crash
-    describe() on one bad field."""
-    try:
-        return int(value)
-    except (TypeError, ValueError):
-        return default
 
 
 def _role_completions(jobset: Mapping[str, Any]) -> dict[str, int]:

@@ -35,6 +35,7 @@ from typing import Any, Iterable, Mapping, Optional
 
 from torchx_tpu import settings
 from torchx_tpu.schedulers.api import (
+    dquote as _dquote,
     DescribeAppResponse,
     ListAppResponse,
     Scheduler,
@@ -82,12 +83,6 @@ SLURM_STATE_MAP: dict[str, AppState] = {
     "CANCELLED": AppState.CANCELLED,
     "REVOKED": AppState.CANCELLED,
 }
-
-
-def _dquote(s: str) -> str:
-    """Double-quote for bash: metachars are safe but ``$var``/``${var}``
-    still expand (runtime macros depend on this)."""
-    return '"' + s.replace("\\", "\\\\").replace('"', '\\"').replace("`", "\\`") + '"'
 
 
 def slurm_state(state_str: str) -> AppState:

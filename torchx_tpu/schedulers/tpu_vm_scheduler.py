@@ -36,6 +36,7 @@ from typing import Any, Iterable, Mapping, Optional
 
 from torchx_tpu import settings
 from torchx_tpu.schedulers.api import (
+    dquote as _dquote,
     DescribeAppResponse,
     ListAppResponse,
     Scheduler,
@@ -136,12 +137,6 @@ class TpuVmRequest:
             shlex.quote(c) if "startup-script" not in c else "'startup-script=...'"
             for c in self.create_cmd()
         ) + f"\n--- startup script ---\n{self.startup_script}"
-
-
-def _dquote(s: str) -> str:
-    """Double-quote for bash: metachars are safe but ``$WORKER_ID`` (the
-    replica-id macro's runtime value) still expands."""
-    return '"' + s.replace("\\", "\\\\").replace('"', '\\"').replace("`", "\\`") + '"'
 
 
 def make_startup_script(role, app_id: str, num_hosts: int) -> str:  # noqa: ANN001
