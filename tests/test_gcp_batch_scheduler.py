@@ -288,7 +288,7 @@ class TestLifecycle:
         with pytest.raises(ValueError, match="location:name"):
             sched.describe("a:b:c:d")
 
-    def test_log_iter_reads_cloud_logging(self):
+    def test_log_iter_filters_on_server_uid(self):
         entries = json.dumps(
             [{"textPayload": "step 1\n"}, {"textPayload": "step 2 done\n"}]
         )
@@ -296,14 +296,39 @@ class TestLifecycle:
 
         def run_cmd(cmd, **kwargs):
             calls.append(cmd)
+            if "describe" in cmd:
+                # Batch stamps logs with the server-generated UID
+                return proc(stdout=json.dumps({"uid": "app-1-7f3e0d"}))
             return proc(stdout=entries)
 
         sched = self._sched(run_cmd)
         lines = list(sched.log_iter("us-central1:app-1", "w", 1, regex="done"))
         assert lines == ["step 2 done"]
-        (cmd,) = calls
-        assert cmd[:3] == ["gcloud", "logging", "read"]
-        assert 'labels.task_index="1"' in cmd[3]
+        read_cmd = calls[-1]
+        assert read_cmd[:3] == ["gcloud", "logging", "read"]
+        assert 'labels.job_uid="app-1-7f3e0d"' in read_cmd[3]
+        assert 'labels.task_index="1"' in read_cmd[3]
+
+    def test_log_iter_uid_fallback_when_describe_fails(self):
+        calls = []
+
+        def run_cmd(cmd, **kwargs):
+            calls.append(cmd)
+            if "describe" in cmd:
+                return proc(rc=1, stderr="gone")
+            return proc(stdout="[]")
+
+        sched = self._sched(run_cmd)
+        list(sched.log_iter("us-central1:app-1", "w", 0))
+        assert 'labels.job_uid="app-1"' in calls[-1][3]
+
+    def test_long_app_name_capped_to_63(self):
+        sched = self._sched(lambda cmd, **kw: proc())
+        app = AppDef(name="x" * 80, roles=[cpu_role()])
+        info = sched.submit_dryrun(app, {})
+        assert len(info.request.name) <= 60
+        labels = info.request.config["labels"]
+        assert all(len(v) <= 63 for v in labels.values())
 
 
 class TestRegistry:

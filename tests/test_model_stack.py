@@ -281,6 +281,28 @@ class TestLlama:
         )
         assert metrics["loss"] < 5.5  # from ~6.2 (ln 512) at init
 
+    def test_remat_policies_agree(self):
+        # all remat policies compute identical grads (they only change
+        # what is saved vs recomputed), including the named-attn policy
+        import jax
+        import jax.numpy as jnp
+
+        tokens = jnp.arange(2 * 64, dtype=jnp.int32).reshape(2, 64) % 512
+        grads = {}
+        for policy in ["full", "dots", "dots_attn"]:
+            cfg = llama.llama_tiny(remat_policy=policy)
+            params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+            def loss(p, cfg=cfg):
+                return llama.forward(p, tokens, cfg).astype(jnp.float32).mean()
+
+            grads[policy] = jax.grad(loss)(params)
+        flat_a = jax.tree_util.tree_leaves(grads["full"])
+        for other in ["dots", "dots_attn"]:
+            flat_b = jax.tree_util.tree_leaves(grads[other])
+            for a, b in zip(flat_a, flat_b):
+                assert jnp.allclose(a, b, atol=2e-2), other
+
     def test_ring_attention_with_remat(self):
         # the 8B long-context path: remat + ring attention compose
         cfg = llama.llama_tiny(use_ring_attention=True, remat=True)

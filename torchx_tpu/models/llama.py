@@ -31,6 +31,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torchx_tpu.ops.attention import attention
@@ -293,6 +294,10 @@ def _layer(
             block_q=cfg.attn_block_q,
             block_kv=cfg.attn_block_kv,
         )
+    # named so remat policies can SAVE the kernel output: the attention
+    # kernels are not dot_generals, so "dots" alone recomputes the whole
+    # flash/splash forward in the backward pass (see "dots_attn")
+    attn_out = checkpoint_name(attn_out, "attn_out")
     attn_out = attn_out.reshape(b, s, h * hd) @ layer["wo"]
     x = x + attn_out
     x = _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
@@ -310,6 +315,18 @@ def _remat(body, cfg: LlamaConfig):  # noqa: ANN001
     if cfg.remat_policy == "dots":
         return jax.checkpoint(
             body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    if cfg.remat_policy == "dots_attn":
+        # dots + the named attention-kernel outputs: flash/splash are pallas
+        # calls, not dot_generals, so plain "dots" recomputes the whole
+        # attention forward in the backward; saving [b, s, h, d] bf16 per
+        # layer (~17 MB/layer at 1B shapes) skips that recompute
+        return jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names("attn_out"),
+            ),
         )
     return jax.checkpoint(body)
 

@@ -47,7 +47,7 @@ from torchx_tpu.schedulers.api import (
     filter_regex,
     tpu_hosts_for_role,
 )
-from torchx_tpu.schedulers.ids import cleanup, make_unique
+from torchx_tpu.schedulers.ids import cleanup, make_unique, sanitize_name
 from torchx_tpu.schedulers.structured_opts import StructuredOpts
 from torchx_tpu.specs.api import (
     AppDef,
@@ -105,8 +105,6 @@ class GCPBatchOpts(StructuredOpts):
     machine_type: str = "e2-standard-4"
     """machine type for CPU roles (TPU roles derive theirs from the slice)."""
 
-    runtime_version: str = "tpu-ubuntu2204-base"
-    """TPU VM runtime image (TPU roles)."""
 
 
 @dataclass
@@ -228,7 +226,10 @@ def app_to_batch_job(
     else:
         machine = opts.machine_type
 
-    labels = {"tpx-app-name": app_id, "tpx-role-name": cleanup(role.name)}
+    labels = {
+        "tpx-app-name": app_id,
+        "tpx-role-name": sanitize_name(role.name, max_len=63),
+    }
     config: dict[str, Any] = {
         "taskGroups": [task_group],
         "allocationPolicy": {
@@ -298,7 +299,9 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
         self, app: AppDef, cfg: Mapping[str, CfgVal]
     ) -> AppDryRunInfo[GCPBatchJob]:
         opts = GCPBatchOpts.from_cfg(cfg)
-        app_id = make_unique(app.name)
+        # Batch job ids and label values cap at 63 chars (hash-suffix
+        # truncation keeps derived strings stable, same as the GKE budget)
+        app_id = sanitize_name(make_unique(app.name), max_len=60)
         images_to_push = self.dryrun_push_images(app, cfg)
         config = app_to_batch_job(app, app_id, opts)
         req = GCPBatchJob(
@@ -415,8 +418,19 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
         """Cloud Logging fetch (the CloudWatch analog of the reference's
         aws_batch log_iter); no tail, single page of recent entries."""
         job = self._parse_app_id(app_id)
+        # Batch stamps log entries with the server-generated job UID, not
+        # the submitted job id — resolve it via describe first
+        uid = job.name
+        proc0 = self._run_cmd(
+            self._gcloud(job, "describe", job.name, "--format", "json")
+        )
+        if proc0.returncode == 0:
+            try:
+                uid = json.loads(proc0.stdout or "{}").get("uid") or uid
+            except json.JSONDecodeError:
+                pass
         filt = (
-            f'labels.job_uid="{job.name}" AND '
+            f'labels.job_uid="{uid}" AND '
             f'labels.task_index="{k}"'
         )
         cmd = [

@@ -32,7 +32,6 @@ materialization is plain dicts, so dryrun tests run with no cluster
 from __future__ import annotations
 
 import copy
-import hashlib
 import json
 import logging
 import time
@@ -48,7 +47,7 @@ from torchx_tpu.schedulers.api import (
     Stream,
     filter_regex,
 )
-from torchx_tpu.schedulers.ids import cleanup, make_unique
+from torchx_tpu.schedulers.ids import cleanup, make_unique, sanitize_name
 from torchx_tpu.util.strings import normalize_str
 from torchx_tpu.schedulers.structured_opts import StructuredOpts
 from torchx_tpu.specs.api import (
@@ -148,22 +147,6 @@ class GKEJob:
 # =========================================================================
 # Request materialization (pure functions -> testable without a cluster)
 # =========================================================================
-
-
-def sanitize_name(name: str, max_len: int = 53) -> str:
-    """DNS-1123 subdomain, shortened to leave room for JobSet suffixes
-    (jobset adds -{job}-{index}-{podindex}; the 63-char pod-name check the
-    reference does at :862-889 is enforced here by budgeting upfront).
-
-    Truncation appends a suffix derived from a *hash* of the full name so
-    repeated calls agree — pod-name selectors, container names, and the
-    coordinator DNS derivation must all resolve to the same string.
-    """
-    name = cleanup(name)
-    if len(name) > max_len:
-        digest = hashlib.sha1(name.encode()).hexdigest()[:5]
-        name = name[: max_len - 6].rstrip("-") + "-" + digest
-    return name
 
 
 def role_to_container(role: Role) -> dict[str, Any]:

@@ -22,3 +22,18 @@ def cleanup(name: str) -> str:
     from torchx_tpu.util.strings import normalize_str
 
     return normalize_str(name, max_len=10_000) or "app"
+
+
+def sanitize_name(name: str, max_len: int = 53) -> str:
+    """DNS-1123-ish identifier shortened to ``max_len``: truncation appends
+    a suffix derived from a *hash* of the full name so repeated calls
+    agree — any derived strings (selectors, DNS names, labels) resolve to
+    the same value. Shared by the gke (pod-name budget) and gcp_batch
+    (63-char job-id/label cap) schedulers."""
+    import hashlib
+
+    name = cleanup(name)
+    if len(name) > max_len:
+        digest = hashlib.sha1(name.encode()).hexdigest()[:5]
+        name = name[: max_len - 6].rstrip("-") + "-" + digest
+    return name
