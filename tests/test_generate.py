@@ -111,3 +111,42 @@ class TestMoEGenerate:
             seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
         out = gen.generate(params, prompt, cfg, max_new_tokens=4)
         np.testing.assert_array_equal(out, seq)
+
+
+class TestGenerateStream:
+    def test_stream_token_identical_to_batch(self):
+        import jax
+        import jax.numpy as jnp
+        from torchx_tpu.models import generate as gen, llama
+
+        cfg = llama.llama_tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = jnp.array([[1, 2, 3, 4], [5, 6, 7, 8]], dtype=jnp.int32)
+        for temp, seed in [(0.0, 0), (0.8, 7)]:
+            full = gen.generate(
+                params, prompt, cfg, max_new_tokens=11,
+                temperature=temp, rng=jax.random.PRNGKey(seed),
+            )
+            chunks = list(gen.generate_stream(
+                params, prompt, cfg, max_new_tokens=11,
+                temperature=temp, rng=jax.random.PRNGKey(seed), chunk=4,
+            ))
+            streamed = jnp.concatenate([jnp.asarray(c) for c in chunks], axis=1)
+            assert (streamed == full[:, 4:]).all(), temp
+            # chunk sizes: prefill token, then 4/4/2
+            assert [c.shape[1] for c in chunks] == [1, 4, 4, 2]
+
+    def test_stream_rejects_overflow(self):
+        import jax
+        import jax.numpy as jnp
+        from torchx_tpu.models import generate as gen, llama
+
+        cfg = llama.llama_tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = jnp.ones((1, 4), dtype=jnp.int32)
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="max_seq"):
+            list(gen.generate_stream(
+                params, prompt, cfg, max_new_tokens=cfg.max_seq,
+            ))

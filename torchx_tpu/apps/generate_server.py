@@ -29,6 +29,7 @@ to ``--max-batch`` instead of serializing forward passes.
 from __future__ import annotations
 
 import argparse
+import codecs
 import dataclasses
 import json
 import logging
@@ -283,6 +284,52 @@ class GenerateService:
             raise errors[0]
         return [p.result for p in pendings]
 
+    def generate_stream(
+        self,
+        tokens: list[int],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        seed: int = 0,
+        chunk: int = 8,
+    ):
+        """Yield lists of new token ids as they decode (single sequence).
+
+        Streaming bypasses the batcher — a stream holds the device for its
+        whole decode, so it trades coalescing for time-to-first-token;
+        token-identical to the batch path at the same seed."""
+        if self._closed:
+            raise RuntimeError("generate service is closed")
+        if not tokens:
+            raise ValueError("tokens must be a non-empty sequence")
+        if len(tokens) + max_new_tokens > self.cfg.max_seq:
+            raise ValueError(
+                f"prompt length {len(tokens)} + {max_new_tokens} new tokens"
+                f" exceeds max_seq {self.cfg.max_seq}"
+            )
+        from torchx_tpu.models import generate as gen
+
+        with self._count_lock:
+            self.requests += 1
+        batch = jnp.asarray([tokens], dtype=jnp.int32)
+        # gen.generate_stream ALSO validates eagerly (chunk/max_new/max_seq)
+        # before returning its generator, so every argument error surfaces
+        # here — before the caller commits an HTTP status line
+        it = gen.generate_stream(
+            self.params,
+            batch,
+            self.cfg,
+            max_new_tokens=max_new_tokens,
+            temperature=round(temperature, 3),
+            rng=jax.random.PRNGKey(seed),
+            chunk=chunk,
+        )
+
+        def rows():
+            for piece in it:
+                yield [int(x) for x in piece[0]]
+
+        return rows()
+
 
 def _make_handler(service: GenerateService):
     class Handler(BaseHTTPRequestHandler):
@@ -314,6 +361,52 @@ def _make_handler(service: GenerateService):
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
+        def _stream(self, tokens: list[int], req: dict, text_mode: bool) -> None:
+            """JSONL streaming response (one line per decoded chunk,
+            terminated by {\"done\": true}); connection closes at the end.
+
+            The iterator is created BEFORE the 200 goes out — validation
+            errors still surface as a clean 400. Once streaming has begun
+            no status line may be written; mid-stream failures just end
+            the stream (the missing done marker tells the client)."""
+            it = service.generate_stream(
+                tokens,
+                max_new_tokens=int(req.get("max_new_tokens", 16)),
+                temperature=float(req.get("temperature", 0.0)),
+                seed=int(req.get("seed", 0)),
+                # clamp: chunk < 1 would raise, huge chunks defeat streaming
+                chunk=max(1, min(int(req.get("stream_chunk", 8)), 64)),
+            )
+            self._streamed = True  # no _reply may run after this point
+            self.send_response(200)
+            self.send_header("Content-Type", "application/jsonl")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            # multibyte UTF-8 sequences can split across chunk boundaries;
+            # an incremental decoder carries the partial bytes over
+            decoder = codecs.getincrementaldecoder("utf-8")("replace")
+            try:
+                for piece in it:
+                    if text_mode:
+                        payload = {
+                            "text_delta": decoder.decode(
+                                bytes(b for b in piece if 0 <= b < 256)
+                            )
+                        }
+                    else:
+                        payload = {"tokens": piece}
+                    self.wfile.write(json.dumps(payload).encode() + b"\n")
+                    self.wfile.flush()
+                if text_mode:
+                    tail = decoder.decode(b"", final=True)
+                    if tail:
+                        self.wfile.write(
+                            json.dumps({"text_delta": tail}).encode() + b"\n"
+                        )
+                self.wfile.write(b'{"done": true}\n')
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client went away mid-stream; nothing to reply to
+
         def do_POST(self) -> None:  # noqa: N802
             if self.path != "/v1/generate":
                 self._reply(404, {"error": f"unknown path {self.path}"})
@@ -329,6 +422,15 @@ def _make_handler(service: GenerateService):
                     tokens = [list(t.encode("utf-8")) for t in texts]
                 else:
                     tokens = req["tokens"]
+                if req.get("stream"):
+                    if len(tokens) != 1:
+                        self._reply(
+                            400,
+                            {"error": "stream mode takes exactly one sequence"},
+                        )
+                        return
+                    self._stream(tokens[0], req, text_mode)
+                    return
                 out = service.generate(
                     tokens,
                     max_new_tokens=int(req.get("max_new_tokens", 16)),
@@ -350,9 +452,11 @@ def _make_handler(service: GenerateService):
                 else:
                     self._reply(200, {"tokens": out})
             except (KeyError, ValueError, TypeError) as e:
-                self._reply(400, {"error": str(e)})
+                if not getattr(self, "_streamed", False):
+                    self._reply(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 - surface, don't kill the server
-                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                if not getattr(self, "_streamed", False):
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
     return Handler
 

@@ -12,6 +12,7 @@ forward), temperature>0 samples from the softmax.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -124,6 +125,33 @@ def forward_with_cache(
     return logits, {"k": k_new, "v": v_new}
 
 
+def _sample(logits_t: jnp.ndarray, key: jax.Array, temperature: float) -> jnp.ndarray:
+    """Greedy at temperature 0, else categorical — the ONE sampling rule
+    both the batch and streaming paths use (parity depends on it)."""
+    if temperature <= 0:
+        return jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits_t / temperature, axis=-1).astype(
+        jnp.int32
+    )
+
+
+def _prefill(
+    params: llama.Params,
+    prompt: jnp.ndarray,
+    cfg: llama.LlamaConfig,
+    total: int,
+    rng: jax.Array,
+    temperature: float,
+) -> tuple[KVCache, jnp.ndarray, jax.Array]:
+    """Shared prompt pass: -> (cache, first sampled token, carried rng).
+    Consumes a fresh subkey for token 0 and carries the unconsumed key, so
+    step 0's draw is independent of step 1's."""
+    cache = init_kv_cache(cfg, prompt.shape[0], total)
+    logits, cache = forward_with_cache(params, prompt, cache, jnp.int32(0), cfg)
+    rng, first_key = jax.random.split(rng)
+    return cache, _sample(logits[:, -1], first_key, temperature), rng
+
+
 def generate(
     params: llama.Params,
     prompt: jnp.ndarray,  # [b, t0] int32
@@ -144,23 +172,11 @@ def generate(
         raise ValueError(
             f"prompt + new tokens ({total}) exceeds max_seq {cfg.max_seq}"
         )
-    cache = init_kv_cache(cfg, b, total)
-    logits, cache = forward_with_cache(
-        params, prompt, cache, jnp.int32(0), cfg
-    )
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    # consume a fresh subkey for token 0 and carry the unconsumed key into
-    # the scan, so step 0's draw is independent of step 1's
-    rng, first_key = jax.random.split(rng)
+    cache, next_tok, rng = _prefill(params, prompt, cfg, total, rng, temperature)
 
     def sample(logits_t, key):  # noqa: ANN001
-        if temperature <= 0:
-            return jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits_t / temperature, axis=-1).astype(
-            jnp.int32
-        )
-
-    next_tok = sample(logits[:, -1], first_key)
+        return _sample(logits_t, key, temperature)
     out = jnp.zeros((b, max_new_tokens), dtype=jnp.int32)
     out = out.at[:, 0].set(next_tok)
 
@@ -180,3 +196,81 @@ def generate(
             step, (cache, next_tok, out, rng), jnp.arange(max_new_tokens - 1)
         )
     return jnp.concatenate([prompt, out], axis=1)
+
+
+@functools.lru_cache(maxsize=64)
+def _stream_fns(cfg: llama.LlamaConfig, total: int, temperature: float, chunk: int):
+    """Jitted (prefill, decode_chunk) pair for one streaming shape — cached
+    at module level so repeated streaming requests reuse the compiled
+    programs instead of re-tracing per call (jax's own jit cache then
+    handles distinct batch sizes under each entry)."""
+
+    @jax.jit
+    def prefill(params, prompt, rng):  # noqa: ANN001
+        return _prefill(params, prompt, cfg, total, rng, temperature)
+
+    @jax.jit
+    def decode_chunk(params, cache, tok, rng, start):  # noqa: ANN001
+        # always runs `chunk` steps (static shapes under jit); on the final
+        # partial chunk the caller slices off the surplus tokens, whose
+        # cache writes are never read again
+        def step(carry, i):  # noqa: ANN001
+            cache, tok, key = carry
+            key, sub = jax.random.split(key)
+            logits, cache = forward_with_cache(params, tok[:, None], cache, start + i, cfg)
+            nxt = _sample(logits[:, -1], sub, temperature)
+            return (cache, nxt, key), nxt
+
+        (cache, tok, rng), toks = jax.lax.scan(
+            step, (cache, tok, rng), jnp.arange(chunk)
+        )
+        return cache, tok, rng, toks.swapaxes(0, 1)  # [b, chunk]
+
+    return prefill, decode_chunk
+
+
+def generate_stream(
+    params: llama.Params,
+    prompt: jnp.ndarray,  # [b, t0] int32
+    cfg: llama.LlamaConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    chunk: int = 8,
+):
+    """Streaming :func:`generate`: yields ``[b, t]`` int32 arrays of NEW
+    tokens as they decode (t <= ``chunk``), token-identical to the batch
+    path at the same seed (shared ``_sample``/``_prefill``).
+
+    Decode runs in jitted ``chunk``-step segments — one device dispatch +
+    one host transfer per chunk; the compiled programs are cached across
+    calls (:func:`_stream_fns`). Arguments are validated eagerly (this is
+    a generator; callers see errors before any output is produced)."""
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    b, t0 = prompt.shape
+    total = t0 + max_new_tokens
+    if total > cfg.max_seq:
+        raise ValueError(
+            f"prompt + new tokens ({total}) exceeds max_seq {cfg.max_seq}"
+        )
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    prefill, decode_chunk = _stream_fns(cfg, total, float(temperature), chunk)
+
+    def run():
+        cache, tok, carried = prefill(params, prompt, rng)
+        yield jax.device_get(tok)[:, None]
+        produced = 1
+        state = (cache, tok, carried)
+        while produced < max_new_tokens:
+            n = min(chunk, max_new_tokens - produced)
+            cache, tok, carried, toks = decode_chunk(
+                params, *state, jnp.int32(t0 + produced - 1)
+            )
+            state = (cache, tok, carried)
+            yield jax.device_get(toks)[:, :n]
+            produced += n
+
+    return run()

@@ -353,8 +353,10 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
             " [project:]location:name"
         )
 
-    def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
-        job = self._parse_app_id(app_id)
+    def _describe_json(self, job: "GCPBatchScheduler._Id") -> Optional[dict]:
+        """Raw ``gcloud batch jobs describe`` payload, or None when the job
+        is unknown / the output is unparseable (shared by describe and the
+        log-UID resolution)."""
         proc = self._run_cmd(
             self._gcloud(job, "describe", job.name, "--format", "json")
         )
@@ -363,6 +365,13 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
         try:
             payload = json.loads(proc.stdout or "{}")
         except json.JSONDecodeError:
+            return None
+        return payload if isinstance(payload, dict) else None
+
+    def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
+        job = self._parse_app_id(app_id)
+        payload = self._describe_json(job)
+        if payload is None:
             return None
         # single-role jobs: the real role name rides the job label we set
         # at materialization (Batch taskGroups carry no names)
@@ -420,15 +429,8 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
         job = self._parse_app_id(app_id)
         # Batch stamps log entries with the server-generated job UID, not
         # the submitted job id — resolve it via describe first
-        uid = job.name
-        proc0 = self._run_cmd(
-            self._gcloud(job, "describe", job.name, "--format", "json")
-        )
-        if proc0.returncode == 0:
-            try:
-                uid = json.loads(proc0.stdout or "{}").get("uid") or uid
-            except json.JSONDecodeError:
-                pass
+        payload = self._describe_json(job)
+        uid = (payload or {}).get("uid") or job.name
         filt = (
             f'labels.job_uid="{uid}" AND '
             f'labels.task_index="{k}"'
