@@ -107,3 +107,97 @@ def test_sync_mode_still_supported(tmp_path):
         assert ckpt.latest_step() == 1
     finally:
         ckpt.close()
+
+
+class TestRobustness:
+    """Edge cases a real preemption leaves behind: partial/corrupt
+    checkpoint dirs must not take down the resume path."""
+
+    def _state(self):
+        import jax.numpy as jnp
+
+        return {"w": jnp.arange(8, dtype=jnp.float32), "step": jnp.int32(0)}
+
+    def test_restore_falls_back_past_corrupt_latest(self, tmp_path):
+        """A preemption mid-write leaves the newest step corrupt; resume
+        must fall back to the previous intact step, not die."""
+        import jax.numpy as jnp
+
+        from torchx_tpu.parallel.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(str(tmp_path), async_save=False)
+        ckpt.save(1, {"w": jnp.full(8, 1.0), "step": jnp.int32(1)})
+        ckpt.save(2, {"w": jnp.full(8, 2.0), "step": jnp.int32(2)})
+        ckpt.wait()
+        ckpt.close()
+        # gut step 2's payload (orbax dir "2" or pickle "step_2.pkl")
+        corrupted = 0
+        for p in tmp_path.iterdir():
+            if p.name == "2" or p.name.startswith("step_2"):
+                if p.is_file():
+                    p.write_bytes(b"truncated")
+                    corrupted += 1
+                else:
+                    for child in p.rglob("*"):
+                        if child.is_file():
+                            child.write_bytes(b"truncated")
+                            corrupted += 1
+        assert corrupted, "corruption target not found: layout changed?"
+        ckpt2 = Checkpointer(str(tmp_path), async_save=False)
+        step, restored = ckpt2.restore_latest(self._state())
+        # fell back to the intact step 1 with its REAL data
+        assert step == 1
+        assert (jax.device_get(restored["w"]) == 1.0).all()
+        # the corrupt step was quarantined, so training that resumes from
+        # step 1 can SAVE step 2 again (no StepAlreadyExistsError crash
+        # loop under gang-restart retries)
+        assert ckpt2.save(2, {"w": jnp.full(8, 2.5), "step": jnp.int32(2)})
+        ckpt2.wait()
+        ckpt2.close()
+        ckpt3 = Checkpointer(str(tmp_path))
+        step3, restored3 = ckpt3.restore_latest(self._state())
+        ckpt3.close()
+        assert step3 == 2
+        assert (jax.device_get(restored3["w"]) == 2.5).all()
+        # the quarantined dir is kept aside as evidence
+        assert any(".corrupt" in p.name for p in tmp_path.iterdir())
+
+    def test_all_corrupt_raises_instead_of_reinit(self, tmp_path):
+        import pytest as _pytest
+
+        from torchx_tpu.parallel.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(str(tmp_path), async_save=False)
+        ckpt.save(1, self._state())
+        ckpt.wait()
+        ckpt.close()
+        for p in tmp_path.rglob("*"):
+            if p.is_file():
+                p.write_bytes(b"junk")
+        ckpt2 = Checkpointer(str(tmp_path), async_save=False)
+        with _pytest.raises(RuntimeError, match="failed to restore"):
+            ckpt2.restore_latest(self._state())
+        ckpt2.close()
+
+    def test_empty_directory_roundtrip(self, tmp_path):
+        from torchx_tpu.parallel.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(str(tmp_path / "fresh"))
+        step, restored = ckpt.restore_latest(self._state())
+        assert restored is None and not step
+        ckpt.close()
+
+    def test_save_interval_respected(self, tmp_path):
+        from torchx_tpu.parallel.checkpoint import Checkpointer
+
+        state = self._state()
+        ckpt = Checkpointer(str(tmp_path), save_interval_steps=5, async_save=False)
+        for s in range(1, 12):
+            ckpt.save(s, state)
+        ckpt.wait()
+        ckpt.close()
+        ckpt2 = Checkpointer(str(tmp_path))
+        step, restored = ckpt2.restore_latest(self._state())
+        ckpt2.close()
+        # only interval steps persisted; latest is the last multiple of 5
+        assert step == 10

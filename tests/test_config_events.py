@@ -106,3 +106,39 @@ class TestEvents:
         restored = TpxEvent.deserialize(ev.serialize())
         assert restored == ev
         assert json.loads(ev.serialize())["app_id"] == "a1"
+
+
+class TestConfigEnvPrecedence:
+    def test_tpxconfig_env_wins_over_home_and_cwd(self, tmp_path, monkeypatch):
+        """$TPXCONFIG > $HOME/.tpxconfig > ./.tpxconfig (reference
+        precedence, runner/config.py docstring)."""
+        from torchx_tpu.runner import config as cfg_mod
+
+        env_file = tmp_path / "env.tpxconfig"
+        env_file.write_text("[local]\nlog_dir = /from-env\n")
+        home = tmp_path / "home"
+        home.mkdir()
+        (home / ".tpxconfig").write_text("[local]\nlog_dir = /from-home\n")
+        cwd = tmp_path / "cwd"
+        cwd.mkdir()
+        (cwd / ".tpxconfig").write_text("[local]\nlog_dir = /from-cwd\n")
+        monkeypatch.setenv("TPXCONFIG", str(env_file))
+        monkeypatch.setenv("HOME", str(home))
+        monkeypatch.chdir(cwd)
+        out: dict = {}
+        cfg_mod.apply("local", out)
+        assert out["log_dir"] == "/from-env"
+        # without the env file, HOME wins over CWD
+        monkeypatch.delenv("TPXCONFIG")
+        out2: dict = {}
+        cfg_mod.apply("local", out2)
+        assert out2["log_dir"] == "/from-home"
+
+    def test_explicit_cfg_beats_every_file(self, tmp_path, monkeypatch):
+        from torchx_tpu.runner import config as cfg_mod
+
+        (tmp_path / ".tpxconfig").write_text("[local]\nlog_dir = /from-file\n")
+        monkeypatch.chdir(tmp_path)
+        out = {"log_dir": "/explicit"}
+        cfg_mod.apply("local", out)
+        assert out["log_dir"] == "/explicit"

@@ -134,3 +134,64 @@ def test_spmd_retry_restarts_failed_gang(tmp_path):
         for replica in (0, 1):
             lines = list(runner.log_lines(handle, "spmd", replica))
             assert any("computed_mesh_size=4" in ln for ln in lines), lines
+
+
+@pytest.mark.e2e
+def test_resize_resumes_training_from_checkpoint(tmp_path):
+    """BASELINE config 4, operator-driven: `resize` a live 2-process SPMD
+    training gang down to 1; the restarted world re-forms jax.distributed,
+    resumes from the checkpoint, and finishes."""
+    import time
+
+    ckpt = tmp_path / "ckpt"
+    with get_runner("resize-e2e") as runner:
+        handle = runner.run_component(
+            "dist.spmd",
+            [
+                "-j", "2x1",
+                "-m", "torchx_tpu.examples.train_llama",
+                "--",
+                "--config", "tiny",
+                "--mesh", "dp=-1,fsdp=1",
+                "--batch", "4",
+                "--seq", "32",
+                "--steps", "300",
+                "--ckpt-dir", str(ckpt),
+                "--ckpt-every", "20",
+            ],
+            "local",
+            {"log_dir": str(tmp_path)},
+        )
+        def finalized_step() -> bool:
+            # orbax writes async saves into *.orbax-checkpoint-tmp-* staging
+            # dirs first; only a committed digit-named step dir (or pickle
+            # step file) counts as a durable checkpoint
+            if not ckpt.exists():
+                return False
+            return any(
+                p.name.isdigit() or p.name.startswith("step_")
+                for p in ckpt.iterdir()
+            )
+
+        # wait until training is underway and a checkpoint landed
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if finalized_step():
+                break
+            status = runner.status(handle)
+            assert status is not None and not status.is_terminal(), (
+                status and status.format()
+            )
+            time.sleep(0.5)
+        else:
+            raise TimeoutError("no checkpoint appeared")
+        runner.resize(handle, "spmd", 1)
+        status = runner.wait(handle, wait_interval=0.5)
+        assert status is not None and status.state == AppState.SUCCEEDED, (
+            status and status.format()
+        )
+        lines = list(runner.log_lines(handle, "spmd", 0))
+        assert any("resumed from checkpoint step" in ln for ln in lines), lines
+        # exactly one replica in the resized terminal gang
+        (rs,) = status.roles
+        assert len(rs.replicas) == 1

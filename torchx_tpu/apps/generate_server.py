@@ -452,10 +452,16 @@ def _make_handler(service: GenerateService):
                 else:
                     self._reply(200, {"tokens": out})
             except (KeyError, ValueError, TypeError) as e:
-                if not getattr(self, "_streamed", False):
+                if getattr(self, "_streamed", False):
+                    logger.warning("stream aborted mid-flight: %s", e)
+                else:
                     self._reply(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 - surface, don't kill the server
-                if not getattr(self, "_streamed", False):
+                if getattr(self, "_streamed", False):
+                    logger.error(
+                        "stream aborted mid-flight: %s: %s", type(e).__name__, e
+                    )
+                else:
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
     return Handler

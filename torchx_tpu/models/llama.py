@@ -346,8 +346,21 @@ def forward_features(
     pp=1 value when routing varies across microbatches — the standard
     group-wise aux (GShard computes it per dispatch group the same way);
     router balancing pressure is preserved, exact loss parity is not."""
-    s = tokens.shape[1]
     x = params["embed"][tokens].astype(cfg.dtype)  # [b, s, d]
+    return features_from_embeddings(params, x, cfg, mesh)
+
+
+def features_from_embeddings(
+    params: Params,
+    x: jnp.ndarray,  # [b, s, d] input embeddings
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`forward_features` starting AFTER the embedding lookup — the
+    continuous-input entry point interpretability needs (gradients w.r.t.
+    embeddings, e.g. saliency / integrated gradients over tokens)."""
+    s = x.shape[1]
+    x = x.astype(cfg.dtype)
     x = _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
 
     cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
@@ -392,6 +405,21 @@ def forward_features(
 
 def lm_head(params: Params, cfg: LlamaConfig) -> jnp.ndarray:
     return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+
+
+def forward_from_embeddings(
+    params: Params,
+    embeds: jnp.ndarray,  # [b, s, d]
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+) -> jnp.ndarray:
+    """-> logits [b, s, vocab] f32 from input embeddings (see
+    :func:`features_from_embeddings`)."""
+    x, _ = features_from_embeddings(params, embeds, cfg, mesh)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, lm_head(params, cfg), preferred_element_type=jnp.float32
+    )
+    return _constraint(logits, mesh, ("dp", "fsdp"), "sp", "tp")
 
 
 def forward(

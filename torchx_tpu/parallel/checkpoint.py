@@ -90,12 +90,7 @@ class Checkpointer:
         if self._mgr is not None:
             self.wait()  # an in-flight save IS the latest once finalized
             return self._mgr.latest_step()
-        steps = [
-            int(m.group(1))
-            for p in os.listdir(self.directory)
-            if (m := re.fullmatch(r"step_(\d+)\.pkl", p))
-        ]
-        return max(steps, default=None)
+        return next(iter(self._all_steps()), None)
 
     def restore(self, step: int, abstract_state: Any) -> Any:
         """Restore onto the shardings/dtypes of ``abstract_state`` (a pytree
@@ -125,12 +120,100 @@ class Checkpointer:
             )
         return self._pickle_restore(step, abstract_state)
 
+    def _all_steps(self) -> list[int]:
+        """Known finalized steps, newest first (the ONE place the pickle
+        step layout is parsed; latest_step and _prune derive from it)."""
+        if self._mgr is not None:
+            self.wait()
+            return sorted(self._mgr.all_steps(), reverse=True)
+        return sorted(
+            (
+                int(m.group(1))
+                for p in os.listdir(self.directory)
+                if (m := re.fullmatch(r"step_(\d+)\.pkl", p))
+            ),
+            reverse=True,
+        )
+
     def restore_latest(self, abstract_state: Any) -> tuple[Optional[int], Any]:
-        """-> (step, state) from the newest checkpoint, or (None, None)."""
-        step = self.latest_step()
-        if step is None:
+        """-> (step, state) from the newest RESTORABLE checkpoint, or
+        (None, None).
+
+        A preemption can kill the process mid-write, leaving the newest
+        step present-but-corrupt; resume must not die on it, so restore
+        walks newest -> oldest, logging and skipping steps that fail to
+        load. Only when every retained step is unreadable does the error
+        propagate (silently reinitializing from scratch with corrupt
+        checkpoints on disk would hide real data loss)."""
+        steps = self._all_steps()
+        if not steps:
             return None, None
-        return step, self.restore(step, abstract_state)
+        if jax.process_count() > 1:
+            # the fallback decision must be GANG-COORDINATED: orbax restore
+            # is collective, so hosts independently skipping different
+            # corrupt steps would enter mismatched collectives (hang) or
+            # resume from different params (silent divergence). Restore the
+            # newest step on every host and let a failure surface; the
+            # launcher's retry policy restarts the gang, and an operator
+            # can prune the corrupt step dir to fall back explicitly.
+            step = steps[0]
+            return step, self.restore(step, abstract_state)
+        last_err: Optional[Exception] = None
+        for step in steps:
+            try:
+                return step, self.restore(step, abstract_state)
+            except Exception as e:  # noqa: BLE001 - per-step corruption
+                logger.warning(
+                    "checkpoint step %d is unreadable (%s: %s); trying the"
+                    " previous step",
+                    step,
+                    type(e).__name__,
+                    e,
+                )
+                last_err = e
+                # quarantine the corrupt step: training resumed from an
+                # older step will reach this step number again, and a
+                # lingering dir would make the re-save crash
+                # (orbax StepAlreadyExistsError) — a permanent crash loop
+                # under gang-restart retries
+                self._quarantine(step)
+        raise RuntimeError(
+            f"all {len(steps)} retained checkpoints under {self.directory}"
+            " failed to restore; refusing to silently reinitialize"
+        ) from last_err
+
+    def _quarantine(self, step: int) -> None:
+        """Move an unreadable step aside (never delete: it is evidence,
+        and an operator may still salvage shards from it)."""
+        candidates = [
+            os.path.join(self.directory, str(step)),
+            os.path.join(self.directory, f"step_{step}.pkl"),
+        ]
+        for path in candidates:
+            if not os.path.exists(path):
+                continue
+            dst = f"{path}.corrupt"
+            n = 0
+            while os.path.exists(dst):
+                n += 1
+                dst = f"{path}.corrupt{n}"
+            try:
+                os.rename(path, dst)
+                logger.warning("quarantined corrupt checkpoint %s -> %s", path, dst)
+            except OSError as e:
+                logger.error("could not quarantine %s: %s", path, e)
+        if self._mgr is not None:
+            # orbax caches the step list; re-open so the quarantined step
+            # disappears from all_steps()/latest_step() and save() works
+            self._mgr.close()
+            self._mgr = self._ocp.CheckpointManager(
+                self.directory,
+                options=self._ocp.CheckpointManagerOptions(
+                    max_to_keep=self._max_to_keep,
+                    save_interval_steps=self._save_interval,
+                    enable_async_checkpointing=self._async,
+                ),
+            )
 
     def close(self) -> None:
         if self._mgr is not None:
@@ -169,10 +252,6 @@ class Checkpointer:
         )
 
     def _prune(self) -> None:
-        steps = sorted(
-            int(m.group(1))
-            for p in os.listdir(self.directory)
-            if (m := re.fullmatch(r"step_(\d+)\.pkl", p))
-        )
+        steps = sorted(self._all_steps())
         for old in steps[: -self._max_to_keep]:
             os.unlink(os.path.join(self.directory, f"step_{old}.pkl"))
