@@ -75,7 +75,8 @@ class TestDockerDryrun:
             ),
             {},
         )
-        assert len(info.request.containers) == 2  # 16 v5e chips -> 2 hosts
+        # multi-host v5e is built from 4-chip VMs: 16 chips -> 4 hosts
+        assert len(info.request.containers) == 4
 
     def test_copy_env_globs(self, sched, monkeypatch):
         monkeypatch.setenv("TPX_TEST_SECRETVAR", "v")

@@ -100,14 +100,34 @@ class TestMaterialization:
         group = role_to_task_group(tpu_role(num_replicas=2), "app-1")
         assert group["taskCount"] == 8  # 2 slices x 4 hosts
 
-    def test_tpu_machine_type(self):
+    def test_tpu_machine_type_single_host(self):
+        # v5litepod-8 fits on one host: the 8-chip VM family
         cfg = app_to_batch_job(
             AppDef(name="a", roles=[tpu_role(accelerator="v5e", chips=8)]),
             "app-1",
             GCPBatchOpts(),
         )
         (inst,) = cfg["allocationPolicy"]["instances"]
-        assert inst["policy"]["machineType"] == "ct5lp-hightpu-4t"
+        assert inst["policy"]["machineType"] == "ct5lp-hightpu-8t"
+
+    @pytest.mark.parametrize(
+        "accelerator, chips, machine_type",
+        [
+            ("v5e", 16, "ct5lp-hightpu-4t"),  # multi-host v5e = 4-chip VMs
+            ("v5e", 64, "ct5lp-hightpu-4t"),
+            ("v6e", 16, "ct6e-standard-4t"),
+            ("v6e", 8, "ct6e-standard-8t"),  # single host keeps the 8t VM
+            ("v4", 16, "ct4p-hightpu-4t"),
+        ],
+    )
+    def test_tpu_machine_type_geometry(self, accelerator, chips, machine_type):
+        cfg = app_to_batch_job(
+            AppDef(name="a", roles=[tpu_role(accelerator=accelerator, chips=chips)]),
+            "app-1",
+            GCPBatchOpts(),
+        )
+        (inst,) = cfg["allocationPolicy"]["instances"]
+        assert inst["policy"]["machineType"] == machine_type
 
     def test_unknown_accelerator_raises(self):
         # v7x is a valid slice generation but has no Batch machine family
@@ -264,6 +284,51 @@ class TestLifecycle:
         assert item.name == "app-1"
         assert item.state == AppState.RUNNING
 
+    def test_list_scoped_to_session_cfg(self):
+        # jobs submitted with an explicit project/location must stay visible
+        # to list(), and listed ids must carry the project prefix so later
+        # describe/cancel target the same project
+        payload = json.dumps(
+            [
+                {
+                    "name": "projects/my-proj/locations/eu-west4/jobs/app-1",
+                    "status": {"state": "RUNNING"},
+                }
+            ]
+        )
+        calls = []
+
+        def run_cmd(cmd, **kwargs):
+            calls.append(cmd)
+            return proc(stdout=payload if "list" in cmd else "{}")
+
+        sched = self._sched(run_cmd)
+        info = sched.submit_dryrun(
+            AppDef(name="t", roles=[cpu_role()]),
+            {"location": "eu-west4", "project": "my-proj"},
+        )
+        sched.schedule(info)  # list() scopes to SUBMITTED cfg, not dryruns
+        (item,) = sched.list()
+        assert item.app_id == "my-proj:eu-west4:app-1"
+        list_cmd = calls[-1]
+        assert "--project" in list_cmd and "my-proj" in list_cmd
+        assert "--location" in list_cmd and "eu-west4" in list_cmd
+
+    def test_list_falls_back_to_gcloud_project(self):
+        # no session cfg: list() asks gcloud for the configured project
+        jobs = json.dumps(
+            [{"name": "projects/p/locations/l/jobs/j-1", "status": {"state": "QUEUED"}}]
+        )
+
+        def run_cmd(cmd, **kwargs):
+            if "config" in cmd:
+                return proc(stdout="cfg-proj\n")
+            return proc(stdout=jobs)
+
+        sched = self._sched(run_cmd)
+        (item,) = sched.list()
+        assert item.app_id == "cfg-proj:us-central1:j-1"
+
     def test_cancel_falls_back_to_delete(self):
         calls = []
 
@@ -321,6 +386,30 @@ class TestLifecycle:
         sched = self._sched(run_cmd)
         list(sched.log_iter("us-central1:app-1", "w", 0))
         assert 'labels.job_uid="app-1"' in calls[-1][3]
+
+    def test_log_iter_window_filters(self):
+        calls = []
+
+        def run_cmd(cmd, **kwargs):
+            calls.append(cmd)
+            if "describe" in cmd:
+                return proc(stdout=json.dumps({"uid": "u1"}))
+            return proc(stdout="[]")
+
+        sched = self._sched(run_cmd)
+        # 2026-07-29T00:00:00Z .. +1h
+        list(sched.log_iter("us-central1:app-1", "w", 0, since=1785283200.0,
+                            until=1785286800.0))
+        filt = calls[-1][3]
+        assert 'timestamp>="2026-07-29T00:00:00Z"' in filt
+        assert 'timestamp<="2026-07-29T01:00:00Z"' in filt
+
+    def test_log_iter_rejects_stream_selection(self):
+        from torchx_tpu.schedulers.api import Stream
+
+        sched = self._sched(lambda cmd, **kw: proc())
+        with pytest.raises(ValueError, match="combined"):
+            sched.log_iter("us-central1:app-1", "w", 0, streams=Stream.STDOUT)
 
     def test_long_app_name_capped_to_63(self):
         sched = self._sched(lambda cmd, **kw: proc())

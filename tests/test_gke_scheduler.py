@@ -70,6 +70,33 @@ class TestJobSetMaterialization:
         assert container["resources"]["limits"]["google.com/tpu"] == 4
         assert pod["spec"]["tolerations"][0]["key"] == "google.com/tpu"
 
+    # A real GKE 4x4 v5e pool is 4 nodes x 4 chips: the JobSet must ask for
+    # parallelism=4 with google.com/tpu: 4, or it can never schedule.
+    @pytest.mark.parametrize(
+        "accelerator, chips, hosts, tpu_limit, topology, selector",
+        [
+            ("v5e", 16, 4, 4, "4x4", "tpu-v5-lite-podslice"),
+            ("v5e", 32, 8, 4, "4x8", "tpu-v5-lite-podslice"),
+            ("v5e", 8, 1, 8, "2x4", "tpu-v5-lite-podslice"),
+            ("v6e", 16, 4, 4, "4x4", "tpu-v6e-slice"),
+            ("v6e", 8, 1, 8, "2x4", "tpu-v6e-slice"),
+        ],
+    )
+    def test_v5e_v6e_geometry(
+        self, accelerator, chips, hosts, tpu_limit, topology, selector
+    ):
+        js = make_jobset(
+            AppDef(name="a", roles=[tpu_role(chips=chips, accelerator=accelerator)])
+        )
+        (rj,) = js["spec"]["replicatedJobs"]
+        spec = rj["template"]["spec"]
+        assert spec["parallelism"] == hosts and spec["completions"] == hosts
+        pod = spec["template"]["spec"]
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == topology
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == selector
+        limits = pod["containers"][0]["resources"]["limits"]
+        assert limits["google.com/tpu"] == tpu_limit
+
     def test_replica_id_via_completion_index(self):
         js = make_jobset(AppDef(name="a", roles=[tpu_role()]))
         container = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"][
@@ -617,6 +644,48 @@ class TestGKELifecycle:
         sched = GKEScheduler("t", client=object())
         with pytest.raises(ValueError, match="expected namespace:name"):
             sched.describe("no-colon-here")
+
+    def _log_sched(self, monkeypatch, log_lines):
+        core = mock.MagicMock()
+        pod = mock.MagicMock()
+        pod.metadata.name = "app1-w-0-0-xyz"
+        pod.metadata.labels = {}
+        pod.metadata.annotations = {}
+        core.list_namespaced_pod.return_value.items = [pod]
+        core.read_namespaced_pod_log.return_value = log_lines
+        return self._sched_with_api(monkeypatch, core=core), core
+
+    def test_log_iter_since_maps_to_since_seconds(self, monkeypatch, fake_k8s):
+        import time
+
+        sched, core = self._log_sched(monkeypatch, [b"x\n"])
+        list(sched.log_iter("ml:app1", "w", 0, since=time.time() - 120))
+        kwargs = core.read_namespaced_pod_log.call_args.kwargs
+        assert 115 <= kwargs["since_seconds"] <= 125
+
+    def test_log_iter_until_filters_and_strips_stamps(self, monkeypatch, fake_k8s):
+        # kubelet RFC3339Nano stamps; line 3 is past the window
+        sched, core = self._log_sched(
+            monkeypatch,
+            [
+                b"2026-07-29T10:00:00.123456789Z first\n",
+                b"2026-07-29T10:00:05.000000000Z second\n",
+                b"2026-07-29T10:30:00.000000000Z too late\n",
+            ],
+        )
+        from datetime import datetime, timezone
+
+        until = datetime(2026, 7, 29, 10, 1, tzinfo=timezone.utc).timestamp()
+        lines = list(sched.log_iter("ml:app1", "w", 0, until=until))
+        assert lines == ["first", "second"]
+        assert core.read_namespaced_pod_log.call_args.kwargs["timestamps"] is True
+
+    def test_log_iter_rejects_stream_selection(self, monkeypatch, fake_k8s):
+        from torchx_tpu.schedulers.api import Stream
+
+        sched, _ = self._log_sched(monkeypatch, [])
+        with pytest.raises(ValueError, match="combined stream"):
+            sched.log_iter("ml:app1", "w", 0, streams=Stream.STDERR)
 
 
 # =========================================================================

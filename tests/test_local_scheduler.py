@@ -466,6 +466,31 @@ class TestLocalScheduler:
         time.sleep(0.3)  # allow tee to drain
         combined = (tmp_path / app_id / "c" / "0" / "combined.log").read_text()
         assert "out" in combined and "err" in combined
+        # every tee'd line leads with an epoch stamp (what log windows use)
+        from torchx_tpu.schedulers.api import parse_epoch_stamp
+
+        for raw in combined.splitlines():
+            ts, payload = parse_epoch_stamp(raw)
+            assert ts is not None and payload in ("out", "err")
+
+    def test_log_windows_on_combined(self, sched, tmp_path):
+        app = AppDef(name="win", roles=[sh_role("w", "echo early; echo late")])
+        app_id = sched.submit(app, {"log_dir": str(tmp_path)})
+        wait_terminal(sched, app_id)
+        time.sleep(0.3)  # allow tee to drain
+        now = time.time()
+        # stamps are stripped from the default (combined) stream
+        lines = list(sched.log_iter(app_id, "w", 0))
+        assert lines == ["early", "late"]
+        # a window entirely in the past excludes everything
+        assert list(sched.log_iter(app_id, "w", 0, until=now - 3600)) == []
+        # a window entirely in the future excludes everything
+        assert list(sched.log_iter(app_id, "w", 0, since=now + 3600)) == []
+        # a window spanning now includes everything
+        assert (
+            list(sched.log_iter(app_id, "w", 0, since=now - 3600, until=now + 60))
+            == ["early", "late"]
+        )
 
     def test_dir_image_provider(self, tmp_path):
         img = tmp_path / "img"

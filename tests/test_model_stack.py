@@ -21,6 +21,7 @@ class TestMeshConfig:
             "pp": 1,
             "dp": 2,
             "fsdp": 2,
+            "ep": 1,
             "tp": 2,
             "sp": 1,
         }
@@ -38,7 +39,9 @@ class TestMeshConfig:
 
     def test_make_mesh(self):
         mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2, sp=1))
-        assert dict(mesh.shape) == {"pp": 1, "dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
+        assert dict(mesh.shape) == {
+            "pp": 1, "dp": 2, "fsdp": 2, "ep": 1, "tp": 2, "sp": 1,
+        }
 
 
 class TestOps:

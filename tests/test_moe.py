@@ -88,6 +88,20 @@ class TestMoEModel:
         out = jax.jit(lambda p, t: moe.forward(p, t, cfg, mesh))(sharded, tokens)
         np.testing.assert_allclose(out, ref, atol=2e-4)
 
+    def test_expert_parallel_over_ep_axis(self):
+        # tp=1, ep=4: expert parallelism without tensor parallelism — the
+        # layout the dedicated ep axis exists for
+        cfg = moe.moe_tiny()
+        params = moe.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 512)
+        ref = moe.forward(params, tokens, cfg)
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=2, ep=4, tp=1, sp=1))
+        sharded = moe.shard_params(params, cfg, mesh)
+        spec = moe.param_specs(cfg)["layers"]["w_gate"]
+        assert spec[1] == ("ep", "tp")  # expert axis shards over ep x tp
+        out = jax.jit(lambda p, t: moe.forward(p, t, cfg, mesh))(sharded, tokens)
+        np.testing.assert_allclose(out, ref, atol=2e-4)
+
     def test_moe_with_pp_mesh(self):
         cfg = moe.moe_tiny(n_experts=4, top_k=2)
         params = moe.init_params(cfg, jax.random.PRNGKey(0))

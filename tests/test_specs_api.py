@@ -48,7 +48,49 @@ class TestTpuSlice:
     def test_v6e(self):
         s = TpuSlice.from_type("v6e-16")
         assert s.chips == 16
-        assert s.hosts == 2
+        # multi-host v6e is built from 4-chip VMs (ct6e-standard-4t)
+        assert s.hosts == 4
+
+    # Multi-host v5e/v6e slices use 4-chip VMs exclusively; only slices that
+    # fit on a single host come as 8-chip (or 1-chip) VMs. A wrong host count
+    # here makes every GKE/Vertex/Batch request unschedulable.
+    @pytest.mark.parametrize(
+        "acc_type, chips_per_host, hosts, topology",
+        [
+            ("v5litepod-1", 1, 1, "1x1"),
+            ("v5litepod-4", 4, 1, "2x2"),
+            ("v5litepod-8", 8, 1, "2x4"),
+            ("v5litepod-16", 4, 4, "4x4"),
+            ("v5litepod-32", 4, 8, "4x8"),
+            ("v5litepod-64", 4, 16, "8x8"),
+            ("v5litepod-128", 4, 32, "8x16"),
+            ("v5litepod-256", 4, 64, "16x16"),
+            ("v6e-8", 8, 1, "2x4"),
+            ("v6e-16", 4, 4, "4x4"),
+            ("v6e-32", 4, 8, "4x8"),
+            ("v6e-64", 4, 16, "8x8"),
+        ],
+    )
+    def test_v5e_v6e_host_geometry(self, acc_type, chips_per_host, hosts, topology):
+        s = TpuSlice.from_type(acc_type)
+        assert s.chips_per_host == chips_per_host
+        assert s.hosts == hosts
+        assert s.default_topology() == topology
+
+    @pytest.mark.parametrize(
+        "acc_type, chips_per_host, hosts",
+        [
+            ("v4-8", 4, 1),
+            ("v4-32", 4, 4),
+            ("v5p-8", 4, 1),
+            ("v5p-32", 4, 4),
+            ("v5p-128", 4, 16),
+        ],
+    )
+    def test_v4_v5p_host_geometry(self, acc_type, chips_per_host, hosts):
+        s = TpuSlice.from_type(acc_type)
+        assert s.chips_per_host == chips_per_host
+        assert s.hosts == hosts
 
     def test_v4_single_host(self):
         s = TpuSlice.from_type("v4-8")

@@ -51,6 +51,25 @@ class TestCustomJobMaterialization:
         assert spec["machineType"] == "ct5lp-hightpu-8t"
         assert "tpuTopology" not in spec  # single host: no topology field
 
+    # Multi-host v5e/v6e rides 4-chip VMs: ct5lp-hightpu-8t + tpuTopology 4x4
+    # is an invalid machine spec Vertex rejects at admission.
+    @pytest.mark.parametrize(
+        "accelerator, chips, machine_type, topology",
+        [
+            ("v5e", 16, "ct5lp-hightpu-4t", "4x4"),
+            ("v5e", 32, "ct5lp-hightpu-4t", "4x8"),
+            ("v5e", 64, "ct5lp-hightpu-4t", "8x8"),
+            ("v6e", 16, "ct6e-standard-4t", "4x4"),
+            ("v6e", 32, "ct6e-standard-4t", "4x8"),
+        ],
+    )
+    def test_tpu_machine_spec_multihost_v5e_v6e(
+        self, accelerator, chips, machine_type, topology
+    ):
+        spec = tpu_machine_spec(tpu_role(chips=chips, accelerator=accelerator))
+        assert spec["machineType"] == machine_type
+        assert spec["tpuTopology"] == topology
+
     def test_unknown_generation_raises(self):
         with pytest.raises(ValueError, match="no Vertex AI machine type"):
             tpu_machine_spec(tpu_role(accelerator="v2", chips=8))
@@ -157,6 +176,36 @@ class TestVertexLifecycle:
     def test_describe_unknown_app(self, tmp_path, monkeypatch):
         sched, _ = self.make_sched(tmp_path, monkeypatch)
         assert sched.describe("nope") is None
+
+    def test_log_iter_window_filters(self, tmp_path, monkeypatch):
+        sched, client = self.make_sched(tmp_path, monkeypatch)
+        app_id = sched.submit(
+            AppDef(name="t", roles=[tpu_role()]), {"project": "p", "region": "r"}
+        )
+        calls = []
+
+        def fake_run(cmd, **kwargs):
+            calls.append(cmd)
+            return mock.MagicMock(returncode=0, stdout="a\nb\n", stderr="")
+
+        monkeypatch.setattr("subprocess.run", fake_run)
+        lines = list(
+            sched.log_iter(app_id, "w", 0, since=1785283200.0, until=1785286800.0)
+        )
+        assert lines == ["a", "b"]
+        filt = calls[-1][3]
+        assert 'timestamp>="2026-07-29T00:00:00Z"' in filt
+        assert 'timestamp<="2026-07-29T01:00:00Z"' in filt
+
+    def test_log_iter_rejects_stream_selection(self, tmp_path, monkeypatch):
+        from torchx_tpu.schedulers.api import Stream
+
+        sched, _ = self.make_sched(tmp_path, monkeypatch)
+        app_id = sched.submit(
+            AppDef(name="t", roles=[tpu_role()]), {"project": "p", "region": "r"}
+        )
+        with pytest.raises(ValueError, match="combined"):
+            sched.log_iter(app_id, "w", 0, streams=Stream.STDERR)
 
     def test_state_map_and_error_surface(self):
         resp = describe_custom_job(

@@ -76,6 +76,16 @@ class GenerateService:
     window, merging compatible sequences (same prompt length / max_new /
     temperature / seed) into ONE device batch — concurrent clients share
     MXU work instead of serializing whole forward passes behind a lock.
+
+    Seed semantics under coalescing: one ``PRNGKey(seed)`` drives the whole
+    merged batch, so at ``temperature > 0`` a request's sampled tokens
+    depend on its row position within whatever batch it merged into — the
+    same (prompt, seed) pair is NOT reproducible across runs with other
+    concurrent traffic. Results are deterministic at ``temperature == 0``
+    (greedy ignores the rng), with the batcher effectively disabled
+    (``max_batch=1``), or when a client is alone in the window. Per-row
+    key folding is deliberately not done: it would break token parity with
+    :func:`torchx_tpu.models.generate.generate` at the same seed.
     """
 
     def __init__(

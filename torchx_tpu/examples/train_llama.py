@@ -346,7 +346,12 @@ def all_configs() -> dict:
 def main(argv: Optional[list[str]] = None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--config", default="tiny", choices=sorted(all_configs()))
-    parser.add_argument("--mesh", default="fsdp=-1", help="e.g. dp=2,fsdp=-1,tp=4")
+    parser.add_argument(
+        "--mesh",
+        default="fsdp=-1",
+        help="axis sizes pp/dp/fsdp/ep/tp/sp, e.g. dp=2,fsdp=-1,tp=4"
+        " (ep shards MoE experts independently of tp, e.g. ep=8,tp=1)",
+    )
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--seq", type=int, default=128)
     parser.add_argument("--steps", type=int, default=10)

@@ -7,10 +7,12 @@ fixed per-expert capacity, so every shape is static and XLA lays the whole
 thing on the MXU (no dynamic gathers, the TPU-idiomatic MoE).
 
 Expert parallelism (EP): the expert axis of the expert weights shards over
-the ``tp`` mesh axis (see :func:`param_specs`); the dispatch einsum then
-becomes the token all-to-all over ICI, placed by XLA. Capacity overflow
-tokens are dropped (standard GShard semantics) — size capacity_factor
-accordingly.
+the combined ``("ep", "tp")`` mesh axes (see :func:`param_specs`); the
+dispatch einsum then becomes the token all-to-all over ICI, placed by XLA.
+A dedicated ``ep`` axis means ep and tp size independently — tp=1, ep=8
+runs a small MoE expert-parallel without tensor parallelism; at ep=1 the
+layout degenerates to experts-over-tp. Capacity overflow tokens are
+dropped (standard GShard semantics) — size capacity_factor accordingly.
 """
 
 from __future__ import annotations
@@ -118,15 +120,17 @@ def init_params(cfg: MoEConfig, key: jax.Array) -> llama.Params:
 
 
 def param_specs(cfg: MoEConfig, pp: bool = False) -> llama.Params:
-    """Expert axis shards over ``tp`` (expert parallelism); within-expert
-    dims shard over ``fsdp`` like the dense model; the stacked layer axis
-    shards over ``pp`` when pipeline parallelism is on."""
+    """Expert axis shards over ``("ep", "tp")`` combined (expert
+    parallelism, independent of tensor-parallel size); within-expert dims
+    shard over ``fsdp`` like the dense model; the stacked layer axis shards
+    over ``pp`` when pipeline parallelism is on."""
     layer_axis = "pp" if pp else None
+    expert_axes = ("ep", "tp")
     specs = llama.param_specs(cfg, pp=pp)
     specs["layers"]["w_router"] = P(layer_axis, "fsdp", None)
-    specs["layers"]["w_gate"] = P(layer_axis, "tp", "fsdp", None)
-    specs["layers"]["w_up"] = P(layer_axis, "tp", "fsdp", None)
-    specs["layers"]["w_down"] = P(layer_axis, "tp", None, "fsdp")
+    specs["layers"]["w_gate"] = P(layer_axis, expert_axes, "fsdp", None)
+    specs["layers"]["w_up"] = P(layer_axis, expert_axes, "fsdp", None)
+    specs["layers"]["w_down"] = P(layer_axis, expert_axes, None, "fsdp")
     return specs
 
 

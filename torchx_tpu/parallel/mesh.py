@@ -1,16 +1,20 @@
 """Device mesh construction + sharding helpers for SPMD training.
 
-The canonical 5-axis mesh for TPU LLM training (scaling-book recipe: pick a
+The canonical 6-axis mesh for TPU LLM training (scaling-book recipe: pick a
 mesh, annotate shardings, let XLA insert the collectives over ICI/DCN):
 
 * ``pp``   — pipeline parallelism (layer stages; between slices, DCN),
 * ``dp``   — pure data parallelism (between slices, rides DCN),
 * ``fsdp`` — data parallelism with parameter sharding (rides ICI),
+* ``ep``   — expert parallelism (MoE expert axis; dense models leave it 1),
 * ``tp``   — tensor (model) parallelism within attention/MLP blocks,
 * ``sp``   — sequence/context parallelism for long sequences.
 
 Axis sizes multiply to the device count; unused axes get size 1 so
-PartitionSpecs can always name every axis.
+PartitionSpecs can always name every axis. MoE expert weights shard over
+``("ep", "tp")`` combined (models/moe.py), so ep and tp can be sized
+independently — tp=1, ep=8 for a small MoE, or tp=4, ep=2 to split both
+ways.
 """
 
 from __future__ import annotations
@@ -23,7 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("pp", "dp", "fsdp", "tp", "sp")
+AXES = ("pp", "dp", "fsdp", "ep", "tp", "sp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,6 +38,7 @@ class MeshConfig:
     pp: int = 1
     dp: int = 1
     fsdp: int = -1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 
@@ -60,11 +65,12 @@ def make_mesh(
     config: MeshConfig = MeshConfig(),
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build the 5-axis mesh over all (or the given) devices.
+    """Build the 6-axis mesh over all (or the given) devices.
 
-    Axis order is (pp, dp, fsdp, tp, sp) — outermost-to-innermost matches
-    slowest-to-fastest interconnect: pp/dp between slices over DCN, tp on
-    the innermost ICI dimension where its all-reduces are cheapest.
+    Axis order is (pp, dp, fsdp, ep, tp, sp) — outermost-to-innermost
+    matches slowest-to-fastest interconnect: pp/dp between slices over DCN,
+    tp on the innermost ICI dimension where its all-reduces are cheapest;
+    ep sits just outside tp so the MoE all-to-all also rides ICI.
     """
     devs = list(devices) if devices is not None else jax.devices()
     sizes = config.resolve(len(devs))

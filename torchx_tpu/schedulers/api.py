@@ -61,10 +61,19 @@ class ListAppResponse:
 
 
 def dquote(s: str) -> str:
-    """Double-quote a string for bash: metachars are safe but ``$VAR``
-    references (runtime macro values like the replica id) still expand.
-    Shared by every scheduler that materializes shell scripts."""
-    return '"' + s.replace("\\", "\\\\").replace('"', '\\"').replace("`", "\\`") + '"'
+    """Double-quote a string for bash: metachars are safe but ``$VAR`` /
+    ``${VAR}`` references (runtime macro values like the replica id) still
+    expand. Command substitution is neutralized both ways — backticks and
+    ``$(...)`` are escaped, since intentional variable expansion never
+    requires running commands from inside role args/env values. Shared by
+    every scheduler that materializes shell scripts."""
+    out = (
+        s.replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("`", "\\`")
+        .replace("$(", "\\$(")
+    )
+    return '"' + out + '"'
 
 
 def safe_int(value: Any, default: int = 0) -> int:
@@ -81,6 +90,55 @@ def filter_regex(regex: str, data: Iterable[str]) -> Iterable[str]:
 
     r = re.compile(regex)
     return (line for line in data if r.search(line))
+
+
+_STAMP_RE = None  # compiled lazily; the pattern matches a real epoch only
+
+
+def parse_epoch_stamp(line: str) -> "tuple[Optional[float], str]":
+    """-> (epoch or None, payload) for log lines stamped ``<epoch.millis> ``.
+
+    Shared by the tpu_vm remote stamper and the local Tee: anything not
+    shaped like a real epoch (legacy logs, raw writes, lines that merely
+    start with a number like '3 retries left') passes through unstamped."""
+    global _STAMP_RE
+    if _STAMP_RE is None:
+        import re
+
+        _STAMP_RE = re.compile(r"^\d{9,12}\.\d{3}$")
+    head, sep, rest = line.partition(" ")
+    if sep and _STAMP_RE.match(head):
+        return float(head), rest
+    return None, line
+
+
+def rfc3339(epoch: float) -> str:
+    """Epoch seconds -> the RFC3339 UTC form Cloud Logging filters expect
+    (shared by the gcp_batch and vertex log windows)."""
+    from datetime import datetime, timezone
+
+    return (
+        datetime.fromtimestamp(epoch, tz=timezone.utc)
+        .isoformat()
+        .replace("+00:00", "Z")
+    )
+
+
+def window_stamped_lines(
+    lines: Iterable[str],
+    since: Optional[float] = None,
+    until: Optional[float] = None,
+) -> Iterable[str]:
+    """Apply a since/until window to epoch-stamped lines and strip the
+    stamps. Unstamped lines pass through whole (no stamp -> no window)."""
+    for line in lines:
+        ts, payload = parse_epoch_stamp(line)
+        if ts is not None:
+            if since is not None and ts < since:
+                continue
+            if until is not None and ts > until:
+                continue
+        yield payload
 
 
 def split_lines(text: str) -> list[str]:

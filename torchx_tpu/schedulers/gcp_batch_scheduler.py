@@ -45,6 +45,7 @@ from torchx_tpu.schedulers.api import (
     Scheduler,
     Stream,
     filter_regex,
+    rfc3339 as _rfc3339,
     tpu_hosts_for_role,
 )
 from torchx_tpu.schedulers.ids import cleanup, make_unique, sanitize_name
@@ -64,14 +65,16 @@ from torchx_tpu.workspace.docker_workspace import DockerWorkspaceMixin
 
 logger = logging.getLogger(__name__)
 
-# TPU generation -> Batch TPU-VM machine family (chips-per-host is fixed
-# per family; multi-host slices scale via taskCount, mirroring how the GKE
-# path scales via Indexed-Job completions)
+# TPU generation -> Batch TPU-VM machine family. ``{chips}`` is filled from
+# ``TpuSlice.chips_per_host``, which is shape-dependent on v5e/v6e: a
+# single-host v5litepod-8 is one ct5lp-hightpu-8t VM, while any multi-host
+# v5e slice is built from ct5lp-hightpu-4t VMs (taskCount scales the hosts,
+# mirroring how the GKE path scales via Indexed-Job completions).
 TPU_MACHINE_TYPES = {
     "v4": "ct4p-hightpu-4t",
-    "v5e": "ct5lp-hightpu-4t",
+    "v5e": "ct5lp-hightpu-{chips}t",
     "v5p": "ct5p-hightpu-4t",
-    "v6e": "ct6e-standard-4t",
+    "v6e": "ct6e-standard-{chips}t",
 }
 
 # Batch job state -> AppState (``gcloud batch jobs describe`` status.state)
@@ -217,12 +220,13 @@ def app_to_batch_job(
     task_group = role_to_task_group(role, app_id)
     tpu = role.resource.tpu if role.resource is not None else None
     if tpu:
-        machine = TPU_MACHINE_TYPES.get(tpu.accelerator)
-        if machine is None:
+        family = TPU_MACHINE_TYPES.get(tpu.accelerator)
+        if family is None:
             raise ValueError(
                 f"no Batch TPU-VM machine family for {tpu.accelerator!r};"
                 f" known: {sorted(TPU_MACHINE_TYPES)}"
             )
+        machine = family.format(chips=tpu.chips_per_host)
     else:
         machine = opts.machine_type
 
@@ -273,12 +277,17 @@ def describe_batch_job(
 class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
     """Submits AppDefs as GCP Batch jobs through the gcloud CLI."""
 
+    # since/until become server-side Cloud Logging timestamp filters
+    supports_log_windows = True
+
     def __init__(self, session_name: str, docker_client: Optional[Any] = None) -> None:
         super().__init__(
             docker_client=docker_client,
             backend="gcp_batch",
             session_name=session_name,
         )
+        # last-submitted run cfg; list() reuses it for project/location scope
+        self._session_opts: Optional[GCPBatchOpts] = None
 
     def _run_cmd(self, cmd: list[str], **kwargs: Any) -> subprocess.CompletedProcess:
         return subprocess.run(cmd, capture_output=True, text=True, **kwargs)
@@ -315,6 +324,12 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
 
     def schedule(self, dryrun_info: AppDryRunInfo[GCPBatchJob]) -> str:
         req = dryrun_info.request
+        # remember where this session actually submits, for list() scoping
+        # (set here, not in dryrun: a dryrun that is never scheduled must
+        # not retarget list())
+        self._session_opts = GCPBatchOpts(
+            project=req.project, location=req.location
+        )
         self.push_images(req.images_to_push)
         proc = self._run_cmd(
             self._gcloud(req, "submit", req.name, "--config", "-"),
@@ -379,9 +394,11 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
         return describe_batch_job(app_id, payload, [role_name])
 
     def list(self) -> list[ListAppResponse]:
-        # location-scoped listing requires cfg; list across the configured
-        # default project/location
-        opts = GCPBatchOpts()
+        # Batch listing is location-scoped but list() takes no cfg: reuse the
+        # session's last-submitted project/location (set by _submit_dryrun)
+        # so jobs submitted with an explicit project stay visible, falling
+        # back to the gcloud-configured project + default location.
+        opts = self._session_opts or GCPBatchOpts(project=self._gcloud_project())
         proc = self._run_cmd(self._gcloud(opts, "list", "--format", "json"))
         if proc.returncode != 0:
             return []
@@ -389,6 +406,9 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
             jobs = json.loads(proc.stdout or "[]")
         except json.JSONDecodeError:
             return []
+        # mint ids with the project prefix when known, so describe/cancel/
+        # log on a listed id target the same project list() queried
+        prefix = f"{opts.project}:{opts.location}" if opts.project else opts.location
         out = []
         for j in jobs:
             name = str(j.get("name", "")).rsplit("/", 1)[-1]
@@ -396,11 +416,17 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
                 str((j.get("status") or {}).get("state", "")), AppState.UNKNOWN
             )
             out.append(
-                ListAppResponse(
-                    app_id=f"{opts.location}:{name}", state=state, name=name
-                )
+                ListAppResponse(app_id=f"{prefix}:{name}", state=state, name=name)
             )
         return out
+
+    def _gcloud_project(self) -> Optional[str]:
+        """The gcloud-configured default project, or None."""
+        proc = self._run_cmd(["gcloud", "config", "get-value", "project"])
+        if proc.returncode != 0:
+            return None
+        val = (proc.stdout or "").strip()
+        return val if val and val != "(unset)" else None
 
     def _cancel_existing(self, app_id: str) -> None:
         job = self._parse_app_id(app_id)
@@ -425,7 +451,14 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
         streams: Optional[Stream] = None,
     ) -> Iterable[str]:
         """Cloud Logging fetch (the CloudWatch analog of the reference's
-        aws_batch log_iter); no tail, single page of recent entries."""
+        aws_batch log_iter); no tail, single page of recent entries.
+        since/until map to server-side ``timestamp`` filters; Batch keeps
+        one combined log per task, so stream selection raises."""
+        if streams not in (None, Stream.COMBINED):
+            raise ValueError(
+                f"gcp_batch task logs are a single combined Cloud Logging"
+                f" stream; selecting {streams} is not supported"
+            )
         job = self._parse_app_id(app_id)
         # Batch stamps log entries with the server-generated job UID, not
         # the submitted job id — resolve it via describe first
@@ -435,6 +468,10 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
             f'labels.job_uid="{uid}" AND '
             f'labels.task_index="{k}"'
         )
+        if since is not None:
+            filt += f' AND timestamp>="{_rfc3339(since)}"'
+        if until is not None:
+            filt += f' AND timestamp<="{_rfc3339(until)}"'
         cmd = [
             "gcloud",
             "logging",

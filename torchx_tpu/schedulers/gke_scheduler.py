@@ -34,9 +34,12 @@ from __future__ import annotations
 import copy
 import json
 import logging
+import math
+import os
+import re
 import time
 from dataclasses import dataclass, field
-from typing import Any, Iterable, Mapping, Optional, TYPE_CHECKING
+from typing import Any, Iterable, Iterator, Mapping, Optional, TYPE_CHECKING
 
 from torchx_tpu import settings
 from torchx_tpu.schedulers.api import (
@@ -801,6 +804,17 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
                 num_replicas,
             )
             return
+        # Rescue the rewritten body to disk BEFORE the delete: the
+        # delete/poll/create window is up to 120 polls long, and if this
+        # process dies inside it the app would otherwise be gone with
+        # nothing to resubmit. `kubectl apply -f <path>` recovers.
+        rescue_path = self._write_resize_rescue(name, body)
+        logger.info(
+            "resize %s: rewritten body saved to %s (kubectl apply -f it"
+            " if this process dies mid-resize)",
+            app_id,
+            rescue_path,
+        )
         # foreground propagation: the JobSet object only 404s once its
         # child Jobs/pods are gone too, so the poll below doubles as
         # waiting for the old gang's TPU capacity to actually free up
@@ -818,7 +832,8 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
         else:
             raise RuntimeError(
                 f"jobset {name} was not deleted in time; resize aborted"
-                " before re-creation (re-run once the deletion finishes)"
+                f" before re-creation (re-run once the deletion finishes,"
+                f" or `kubectl apply -f {rescue_path}`)"
             )
         try:
             api.create_namespaced_custom_object(
@@ -829,23 +844,32 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
                 body=body,
             )
         except Exception:
-            # the old set is gone; losing the rewritten body too would
-            # leave the operator with nothing to resubmit
-            import tempfile
-
-            fd, path = tempfile.mkstemp(
-                prefix=f"tpx-resize-{name}-", suffix=".json"
-            )
-            with open(fd, "w") as f:
-                json.dump(body, f, indent=2, default=str)
+            # the old set is gone; the pre-delete rescue file is the
+            # operator's path to resubmission
             logger.error(
                 "re-creation of jobset %s failed AFTER deletion; the"
                 " resized body was saved to %s — fix the rejection and"
                 " `kubectl apply -f` it",
                 name,
-                path,
+                rescue_path,
             )
             raise
+        else:
+            try:
+                os.unlink(rescue_path)
+            except OSError:
+                pass
+
+    @staticmethod
+    def _write_resize_rescue(name: str, body: dict) -> str:
+        import tempfile
+
+        fd, path = tempfile.mkstemp(prefix=f"tpx-resize-{name}-", suffix=".json")
+        with open(fd, "w") as f:
+            json.dump(body, f, indent=2, default=str)
+        return path
+
+    supports_log_windows = True  # since via since_seconds, until via stamps
 
     def log_iter(
         self,
@@ -858,16 +882,38 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
         should_tail: bool = False,
         streams: Optional[Stream] = None,
     ) -> Iterable[str]:
+        """Pod logs with real window fidelity: ``since`` maps to the API's
+        ``since_seconds``, ``until`` is applied client-side from kubelet
+        RFC3339 line stamps (``timestamps=True``; stamps are stripped
+        before yielding so output is byte-identical to the unwindowed
+        path), and stdout/stderr selection raises — the kubelet keeps one
+        combined stream per container (reference analog:
+        kubernetes_scheduler.py:1025-1045)."""
+        if streams not in (None, Stream.COMBINED):
+            raise ValueError(
+                f"kubernetes pod logs are a single combined stream;"
+                f" selecting {streams} is not supported on gke"
+            )
         namespace, name = self._parse_app_id(app_id)
         pod_name = self._resolve_pod_name(namespace, name, role_name, k)
         core = self._core_api()
+        kwargs: dict[str, Any] = {}
+        if since is not None:
+            # ceil keeps the window inclusive (int() would start it up to
+            # 1s late and drop in-window lines)
+            kwargs["since_seconds"] = max(1, math.ceil(time.time() - since))
+        if until is not None:
+            kwargs["timestamps"] = True
         resp = core.read_namespaced_pod_log(
             name=pod_name,
             namespace=namespace,
             follow=should_tail,
             _preload_content=False,
+            **kwargs,
         )
         lines = (ln.decode("utf-8", errors="replace").rstrip("\n") for ln in resp)
+        if until is not None:
+            lines = _strip_until(lines, until)
         if regex:
             lines = filter_regex(regex, lines)
         return lines
@@ -906,6 +952,28 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
                 f" ({len(indexed)} pods exist for jobset {name})"
             )
         return indexed[k][2]
+
+
+def _strip_until(lines: Iterable[str], until: float) -> Iterator[str]:
+    """Drop lines stamped after ``until`` and strip the kubelet RFC3339
+    timestamp prefix from the rest. Unstamped lines (shouldn't happen with
+    ``timestamps=True``, but be permissive) pass through whole."""
+    from datetime import datetime
+
+    for line in lines:
+        stamp, _, payload = line.partition(" ")
+        try:
+            # kubelet stamps are RFC3339Nano; fromisoformat needs <= 6
+            # fractional digits, so trim nanos down to micros
+            ts = datetime.fromisoformat(
+                re.sub(r"(\.\d{6})\d+", r"\1", stamp.replace("Z", "+00:00"))
+            ).timestamp()
+        except ValueError:
+            yield line
+            continue
+        if ts > until:
+            return
+        yield payload
 
 
 # =========================================================================

@@ -41,6 +41,7 @@ from torchx_tpu.schedulers.api import (
     filter_regex,
     role_replica_env,
     tpu_hosts_for_role,
+    window_stamped_lines,
 )
 from torchx_tpu.schedulers.ids import make_unique
 from torchx_tpu.schedulers.streams import Tee
@@ -404,6 +405,10 @@ class _LocalApp:
 
 class LocalScheduler(Scheduler[PopenRequest]):
     """Executes AppDef roles as local subprocesses."""
+
+    # combined.log lines are epoch-stamped by the Tee (streams.py), so
+    # since/until windows are honored on the default combined stream
+    supports_log_windows = True
 
     def __init__(
         self,
@@ -1060,6 +1065,18 @@ class LocalScheduler(Scheduler[PopenRequest]):
         }[stream]
         log_file = os.path.join(log_root, role_name, str(k), fname)
         it: Iterable[str] = LogIterator(self, app_id, log_file, should_tail)
+        # combined.log lines are epoch-stamped by the Tee: apply the window
+        # and strip the stamps. stdout/stderr are the raw process FDs — no
+        # stamps, so windows cannot apply there; say so instead of silently
+        # returning the full log.
+        if stream is not Stream.COMBINED and (since or until):
+            logger.warning(
+                "since/until only apply to the local combined stream"
+                " (stdout/stderr are raw process files with no line"
+                " timestamps); showing the full %s log",
+                stream.value,
+            )
+        it = window_stamped_lines(it, since, until)
         if regex:
             it = filter_regex(regex, it)
         return it

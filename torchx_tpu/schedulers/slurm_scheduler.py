@@ -370,6 +370,11 @@ class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
         should_tail: bool = False,
         streams: Optional[Stream] = None,
     ) -> Iterable[str]:
+        # since/until are NOT applied here (supports_log_windows stays
+        # False, the runner warns): slurm's own --output/--error files have
+        # no per-line timestamps, and interposing a stamper between srun
+        # and the filesystem would break sites' existing log tooling. Use
+        # `sacct --format=Start,End` to bracket a job's wall-clock instead.
         job_dir = _load_job_dir(app_id)
         if job_dir is None:
             raise RuntimeError(

@@ -42,6 +42,7 @@ from torchx_tpu.schedulers.api import (
     Scheduler,
     Stream,
     filter_regex,
+    parse_epoch_stamp,
 )
 from torchx_tpu.schedulers.ids import make_unique
 from torchx_tpu.specs.api import (
@@ -390,18 +391,8 @@ class TpuVmScheduler(Scheduler[TpuVmRequest]):
         return _parse_log_frames(proc.stdout, list(offsets))
 
 
-_STAMP_RE = re.compile(r"^\d{9,12}\.\d{3}$")
-
-
-def _parse_stamp(line: str) -> tuple[Optional[float], str]:
-    """-> (epoch or None, payload). Lines from the stamper lead with
-    '<epoch.millis> '; anything else (legacy combined log, raw writes,
-    lines that merely START with a number like '3 retries left') passes
-    through unstamped — the stamp must look like a real epoch."""
-    head, sep, rest = line.partition(" ")
-    if sep and _STAMP_RE.match(head):
-        return float(head), rest
-    return None, line
+# stamp parsing is shared with the local Tee (same wire format)
+_parse_stamp = parse_epoch_stamp
 
 
 def _parse_log_frames(

@@ -36,6 +36,7 @@ from torchx_tpu.schedulers.api import (
     Scheduler,
     Stream,
     filter_regex,
+    rfc3339 as _rfc3339,
 )
 from torchx_tpu.schedulers.ids import make_unique
 from torchx_tpu.specs.api import (
@@ -199,6 +200,9 @@ class VertexJob:
 class VertexScheduler(DockerWorkspaceMixin, Scheduler[VertexJob]):
     """Submits AppDefs as Vertex AI CustomJobs (managed TPU training)."""
 
+    # since/until become server-side Cloud Logging timestamp filters
+    supports_log_windows = True
+
     def __init__(
         self,
         session_name: str,
@@ -342,9 +346,16 @@ class VertexScheduler(DockerWorkspaceMixin, Scheduler[VertexJob]):
         streams: Optional[Stream] = None,
     ) -> Iterable[str]:
         """Worker logs land in Cloud Logging; fetched via gcloud so the
-        scheduler needs no logging SDK (same pattern as tpu_vm ssh logs)."""
+        scheduler needs no logging SDK (same pattern as tpu_vm ssh logs).
+        since/until map to server-side ``timestamp`` filters; Vertex keeps
+        one combined stream per job, so stream selection raises."""
         import subprocess
 
+        if streams not in (None, Stream.COMBINED):
+            raise ValueError(
+                f"vertex job logs are a single combined Cloud Logging"
+                f" stream; selecting {streams} is not supported"
+            )
         name = _load_job_name(app_id)
         if name is None:
             raise ValueError(f"unknown app: {app_id}")
@@ -353,12 +364,17 @@ class VertexScheduler(DockerWorkspaceMixin, Scheduler[VertexJob]):
         parts = name.split("/")
         project = parts[1] if len(parts) > 3 else ""
         job_id = parts[-1]
+        filt = f'resource.labels.job_id="{job_id}"'
+        if since is not None:
+            filt += f' AND timestamp>="{_rfc3339(since)}"'
+        if until is not None:
+            filt += f' AND timestamp<="{_rfc3339(until)}"'
         proc = subprocess.run(
             [
                 "gcloud",
                 "logging",
                 "read",
-                f'resource.labels.job_id="{job_id}"',
+                filt,
                 *(["--project", project] if project else []),
                 "--format=value(textPayload)",
                 "--order=asc",

@@ -17,6 +17,7 @@ import copy
 import json
 import math
 import re
+import warnings
 from dataclasses import dataclass, field
 from datetime import datetime
 from enum import Enum
@@ -29,17 +30,21 @@ from typing import Any, Callable, Generic, Iterator, Mapping, Optional, TypeVar,
 
 # Physical facts per TPU generation. ``cores_per_chip`` matters because v4/v5p
 # slice names count TensorCores ("v4-8" = 4 chips) while v5e/v6e names count
-# chips ("v5litepod-8" = 8 chips). ``chips_per_host`` bounds how many chips a
-# single TPU-VM host exposes, which determines the number of workers (hosts)
-# the launcher must gang-schedule for a slice.
+# chips ("v5litepod-8" = 8 chips). ``single_host_chips`` is the largest slice
+# that fits on ONE TPU-VM host; ``multi_host_vm_chips`` is the chips-per-VM
+# for slices bigger than that. The two differ on v5e/v6e: single-host slices
+# come as 1/4/8-chip VMs (ct5lp-hightpu-{1,4,8}t / ct6e-standard-{1,4,8}t)
+# but multi-host slices are built EXCLUSIVELY from 4-chip VMs
+# (ct5lp-hightpu-4t / ct6e-standard-4t) — e.g. v5litepod-16 is 4 hosts x 4
+# chips on a 4x4 topology, never 2 hosts x 8.
 _TPU_GENERATIONS: dict[str, dict[str, Any]] = {
-    "v2": {"cores_per_chip": 2, "chips_per_host": 4, "name_counts_cores": True},
-    "v3": {"cores_per_chip": 2, "chips_per_host": 4, "name_counts_cores": True},
-    "v4": {"cores_per_chip": 2, "chips_per_host": 4, "name_counts_cores": True},
-    "v5p": {"cores_per_chip": 2, "chips_per_host": 4, "name_counts_cores": True},
-    "v5e": {"cores_per_chip": 1, "chips_per_host": 8, "name_counts_cores": False},
-    "v6e": {"cores_per_chip": 1, "chips_per_host": 8, "name_counts_cores": False},
-    "v7x": {"cores_per_chip": 2, "chips_per_host": 4, "name_counts_cores": False},
+    "v2": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": True},
+    "v3": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": True},
+    "v4": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": True},
+    "v5p": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": True},
+    "v5e": {"cores_per_chip": 1, "single_host_chips": 8, "multi_host_vm_chips": 4, "name_counts_cores": False},
+    "v6e": {"cores_per_chip": 1, "single_host_chips": 8, "multi_host_vm_chips": 4, "name_counts_cores": False},
+    "v7x": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": False},
 }
 
 # Aliases seen in Cloud TPU accelerator-type strings.
@@ -135,8 +140,17 @@ class TpuSlice:
 
     @property
     def chips_per_host(self) -> int:
-        """Chips exposed to each TPU-VM host in this slice."""
-        return min(self.chips, _TPU_GENERATIONS[self.accelerator]["chips_per_host"])
+        """Chips exposed to each TPU-VM host in this slice.
+
+        Shape-dependent on v5e/v6e: a slice that fits on one host uses that
+        host's full chip count (up to 8), but multi-host slices are built
+        from 4-chip VMs only (``ct5lp-hightpu-4t`` / ``ct6e-standard-4t``),
+        so ``v5litepod-16`` is 4 hosts x 4 chips, not 2 x 8.
+        """
+        info = _TPU_GENERATIONS[self.accelerator]
+        if self.chips <= info["single_host_chips"]:
+            return self.chips
+        return info["multi_host_vm_chips"]
 
     @property
     def hosts(self) -> int:
@@ -739,6 +753,11 @@ class runopts:
             ckey = key if key in self._opts else self.canonical(key)
             opt = self._opts.get(ckey)
             if opt is None:
+                warnings.warn(
+                    f"unknown runopt {key!r} passed through unvalidated"
+                    f" (known: {sorted(self._opts)})",
+                    stacklevel=2,
+                )
                 resolved[key] = val  # pass through for forward/plugin compat
                 continue
             seen.add(ckey)
