@@ -856,3 +856,170 @@ class TestResizeLifecycle:
         sched.resize("ml:app-x", "trainer", 4)
         custom.delete_namespaced_custom_object.assert_not_called()
         custom.create_namespaced_custom_object.assert_not_called()
+
+
+# =========================================================================
+# Failure-driven elastic loop (watch_elastic: observe slice failure ->
+# auto-shrink to the surviving count -> Kueue re-admission)
+# =========================================================================
+
+from torchx_tpu.schedulers.gke_scheduler import plan_elastic_shrink
+
+
+def _with_status(js, role_job_name, failed=0, extra_status=None):
+    body = copy.deepcopy(js)
+    body["status"] = {
+        "replicatedJobsStatus": [
+            {"name": role_job_name, "failed": failed, "ready": 1}
+        ],
+        **(extra_status or {}),
+    }
+    return body
+
+
+import copy
+
+
+class TestPlanElasticShrink:
+    def _elastic_jobset(self, num_replicas=4, min_replicas=2):
+        return make_jobset(
+            AppDef(
+                name="a",
+                roles=[
+                    tpu_role(num_replicas=num_replicas, min_replicas=min_replicas)
+                ],
+            ),
+            namespace="ml",
+            queue="tpu-queue",
+        )
+
+    def _job_name(self, js):
+        return js["spec"]["replicatedJobs"][0]["name"]
+
+    def test_no_failure_no_plan(self):
+        js = self._elastic_jobset()
+        assert plan_elastic_shrink(_with_status(js, self._job_name(js), 0)) is None
+
+    def test_failure_plans_shrink_to_survivors(self):
+        js = self._elastic_jobset(num_replicas=4, min_replicas=2)
+        plan = plan_elastic_shrink(_with_status(js, self._job_name(js), 1))
+        assert plan == ("trainer", 3)
+
+    def test_below_floor_is_unrescuable(self):
+        js = self._elastic_jobset(num_replicas=3, min_replicas=3)
+        plan = plan_elastic_shrink(_with_status(js, self._job_name(js), 1))
+        assert plan == ("trainer", None)
+
+    def test_rigid_role_ignored(self):
+        # no min_replicas -> no floor annotation -> the watcher leaves the
+        # JobSet's own failure policy in charge
+        js = make_jobset(
+            AppDef(name="a", roles=[tpu_role(num_replicas=4)]), namespace="ml"
+        )
+        assert plan_elastic_shrink(_with_status(js, self._job_name(js), 2)) is None
+
+
+class _ElasticClusterFake:
+    """Stateful fake custom-objects API scripting a slice failure: the
+    watcher sees a failing JobSet, resize() deletes + re-creates it, and
+    the recreated (shrunken) set then completes."""
+
+    def __init__(self, failing_jobset):
+        self.jobset = failing_jobset
+        self.deleted = False
+        self.created_bodies = []
+
+    def get_namespaced_custom_object(self, **kwargs):
+        if self.deleted and not self.created_bodies:
+            raise _FakeApiException(404)
+        return self.jobset
+
+    def delete_namespaced_custom_object(self, **kwargs):
+        self.deleted = True
+
+    def create_namespaced_custom_object(self, body, **kwargs):
+        self.created_bodies.append(body)
+        # recreated set: healthy, then terminally Completed so the watcher
+        # exits its poll loop
+        self.jobset = copy.deepcopy(body)
+        self.jobset["status"] = {
+            "replicatedJobsStatus": [{"name": "x", "failed": 0}],
+            "conditions": [{"type": "Completed", "status": "True"}],
+        }
+
+
+class TestWatchElastic:
+    def test_slice_failure_triggers_shrink_and_readmission(
+        self, monkeypatch, fake_k8s
+    ):
+        js = make_jobset(
+            AppDef(
+                name="a", roles=[tpu_role(num_replicas=4, min_replicas=2)]
+            ),
+            namespace="ml",
+            queue="tpu-queue",
+        )
+        job_name = js["spec"]["replicatedJobs"][0]["name"]
+        fake = _ElasticClusterFake(_with_status(js, job_name, failed=1))
+        sched = GKEScheduler("t", client=object())
+        sched.resize_poll_interval = 0
+        monkeypatch.setattr(sched, "_custom_objects_api", lambda: fake)
+        n = sched.watch_elastic("ml:app-x", poll_interval=0)
+        assert n == 1
+        (body,) = fake.created_bodies
+        (rj,) = body["spec"]["replicatedJobs"]
+        # shrunk to the 3 surviving slices, world env rewritten coherently
+        assert rj["replicas"] == 3
+        hosts = rj["template"]["spec"]["completions"]
+        env = {
+            e["name"]: e.get("value")
+            for e in rj["template"]["spec"]["template"]["spec"]["containers"][0][
+                "env"
+            ]
+        }
+        assert env["TPX_NUM_REPLICAS"] == str(3 * hosts)
+        assert env["MEGASCALE_NUM_SLICES"] == "3"
+        # under Kueue the resized set re-enters the queue suspended
+        assert body["spec"]["suspend"] is True
+
+    def test_below_floor_stops_without_restart(self, monkeypatch, fake_k8s):
+        js = make_jobset(
+            AppDef(
+                name="a", roles=[tpu_role(num_replicas=2, min_replicas=2)]
+            ),
+            namespace="ml",
+        )
+        job_name = js["spec"]["replicatedJobs"][0]["name"]
+        fake = _ElasticClusterFake(_with_status(js, job_name, failed=1))
+        sched = GKEScheduler("t", client=object())
+        monkeypatch.setattr(sched, "_custom_objects_api", lambda: fake)
+        assert sched.watch_elastic("ml:app-x", poll_interval=0) == 0
+        assert not fake.created_bodies
+
+    def test_terminal_app_exits_watch(self, monkeypatch, fake_k8s):
+        js = make_jobset(
+            AppDef(
+                name="a", roles=[tpu_role(num_replicas=4, min_replicas=2)]
+            ),
+            namespace="ml",
+        )
+        job_name = js["spec"]["replicatedJobs"][0]["name"]
+        done = _with_status(
+            js,
+            job_name,
+            failed=0,
+            extra_status={
+                "conditions": [{"type": "Completed", "status": "True"}]
+            },
+        )
+        fake = _ElasticClusterFake(done)
+        sched = GKEScheduler("t", client=object())
+        monkeypatch.setattr(sched, "_custom_objects_api", lambda: fake)
+        assert sched.watch_elastic("ml:app-x", poll_interval=0) == 0
+
+    def test_gone_jobset_exits_watch(self, monkeypatch, fake_k8s):
+        fake = mock.MagicMock()
+        fake.get_namespaced_custom_object.side_effect = _FakeApiException(404)
+        sched = GKEScheduler("t", client=object())
+        monkeypatch.setattr(sched, "_custom_objects_api", lambda: fake)
+        assert sched.watch_elastic("ml:app-x", poll_interval=0) == 0

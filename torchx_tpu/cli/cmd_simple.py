@@ -94,6 +94,35 @@ class CmdResize(SubCommand):
             )
 
 
+class CmdWatch(SubCommand):
+    """Failure-driven elastic controller: `tpx watch <handle>` observes a
+    running app and auto-shrinks roles with a min_replicas floor when
+    slices fail (the operator-side analog of the local scheduler's elastic
+    restart). Blocks until the app terminates or the budget is spent."""
+
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument("app_handle")
+        subparser.add_argument(
+            "--interval", type=float, default=10.0, help="poll seconds"
+        )
+        subparser.add_argument(
+            "--timeout", type=float, default=None, help="give up after seconds"
+        )
+        subparser.add_argument(
+            "--max-restarts", type=int, default=3, help="shrink budget"
+        )
+
+    def run(self, args: argparse.Namespace) -> None:
+        with get_runner() as runner:
+            n = runner.watch_elastic(
+                args.app_handle,
+                poll_interval=args.interval,
+                timeout=args.timeout,
+                max_restarts=args.max_restarts,
+            )
+            print(f"watch done: {n} elastic shrink-restart(s)")
+
+
 class CmdRunopts(SubCommand):
     def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
         subparser.add_argument(

@@ -24,6 +24,7 @@ from torchx_tpu.cli.cmd_simple import (
     CmdResize,
     CmdRunopts,
     CmdStatus,
+    CmdWatch,
 )
 from torchx_tpu.version import __version__
 
@@ -40,6 +41,7 @@ def get_sub_cmds() -> dict[str, SubCommand]:
         "cancel": CmdCancel(),
         "delete": CmdDelete(),
         "resize": CmdResize(),
+        "watch": CmdWatch(),
         "runopts": CmdRunopts(),
         "builtins": CmdBuiltins(),
         "configure": CmdConfigure(),

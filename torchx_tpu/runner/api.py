@@ -269,6 +269,36 @@ class Runner:
         with log_event("resize", scheduler, app_id, session=self._name):
             self._scheduler(scheduler).resize(app_id, role_name, num_replicas)
 
+    def watch_elastic(
+        self,
+        app_handle: AppHandle,
+        poll_interval: float = 10.0,
+        timeout: Optional[float] = None,
+        max_restarts: int = 3,
+    ) -> int:
+        """Run the failure-driven elastic controller for an app: observe
+        gang failures and auto-shrink roles with a ``min_replicas`` floor
+        (the operator-side analog of the local scheduler's elastic
+        restart). Blocks until the app terminates, the floor is breached,
+        or the restart budget is spent; returns shrink-restarts performed.
+        Backends without a watcher raise."""
+        scheduler, _, app_id = parse_app_handle(app_handle)
+        sched = self._scheduler(scheduler)
+        watch = getattr(sched, "watch_elastic", None)
+        if watch is None:
+            raise ValueError(
+                f"the {scheduler} scheduler has no elastic watcher"
+                " (local restarts elastically on its own; others need"
+                " operator resize)"
+            )
+        with log_event("watch_elastic", scheduler, app_id, session=self._name):
+            return watch(
+                app_id,
+                poll_interval=poll_interval,
+                timeout=timeout,
+                max_restarts=max_restarts,
+            )
+
     def describe(self, app_handle: AppHandle) -> Optional[AppDef]:
         """Best-effort reconstruction of the AppDef from the backend."""
         scheduler, _, app_id = parse_app_handle(app_handle)

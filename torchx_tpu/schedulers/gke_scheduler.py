@@ -64,6 +64,7 @@ from torchx_tpu.specs.api import (
     Role,
     RoleStatus,
     VolumeMount,
+    is_terminal,
     macros,
     runopts,
 )
@@ -593,6 +594,49 @@ def resize_jobset(
     return body
 
 
+def plan_elastic_shrink(
+    jobset: Mapping[str, Any],
+) -> Optional[tuple[str, Optional[int]]]:
+    """Decide whether a failing elastic gang should shrink, from the raw
+    JobSet dict (pure function -> fixture-testable, like jobset_state).
+
+    Scans roles carrying the ``tpx.sh/min-replicas`` floor annotation for
+    failed child Jobs (one child Job == one slice for TPU roles). Returns
+    ``(role_name, new_size)`` to shrink to the surviving slice count,
+    ``(role_name, None)`` when survivors are below the floor (un-rescuable),
+    or ``None`` when nothing relevant failed. CPU roles are left to Kueue's
+    ``job-min-parallelism`` — slice-granular shrink is a TPU-gang concern.
+    """
+    status = jobset.get("status") or {}
+    by_name = {
+        str(s.get("name")): s for s in status.get("replicatedJobsStatus") or []
+    }
+    for rj in jobset.get("spec", {}).get("replicatedJobs", []):
+        tmpl = rj.get("template", {})
+        annotations = tmpl.get("metadata", {}).get("annotations", {}) or {}
+        floor = annotations.get(ANNOTATION_MIN_REPLICAS)
+        if floor is None:
+            continue
+        st = by_name.get(str(rj.get("name"))) or {}
+        failed = int(st.get("failed") or 0)
+        if failed <= 0:
+            continue
+        pod_labels = (
+            tmpl.get("spec", {})
+            .get("template", {})
+            .get("metadata", {})
+            .get("labels", {})
+            or {}
+        )
+        role_name = pod_labels.get(LABEL_ROLE_NAME) or str(rj.get("name"))
+        current = int(rj.get("replicas", 1))
+        new_size = current - failed
+        if new_size < max(1, int(floor)):
+            return role_name, None
+        return role_name, new_size
+    return None
+
+
 # =========================================================================
 # Scheduler
 # =========================================================================
@@ -869,6 +913,76 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
             json.dump(body, f, indent=2, default=str)
         return path
 
+    def watch_elastic(
+        self,
+        app_id: str,
+        poll_interval: float = 10.0,
+        timeout: Optional[float] = None,
+        max_restarts: int = 3,
+    ) -> int:
+        """Failure-driven elastic controller: the GKE analog of the local
+        scheduler's ``_try_elastic_restart`` (local_scheduler.py), run
+        operator-side because JobSet has no in-cluster shrink semantics.
+
+        Polls the JobSet; when a slice of a role carrying the
+        ``tpx.sh/min-replicas`` floor fails, shrinks the gang to the
+        surviving slice count via :meth:`resize` (delete + re-create; user
+        code resumes from its checkpoint exactly as with the manual
+        ``resize`` verb — under Kueue the resized set re-enters the queue
+        suspended). Returns the number of shrink-restarts performed.
+        Stops on: terminal app state, survivors below the floor, restart
+        budget exhausted, or ``timeout`` seconds elapsed.
+        """
+        namespace, name = self._parse_app_id(app_id)
+        from kubernetes.client.rest import ApiException
+
+        api = self._custom_objects_api()
+        deadline = time.monotonic() + timeout if timeout else None
+        restarts = 0
+        while True:
+            try:
+                jobset = api.get_namespaced_custom_object(
+                    group=JOBSET_GROUP,
+                    version=JOBSET_VERSION,
+                    namespace=namespace,
+                    plural=JOBSET_PLURAL,
+                    name=name,
+                )
+            except ApiException as e:
+                if e.status == 404:
+                    return restarts  # deleted out from under the watcher
+                raise
+            state = jobset_state(jobset)
+            plan = plan_elastic_shrink(jobset)
+            if plan is not None:
+                role_name, new_size = plan
+                if new_size is None:
+                    logger.error(
+                        "%s role %s: survivors below the min-replicas floor;"
+                        " not rescuable by shrinking",
+                        app_id,
+                        role_name,
+                    )
+                    return restarts
+                if restarts >= max_restarts:
+                    logger.error(
+                        "%s: shrink budget (%d) exhausted", app_id, max_restarts
+                    )
+                    return restarts
+                logger.info(
+                    "%s role %s: slice failure detected; shrinking to %d",
+                    app_id,
+                    role_name,
+                    new_size,
+                )
+                self.resize(app_id, role_name, new_size)
+                restarts += 1
+            elif is_terminal(state):
+                return restarts
+            if deadline is not None and time.monotonic() >= deadline:
+                return restarts
+            time.sleep(poll_interval)
+
     supports_log_windows = True  # since via since_seconds, until via stamps
 
     def log_iter(
@@ -899,9 +1013,12 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
         core = self._core_api()
         kwargs: dict[str, Any] = {}
         if since is not None:
+            age = time.time() - since
+            if age <= 0:
+                return iter(())  # window entirely in the future: nothing
             # ceil keeps the window inclusive (int() would start it up to
             # 1s late and drop in-window lines)
-            kwargs["since_seconds"] = max(1, math.ceil(time.time() - since))
+            kwargs["since_seconds"] = max(1, math.ceil(age))
         if until is not None:
             kwargs["timestamps"] = True
         resp = core.read_namespaced_pod_log(
@@ -963,11 +1080,15 @@ def _strip_until(lines: Iterable[str], until: float) -> Iterator[str]:
     for line in lines:
         stamp, _, payload = line.partition(" ")
         try:
-            # kubelet stamps are RFC3339Nano; fromisoformat needs <= 6
-            # fractional digits, so trim nanos down to micros
-            ts = datetime.fromisoformat(
-                re.sub(r"(\.\d{6})\d+", r"\1", stamp.replace("Z", "+00:00"))
-            ).timestamp()
+            # kubelet stamps are RFC3339Nano with trailing zeros trimmed
+            # (Go time formatting); Python 3.10's fromisoformat accepts
+            # only 3 or 6 fractional digits, so normalize to exactly 6
+            norm = re.sub(
+                r"\.(\d+)",
+                lambda m: "." + (m.group(1) + "000000")[:6],
+                stamp.replace("Z", "+00:00"),
+            )
+            ts = datetime.fromisoformat(norm).timestamp()
         except ValueError:
             yield line
             continue

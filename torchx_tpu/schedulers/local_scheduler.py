@@ -1069,14 +1069,15 @@ class LocalScheduler(Scheduler[PopenRequest]):
         # and strip the stamps. stdout/stderr are the raw process FDs — no
         # stamps, so windows cannot apply there; say so instead of silently
         # returning the full log.
-        if stream is not Stream.COMBINED and (since or until):
+        if stream is Stream.COMBINED:
+            it = window_stamped_lines(it, since, until)
+        elif since or until:
             logger.warning(
                 "since/until only apply to the local combined stream"
                 " (stdout/stderr are raw process files with no line"
                 " timestamps); showing the full %s log",
                 stream.value,
             )
-        it = window_stamped_lines(it, since, until)
         if regex:
             it = filter_regex(regex, it)
         return it
