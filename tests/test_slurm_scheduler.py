@@ -447,3 +447,164 @@ class TestCancelFailure:
         monkeypatch.setattr(sched, "_run_cmd", run_cmd)
         with pytest.raises(RuntimeError, match="scancel failed"):
             sched.cancel("1")
+
+
+class TestElasticGang:
+    """min_replicas -> one RANGED --nodes group; slurm restarts a requeued
+    job with whatever node count survives (>= the floor)."""
+
+    def _dryrun(self, sched, **role_kwargs):
+        role_kwargs.setdefault("min_replicas", 1)
+        role_kwargs.setdefault("max_retries", 2)
+        app = AppDef(name="t", roles=[tpu_role(**role_kwargs)])
+        return sched.submit_dryrun(app, {})
+
+    def test_ranged_nodes_no_hetjob(self, sched):
+        # v5p-16 slice = 2 hosts; min 1 slice -> 2-2 ... use 2 slices
+        info = self._dryrun(sched, num_replicas=2, min_replicas=1)
+        script = info.request.script()
+        # 2 slices x 2 hosts max, floor 1 slice x 2 hosts
+        assert "#SBATCH --nodes=2-4" in script
+        assert "hetjob" not in script
+        assert "--ntasks-per-node=1" in script
+        assert info.request.elastic_range == (2, 4)
+
+    def test_runtime_identity_derivation(self, sched):
+        script = self._dryrun(sched, num_replicas=2, min_replicas=1).request.script()
+        # identity comes from slurm at RUN time (size known only then)
+        assert 'TPX_REPLICA_ID="$SLURM_PROCID"' in script
+        assert 'TPX_NUM_REPLICAS="$SLURM_NTASKS"' in script
+        assert "export TPX_MIN_REPLICAS=2" in script
+        # the macro-substituted arg defers to the task-derived env
+        assert "--id=${SLURM_JOB_ID}" in script
+
+    def test_requeue_trap_present(self, sched):
+        script = self._dryrun(sched, num_replicas=2, min_replicas=1).request.script()
+        assert "scontrol requeue" in script
+        assert "trap tpx_requeue ERR" in script
+
+    def test_per_task_log_files(self, sched):
+        script = self._dryrun(sched, num_replicas=2, min_replicas=1).request.script()
+        # %t = task id, matching log_iter's slurm-{id}-{role}-{k}.{out}
+        assert "--output=slurm-${SLURM_JOB_ID}-trainer-%t.out" in script
+
+    def test_multi_role_elastic_rejected(self, sched):
+        cpu = Role(
+            name="reader", image="/x", entrypoint="python",
+            resource=Resource(cpu=2, memMB=100),
+        )
+        app = AppDef(
+            name="t", roles=[tpu_role(min_replicas=1), cpu]
+        )
+        with pytest.raises(ValueError, match="single-role"):
+            sched.submit_dryrun(app, {})
+
+    def test_elastic_lifecycle_requeued_then_resized(self, sched, monkeypatch):
+        """Canned lifecycle: sbatch -> squeue shows RUNNING on 4 nodes ->
+        node failure requeues -> squeue shows REQUEUED then RUNNING on 2
+        nodes -> sacct shows COMPLETED. The launcher's view stays coherent
+        through the shrink."""
+        phases = iter(
+            [
+                ("sinfo", completed(stdout="128000\n")),  # mem probe
+                ("sbatch", completed(stdout="999\n")),
+                ("squeue", completed(stdout=json.dumps({"jobs": [
+                    {"job_id": 999, "name": "trainer-0",
+                     "job_state": ["RUNNING"],
+                     "job_resources": {"nodes": "n[0-3]"}}]}))),
+                ("squeue", completed(stdout=json.dumps({"jobs": [
+                    {"job_id": 999, "name": "trainer-0",
+                     "job_state": ["REQUEUED"]}]}))),
+                ("squeue", completed(stdout=json.dumps({"jobs": [
+                    {"job_id": 999, "name": "trainer-0",
+                     "job_state": ["RUNNING"],
+                     "job_resources": {"nodes": "n[0-1]"}}]}))),
+                ("squeue", completed(rc=1)),  # left the queue
+                ("sacct", completed(stdout=(
+                    "JobID|JobName|State\n"
+                    "999|trainer-0|COMPLETED\n"
+                    "999.batch|batch|COMPLETED\n"
+                ))),
+            ]
+        )
+
+        def run_cmd(cmd, **kw):
+            expect, out = next(phases)
+            assert cmd[0] == expect, (cmd, expect)
+            return out
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        app = AppDef(
+            name="t", roles=[tpu_role(num_replicas=2, min_replicas=1,
+                                      max_retries=2)]
+        )
+        app_id = sched.schedule(sched.submit_dryrun(app, {}))
+        assert app_id == "999"
+        assert sched.describe(app_id).state == AppState.RUNNING
+        assert sched.describe(app_id).state == AppState.PENDING  # requeued
+        assert sched.describe(app_id).state == AppState.RUNNING  # shrunk
+        final = sched.describe(app_id)
+        assert final.state == AppState.SUCCEEDED
+
+
+class TestMemProbe:
+    def _probe(self, sched, monkeypatch, sinfo_out, rc=0):
+        calls = []
+
+        def run_cmd(cmd, **kw):
+            calls.append(cmd)
+            if cmd[0] == "sinfo":
+                return completed(stdout=sinfo_out, rc=rc)
+            return completed(stdout="1\n")
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        return calls
+
+    def test_unset_realmemory_drops_mem(self, sched, monkeypatch):
+        self._probe(sched, monkeypatch, "1\n1\n")
+        script = sched.submit_dryrun(
+            AppDef(name="t", roles=[tpu_role()]), {"partition": "tpu"}
+        ).request.script()
+        assert "--mem=" not in script
+
+    def test_real_memory_keeps_mem(self, sched, monkeypatch):
+        self._probe(sched, monkeypatch, "128000+\n")
+        script = sched.submit_dryrun(
+            AppDef(name="t", roles=[tpu_role()]), {"partition": "tpu"}
+        ).request.script()
+        assert "--mem=1000" in script
+
+    def test_probe_failure_keeps_mem(self, sched, monkeypatch):
+        self._probe(sched, monkeypatch, "", rc=1)
+        script = sched.submit_dryrun(
+            AppDef(name="t", roles=[tpu_role()]), {"partition": "x"}
+        ).request.script()
+        assert "--mem=1000" in script
+
+    def test_probe_cached_per_partition(self, sched, monkeypatch):
+        calls = self._probe(sched, monkeypatch, "128000\n")
+        app = AppDef(name="t", roles=[tpu_role()])
+        sched.submit_dryrun(app, {"partition": "tpu"})
+        sched.submit_dryrun(app, {"partition": "tpu"})
+        assert sum(1 for c in calls if c[0] == "sinfo") == 1
+
+
+class TestSacctRequeueVariant:
+    def test_requeued_job_with_extern_steps(self, sched, monkeypatch):
+        """Third sacct variant: a requeued job mid-restart — REQUEUED top
+        row maps to PENDING, `.extern`/`.batch`/`.0` step rows (including
+        truncated `CANCELLED+` states) are skipped, and the launcher keeps
+        polling rather than declaring the app dead."""
+
+        def run_cmd(cmd, **kw):
+            if cmd[0] == "squeue":
+                return completed(rc=1)
+            with open("tests/fixtures/sacct_requeue.txt") as f:
+                return completed(stdout=f.read())
+
+        monkeypatch.setattr(sched, "_run_cmd", run_cmd)
+        resp = sched.describe("888")
+        assert resp is not None
+        assert resp.state == AppState.PENDING  # requeued, not failed
+        (rs,) = [r for r in resp.roles_statuses if r.role == "spmd"]
+        assert {r.id: r.state for r in rs.replicas} == {0: AppState.PENDING}

@@ -110,6 +110,11 @@ class SlurmBatchRequest:
     replicas: list[SlurmReplicaRequest]
     job_dir: Optional[str]
     max_retries: int = 0
+    # (min_hosts, max_hosts) for an elastic single-role gang: materialized
+    # as one RANGED group (``--nodes=min-max``) instead of het groups, so
+    # slurm itself may start — or requeue — the job with any surviving node
+    # count in range (the slurm-native analog of torchrun --nnodes min:max)
+    elastic_range: Optional[tuple[int, int]] = None
 
     def script(self) -> str:
         return materialize_script(self)
@@ -169,9 +174,57 @@ def _role_to_replicas(
     return out
 
 
+def _elastic_replica(role: Role, cfg: Mapping[str, CfgVal]) -> SlurmReplicaRequest:
+    """Template for the single RANGED group of an elastic gang.
+
+    Identity env cannot be baked per-replica (the started size is only
+    known at run time), so the macros defer to ``TPX_REPLICA_ID`` /
+    ``TPX_NUM_REPLICAS``, which the per-task wrapper derives from
+    ``SLURM_PROCID`` / ``SLURM_NTASKS`` (see :func:`materialize_script`).
+    """
+    values = macros.Values(
+        img_root=role.image,
+        app_id="${SLURM_JOB_ID}",
+        replica_id="${TPX_REPLICA_ID}",
+        num_replicas="${TPX_NUM_REPLICAS}",
+        coordinator_env=settings.ENV_TPX_COORDINATOR_HOST,
+    )
+    rrole = values.apply(role)
+    partition = cfg.get("partition")
+    sbatch_opts = [
+        f"--job-name={role.name}-0",  # describe() parses {role}-{replica}
+        "--ntasks-per-node=1",
+    ]
+    if partition:
+        sbatch_opts.append(f"--partition={shlex.quote(str(partition))}")
+    if rrole.resource.cpu > 0:
+        sbatch_opts.append(f"--cpus-per-task={int(rrole.resource.cpu)}")
+    if rrole.resource.memMB > 0 and not cfg.get("nomem"):
+        sbatch_opts.append(f"--mem={int(rrole.resource.memMB)}")
+    if cfg.get("time"):
+        sbatch_opts.append(f"--time={cfg['time']}")
+    for cap, val in rrole.resource.capabilities.items():
+        if cap == "slurm.constraint":
+            sbatch_opts.append(f"--constraint={val}")
+    env = dict(rrole.env)
+    env[settings.ENV_TPX_ROLE_NAME] = role.name
+    if rrole.resource.tpu is not None:
+        env["TPX_TPU_ACCELERATOR_TYPE"] = rrole.resource.tpu.accelerator_type
+    return SlurmReplicaRequest(
+        name=role.name,
+        sbatch_opts=sbatch_opts,
+        srun_opts=["--kill-on-bad-exit=1", "--wait=60"],
+        env=env,
+        cmd=[rrole.entrypoint, *rrole.args],
+    )
+
+
 def materialize_script(req: SlurmBatchRequest) -> str:
-    """The full sbatch script: SBATCH headers (hetjob groups), coordinator
-    export, requeue-on-failure logic, and the single srun line."""
+    """The full sbatch script: SBATCH headers (hetjob groups, or one ranged
+    group for an elastic gang), coordinator export, requeue-on-failure
+    logic, and the single srun line."""
+    if req.elastic_range is not None:
+        return _materialize_elastic_script(req)
     lines = ["#!/bin/bash"]
     for i, rep in enumerate(req.replicas):
         if i > 0:
@@ -223,11 +276,73 @@ def materialize_script(req: SlurmBatchRequest) -> str:
     return "\n".join(lines)
 
 
+def _materialize_elastic_script(req: SlurmBatchRequest) -> str:
+    """Elastic gang: ONE ranged group (``--nodes=min-max``) instead of het
+    groups — slurm may start the job with any node count in range, and a
+    ``scontrol requeue`` after a node failure restarts it with whatever
+    survives (still >= min), which is the slurm-native shrink-and-restart:
+    the analog of the local scheduler's elastic restart and torchrun's
+    ``--nnodes min:max`` rendezvous. Each task derives its identity from
+    ``SLURM_PROCID``/``SLURM_NTASKS`` at run time, so the restarted world
+    re-forms coherently at the new size and user code resumes from its
+    checkpoint."""
+    assert req.elastic_range is not None
+    min_hosts, max_hosts = req.elastic_range
+    (rep,) = req.replicas
+    lines = ["#!/bin/bash"]
+    lines.append(f"#SBATCH --nodes={min_hosts}-{max_hosts}")
+    lines.extend(f"#SBATCH {opt}" for opt in rep.sbatch_opts)
+    lines += [
+        "",
+        "set -e",
+        'export TPX_COORDINATOR_HOST=$(scontrol show hostnames'
+        ' "$SLURM_JOB_NODELIST" | head -n 1)',
+        f"export TPX_APP_ID=tpx-${{SLURM_JOB_ID}}",
+        f"export {settings.ENV_TPX_MIN_REPLICAS}={min_hosts}",
+        "",
+    ]
+    if req.max_retries > 0:
+        lines += [
+            f"export TPX_MAX_RETRIES={req.max_retries}",
+            "tpx_requeue() {",
+            '  if [ "${SLURM_RESTART_COUNT:-0}" -lt "$TPX_MAX_RETRIES" ]; then',
+            "    # ranged --nodes: the requeued job may restart smaller",
+            '    scontrol requeue "$SLURM_JOB_ID"',
+            "  fi",
+            "}",
+            "trap tpx_requeue ERR",
+            "",
+        ]
+    env_prefix = " ".join(
+        f"{k}={_dquote(v)}" for k, v in sorted(rep.env.items())
+    )
+    # the wrapper runs ON each task node (bash -c under srun), where
+    # SLURM_PROCID/SLURM_NTASKS are set; single-quoting via shlex defers
+    # all expansion from the batch shell to the task shell
+    inner = (
+        'export TPX_REPLICA_ID="$SLURM_PROCID"'
+        ' TPX_NUM_REPLICAS="$SLURM_NTASKS"; '
+        + "exec "
+        + (("env " + env_prefix + " ") if env_prefix else "")
+        + " ".join(_dquote(c) for c in rep.cmd)
+    )
+    lines.append(
+        "srun "
+        + " ".join(rep.srun_opts)
+        + f" --output=slurm-${{SLURM_JOB_ID}}-{rep.name}-%t.out"
+        + f" --error=slurm-${{SLURM_JOB_ID}}-{rep.name}-%t.err"
+        + f" bash -c {shlex.quote(inner)}"
+    )
+    lines.append("")
+    return "\n".join(lines)
+
+
 class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
     """Submits AppDefs as heterogeneous sbatch jobs."""
 
     def __init__(self, session_name: str) -> None:
         super().__init__(backend="slurm", session_name=session_name)
+        self._mem_probe_cache: dict[str, bool] = {}
 
     def _run_cmd(self, cmd: list[str], **kwargs: Any) -> subprocess.CompletedProcess:
         """Single subprocess seam — tests monkeypatch this."""
@@ -254,9 +369,45 @@ class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
     def _submit_dryrun(
         self, app: AppDef, cfg: Mapping[str, CfgVal]
     ) -> AppDryRunInfo[SlurmBatchRequest]:
-        replicas: list[SlurmReplicaRequest] = []
-        for role in app.roles:
-            replicas.extend(_role_to_replicas(role, cfg))
+        cfg = dict(cfg)
+        if not cfg.get("nomem") and not self._partition_supports_mem(
+            cfg.get("partition")
+        ):
+            # partitions with unset RealMemory reject --mem outright
+            # (reference analog: the aws slurm partition memory probe)
+            logger.info(
+                "partition %s reports no usable RealMemory; dropping --mem",
+                cfg.get("partition") or "<default>",
+            )
+            cfg["nomem"] = True
+        elastic_role = next(
+            (r for r in app.roles if r.min_replicas is not None), None
+        )
+        elastic_range: Optional[tuple[int, int]] = None
+        if elastic_role is not None:
+            if len(app.roles) != 1:
+                raise ValueError(
+                    "slurm elastic gangs (min_replicas) require a"
+                    " single-role app: the ranged --nodes allocation is"
+                    " job-wide — split other roles into their own apps"
+                )
+            # min_replicas is in AppDef units (slices for TPU roles);
+            # slurm nodes are hosts, and TPU gangs shrink in whole slices
+            hosts_per_unit = (
+                elastic_role.resource.tpu.hosts
+                if elastic_role.resource is not None
+                and elastic_role.resource.tpu is not None
+                else 1
+            )
+            elastic_range = (
+                max(1, elastic_role.min_replicas) * hosts_per_unit,
+                tpu_hosts_for_role(elastic_role),
+            )
+            replicas = [_elastic_replica(elastic_role, cfg)]
+        else:
+            replicas = []
+            for role in app.roles:
+                replicas.extend(_role_to_replicas(role, cfg))
         cmd = ["sbatch", "--parsable"]
         if cfg.get("comment"):
             cmd.append(f"--comment={cfg['comment']}")
@@ -265,8 +416,33 @@ class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
             replicas=replicas,
             job_dir=str(cfg["job_dir"]) if cfg.get("job_dir") else None,
             max_retries=max((r.max_retries for r in app.roles), default=0),
+            elastic_range=elastic_range,
         )
         return AppDryRunInfo(req)
+
+    def _partition_supports_mem(self, partition: Optional[CfgVal]) -> bool:
+        """Probe ``sinfo`` for the partition's configured node memory:
+        RealMemory=1 (slurm's unset marker) means ``--mem`` requests can
+        never be satisfied and must be dropped. Probe failures (no slurm
+        on PATH, standalone dryruns) keep --mem. Cached per partition."""
+        key = str(partition) if partition else ""
+        if key in self._mem_probe_cache:
+            return self._mem_probe_cache[key]
+        cmd = ["sinfo", "--noheader", "--format=%m"]
+        if partition:
+            cmd += ["--partition", str(partition)]
+        try:
+            proc = self._run_cmd(cmd)
+        except (OSError, subprocess.SubprocessError):
+            self._mem_probe_cache[key] = True
+            return True
+        if proc.returncode != 0:
+            ok = True  # can't probe: keep --mem
+        else:
+            vals = [v.strip().rstrip("+") for v in proc.stdout.split()]
+            ok = not vals or any(v.isdigit() and int(v) > 1 for v in vals)
+        self._mem_probe_cache[key] = ok
+        return ok
 
     def schedule(self, dryrun_info: AppDryRunInfo[SlurmBatchRequest]) -> str:
         req = dryrun_info.request
