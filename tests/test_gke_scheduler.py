@@ -1023,3 +1023,77 @@ class TestWatchElastic:
         sched = GKEScheduler("t", client=object())
         monkeypatch.setattr(sched, "_custom_objects_api", lambda: fake)
         assert sched.watch_elastic("ml:app-x", poll_interval=0) == 0
+
+
+# =========================================================================
+# Heterogeneous node pools: GPU + GCE machine-type roles beside TPU gangs
+# =========================================================================
+
+
+class TestHeterogeneousPools:
+    def _mixed_app(self):
+        from torchx_tpu.specs import named_resources
+
+        gpu_res = named_resources["gpu_a100_4"]
+        cpu_res = named_resources["gce_n2_standard_8"]
+        return AppDef(
+            name="mixed",
+            roles=[
+                tpu_role(chips=16, accelerator="v5e"),
+                Role(
+                    name="scorer",
+                    image="gcr.io/p/gpu:1",
+                    entrypoint="python",
+                    num_replicas=2,
+                    resource=gpu_res,
+                ),
+                Role(
+                    name="reader",
+                    image="gcr.io/p/cpu:1",
+                    entrypoint="python",
+                    num_replicas=1,
+                    resource=cpu_res,
+                ),
+            ],
+        )
+
+    def test_mixed_roles_materialize_their_pools(self):
+        js = make_jobset(self._mixed_app())
+        by_name = {rj["name"]: rj for rj in js["spec"]["replicatedJobs"]}
+        assert set(by_name) == {"trainer", "scorer", "reader"}
+
+        tpu_pod = by_name["trainer"]["template"]["spec"]["template"]["spec"]
+        assert "cloud.google.com/gke-tpu-accelerator" in tpu_pod["nodeSelector"]
+
+        gpu_pod = by_name["scorer"]["template"]["spec"]["template"]["spec"]
+        sel = gpu_pod["nodeSelector"]
+        assert sel["cloud.google.com/gke-accelerator"] == "nvidia-tesla-a100"
+        assert sel["node.kubernetes.io/instance-type"] == "a2-highgpu-4g"
+        limits = gpu_pod["containers"][0]["resources"]["limits"]
+        assert limits["nvidia.com/gpu"] == 4
+        assert gpu_pod["tolerations"][0]["key"] == "nvidia.com/gpu"
+        # GPU pods are plain parallel jobs, 2 replicas
+        assert by_name["scorer"]["template"]["spec"]["parallelism"] == 2
+
+        cpu_pod = by_name["reader"]["template"]["spec"]["template"]["spec"]
+        assert cpu_pod["nodeSelector"] == {
+            "node.kubernetes.io/instance-type": "n2-standard-8"
+        }
+        assert "tolerations" not in cpu_pod
+        assert "nvidia.com/gpu" not in cpu_pod["containers"][0]["resources"]["limits"]
+
+    def test_gpu_catalog_shapes(self):
+        from torchx_tpu.specs import named_resources
+
+        r = named_resources["gpu_h100_8"]
+        assert r.devices == {"nvidia.com/gpu": 8}
+        assert r.capabilities["gke.accelerator"] == "nvidia-h100-80gb"
+        assert r.capabilities["gce.machine_type"] == "a3-highgpu-8g"
+        assert r.cpu == 208
+
+    def test_gce_raw_name_lookup(self):
+        from torchx_tpu.specs import named_resources
+
+        r = named_resources["n2-standard-16"]
+        assert r.cpu == 16 and r.tpu is None
+        assert r.capabilities["gce.machine_type"] == "n2-standard-16"

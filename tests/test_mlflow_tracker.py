@@ -1,0 +1,229 @@
+"""MLflow tracker backend tests against an in-memory fake client (the
+reference pattern: mlflow_test.py runs against a throwaway tracking store;
+mlflow itself is not a baked dependency here, so the client surface the
+tracker touches is faked instead)."""
+
+from __future__ import annotations
+
+import re
+import sys
+import types
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import pytest
+
+
+@dataclass
+class _Info:
+    run_id: str
+    artifact_uri: str
+
+
+@dataclass
+class _Data:
+    tags: dict = field(default_factory=dict)
+    params: dict = field(default_factory=dict)
+    metrics: dict = field(default_factory=dict)
+
+
+@dataclass
+class _Run:
+    info: _Info
+    data: _Data = field(default_factory=_Data)
+
+
+@dataclass
+class _FileInfo:
+    path: str
+    is_dir: bool
+
+
+class FakeMlflowClient:
+    def __init__(self, tracking_uri: Optional[str] = None) -> None:
+        self.tracking_uri = tracking_uri
+        self.experiments: dict[str, str] = {}
+        self.runs: dict[str, _Run] = {}
+        self.artifact_store: dict[str, list[str]] = {}  # run -> file paths
+        self._n = 0
+
+    def get_experiment_by_name(self, name):
+        if name in self.experiments:
+            return types.SimpleNamespace(experiment_id=self.experiments[name])
+        return None
+
+    def create_experiment(self, name):
+        self.experiments[name] = f"exp-{len(self.experiments)}"
+        return self.experiments[name]
+
+    def create_run(self, experiment_id, tags=None, run_name=None):
+        self._n += 1
+        rid = f"mlrun-{self._n}"
+        run = _Run(
+            info=_Info(run_id=rid, artifact_uri=f"mlflow-artifacts:/{rid}"),
+            data=_Data(tags=dict(tags or {})),
+        )
+        self.runs[rid] = run
+        self.artifact_store[rid] = []
+        return run
+
+    def get_run(self, run_id):
+        return self.runs[run_id]
+
+    def search_runs(self, experiment_ids, filter_string: Optional[str] = None):
+        out = list(self.runs.values())
+        if filter_string:
+            m = re.search(r"= '([^']*)'", filter_string)
+            want = m.group(1) if m else ""
+            out = [r for r in out if r.data.tags.get("tpx.run_id") == want]
+        return out
+
+    def set_tag(self, run_id, key, value):
+        self.runs[run_id].data.tags[key] = value
+
+    def log_param(self, run_id, key, value):
+        self.runs[run_id].data.params[key] = str(value)
+
+    def log_metric(self, run_id, key, value):
+        self.runs[run_id].data.metrics[key] = value
+
+    def log_artifact(self, run_id, local_path, artifact_path=None):
+        import os
+
+        name = os.path.basename(local_path)
+        dest = f"{artifact_path}/{name}" if artifact_path else name
+        self.artifact_store[run_id].append(dest)
+
+    def log_artifacts(self, run_id, local_dir, artifact_path=None):
+        import os
+
+        for root, _dirs, files in os.walk(local_dir):
+            for f in files:
+                rel = os.path.relpath(os.path.join(root, f), local_dir)
+                dest = f"{artifact_path}/{rel}" if artifact_path else rel
+                self.artifact_store[run_id].append(dest)
+
+    def list_artifacts(self, run_id, path=None):
+        # one flat level per call, emulating the real API
+        seen: dict[str, _FileInfo] = {}
+        prefix = f"{path}/" if path else ""
+        for p in self.artifact_store[run_id]:
+            if not p.startswith(prefix):
+                continue
+            rest = p[len(prefix) :]
+            head = rest.split("/", 1)[0]
+            full = prefix + head
+            seen[full] = _FileInfo(path=full, is_dir="/" in rest)
+        return list(seen.values())
+
+
+@pytest.fixture
+def tracker(monkeypatch):
+    """MLflowTracker wired to the fake client via a stub mlflow module."""
+    fake_clients = []
+
+    def client_factory(tracking_uri=None):
+        c = FakeMlflowClient(tracking_uri)
+        fake_clients.append(c)
+        return c
+
+    mlflow_mod = types.ModuleType("mlflow")
+    tracking_mod = types.ModuleType("mlflow.tracking")
+    tracking_mod.MlflowClient = client_factory
+    mlflow_mod.tracking = tracking_mod
+    monkeypatch.setitem(sys.modules, "mlflow", mlflow_mod)
+    monkeypatch.setitem(sys.modules, "mlflow.tracking", tracking_mod)
+
+    from torchx_tpu.tracker.mlflow import MLflowTracker
+
+    t = MLflowTracker(tracking_uri="fake://x", experiment_name="tpx-test")
+    t._fake = fake_clients[0]
+    return t
+
+
+class TestMLflowTracker:
+    def test_run_mapping_is_stable(self, tracker):
+        a = tracker._mlflow_run("app-1")
+        b = tracker._mlflow_run("app-1")
+        assert a == b
+        run = tracker._fake.runs[a]
+        assert run.data.tags["tpx.run_id"] == "app-1"
+
+    def test_metadata_params_vs_metrics(self, tracker):
+        tracker.add_metadata("app-1", lr=3e-4, steps=100, name="llama", flag=True)
+        md = tracker.metadata("app-1")
+        assert md["name"] == "llama" and md["flag"] == "True"  # params
+        assert md["lr"] == 3e-4 and md["steps"] == 100.0  # metrics
+
+    def test_local_file_artifact_logged_to_store(self, tracker, tmp_path):
+        f = tmp_path / "model.ckpt"
+        f.write_text("weights")
+        tracker.add_artifact("app-1", "ckpt", str(f), metadata={"step": 42})
+        arts = tracker.artifacts("app-1")
+        assert set(arts) == {"ckpt"}
+        # resolved to the artifact-store URI, not the local path
+        assert arts["ckpt"].path.startswith("mlflow-artifacts:/")
+        assert arts["ckpt"].metadata == {"step": 42}
+        mlrun = tracker._mlflow_run("app-1")
+        assert "ckpt/model.ckpt" in tracker._fake.artifact_store[mlrun]
+
+    def test_dir_artifact_logged_recursively(self, tracker, tmp_path):
+        d = tmp_path / "ckpt_dir"
+        (d / "sub").mkdir(parents=True)
+        (d / "a.txt").write_text("1")
+        (d / "sub" / "b.txt").write_text("2")
+        tracker.add_artifact("app-1", "ckpt", str(d))
+        mlrun = tracker._mlflow_run("app-1")
+        assert sorted(tracker._fake.artifact_store[mlrun]) == [
+            "ckpt/a.txt",
+            "ckpt/sub/b.txt",
+        ]
+
+    def test_remote_artifact_becomes_pointer(self, tracker):
+        tracker.add_artifact("app-1", "data", "gs://bucket/data")
+        arts = tracker.artifacts("app-1")
+        assert arts["data"].path == "gs://bucket/data"
+
+    def test_store_only_artifacts_surface(self, tracker):
+        # logged via raw mlflow, outside add_artifact
+        mlrun = tracker._mlflow_run("app-1")
+        tracker._fake.artifact_store[mlrun].append("profile/trace.json")
+        arts = tracker.artifacts("app-1")
+        assert "profile" in arts
+
+    def test_lineage_upstream_and_downstream(self, tracker):
+        tracker.add_source("train-1", "data-prep-1", artifact_name="tokens")
+        tracker.add_source("eval-1", "train-1")
+        lineage = tracker.lineage("train-1")
+        assert [s.source_run_id for s in lineage.sources] == ["data-prep-1"]
+        assert lineage.sources[0].artifact_name == "tokens"
+        assert lineage.descendants == ["eval-1"]
+
+    def test_run_ids_and_source_filter(self, tracker):
+        tracker.add_source("eval-1", "train-1")
+        tracker.add_metadata("train-1", x=1)
+        assert set(tracker.run_ids()) == {"eval-1", "train-1"}
+        assert list(tracker.run_ids(source_run_id="train-1")) == ["eval-1"]
+
+    def test_log_params_flat(self, tracker):
+        from dataclasses import dataclass as dc
+
+        @dc
+        class Opt:
+            lr: float = 3e-4
+            warmup: int = 100
+
+        cfg = {"model": "llama3_1b", "opt": Opt(), "layers": [1, 2]}
+        tracker.log_params_flat("app-1", cfg)
+        md = tracker.metadata("app-1")
+        assert md["model"] == "llama3_1b"
+        assert md["opt.lr"] == 3e-4
+        assert md["opt.warmup"] == 100.0
+        assert md["layers"] == "[1, 2]"
+
+    def test_factory_config_parse(self, tracker, monkeypatch):
+        from torchx_tpu.tracker.mlflow import create
+
+        t = create("fake://host:5000;experiment=myexp")
+        assert t._fake if hasattr(t, "_fake") else True
+        assert "myexp" in t._client.experiments

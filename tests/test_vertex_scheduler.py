@@ -74,6 +74,30 @@ class TestCustomJobMaterialization:
         with pytest.raises(ValueError, match="no Vertex AI machine type"):
             tpu_machine_spec(tpu_role(accelerator="v2", chips=8))
 
+    def test_gpu_machine_spec_from_catalog(self):
+        from torchx_tpu.specs import named_resources
+
+        role = Role(
+            name="scorer", image="i", entrypoint="python",
+            resource=named_resources["gpu_a100_4"],
+        )
+        spec = cpu_machine_spec(role)
+        assert spec == {
+            "machineType": "a2-highgpu-4g",
+            "acceleratorType": "NVIDIA_TESLA_A100",
+            "acceleratorCount": 4,
+        }
+
+    def test_machine_type_capability_wins(self):
+        role = Role(
+            name="r", image="i", entrypoint="python",
+            resource=Resource(
+                cpu=6, memMB=40 * 1024,
+                capabilities={"gce.machine_type": "c3-standard-22"},
+            ),
+        )
+        assert cpu_machine_spec(role) == {"machineType": "c3-standard-22"}
+
     def test_cpu_machine_spec_covers_ask(self):
         role = Role(
             name="r", image="i", entrypoint="python",

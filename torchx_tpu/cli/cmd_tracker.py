@@ -80,6 +80,9 @@ class CmdTracker(SubCommand):
         trackers = _trackers()
         prefix = (lambda name: f"[{name}] ") if len(trackers) > 1 else (lambda name: "")
         for name, tracker in trackers.items():
-            for src in tracker.sources(args.run_id):
+            lineage = tracker.lineage(args.run_id)
+            for src in lineage.sources:
                 suffix = f" (artifact: {src.artifact_name})" if src.artifact_name else ""
-                print(f"{prefix(name)}{src.source_run_id}{suffix}")
+                print(f"{prefix(name)}upstream: {src.source_run_id}{suffix}")
+            for rid in lineage.descendants:
+                print(f"{prefix(name)}downstream: {rid}")

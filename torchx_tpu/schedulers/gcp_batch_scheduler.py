@@ -228,7 +228,9 @@ def app_to_batch_job(
             )
         machine = family.format(chips=tpu.chips_per_host)
     else:
-        machine = opts.machine_type
+        # per-role machine pin (heterogeneous catalog) beats the run cfg
+        caps = role.resource.capabilities if role.resource is not None else {}
+        machine = str(caps.get("gce.machine_type") or opts.machine_type)
 
     labels = {
         "tpx-app-name": app_id,

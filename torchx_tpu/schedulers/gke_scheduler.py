@@ -325,6 +325,28 @@ def role_to_pod_template(
         spec["tolerations"] = [
             {"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"}
         ]
+    else:
+        # heterogeneous node pools for CPU / GPU roles on mixed clusters:
+        # GPU roles (Resource.devices nvidia.com/gpu) pin their accelerator
+        # pool and tolerate GKE's GPU taint; a gce.machine_type capability
+        # pins the instance type for either kind of role
+        selector: dict[str, str] = {}
+        accel = role.resource.capabilities.get("gke.accelerator")
+        if accel:
+            selector["cloud.google.com/gke-accelerator"] = str(accel)
+        machine = role.resource.capabilities.get("gce.machine_type")
+        if machine:
+            selector["node.kubernetes.io/instance-type"] = str(machine)
+        if selector:
+            spec["nodeSelector"] = selector
+        if role.resource.devices.get("nvidia.com/gpu"):
+            spec["tolerations"] = [
+                {
+                    "key": "nvidia.com/gpu",
+                    "operator": "Exists",
+                    "effect": "NoSchedule",
+                }
+            ]
 
     return {
         "metadata": {

@@ -115,6 +115,10 @@ class SlurmBatchRequest:
     # slurm itself may start — or requeue — the job with any surviving node
     # count in range (the slurm-native analog of torchrun --nnodes min:max)
     elastic_range: Optional[tuple[int, int]] = None
+    # hosts per AppDef unit (slice) — the srun step rounds the allocation
+    # down to a whole-slice multiple, and TPX_MIN_REPLICAS stays in AppDef
+    # units (matching the GKE backend's injection)
+    elastic_hosts_per_unit: int = 1
 
     def script(self) -> str:
         return materialize_script(self)

@@ -99,13 +99,28 @@ def tpu_machine_spec(role: Role) -> dict[str, Any]:
 
 
 def cpu_machine_spec(role: Role) -> dict[str, Any]:
-    """Smallest n2-standard machine covering the role's cpu/mem ask."""
-    cpu = max(1, int(role.resource.cpu or 1))
-    mem_gb = max(1, (int(role.resource.memMB or 0) + 1023) // 1024)
-    for vcpus in (2, 4, 8, 16, 32, 48, 64, 80, 96, 128):
-        if vcpus >= cpu and vcpus * 4 >= mem_gb:  # n2-standard: 4 GB/vCPU
-            return {"machineType": f"n2-standard-{vcpus}"}
-    return {"machineType": "n2-standard-128"}
+    """Machine spec for non-TPU roles: an explicit ``gce.machine_type``
+    capability wins (heterogeneous-fleet catalog, named_resources_gcp);
+    GPU roles add acceleratorType/Count from the devices dict; otherwise
+    the smallest n2-standard covering the cpu/mem ask."""
+    caps = role.resource.capabilities
+    gpus = int(role.resource.devices.get("nvidia.com/gpu", 0))
+    machine = caps.get("gce.machine_type")
+    if machine is None:
+        cpu = max(1, int(role.resource.cpu or 1))
+        mem_gb = max(1, (int(role.resource.memMB or 0) + 1023) // 1024)
+        machine = "n2-standard-128"
+        for vcpus in (2, 4, 8, 16, 32, 48, 64, 80, 96, 128):
+            if vcpus >= cpu and vcpus * 4 >= mem_gb:  # n2-standard: 4 GB/vCPU
+                machine = f"n2-standard-{vcpus}"
+                break
+    spec: dict[str, Any] = {"machineType": str(machine)}
+    if gpus:
+        # Vertex accelerator enums are UPPER_SNAKE of the GKE label
+        accel = str(caps.get("gke.accelerator", "nvidia-tesla-t4"))
+        spec["acceleratorType"] = accel.upper().replace("-", "_")
+        spec["acceleratorCount"] = gpus
+    return spec
 
 
 def role_to_worker_pool(role: Role, app_name: str) -> dict[str, Any]:

@@ -47,6 +47,7 @@ from torchx_tpu.specs.api import (  # noqa: F401
     runopt,
     runopts,
 )
+from torchx_tpu.specs.named_resources_gcp import named_resources_gcp
 from torchx_tpu.specs.named_resources_generic import named_resources_generic
 from torchx_tpu.specs.named_resources_tpu import named_resources_tpu, tpu_slice
 
@@ -76,6 +77,7 @@ def _factories() -> dict[str, Callable[[], Resource]]:
     if _named_resource_factories is None:
         merged: dict[str, Callable[[], Resource]] = {}
         merged.update(named_resources_generic())
+        merged.update(named_resources_gcp())
         merged.update(named_resources_tpu())
         merged.update(_load_custom_factories())
         try:  # plugins may not be importable during bootstrap

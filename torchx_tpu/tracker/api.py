@@ -18,7 +18,7 @@ import importlib
 import logging
 import os
 from abc import ABC, abstractmethod
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Iterable, Mapping, Optional
 
 from torchx_tpu import settings
@@ -41,9 +41,11 @@ class TrackerSource:
 
 @dataclass
 class Lineage:
-    # placeholder for a richer lineage graph object
     run_id: str
     sources: list[TrackerSource]
+    # downstream runs that declared run_id as a source (backends that can
+    # answer the reverse query populate it; others leave it empty)
+    descendants: list[str] = field(default_factory=list)
 
 
 class TrackerBase(ABC):
