@@ -39,6 +39,12 @@ class _FileInfo:
     is_dir: bool
 
 
+class _PagedList(list):
+    """Mimics mlflow's PagedList: a list with a ``token`` attribute."""
+
+    token: Optional[str] = None
+
+
 class FakeMlflowClient:
     def __init__(self, tracking_uri: Optional[str] = None) -> None:
         self.tracking_uri = tracking_uri
@@ -70,13 +76,29 @@ class FakeMlflowClient:
     def get_run(self, run_id):
         return self.runs[run_id]
 
-    def search_runs(self, experiment_ids, filter_string: Optional[str] = None):
+    # paginate with tiny pages so _all_runs' page_token loop is exercised
+    PAGE_SIZE = 2
+
+    def search_runs(
+        self,
+        experiment_ids,
+        filter_string: Optional[str] = None,
+        page_token: Optional[str] = None,
+    ):
         out = list(self.runs.values())
         if filter_string:
             m = re.search(r"= '([^']*)'", filter_string)
             want = m.group(1) if m else ""
             out = [r for r in out if r.data.tags.get("tpx.run_id") == want]
-        return out
+
+        start = int(page_token) if page_token else 0
+        page = _PagedList(out[start : start + self.PAGE_SIZE])
+        page.token = (
+            str(start + self.PAGE_SIZE)
+            if start + self.PAGE_SIZE < len(out)
+            else None
+        )
+        return page
 
     def set_tag(self, run_id, key, value):
         self.runs[run_id].data.tags[key] = value
@@ -198,6 +220,31 @@ class TestMLflowTracker:
         assert [s.source_run_id for s in lineage.sources] == ["data-prep-1"]
         assert lineage.sources[0].artifact_name == "tokens"
         assert lineage.descendants == ["eval-1"]
+
+    def test_source_order_stable_past_ten(self, tracker):
+        # tag suffixes sort numerically: "source.10" after "source.2"
+        for i in range(12):
+            tracker.add_source("train-1", f"shard-{i}")
+        order = [s.source_run_id for s in tracker.sources("train-1")]
+        assert order == [f"shard-{i}" for i in range(12)]
+
+    def test_descendants_paginated(self, tracker):
+        # FakeMlflowClient pages at 2 runs; 4 tracked runs + sources forces
+        # descendants() through multiple page tokens
+        for name in ("eval-1", "eval-2", "eval-3"):
+            tracker.add_source(name, "train-1")
+        tracker.add_metadata("train-1", x=1)
+        assert set(tracker.descendants("train-1")) == {
+            "eval-1",
+            "eval-2",
+            "eval-3",
+        }
+        assert set(tracker.run_ids()) == {
+            "train-1",
+            "eval-1",
+            "eval-2",
+            "eval-3",
+        }
 
     def test_run_ids_and_source_filter(self, tracker):
         tracker.add_source("eval-1", "train-1")

@@ -474,7 +474,13 @@ class TestElasticGang:
         # identity comes from slurm at RUN time (size known only then)
         assert 'TPX_REPLICA_ID="$SLURM_PROCID"' in script
         assert 'TPX_NUM_REPLICAS="$SLURM_NTASKS"' in script
-        assert "export TPX_MIN_REPLICAS=2" in script
+        # AppDef units (1 slice), matching GKE's TPX_MIN_REPLICAS injection
+        assert "export TPX_MIN_REPLICAS=1" in script
+        assert "export TPX_HOSTS_PER_UNIT=2" in script
+        # whole-slice rounding: the srun step is clamped so a requeue that
+        # lands on 3 surviving nodes runs a 2-host (1-slice) gang
+        assert "TPX_USABLE_NODES=$(( SLURM_JOB_NUM_NODES / 2 * 2 ))" in script
+        assert '--nodes="$TPX_USABLE_NODES" --ntasks="$TPX_USABLE_NODES"' in script
         # the macro-substituted arg defers to the task-derived env
         assert "--id=${SLURM_JOB_ID}" in script
 

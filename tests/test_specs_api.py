@@ -1,6 +1,7 @@
 """Unit tests for the specs core data model."""
 
 import json
+import warnings
 
 import pytest
 
@@ -295,6 +296,17 @@ class TestRunopts:
     def test_unknown_passthrough(self):
         cfg = self.make().resolve({"project": "p", "plugin_knob": "x"})
         assert cfg["plugin_knob"] == "x"
+
+    def test_unknown_warns_once_per_key(self):
+        from torchx_tpu.specs import api as specs_api
+
+        specs_api._warned_unknown_opts.discard("plugin_knob2")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            self.make().resolve({"project": "p", "plugin_knob2": "x"})
+            self.make().resolve({"project": "p", "plugin_knob2": "y"})
+        hits = [x for x in w if "plugin_knob2" in str(x.message)]
+        assert len(hits) == 1
 
     def test_merge(self):
         a = runopts()

@@ -292,6 +292,10 @@ def _materialize_elastic_script(req: SlurmBatchRequest) -> str:
     checkpoint."""
     assert req.elastic_range is not None
     min_hosts, max_hosts = req.elastic_range
+    hpu = max(1, req.elastic_hosts_per_unit)
+    # TPX_MIN_REPLICAS is in AppDef units (slices for TPU roles) to match
+    # the GKE backend's injection — in-job bootstrap logic shares it
+    min_units = max(1, min_hosts // hpu)
     (rep,) = req.replicas
     lines = ["#!/bin/bash"]
     lines.append(f"#SBATCH --nodes={min_hosts}-{max_hosts}")
@@ -302,7 +306,17 @@ def _materialize_elastic_script(req: SlurmBatchRequest) -> str:
         'export TPX_COORDINATOR_HOST=$(scontrol show hostnames'
         ' "$SLURM_JOB_NODELIST" | head -n 1)',
         f"export TPX_APP_ID=tpx-${{SLURM_JOB_ID}}",
-        f"export {settings.ENV_TPX_MIN_REPLICAS}={min_hosts}",
+        f"export {settings.ENV_TPX_MIN_REPLICAS}={min_units}",
+        f"export TPX_HOSTS_PER_UNIT={hpu}",
+        "# slurm may start/requeue the ranged job with any node count in",
+        "# range; a TPU gang only works in whole-slice multiples, so the",
+        "# srun step is clamped to the largest usable multiple and spare",
+        "# hosts idle until the next requeue",
+        f'TPX_USABLE_NODES=$(( SLURM_JOB_NUM_NODES / {hpu} * {hpu} ))',
+        f'if [ "$TPX_USABLE_NODES" -lt {min_units * hpu} ]; then',
+        f'  echo "tpx: $SLURM_JOB_NUM_NODES nodes < {min_units * hpu} usable minimum" >&2',
+        "  exit 1",
+        "fi",
         "",
     ]
     if req.max_retries > 0:
@@ -333,6 +347,8 @@ def _materialize_elastic_script(req: SlurmBatchRequest) -> str:
     lines.append(
         "srun "
         + " ".join(rep.srun_opts)
+        # clamp the step to the whole-slice node count computed above
+        + ' --nodes="$TPX_USABLE_NODES" --ntasks="$TPX_USABLE_NODES"'
         + f" --output=slurm-${{SLURM_JOB_ID}}-{rep.name}-%t.out"
         + f" --error=slurm-${{SLURM_JOB_ID}}-{rep.name}-%t.err"
         + f" bash -c {shlex.quote(inner)}"
@@ -421,6 +437,9 @@ class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
             job_dir=str(cfg["job_dir"]) if cfg.get("job_dir") else None,
             max_retries=max((r.max_retries for r in app.roles), default=0),
             elastic_range=elastic_range,
+            elastic_hosts_per_unit=(
+                hosts_per_unit if elastic_range is not None else 1
+            ),
         )
         return AppDryRunInfo(req)
 

@@ -706,6 +706,10 @@ class runopt:
     help: str
 
 
+# keys already warned about as unknown-passthrough (warn once per process)
+_warned_unknown_opts: set[str] = set()
+
+
 class runopts:
     """Schema + validator for per-scheduler run configs.
 
@@ -753,11 +757,16 @@ class runopts:
             ckey = key if key in self._opts else self.canonical(key)
             opt = self._opts.get(ckey)
             if opt is None:
-                warnings.warn(
-                    f"unknown runopt {key!r} passed through unvalidated"
-                    f" (known: {sorted(self._opts)})",
-                    stacklevel=2,
-                )
+                # the passthrough exists for plugin/forward compat, so a
+                # legitimate plugin key must not warn on every submit:
+                # warn once per key per process
+                if key not in _warned_unknown_opts:
+                    _warned_unknown_opts.add(key)
+                    warnings.warn(
+                        f"unknown runopt {key!r} passed through unvalidated"
+                        f" (known: {sorted(self._opts)})",
+                        stacklevel=2,
+                    )
                 resolved[key] = val  # pass through for forward/plugin compat
                 continue
             seen.add(ckey)

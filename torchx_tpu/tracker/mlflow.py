@@ -196,16 +196,41 @@ class MLflowTracker(TrackerBase):
         self, run_id: str, artifact_name: Optional[str] = None
     ) -> Iterable[TrackerSource]:
         run = self._client.get_run(self._mlflow_run(run_id))
-        for tag, value in sorted(run.data.tags.items()):
-            if tag.startswith(SOURCE_TAG_PREFIX):
-                src, _, art = value.partition("|")
-                source = TrackerSource(source_run_id=src, artifact_name=art or None)
-                if artifact_name is None or source.artifact_name == artifact_name:
-                    yield source
+        # numeric sort on the tag index: "…source.10" must come after
+        # "…source.2", which lexicographic sorting would scramble
+        source_tags = [
+            (tag, value)
+            for tag, value in run.data.tags.items()
+            if tag.startswith(SOURCE_TAG_PREFIX)
+        ]
+
+        def _idx(kv: tuple[str, str]) -> int:
+            suffix = kv[0][len(SOURCE_TAG_PREFIX):]
+            return int(suffix) if suffix.isdigit() else 0
+
+        for tag, value in sorted(source_tags, key=_idx):
+            src, _, art = value.partition("|")
+            source = TrackerSource(source_run_id=src, artifact_name=art or None)
+            if artifact_name is None or source.artifact_name == artifact_name:
+                yield source
+
+    def _all_runs(self) -> Iterable[Any]:
+        """Every run in the experiment, following page tokens —
+        ``search_runs`` returns a single page (default ``max_results``),
+        so reverse lineage would silently miss runs in large experiments."""
+        token: Optional[str] = None
+        while True:
+            page = self._client.search_runs(
+                [self._experiment_id], page_token=token
+            )
+            yield from page
+            token = getattr(page, "token", None)
+            if not token:
+                return
 
     def descendants(self, run_id: str) -> Iterable[str]:
         """Runs that declared ``run_id`` as a source (downstream links)."""
-        for run in self._client.search_runs([self._experiment_id]):
+        for run in self._all_runs():
             rid = run.data.tags.get(RUN_ID_TAG)
             if not rid or rid == run_id:
                 continue
@@ -230,7 +255,7 @@ class MLflowTracker(TrackerBase):
         if source:
             yield from self.descendants(source)
             return
-        for run in self._client.search_runs([self._experiment_id]):
+        for run in self._all_runs():
             rid = run.data.tags.get(RUN_ID_TAG)
             if rid:
                 yield rid
