@@ -25,6 +25,16 @@ from torchx_tpu.specs.api import (
 )
 
 
+@pytest.fixture(autouse=True)
+def _isolated_scopes(tmp_path, monkeypatch):
+    """Point the durable scope registry at tmp so tests never touch ~."""
+    from torchx_tpu.schedulers import gcp_batch_scheduler as mod
+
+    monkeypatch.setattr(
+        mod, "_scopes_path", lambda: str(tmp_path / "scopes")
+    )
+
+
 def tpu_role(chips=16, accelerator="v5p", num_replicas=1, **kwargs) -> Role:
     return Role(
         name="trainer",
@@ -279,7 +289,11 @@ class TestLifecycle:
                 }
             ]
         )
-        sched = self._sched(lambda cmd, **kw: proc(stdout=payload))
+        sched = self._sched(
+            lambda cmd, **kw: proc(
+                stdout="(unset)" if "config" in cmd else payload
+            )
+        )
         (item,) = sched.list()
         assert item.name == "app-1"
         assert item.state == AppState.RUNNING
@@ -300,6 +314,8 @@ class TestLifecycle:
 
         def run_cmd(cmd, **kwargs):
             calls.append(cmd)
+            if "config" in cmd:
+                return proc(stdout="(unset)")
             return proc(stdout=payload if "list" in cmd else "{}")
 
         sched = self._sched(run_cmd)
@@ -313,6 +329,132 @@ class TestLifecycle:
         list_cmd = calls[-1]
         assert "--project" in list_cmd and "my-proj" in list_cmd
         assert "--location" in list_cmd and "eu-west4" in list_cmd
+
+    def test_list_scope_survives_fresh_process(self):
+        # the scope registry is durable: a NEW scheduler instance (fresh
+        # CLI process) must still query the explicit project a job was
+        # submitted to, instead of the gcloud default
+        payload = json.dumps(
+            [
+                {
+                    "name": "projects/my-proj/locations/eu-west4/jobs/app-1",
+                    "status": {"state": "RUNNING"},
+                }
+            ]
+        )
+        submitter = self._sched(lambda cmd, **kw: proc(stdout="{}"))
+        info = submitter.submit_dryrun(
+            AppDef(name="t", roles=[cpu_role()]),
+            {"location": "eu-west4", "project": "my-proj"},
+        )
+        submitter.schedule(info)
+
+        calls = []
+
+        def run_cmd(cmd, **kwargs):
+            calls.append(cmd)
+            return proc(stdout=payload if "list" in cmd else "")
+
+        fresh = self._sched(run_cmd)  # no _session_opts
+        (item,) = fresh.list()
+        assert item.app_id == "my-proj:eu-west4:app-1"
+        list_cmd = calls[-1]
+        assert "--project" in list_cmd and "my-proj" in list_cmd
+        assert "--location" in list_cmd and "eu-west4" in list_cmd
+
+    def test_list_unions_scopes_dedup(self):
+        # session scope == registered scope: one gcloud call, no dup rows
+        payload = json.dumps(
+            [
+                {
+                    "name": "projects/my-proj/locations/eu-west4/jobs/app-1",
+                    "status": {"state": "RUNNING"},
+                }
+            ]
+        )
+        calls = []
+
+        def run_cmd(cmd, **kwargs):
+            calls.append(cmd)
+            if "config" in cmd:
+                return proc(stdout="(unset)")
+            return proc(stdout=payload if "list" in cmd else "{}")
+
+        sched = self._sched(run_cmd)
+        info = sched.submit_dryrun(
+            AppDef(name="t", roles=[cpu_role()]),
+            {"location": "eu-west4", "project": "my-proj"},
+        )
+        sched.schedule(info)
+        items = sched.list()
+        assert [i.app_id for i in items] == ["my-proj:eu-west4:app-1"]
+        assert sum(1 for c in calls if "list" in c) == 1
+
+    def test_list_keeps_default_project_jobs_with_explicit_scope(self):
+        # a default-project job (submitted via raw gcloud) must not vanish
+        # from list() once an explicit-project scope is registered
+        explicit = json.dumps(
+            [
+                {
+                    "name": "projects/my-proj/locations/eu-west4/jobs/app-1",
+                    "status": {"state": "RUNNING"},
+                }
+            ]
+        )
+        default = json.dumps(
+            [
+                {
+                    "name": "projects/dflt/locations/us-central1/jobs/raw-1",
+                    "status": {"state": "RUNNING"},
+                }
+            ]
+        )
+
+        def run_cmd(cmd, **kwargs):
+            if "config" in cmd:
+                return proc(stdout="dflt\n")
+            if "list" in cmd:
+                return proc(
+                    stdout=explicit if "my-proj" in cmd else default
+                )
+            return proc(stdout="{}")
+
+        sched = self._sched(run_cmd)
+        info = sched.submit_dryrun(
+            AppDef(name="t", roles=[cpu_role()]),
+            {"location": "eu-west4", "project": "my-proj"},
+        )
+        sched.schedule(info)
+        ids = {i.app_id for i in sched.list()}
+        assert ids == {"my-proj:eu-west4:app-1", "dflt:us-central1:raw-1"}
+
+    def test_list_no_duplicates_when_default_equals_explicit(self):
+        # scope recorded as resolved default + session None-project scope
+        # must collapse to ONE query/row, not duplicate prefixless ids
+        payload = json.dumps(
+            [
+                {
+                    "name": "projects/dflt/locations/us-central1/jobs/j-1",
+                    "status": {"state": "RUNNING"},
+                }
+            ]
+        )
+        calls = []
+
+        def run_cmd(cmd, **kwargs):
+            calls.append(cmd)
+            if "config" in cmd:
+                return proc(stdout="dflt\n")
+            return proc(stdout=payload if "list" in cmd else "{}")
+
+        sched = self._sched(run_cmd)
+        info = sched.submit_dryrun(
+            AppDef(name="t", roles=[cpu_role()]), {}
+        )  # no explicit project: scope records the RESOLVED default
+        sched.schedule(info)
+        items = sched.list()
+        assert [i.app_id for i in items] == ["dflt:us-central1:j-1"]
+        assert sum(1 for c in calls if "list" in c) == 1
 
     def test_list_falls_back_to_gcloud_project(self):
         # no session cfg: list() asks gcloud for the configured project

@@ -55,6 +55,24 @@ class TestSbatchMaterialization:
         assert 'TPX_REPLICA_ID="0"' in script and 'TPX_REPLICA_ID="1"' in script
         assert "--kill-on-bad-exit=1" in script
 
+    def test_het_groups_stamped_via_wrapper(self, sched):
+        app = AppDef(name="t", roles=[tpu_role()])
+        script = sched.submit_dryrun(app, {}).request.script()
+        # every task's stdout/stderr rides through the epoch stamper so
+        # log_iter can window; argv stays batch-shell-expanded positionals,
+        # and pipelines (not procsubs) guarantee the stampers are drained
+        # before slurmstepd reaps the task
+        assert "export TPX_STAMP=" in script
+        assert script.count("bash -c 'set -o pipefail;") == 2
+        assert script.count('{ ("$@") 2>&1 1>&3') == 2
+        assert '| python3 -u -c "$TPX_STAMP" >&2; } 3>&1' in script
+
+    def test_elastic_script_stamped(self, sched):
+        app = AppDef(name="t", roles=[tpu_role(min_replicas=1, num_replicas=2)])
+        script = sched.submit_dryrun(app, {}).request.script()
+        assert "export TPX_STAMP=" in script
+        assert '$TPX_STAMP' in script
+
     def test_macro_substitution_defers_job_id(self, sched):
         app = AppDef(name="t", roles=[tpu_role()])
         script = sched.submit_dryrun(app, {}).request.script()
@@ -390,6 +408,41 @@ class TestSlurmLogIter:
             "keep 1",
             "keep 2",
         ]
+
+    def test_window_on_stamped_lines(self, sched, job_dir):
+        # the batch-script wrapper stamps epoch millis; log_iter windows
+        # on them and strips the stamp (7/7 backends honor windows)
+        (job_dir / "slurm-55-trainer-0.out").write_text(
+            "1700000000.000 early\n1700000100.000 mid\n1700000200.000 late\n"
+        )
+        assert list(
+            sched.log_iter("55", "trainer", 0, since=1700000050.0)
+        ) == ["mid", "late"]
+        assert list(
+            sched.log_iter(
+                "55", "trainer", 0, since=1700000050.0, until=1700000150.0
+            )
+        ) == ["mid"]
+
+    def test_stamps_stripped_without_window(self, sched, job_dir):
+        (job_dir / "slurm-55-trainer-0.out").write_text(
+            "1700000000.000 stamped\nlegacy unstamped\n"
+        )
+        assert list(sched.log_iter("55", "trainer", 0)) == [
+            "stamped",
+            "legacy unstamped",
+        ]
+
+    def test_legacy_unstamped_passes_window(self, sched, job_dir):
+        # pre-stamping log files carry no timestamps: windows can't apply,
+        # lines pass through whole rather than vanishing
+        (job_dir / "slurm-55-trainer-0.out").write_text("legacy line\n")
+        assert list(
+            sched.log_iter("55", "trainer", 0, since=1700000050.0)
+        ) == ["legacy line"]
+
+    def test_supports_log_windows_flag(self, sched):
+        assert type(sched).supports_log_windows is True
 
     def test_unknown_job_dir_raises(self, sched, job_dir):
         with pytest.raises(RuntimeError, match="no job dir recorded"):

@@ -94,6 +94,18 @@ def filter_regex(regex: str, data: Iterable[str]) -> Iterable[str]:
 
 _STAMP_RE = None  # compiled lazily; the pattern matches a real epoch only
 
+# stdin->stdout line stamper (``<epoch.millis> <line>``) run as
+# ``python3 -u -c``; shared by the tpu_vm remote wrapper and the slurm
+# batch-script wrapper so every backend's window filter can reuse
+# parse_epoch_stamp
+EPOCH_STAMPER = (
+    "import sys,time\n"
+    "for line in sys.stdin:\n"
+    "    sys.stdout.write(f'{time.time():.3f} '+line)\n"
+    "    sys.stdout.flush()\n"
+)
+
+
 
 def parse_epoch_stamp(line: str) -> "tuple[Optional[float], str]":
     """-> (epoch or None, payload) for log lines stamped ``<epoch.millis> ``.

@@ -341,6 +341,12 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
             raise RuntimeError(
                 f"gcloud batch jobs submit failed: {proc.stderr.strip()}"
             )
+        # durable scope registry: a FRESH process's list() must still query
+        # the project/location this job went to (slurm job-dir pattern).
+        # Resolve the gcloud default NOW so the stored scope is canonical —
+        # storing None would later dedupe against explicit-project scopes
+        # as if they were different projects (duplicate list() rows)
+        _record_scope(req.project or self._gcloud_project(), req.location)
         if req.project:
             return f"{req.project}:{req.location}:{req.name}"
         return f"{req.location}:{req.name}"
@@ -396,30 +402,61 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
         return describe_batch_job(app_id, payload, [role_name])
 
     def list(self) -> list[ListAppResponse]:
-        # Batch listing is location-scoped but list() takes no cfg: reuse the
-        # session's last-submitted project/location (set by _submit_dryrun)
-        # so jobs submitted with an explicit project stay visible, falling
-        # back to the gcloud-configured project + default location.
-        opts = self._session_opts or GCPBatchOpts(project=self._gcloud_project())
-        proc = self._run_cmd(self._gcloud(opts, "list", "--format", "json"))
-        if proc.returncode != 0:
-            return []
-        try:
-            jobs = json.loads(proc.stdout or "[]")
-        except json.JSONDecodeError:
-            return []
-        # mint ids with the project prefix when known, so describe/cancel/
-        # log on a listed id target the same project list() queried
-        prefix = f"{opts.project}:{opts.location}" if opts.project else opts.location
-        out = []
-        for j in jobs:
-            name = str(j.get("name", "")).rsplit("/", 1)[-1]
-            state = BATCH_STATE_MAP.get(
-                str((j.get("status") or {}).get("state", "")), AppState.UNKNOWN
+        # Batch listing is location-scoped but list() takes no cfg: union
+        # every scope this USER ever submitted to (durable registry, so a
+        # fresh CLI process still finds explicit-project jobs) plus the
+        # session's last-submitted scope, falling back to the
+        # gcloud-configured project + default location when neither exists.
+        default_project = self._gcloud_project()
+        raw: list[tuple[Optional[str], str]] = []
+        if self._session_opts is not None:
+            raw.append(
+                (self._session_opts.project, self._session_opts.location)
             )
-            out.append(
-                ListAppResponse(app_id=f"{prefix}:{name}", state=state, name=name)
+        raw.extend(
+            sorted(_known_scopes(), key=lambda s: (s[0] or "", s[1]))
+        )
+        if default_project is not None:
+            # default-project jobs (submitted by gcloud directly or by a
+            # pre-registry version) must not vanish once any scope exists
+            raw.append((default_project, GCPBatchOpts.location))
+        scopes: list[tuple[Optional[str], str]] = []
+        for project, location in raw:
+            scope = (project or default_project, location)
+            if scope not in scopes:
+                scopes.append(scope)
+        if not scopes:
+            scopes.append((default_project, GCPBatchOpts.location))
+        out: list[ListAppResponse] = []
+        seen: set[str] = set()
+        for project, location in scopes:
+            opts = GCPBatchOpts(project=project, location=location)
+            proc = self._run_cmd(
+                self._gcloud(opts, "list", "--format", "json")
             )
+            if proc.returncode != 0:
+                continue
+            try:
+                jobs = json.loads(proc.stdout or "[]")
+            except json.JSONDecodeError:
+                continue
+            # mint ids with the project prefix when known, so describe/
+            # cancel/log on a listed id target the same project list()
+            # queried
+            prefix = f"{project}:{location}" if project else location
+            for j in jobs:
+                name = str(j.get("name", "")).rsplit("/", 1)[-1]
+                app_id = f"{prefix}:{name}"
+                if app_id in seen:
+                    continue
+                seen.add(app_id)
+                state = BATCH_STATE_MAP.get(
+                    str((j.get("status") or {}).get("state", "")),
+                    AppState.UNKNOWN,
+                )
+                out.append(
+                    ListAppResponse(app_id=app_id, state=state, name=name)
+                )
         return out
 
     def _gcloud_project(self) -> Optional[str]:
@@ -499,6 +536,59 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
         if regex:
             lines = filter_regex(regex, lines)
         return lines
+
+
+# -- durable scope registry ---------------------------------------------
+# one line per DISTINCT submitted scope (``scope = project|location``) in
+# the user's home dir, the slurm ``.tpxslurmjobdirs`` pattern: list()
+# from a fresh process unions these scopes instead of falling back to the
+# gcloud default and missing explicit-project jobs
+
+GCP_BATCH_SCOPES_FILE = ".tpxgcpbatchscopes"
+
+
+def _scopes_path() -> str:
+    import os
+
+    return os.path.join(os.path.expanduser("~"), GCP_BATCH_SCOPES_FILE)
+
+
+def _dedup_keeper() -> Any:
+    """Compaction predicate: keep the first line per distinct scope value
+    (staleness can't be probed without gcloud, but duplicates can go)."""
+    seen: set[str] = set()
+
+    def keep(value: str) -> bool:
+        if value in seen:
+            return False
+        seen.add(value)
+        return True
+
+    return keep
+
+
+def _record_scope(project: Optional[str], location: str) -> None:
+    if (project or None, location) in _known_scopes():
+        return  # already durable; keep the file at one line per scope
+    from torchx_tpu.util import registry
+
+    registry.record(
+        _scopes_path(),
+        "scope",
+        f"{project or ''}|{location}",
+        keep=_dedup_keeper(),
+    )
+
+
+def _known_scopes() -> set[tuple[Optional[str], str]]:
+    from torchx_tpu.util import registry
+
+    out: set[tuple[Optional[str], str]] = set()
+    for _, value in registry.entries(_scopes_path()):
+        project, sep, location = value.partition("|")
+        if sep and location:
+            out.add((project or None, location))
+    return out
 
 
 def create_scheduler(session_name: str, **kwargs: Any) -> GCPBatchScheduler:

@@ -37,11 +37,13 @@ from torchx_tpu import settings
 from torchx_tpu.schedulers.api import (
     dquote as _dquote,
     DescribeAppResponse,
+    EPOCH_STAMPER,
     ListAppResponse,
     Scheduler,
     Stream,
     filter_regex,
     tpu_hosts_for_role,
+    window_stamped_lines,
 )
 from torchx_tpu.specs.api import (
     AppDef,
@@ -223,6 +225,24 @@ def _elastic_replica(role: Role, cfg: Mapping[str, CfgVal]) -> SlurmReplicaReque
     )
 
 
+# Task-side stamping wrapper for het groups: a FIXED single-quoted
+# ``bash -c`` body (no per-group escaping) whose positional params are the
+# already-batch-shell-expanded command argv. Lines gain an epoch-millis
+# prefix (shared ``EPOCH_STAMPER``) which ``log_iter`` strips on read and
+# uses for since/until windows — the slurm analog of the tpu_vm remote
+# wrapper and kubelet's ``timestamps=true``.
+# pipelines, not process substitutions: bash waits for every pipeline
+# member before exiting, so the stampers are fully drained when slurmstepd
+# reaps the task (procsubs are NOT waited on — a crash's final traceback
+# lines would race the reaper and vanish from the .err file); pipefail
+# propagates the command's exit status through both pipelines
+_STAMP_WRAPPER = (
+    "'set -o pipefail;"
+    " { (\"$@\") 2>&1 1>&3 | python3 -u -c \"$TPX_STAMP\" >&2; } 3>&1"
+    " | python3 -u -c \"$TPX_STAMP\"' tpx"
+)
+
+
 def materialize_script(req: SlurmBatchRequest) -> str:
     """The full sbatch script: SBATCH headers (hetjob groups, or one ranged
     group for an elastic gang), coordinator export, requeue-on-failure
@@ -241,6 +261,7 @@ def materialize_script(req: SlurmBatchRequest) -> str:
         'export TPX_COORDINATOR_HOST=$(scontrol show hostnames'
         ' "${SLURM_JOB_NODELIST_HET_GROUP_0:-$SLURM_JOB_NODELIST}" | head -n 1)',
         f"export TPX_APP_ID=tpx-${{SLURM_JOB_ID}}",
+        f"export TPX_STAMP={shlex.quote(EPOCH_STAMPER)}",
         "",
     ]
     if req.max_retries > 0:
@@ -271,6 +292,7 @@ def materialize_script(req: SlurmBatchRequest) -> str:
                 f"--output=slurm-${{SLURM_JOB_ID}}-{rep.name}.out",
                 f"--error=slurm-${{SLURM_JOB_ID}}-{rep.name}.err",
                 ("env " + env_prefix) if env_prefix else "env",
+                "bash -c " + _STAMP_WRAPPER,
                 " ".join(_dquote(c) for c in rep.cmd),
             ]
         ).strip()
@@ -306,6 +328,7 @@ def _materialize_elastic_script(req: SlurmBatchRequest) -> str:
         'export TPX_COORDINATOR_HOST=$(scontrol show hostnames'
         ' "$SLURM_JOB_NODELIST" | head -n 1)',
         f"export TPX_APP_ID=tpx-${{SLURM_JOB_ID}}",
+        f"export TPX_STAMP={shlex.quote(EPOCH_STAMPER)}",
         f"export {settings.ENV_TPX_MIN_REPLICAS}={min_units}",
         f"export TPX_HOSTS_PER_UNIT={hpu}",
         "# slurm may start/requeue the ranged job with any node count in",
@@ -337,12 +360,15 @@ def _materialize_elastic_script(req: SlurmBatchRequest) -> str:
     # the wrapper runs ON each task node (bash -c under srun), where
     # SLURM_PROCID/SLURM_NTASKS are set; single-quoting via shlex defers
     # all expansion from the batch shell to the task shell
+    # same drained-pipeline stamping as _STAMP_WRAPPER (see comment there)
     inner = (
         'export TPX_REPLICA_ID="$SLURM_PROCID"'
-        ' TPX_NUM_REPLICAS="$SLURM_NTASKS"; '
-        + "exec "
+        ' TPX_NUM_REPLICAS="$SLURM_NTASKS"; set -o pipefail; '
+        + "{ ("
         + (("env " + env_prefix + " ") if env_prefix else "")
         + " ".join(_dquote(c) for c in rep.cmd)
+        + ') 2>&1 1>&3 | python3 -u -c "$TPX_STAMP" >&2; } 3>&1'
+        + ' | python3 -u -c "$TPX_STAMP"'
     )
     lines.append(
         "srun "
@@ -359,6 +385,8 @@ def _materialize_elastic_script(req: SlurmBatchRequest) -> str:
 
 class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
     """Submits AppDefs as heterogeneous sbatch jobs."""
+
+    supports_log_windows = True  # wrapper-stamped log lines (_STAMP_WRAPPER)
 
     def __init__(self, session_name: str) -> None:
         super().__init__(backend="slurm", session_name=session_name)
@@ -569,11 +597,10 @@ class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
         should_tail: bool = False,
         streams: Optional[Stream] = None,
     ) -> Iterable[str]:
-        # since/until are NOT applied here (supports_log_windows stays
-        # False, the runner warns): slurm's own --output/--error files have
-        # no per-line timestamps, and interposing a stamper between srun
-        # and the filesystem would break sites' existing log tooling. Use
-        # `sacct --format=Start,End` to bracket a job's wall-clock instead.
+        # the batch-script wrapper stamps every line with epoch millis
+        # (``_STAMP_WRAPPER``), so since/until windows apply here the same
+        # way they do on tpu_vm; stamps are stripped before yielding, and
+        # pre-stamping legacy log files pass through unwindowed.
         job_dir = _load_job_dir(app_id)
         if job_dir is None:
             raise RuntimeError(
@@ -586,7 +613,9 @@ class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
             fallback = os.path.join(job_dir, f"slurm-{app_id}.{ext}")
             if os.path.exists(fallback):
                 log_file = fallback
-        lines: Iterable[str] = _read_lines(log_file)
+        lines: Iterable[str] = window_stamped_lines(
+            _read_lines(log_file), since, until
+        )
         if regex:
             lines = filter_regex(regex, lines)
         return lines

@@ -38,6 +38,7 @@ from torchx_tpu import settings
 from torchx_tpu.schedulers.api import (
     dquote as _dquote,
     DescribeAppResponse,
+    EPOCH_STAMPER,
     ListAppResponse,
     Scheduler,
     Stream,
@@ -68,12 +69,7 @@ REMOTE_LOG = f"{REMOTE_LOG_DIR}/job.log"
 # each log line is prefixed "<epoch.millis> " by the stamper below, which
 # is what makes since/until filtering and combined-stream merging possible
 # without a cloud logging dependency
-_STAMPER = (
-    "import sys,time\n"
-    "for line in sys.stdin:\n"
-    "    sys.stdout.write(f'{time.time():.3f} '+line)\n"
-    "    sys.stdout.flush()\n"
-)
+_STAMPER = EPOCH_STAMPER  # shared with the slurm batch-script wrapper
 
 QR_STATE_MAP: dict[str, AppState] = {
     "CREATING": AppState.PENDING,
