@@ -501,6 +501,7 @@ def fake_k8s(monkeypatch):
     rest = types.ModuleType("kubernetes.client.rest")
     rest.ApiException = _FakeApiException
     client.rest = rest
+    client.BatchV1Api = lambda api: mock.MagicMock()
     root.client = client
     monkeypatch.setitem(sys.modules, "kubernetes", root)
     monkeypatch.setitem(sys.modules, "kubernetes.client", client)
@@ -1097,3 +1098,144 @@ class TestHeterogeneousPools:
         r = named_resources["n2-standard-16"]
         assert r.cpu == 16 and r.tpu is None
         assert r.capabilities["gce.machine_type"] == "n2-standard-16"
+
+
+# =========================================================================
+# In-cluster elastic controller (elastic_controller=True): shrink keeps
+# working after the operator's `tpx watch` terminal is gone
+# =========================================================================
+
+
+class _FakeBatchApi:
+    def __init__(self):
+        self.created = []
+        self.deleted = []
+
+    def create_namespaced_job(self, namespace, body):
+        self.created.append((namespace, body))
+
+    def delete_namespaced_job(self, name, namespace, **kwargs):
+        self.deleted.append((namespace, name))
+
+
+class TestElasticControllerJob:
+    def _dryrun(self, sched, **cfg):
+        app = AppDef(
+            name="a", roles=[tpu_role(num_replicas=4, min_replicas=2)]
+        )
+        cfg.setdefault("elastic_controller", True)
+        cfg.setdefault("namespace", "ml")
+        return sched.submit_dryrun(app, cfg)
+
+    def test_dryrun_emits_controller_manifest(self):
+        sched = GKEScheduler("sess", client=object())
+        info = self._dryrun(sched, service_account="tpx-sa")
+        req = info.request
+        ctrl = req.controller
+        assert ctrl is not None and ctrl["kind"] == "Job"
+        app_name = req.resource["metadata"]["name"]
+        assert ctrl["metadata"]["name"] == f"{app_name}-tpx-watch"
+        assert ctrl["metadata"]["namespace"] == "ml"
+        pod = ctrl["spec"]["template"]["spec"]
+        # existing service_account plumbing gives the pod its RBAC identity
+        assert pod["serviceAccountName"] == "tpx-sa"
+        assert pod["restartPolicy"] == "OnFailure"
+        container = pod["containers"][0]
+        # the role image carries torchx_tpu, so the controller reuses it
+        assert container["image"] == "gcr.io/proj/img:1"
+        assert container["command"][:5] == [
+            "python", "-u", "-m", "torchx_tpu.cli.main", "watch",
+        ]
+        assert container["command"][5] == f"gke://sess/ml:{app_name}"
+
+    def test_no_controller_without_flag(self):
+        sched = GKEScheduler("sess", client=object())
+        app = AppDef(
+            name="a", roles=[tpu_role(num_replicas=4, min_replicas=2)]
+        )
+        info = sched.submit_dryrun(app, {"namespace": "ml"})
+        assert info.request.controller is None
+
+    def test_controller_requires_elastic_role(self):
+        sched = GKEScheduler("sess", client=object())
+        app = AppDef(name="a", roles=[tpu_role(num_replicas=4)])
+        with pytest.raises(ValueError, match="min_replicas"):
+            sched.submit_dryrun(app, {"elastic_controller": True})
+
+    def test_schedule_creates_and_delete_removes(
+        self, monkeypatch, fake_k8s
+    ):
+        sched = GKEScheduler("sess", client=object())
+        batch = _FakeBatchApi()
+        custom = mock.MagicMock()
+        monkeypatch.setattr(sched, "_batch_api", lambda: batch)
+        monkeypatch.setattr(sched, "_custom_objects_api", lambda: custom)
+        info = self._dryrun(sched, service_account="tpx-sa")
+        app_id = sched.schedule(info)
+        (created,) = batch.created
+        assert created[0] == "ml"
+        assert created[1]["metadata"]["name"].endswith("-tpx-watch")
+        sched.delete(app_id)
+        (deleted,) = batch.deleted
+        assert deleted == ("ml", created[1]["metadata"]["name"])
+
+    def test_cancel_removes_controller(self, monkeypatch, fake_k8s):
+        sched = GKEScheduler("sess", client=object())
+        batch = _FakeBatchApi()
+        custom = mock.MagicMock()
+        monkeypatch.setattr(sched, "_batch_api", lambda: batch)
+        monkeypatch.setattr(sched, "_custom_objects_api", lambda: custom)
+        monkeypatch.setattr(
+            sched, "describe", lambda app_id: mock.MagicMock(
+                state=AppState.RUNNING
+            )
+        )
+        sched.cancel("ml:app-x")
+        assert ("ml", "app-x-tpx-watch") in batch.deleted
+
+    def test_controller_pod_performs_shrink(self, monkeypatch, fake_k8s):
+        """Full lifecycle on a fake cluster: the shrink is performed by the
+        controller Job's OWN command (the materialized `tpx watch` argv,
+        executed here as the pod would), NOT by the test harness."""
+        sched = GKEScheduler("sess", client=object())
+        sched.resize_poll_interval = 0
+        batch = _FakeBatchApi()
+        monkeypatch.setattr(sched, "_batch_api", lambda: batch)
+
+        info = self._dryrun(sched)
+        monkeypatch.setattr(sched, "_custom_objects_api", mock.MagicMock())
+        sched.schedule(info)
+        assert batch.created  # the controller Job went to the cluster
+
+        # ...later, a slice fails while the operator is disconnected:
+        js = copy.deepcopy(info.request.resource)
+        job_name = js["spec"]["replicatedJobs"][0]["name"]
+        cluster = _ElasticClusterFake(_with_status(js, job_name, failed=1))
+        monkeypatch.setattr(sched, "_custom_objects_api", lambda: cluster)
+
+        # --- what the controller pod runs -------------------------------
+        command = info.request.controller["spec"]["template"]["spec"][
+            "containers"
+        ][0]["command"]
+        assert command[3] == "torchx_tpu.cli.main"
+        argv = command[4:] + ["--interval", "0"]
+
+        from torchx_tpu.cli import cmd_simple
+        from torchx_tpu.cli.main import main as cli_main
+        from torchx_tpu.runner.api import Runner
+
+        # the pod builds its own runner via get_runner(); point it at the
+        # same fake cluster (in the pod this is load_incluster_config)
+        monkeypatch.setattr(
+            cmd_simple,
+            "get_runner",
+            lambda *a, **kw: Runner(
+                "sess", {"gke": lambda session_name, **kw2: sched}
+            ),
+        )
+        cli_main(argv)
+
+        # the shrink happened, and the CLI (not this test) drove it
+        (body,) = cluster.created_bodies
+        (rj,) = body["spec"]["replicatedJobs"]
+        assert rj["replicas"] == 3

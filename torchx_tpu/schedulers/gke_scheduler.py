@@ -135,6 +135,13 @@ class GKEOpts(StructuredOpts):
     coordinator_port: int = settings.TPX_COORDINATOR_PORT
     """jax.distributed coordinator port."""
 
+    elastic_controller: bool = False
+    """run the elastic shrink controller as an in-cluster Job (survives
+    operator disconnect; requires a role with min_replicas, a
+    service_account with jobset get/delete/create + batch/v1 RBAC, and a
+    role image with the ``kubernetes`` extra installed —
+    ``pip install torchx-tpu[kubernetes]``)."""
+
 
 @dataclass
 class GKEJob:
@@ -143,9 +150,16 @@ class GKEJob:
     namespace: str
     resource: dict[str, Any]
     images_to_push: dict[str, tuple[str, str]] = field(default_factory=dict)
+    # in-cluster elastic controller Job (``elastic_controller=True``):
+    # created alongside the JobSet so slice-failure shrink keeps working
+    # after the operator's `tpx watch` process is gone
+    controller: Optional[dict[str, Any]] = None
 
     def __str__(self) -> str:
-        return json.dumps(self.resource, indent=2, default=str)
+        payload = self.resource
+        if self.controller is not None:
+            payload = {"jobset": self.resource, "controller": self.controller}
+        return json.dumps(payload, indent=2, default=str)
 
 
 # =========================================================================
@@ -659,6 +673,83 @@ def plan_elastic_shrink(
     return None
 
 
+CONTROLLER_SUFFIX = "-tpx-watch"
+LABEL_CONTROLLER_FOR = "tpx.sh/controller-for"
+
+
+def elastic_controller_job(
+    app_name: str,
+    namespace: str,
+    image: str,
+    service_account: Optional[str],
+    session_name: str,
+    max_restarts: int = 3,
+) -> dict[str, Any]:
+    """In-cluster elastic controller: a plain batch/v1 Job running
+    ``tpx watch gke://...`` against its own JobSet, so slice-failure
+    shrink (:func:`plan_elastic_shrink` via :meth:`GKEScheduler.resize`)
+    keeps working when the operator's terminal is gone — the in-cluster
+    analog of the local scheduler's in-process elastic restart.
+
+    Deliberately NOT a child of the JobSet (resize deletes + re-creates
+    the set; the controller must survive that) and not owner-referenced;
+    it exits when the app reaches a terminal state, GCs itself via
+    ``ttlSecondsAfterFinished``, and cancel/delete remove it eagerly.
+    The pod authenticates via the mounted ``service_account`` token
+    (``load_incluster_config`` fallback in ``_api_client``), which needs
+    get/delete/create on jobsets. The shrink budget is process-local: a
+    controller pod restart (restartPolicy OnFailure, e.g. after a
+    transient apiserver error) starts a fresh budget, and once
+    ``backoffLimit`` is spent the app keeps running without elastic
+    protection — `tpx watch` client-side remains available as a backstop.
+    """
+    handle = f"gke://{session_name}/{namespace}:{app_name}"
+    pod_spec: dict[str, Any] = {
+        "restartPolicy": "OnFailure",
+        "containers": [
+            {
+                "name": "tpx-elastic-controller",
+                "image": image,
+                "command": [
+                    "python",
+                    "-u",
+                    "-m",
+                    "torchx_tpu.cli.main",
+                    "watch",
+                    handle,
+                    "--max-restarts",
+                    str(max_restarts),
+                ],
+                "resources": {
+                    "limits": {"cpu": "250m", "memory": "256M"},
+                    "requests": {"cpu": "100m", "memory": "128M"},
+                },
+            }
+        ],
+    }
+    if service_account:
+        pod_spec["serviceAccountName"] = service_account
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {
+            "name": f"{app_name}{CONTROLLER_SUFFIX}",
+            "namespace": namespace,
+            "labels": {LABEL_CONTROLLER_FOR: app_name},
+        },
+        "spec": {
+            "backoffLimit": 6,
+            # a cleanly-finished app leaves no one to call delete(): let
+            # the cluster GC the completed controller Job + pod
+            "ttlSecondsAfterFinished": 3600,
+            "template": {
+                "metadata": {"labels": {LABEL_CONTROLLER_FOR: app_name}},
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
 # =========================================================================
 # Scheduler
 # =========================================================================
@@ -699,6 +790,11 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
 
         return CoreV1Api(self._api_client())
 
+    def _batch_api(self):  # noqa: ANN202
+        from kubernetes.client import BatchV1Api
+
+        return BatchV1Api(self._api_client())
+
     # -- runopts ----------------------------------------------------------
 
     def run_opts(self) -> runopts:
@@ -723,10 +819,31 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
             service_account=opts.service_account,
             coordinator_port=opts.coordinator_port,
         )
+        controller: Optional[dict[str, Any]] = None
+        if opts.elastic_controller:
+            elastic_role = next(
+                (r for r in app.roles if r.min_replicas is not None), None
+            )
+            if elastic_role is None:
+                raise ValueError(
+                    "elastic_controller=True requires a role with a"
+                    " min_replicas floor (e.g. dist.spmd -j min:max)"
+                )
+            # the role image carries torchx_tpu (its entrypoint is
+            # `python -m torchx_tpu.apps...`), so the controller reuses it
+            controller = elastic_controller_job(
+                app_name,
+                namespace=namespace,
+                image=elastic_role.image,
+                service_account=opts.service_account,
+                session_name=self.session_name,
+                max_restarts=max(1, elastic_role.max_retries or 3),
+            )
         req = GKEJob(
             namespace=namespace,
             resource=resource,
             images_to_push=images_to_push,
+            controller=controller,
         )
         return AppDryRunInfo(req)
 
@@ -749,7 +866,26 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
                     f"jobset {req.resource['metadata']['name']} already exists"
                 ) from e
             raise
-        return f"{req.namespace}:{req.resource['metadata']['name']}"
+        app_id = f"{req.namespace}:{req.resource['metadata']['name']}"
+        if req.controller is not None:
+            # the JobSet is already live: a controller-create failure must
+            # not raise (the caller would lose the handle of a running,
+            # capacity-consuming app) — degrade to unprotected + loud
+            try:
+                self._batch_api().create_namespaced_job(
+                    namespace=req.namespace, body=req.controller
+                )
+            except Exception as e:  # noqa: BLE001 - degrade, don't orphan
+                logger.error(
+                    "%s: elastic controller Job creation failed (%s);"
+                    " the app is RUNNING but NOT elastic-protected —"
+                    " run `tpx watch gke://%s/%s` client-side as a backstop",
+                    app_id,
+                    e,
+                    self.session_name,
+                    app_id,
+                )
+        return app_id
 
     # -- monitoring --------------------------------------------------------
 
@@ -806,7 +942,8 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
 
     def _cancel_existing(self, app_id: str) -> None:
         """Suspend (preserves spec + logs) rather than delete (reference
-        cancel=abort-preserving-spec, :901-934)."""
+        cancel=abort-preserving-spec, :901-934). The elastic controller
+        Job (if any) is removed — a suspended set must not be 'rescued'."""
         namespace, name = self._parse_app_id(app_id)
         self._custom_objects_api().patch_namespaced_custom_object(
             group=JOBSET_GROUP,
@@ -816,6 +953,31 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
             name=name,
             body={"spec": {"suspend": True}},
         )
+        self._delete_controller(namespace, name)
+
+    def _delete_controller(self, namespace: str, name: str) -> None:
+        """Remove the in-cluster elastic controller Job, if one exists.
+
+        Best-effort: this runs for EVERY cancel/delete (the scheduler
+        can't know whether the app was submitted with a controller), so
+        an RBAC denial on batch/v1 must not break cancel/delete of apps
+        that never had one."""
+        try:
+            self._batch_api().delete_namespaced_job(
+                name=f"{name}{CONTROLLER_SUFFIX}",
+                namespace=namespace,
+                propagation_policy="Background",
+            )
+        except Exception as e:  # noqa: BLE001 - cleanup is advisory
+            status = getattr(e, "status", None)
+            if status != 404:
+                logger.warning(
+                    "could not delete elastic controller %s%s in %s: %s",
+                    name,
+                    CONTROLLER_SUFFIX,
+                    namespace,
+                    e,
+                )
 
     def delete(self, app_id: str) -> None:
         namespace, name = self._parse_app_id(app_id)
@@ -832,6 +994,7 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
         except ApiException as e:
             if e.status != 404:
                 raise
+        self._delete_controller(namespace, name)
 
     # seconds between deletion polls during resize (tests set this to 0)
     resize_poll_interval: float = 1.0
