@@ -1,0 +1,76 @@
+#!/bin/bash
+# GKE/JobSet integration test against a REAL kubernetes control plane (kind
+# + the JobSet controller, stood up by the CI workflow). Two layers:
+#
+#  1. schema admission — server-side dry-run of the TPU JobSet the gke
+#     scheduler materializes (node selectors, completions, tpu resources):
+#     the apiserver validates it against the installed JobSet CRD, catching
+#     field drift that fixture-based unit tests cannot;
+#  2. CPU end-to-end — a real utils.echo app scheduled as a JobSet, admitted
+#     by the controller, run to completion on kind nodes, observed through
+#     `tpx status/log`.
+#
+# Requires: kubectl context pointing at a cluster with the JobSet CRD,
+# `pip install -e .[kubernetes]` done by the workflow.
+set -eux -o pipefail
+
+command -v kubectl
+kubectl get crd jobsets.jobset.x-k8s.io
+
+WORK="$(mktemp -d)"
+trap 'rm -rf "$WORK"' EXIT
+
+# --- 1. TPU JobSet schema admission (server-side dry-run) ----------------
+tpx run -s gke --dryrun dist.spmd --tpu v5litepod-16 -m mypkg.train \
+  | python -c '
+import json, re, sys
+
+text = sys.stdin.read()
+start = text.index("{", text.index("=== SCHEDULER REQUEST ==="))
+body = json.loads(text[start:])
+jobset = body.get("jobset", body)  # elastic apps wrap {jobset, controller}
+json.dump(jobset, sys.stdout)
+' > "$WORK/tpu-jobset.json"
+kubectl apply --dry-run=server -f "$WORK/tpu-jobset.json"
+echo "TPU JobSet admitted by the apiserver schema"
+
+# elastic variant (min floor annotations + in-cluster controller Job)
+tpx run -s gke -cfg elastic_controller=true --dryrun \
+    dist.spmd -j 1:2 --tpu v5litepod-16 -m mypkg.train \
+  | python -c '
+import json, sys
+
+text = sys.stdin.read()
+start = text.index("{", text.index("=== SCHEDULER REQUEST ==="))
+body = json.loads(text[start:])
+json.dump(body["jobset"], open(sys.argv[1] + "/elastic-jobset.json", "w"))
+json.dump(body["controller"], open(sys.argv[1] + "/controller-job.json", "w"))
+' "$WORK"
+kubectl apply --dry-run=server -f "$WORK/elastic-jobset.json"
+kubectl apply --dry-run=server -f "$WORK/controller-job.json"
+echo "elastic JobSet + controller Job admitted"
+
+# --- 2. CPU end-to-end through the real controller -----------------------
+# busybox has a real `echo`; no workspace (nothing to patch in CI)
+APP_ID="$(tpx run -s gke --workspace "" utils.echo --msg hello-from-kind --image busybox:stable | head -n1)"
+
+for _ in $(seq 1 60); do
+  STATE="$(tpx status "$APP_ID" | head -n1 || true)"
+  case "$STATE" in
+    *SUCCEEDED*) break ;;
+    *FAILED*|*CANCELLED*)
+      echo "FAIL: $STATE" >&2
+      kubectl get jobsets -A -o yaml >&2
+      kubectl get pods -A >&2
+      exit 1 ;;
+  esac
+  sleep 5
+done
+tpx status "$APP_ID" | grep -q SUCCEEDED
+
+tpx describe "$APP_ID"
+tpx log "$APP_ID" | grep -q "hello-from-kind"
+tpx list -s gke | grep -q "$(basename "$APP_ID" | cut -d: -f2)"
+tpx delete "$APP_ID"
+
+echo "gke integration: OK"

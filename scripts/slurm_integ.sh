@@ -1,0 +1,77 @@
+#!/bin/bash
+# Slurm integration test: runs INSIDE a slurm cluster node (the CI workflow
+# launches a dockerized slurmctld cluster and docker-execs this script in a
+# compute node). Everything here exercises the REAL control plane — sbatch
+# admits the generated script, srun runs the gang, sacct reports it — which
+# catches drift that canned-fixture unit tests cannot (sbatch rejecting an
+# option, het-group syntax changes, log files landing elsewhere).
+#
+# Usage: slurm_integ.sh <wheel-or-checkout-path> <venv-path>
+set -eux -o pipefail
+
+SRC="$(realpath "$1")"
+VENV="$(realpath "$2")"
+BASE_DIR="$(mktemp -d /data/tpx-integ-XXXXXX 2>/dev/null || mktemp -d)"
+JOB_DIR="$BASE_DIR/job"
+mkdir -p "$JOB_DIR"
+cd "$BASE_DIR"
+
+# slurm env (slurm-docker-cluster exposes binaries via /opt/slurm)
+SLURM_SH=/opt/slurm/etc/slurm.sh
+[ -e "$SLURM_SH" ] && source "$SLURM_SH"
+sbatch --version
+
+source "$VENV/bin/activate"
+pip install "$SRC"
+# the spmd bootstrap needs CPU jax on the compute nodes
+pip install "jax[cpu]"
+
+PARTITION="$(sinfo --format=%R --noheader | head -n 1)"
+cat <<EOT > .tpxconfig
+[slurm]
+partition = $PARTITION
+time = 10
+job_dir = $JOB_DIR
+EOT
+
+# --- 1. single-replica echo through the full lifecycle ------------------
+cat <<'EOT' > main.py
+import jax
+
+print(f"integ process={jax.process_index()}/{jax.process_count()}"
+      f" devices={jax.device_count()}", flush=True)
+EOT
+
+APP_ID="$(tpx run --wait -s slurm utils.sh echo hello-from-slurm | head -n1)"
+tpx status "$APP_ID"
+tpx describe "$APP_ID"
+tpx log "$APP_ID" | grep -q "hello-from-slurm"
+
+# log WINDOWS against real slurm-written files: the wrapper stamps lines,
+# a future --since must exclude them, a past --since must include them
+FUTURE="$(( $(date +%s) + 3600 ))"
+if tpx log --since "$FUTURE" "$APP_ID" | grep -q "hello-from-slurm"; then
+  echo "FAIL: --since in the future returned stamped lines" >&2
+  exit 1
+fi
+tpx log --since 7d "$APP_ID" | grep -q "hello-from-slurm"
+if tpx log --until 2000-01-01T00:00:00 "$APP_ID" | grep -q "hello-from-slurm"; then
+  echo "FAIL: --until in the distant past returned lines" >&2
+  exit 1
+fi
+
+# --- 2. a 2-process jax gang as het groups ------------------------------
+SPMD_ID="$(tpx run --wait -s slurm dist.spmd -j 2 --cpu 1 --script main.py | head -n1)"
+tpx status "$SPMD_ID"
+sacct -j "$(basename "$SPMD_ID")" --format=JobID,JobName,State
+LINES="$(tpx log "$SPMD_ID" | grep -c 'integ process=')"
+if [ "$LINES" -ne 2 ]; then
+  echo "FAIL: expected 2 gang log lines, got $LINES" >&2
+  tpx log "$SPMD_ID" >&2
+  exit 1
+fi
+
+# --- 3. listing ---------------------------------------------------------
+tpx list -s slurm | grep -q "$(basename "$SPMD_ID")"
+
+echo "slurm integration: OK"

@@ -46,7 +46,11 @@ def test_quickstart_local_path_executes(tmp_path):
     dir: write train.py exactly as documented, then execute every
     documented command and require success (the spmd run must actually
     form the 2x2 mesh)."""
-    env = None  # inherit; conftest pins JAX_PLATFORMS=cpu for the suite
+    import os
+
+    # redirect HOME so subprocesses' per-user registries (~/.tpx_local_apps
+    # etc.) land in the scratch dir, not the developer's real home
+    env = {**os.environ, "HOME": str(tmp_path)}
     outputs: dict[str, str] = {}
     for lang, marker, body in quickstart_blocks():
         if lang == "python" and marker.startswith("verify-write:"):
