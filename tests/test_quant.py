@@ -87,3 +87,51 @@ class TestQuantizedModel:
         # greedy decode from near-identical logits: most tokens agree
         agree = float((qout == out).mean())
         assert agree > 0.8, agree
+
+
+class TestInt8TrainingMatmul:
+    """AQT int8 TRAINING matmuls (fwd+bwd quantized, STE backward) —
+    the training-side counterpart of weight-only serving quant."""
+
+    def test_close_to_bf16_and_grads_flow(self):
+        pytest.importorskip("aqt")
+        import jax
+
+        k = jax.random.PRNGKey(0)
+        x = jax.random.normal(k, (64, 128), jnp.bfloat16)
+        w = jax.random.normal(jax.random.PRNGKey(1), (128, 96), jnp.bfloat16)
+
+        y_fp = quant.maybe_matmul(x, w)
+        y_i8 = quant.maybe_matmul(x, w, int8_training=True)
+        assert y_i8.dtype == y_fp.dtype
+        err = float(
+            jnp.abs(y_i8.astype(jnp.float32) - y_fp.astype(jnp.float32)).mean()
+            / jnp.abs(y_fp.astype(jnp.float32)).mean()
+        )
+        assert err < 0.05, err
+
+        def loss(w):
+            return quant.maybe_matmul(x, w, int8_training=True).astype(
+                jnp.float32
+            ).sum()
+
+        g = jax.grad(loss)(w)
+        assert g.shape == w.shape
+        assert float(jnp.abs(g.astype(jnp.float32)).mean()) > 0
+
+    def test_int8_training_model_matches_bf16(self):
+        pytest.importorskip("aqt")
+        import jax
+        from torchx_tpu.models import llama
+
+        cfg = llama.llama_tiny(remat_policy="full")
+        cfg_i8 = llama.llama_tiny(remat_policy="full", int8_matmuls=True)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        batch = {
+            "tokens": jax.random.randint(
+                jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size
+            )
+        }
+        l_fp = float(llama.loss_fn(params, batch, cfg))
+        l_i8 = float(llama.loss_fn(params, batch, cfg_i8))
+        assert abs(l_fp - l_i8) < 0.2, (l_fp, l_i8)

@@ -108,11 +108,55 @@ def quantize_params(params: Params) -> Params:
     return walk(params)
 
 
-def maybe_matmul(x: jnp.ndarray, w: Any, out_dtype: Any = None) -> jnp.ndarray:
+_AQT_DG = None
+
+
+def aqt_dot_general():  # noqa: ANN201 - aqt types are an optional dep
+    """Drop-in int8 TRAINING dot_general (AQT v2 ``config_v4``): forward
+    and both backward dots run int8xint8->int32 on the MXU with dynamic
+    symmetric per-tensor scales and a straight-through estimator.
+
+    Measured on v5e-1 (slope-timed 4096^3 matmul): bf16 190 TFLOP/s vs
+    int8 370 TOP/s — a 1.94x kernel speedup; see docs/performance.md for
+    what survives at the full-model level. Serving-side weight-only int8
+    (``quantize_params``) is unrelated — this path quantizes dynamically
+    inside the training step and keeps master weights in bf16/f32."""
+    global _AQT_DG
+    if _AQT_DG is None:
+        from aqt.jax.v2 import config as aqt_config
+
+        cfg = aqt_config.config_v4(fwd_bits=8, dlhs_bits=8, drhs_bits=8)
+        # deterministic rounding in the backward: config_v4 defaults the
+        # gradient-side quantizers to stochastic rounding, which demands an
+        # RNG key threaded through every dot (Context.key) — a plumbing
+        # cost the model body shouldn't pay; the quality delta at 8 bits
+        # is second-order next to per-tensor dynamic scaling
+        aqt_config.set_stochastic_rounding(
+            cfg,
+            vjp_lhs_stochastic_rounding=False,
+            vjp_rhs_stochastic_rounding=False,
+            implementation="jax.uniform",
+        )
+        _AQT_DG = cfg
+    return _AQT_DG
+
+
+def maybe_matmul(
+    x: jnp.ndarray,
+    w: Any,
+    out_dtype: Any = None,
+    int8_training: bool = False,
+) -> jnp.ndarray:
     """``x @ w`` that accepts either a plain matrix or a quantized
-    ``{"q", "scale"}`` record — lets one model body serve both."""
+    ``{"q", "scale"}`` record — lets one model body serve both.
+    ``int8_training=True`` routes plain-matrix matmuls through the AQT
+    int8 training dot (quantized fwd + bwd)."""
     if isinstance(w, dict) and "q" in w:
         return int8_matmul(x, w["q"], w["scale"], out_dtype=out_dtype)
+    if int8_training:
+        dg = aqt_dot_general()
+        y = dg(x, w, (((x.ndim - 1,), (0,)), ((), ())))
+        return y.astype(out_dtype or x.dtype)
     y = x @ w
     return y.astype(out_dtype) if out_dtype is not None else y
 
