@@ -150,3 +150,28 @@ class TestGenerateStream:
             list(gen.generate_stream(
                 params, prompt, cfg, max_new_tokens=cfg.max_seq,
             ))
+
+
+@pytest.mark.integ
+def test_bench_serving_script_smoke():
+    """scripts/bench_serving.py runs on CPU (tiny config) and emits valid
+    JSON lines — keeps the serving bench from rotting."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    script = Path(__file__).resolve().parent.parent / "scripts" / "bench_serving.py"
+    proc = subprocess.run(
+        [sys.executable, str(script), "--steps", "4", "--batches", "1"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 2  # bf16 + int8
+    for ln in lines:
+        d = json.loads(ln)
+        assert "error" not in d, d
+        assert d["value"] > 0
