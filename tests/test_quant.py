@@ -135,3 +135,17 @@ class TestInt8TrainingMatmul:
         l_fp = float(llama.loss_fn(params, batch, cfg))
         l_i8 = float(llama.loss_fn(params, batch, cfg_i8))
         assert abs(l_fp - l_i8) < 0.2, (l_fp, l_i8)
+
+    def test_int8_training_on_sharded_mesh(self):
+        """AQT int8 matmuls must compose with GSPMD sharding: users flip
+        int8_matmuls on real dp/fsdp/tp meshes, where AQT's internal
+        quantize/dequantize ops get partitioned too."""
+        pytest.importorskip("aqt")
+        from torchx_tpu.examples.train_llama import train
+        from torchx_tpu.models import llama
+        from torchx_tpu.parallel.mesh import MeshConfig
+
+        cfg = llama.llama_tiny(remat_policy="full", int8_matmuls=True)
+        mesh = MeshConfig(dp=2, fsdp=2, tp=2, sp=1)
+        m = train(cfg, mesh, batch=8, seq=64, steps=3, log_every=3)
+        assert 0 < m["loss"] < 10
