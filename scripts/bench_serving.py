@@ -59,11 +59,90 @@ def bench_decode(params, cfg, batch: int, steps: int, prompt_len: int = 32):
     return batch * steps / dt
 
 
+def bench_server(cfg_name: str, int8: bool, steps: int, clients: int):
+    """Aggregate tokens/sec through the REAL HTTP server under concurrent
+    load: `clients` threads each POST one /v1/generate; the batcher
+    coalesces them into shared device batches. This is the end-to-end
+    number the per-batch decode rows feed into."""
+    import threading
+    import urllib.request
+
+    from torchx_tpu.apps import generate_server
+
+    # wide coalescing window: the measurement wants the full-batch path,
+    # not arrival-jitter-dependent splits
+    server = generate_server.serve(
+        cfg_name, port=0, int8=int8, batch_window_ms=250.0, max_batch=clients
+    )
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        body = json.dumps(
+            {"tokens": [[1] * 16], "max_new_tokens": steps}
+        ).encode()
+
+        def one(errors: list) -> None:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/generate",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=600) as r:
+                    payload = json.loads(r.read())
+                if "tokens" not in payload:
+                    raise RuntimeError(f"bad response: {payload}")
+            except Exception as e:  # noqa: BLE001 - collected, fails the run
+                errors.append(e)
+
+        def round_trip() -> float:
+            errors: list = []
+            t0 = time.monotonic()
+            threads = [
+                threading.Thread(target=one, args=(errors,))
+                for _ in range(clients)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            if errors:
+                # a failed round must not masquerade as a throughput number
+                raise RuntimeError(f"{len(errors)} request(s) failed: {errors[0]}")
+            return time.monotonic() - t0
+
+        round_trip()  # warm: compiles the coalesced batch-`clients` shape
+        svc = server.service
+        batches_before = svc.batches
+        dt = round_trip()
+        return {
+            "metric": f"server aggregate decode tokens/sec ({cfg_name},"
+            f" {'int8' if int8 else 'bf16'}, {clients} concurrent clients)",
+            "value": round(clients * steps / dt, 1),
+            "unit": "tokens/sec",
+            # delta over the timed round only: device_batches == 1 is the
+            # coalescing claim, untangled from warm-round splits
+            "device_batches": svc.batches - batches_before,
+            "batched_sequences": svc.batched_sequences,
+        }
+    finally:
+        server.shutdown()
+        server.server_close()
+        server.service.close()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=128)
     ap.add_argument("--batches", default="1,4,8")
     ap.add_argument("--config", default="llama3_1b")
+    ap.add_argument(
+        "--server",
+        action="store_true",
+        help="also measure aggregate throughput through the HTTP server",
+    )
+    ap.add_argument("--clients", type=int, default=8)
     args = ap.parse_args()
 
     from torchx_tpu.models import llama
@@ -109,6 +188,14 @@ def main() -> None:
                         "unit": "tokens/sec",
                         "per_row": round(tps / batch, 1),
                     }
+                )
+            )
+
+    if args.server:
+        for int8 in (False, True):
+            print(
+                json.dumps(
+                    bench_server(cfg_name, int8, args.steps, args.clients)
                 )
             )
 

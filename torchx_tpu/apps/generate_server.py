@@ -207,7 +207,20 @@ class GenerateService:
         _, max_new, temperature, seed = group[0].key
         try:
             fn = self._decode_fn(max_new, temperature)
-            batch = jnp.asarray([p.tokens for p in group], dtype=jnp.int32)
+            rows = [p.tokens for p in group]
+            # pad the group to a power-of-2 bucket (row 0 repeated): group
+            # size depends on request-arrival jitter, and each distinct
+            # batch shape is a fresh XLA compile — bucketing caps the jit
+            # cache at log2(max_batch) shapes per (max_new, temperature)
+            # instead of one per observed group size
+            bucket = 1
+            while bucket < len(rows):
+                bucket *= 2
+            # never exceed the operator's ceiling (max_batch bounds
+            # KV-cache HBM): a non-power-of-2 max_batch clamps here
+            bucket = min(bucket, self.max_batch)
+            rows = rows + [rows[0]] * (bucket - len(rows))
+            batch = jnp.asarray(rows, dtype=jnp.int32)
             out = jax.device_get(fn(self.params, batch, jax.random.PRNGKey(seed)))
             self.batches += 1
             self.batched_sequences += len(group)
