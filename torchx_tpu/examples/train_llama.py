@@ -136,18 +136,11 @@ def make_train_step(
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
 ):
-    """The jitted SPMD training step: grads + AdamW update, donated state."""
-    # ring attention nested inside a pipeline stage (shard_map in
-    # shard_map) lowers fine for forward, but the backward transpose trips
-    # Shardy's nested-manual-computation verifier ("axis pp already bound
-    # by parent"); GSPMD handles it. Scope the partitioner override to
-    # each call (trace + execute) rather than flipping the global flag —
-    # other models built in this process keep their partitioner.
-    needs_gspmd = (
-        cfg.use_ring_attention
-        and mesh.shape.get("pp", 1) > 1
-        and mesh.shape.get("sp", 1) > 1
-    )
+    """The jitted SPMD training step: grads + AdamW update, donated state.
+
+    All mesh configs — including ring attention inside a pipeline stage
+    (the pipeline manualizes pp and sp in one shard_map) — compile under
+    the default Shardy partitioner; no GSPMD fallback remains."""
 
     def step(state: TrainState, batch: dict[str, jnp.ndarray]):
         (loss, aux), grads = jax.value_and_grad(llama.loss_and_aux, has_aux=True)(
@@ -163,19 +156,7 @@ def make_train_step(
             aux,  # raw MoE balancing aux (router health; 0 for dense)
         )
 
-    jitted = jax.jit(step, donate_argnums=(0,))
-    if not needs_gspmd:
-        return jitted
-
-    def step_under_gspmd(state, batch):  # noqa: ANN001
-        prev = jax.config.jax_use_shardy_partitioner
-        jax.config.update("jax_use_shardy_partitioner", False)
-        try:
-            return jitted(state, batch)
-        finally:
-            jax.config.update("jax_use_shardy_partitioner", prev)
-
-    return step_under_gspmd
+    return jax.jit(step, donate_argnums=(0,))
 
 
 def synthetic_batch(

@@ -251,6 +251,21 @@ def shard_params(params: Params, cfg: LlamaConfig, mesh: Mesh) -> Params:
 def _constraint(x: jnp.ndarray, mesh: Optional[Mesh], *spec) -> jnp.ndarray:
     if mesh is None:
         return x
+    ctx = jax.sharding.get_abstract_mesh()
+    manual = set(ctx.manual_axes) if not ctx.empty else set()
+    if manual:
+        # inside a shard_map manual region (pp stage, possibly with sp
+        # manual too for in-stage ring attention): constraints may only
+        # name the still-automatic axes — manual ones are per-shard here
+        def strip(entry):  # noqa: ANN001
+            if entry is None or isinstance(entry, str):
+                return None if entry in manual else entry
+            kept = tuple(a for a in entry if a not in manual)
+            return kept if kept else None
+
+        spec = tuple(strip(e) for e in spec)
+        if all(e is None for e in spec):
+            return x
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
@@ -292,13 +307,21 @@ def _layer(
     layer: Params,  # one layer's slice
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """-> (x, aux): aux is the MoE load-balancing loss contribution of this
-    layer (0 for dense layers)."""
+    layer (0 for dense layers).
+
+    ``cos``/``sin`` of None means the sequence axis is manual here (ring
+    attention inside a pipeline stage): x holds only this device's shard of
+    positions, so the RoPE frequencies are computed locally from the
+    shard's global offset."""
     b, s, d = x.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    if cos is None:
+        start = jax.lax.axis_index("sp") * s
+        cos, sin = rope_frequencies(hd, s, cfg.rope_theta, start=start)
 
     # attention block
     i8 = cfg.int8_matmuls
-    attn_in = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    attn_in = rms_norm(x, layer["attn_norm"], cfg.norm_eps, mesh=mesh)
     q = maybe_matmul(attn_in, layer["wq"], int8_training=i8).reshape(b, s, h, hd)
     k = maybe_matmul(attn_in, layer["wk"], int8_training=i8).reshape(b, s, kvh, hd)
     v = maybe_matmul(attn_in, layer["wv"], int8_training=i8).reshape(b, s, kvh, hd)
@@ -315,6 +338,7 @@ def _layer(
             impl=cfg.attn_impl,
             block_q=cfg.attn_block_q,
             block_kv=cfg.attn_block_kv,
+            mesh=mesh,
         )
     # named so remat policies can SAVE the kernel output: the attention
     # kernels are not dot_generals, so "dots" alone recomputes the whole
@@ -327,7 +351,7 @@ def _layer(
     x = _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
 
     # mlp block: dense SwiGLU, or sparse MoE when the config carries experts
-    mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps, mesh=mesh)
     down, aux = ffn(cfg, layer, mlp_in)
     x = x + down
     return _constraint(x, mesh, ("dp", "fsdp"), "sp", None), aux
@@ -370,7 +394,18 @@ def forward_features(
     pp=1 value when routing varies across microbatches — the standard
     group-wise aux (GShard computes it per dispatch group the same way);
     router balancing pressure is preserved, exact loss parity is not."""
-    x = params["embed"][tokens].astype(cfg.dtype)  # [b, s, d]
+    # The table lookup follows the ZeRO-3 pattern of every other fsdp
+    # weight: all-gather the (dim-sharded) table at use and gather with
+    # batch/seq-sharded indices, so the output is BORN in the activation
+    # sharding. Gathering from the still-sharded table instead makes the
+    # partitioner reshard the output from dim-sharded to batch/seq-sharded
+    # — an axis-moving reshard it can only do by involuntary full
+    # rematerialization (replicate + reslice), warned on every compile.
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    seq_spec = "sp" if sp > 1 and tokens.shape[1] % sp == 0 else None
+    tokens = _constraint(tokens, mesh, ("dp", "fsdp"), seq_spec)
+    table = _constraint(params["embed"], mesh, None, None)
+    x = table[tokens].astype(cfg.dtype)  # [b, s, d]
     return features_from_embeddings(params, x, cfg, mesh)
 
 
@@ -387,12 +422,25 @@ def features_from_embeddings(
     x = x.astype(cfg.dtype)
     x = _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
 
-    cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    # ring attention under pp runs inside the pipeline's manual region, so
+    # the sequence axis manualizes at the pipeline shard_map (Shardy rejects
+    # a nested shard_map rebinding pp) and RoPE is computed per-shard from
+    # the sp position offset (cos/sin of None -> _layer computes locally)
+    ring_in_pp = (
+        pp > 1
+        and cfg.use_ring_attention
+        and mesh is not None
+        and mesh.shape.get("sp", 1) > 1
+    )
+    if ring_in_pp:
+        cos = sin = None
+    else:
+        cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
 
     body = _remat(functools.partial(_layer, cfg, mesh, cos, sin), cfg)
     aux_total = jnp.float32(0)
 
-    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     if pp > 1:
         # pipeline the layer stack over the pp axis (embedding/head stay
         # outside the pipeline, replicated over pp)
@@ -416,6 +464,8 @@ def features_from_embeddings(
             mesh,
             n_microbatches=n_micro,
             with_aux=True,
+            manual_axes=frozenset({"sp"}) if ring_in_pp else frozenset(),
+            x_spec=P(None, "sp", None) if ring_in_pp else None,
         )
     else:
         def scan_step(x, layer_slice):  # noqa: ANN001
@@ -424,7 +474,7 @@ def features_from_embeddings(
 
         x, aux_per_layer = jax.lax.scan(scan_step, x, params["layers"])
         aux_total = aux_per_layer.sum()
-    return rms_norm(x, params["final_norm"], cfg.norm_eps), aux_total
+    return rms_norm(x, params["final_norm"], cfg.norm_eps, mesh=mesh), aux_total
 
 
 def lm_head(params: Params, cfg: LlamaConfig) -> jnp.ndarray:

@@ -29,6 +29,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 
 def _on_tpu() -> bool:
@@ -213,6 +214,67 @@ def splash_attention(
     return out.transpose(0, 2, 1, 3)
 
 
+def _shard_wrap(kernel, q, k, v, segment_ids, mesh, batch_axes, head_axis):
+    """Run a Pallas kernel under shard_map when the mesh shards its inputs.
+
+    Mosaic lowering demands a FULLY-manual axis context (partial-manual is
+    rejected with "Mosaic kernels cannot be automatically partitioned", see
+    jax/_src/tpu_custom_call.py), so the wrap manualizes every mesh axis
+    not already bound by a parent shard_map. Attention is embarrassingly
+    parallel over batch and heads: batch shards over (dp, fsdp), heads over
+    tp, the sequence axis stays whole (resharded at entry if the residual
+    stream was sp-sharded), and nothing else moves — no collectives inside;
+    fsdp/tp weight collectives stay outside, handled by the partitioner.
+
+    Returns None when the shapes don't divide the mesh (caller falls back
+    to xla attention, which partitions automatically).
+    """
+    sizes = dict(mesh.shape)
+    if all(s == 1 for s in sizes.values()):
+        # single-device mesh (the single-chip bench): nothing to partition
+        return kernel(q, k, v, segment_ids)
+    ctx = jax.sharding.get_abstract_mesh()
+    parent_manual = (
+        set(ctx.manual_axes) if not ctx.empty and ctx.manual_axes else set()
+    )
+    batch_axes = tuple(
+        a for a in batch_axes if sizes.get(a, 1) > 1 and a not in parent_manual
+    )
+    if head_axis in parent_manual or sizes.get(head_axis, 1) <= 1:
+        head_axis = None
+
+    batch_div = 1
+    for a in batch_axes:
+        batch_div *= sizes[a]
+    head_div = sizes.get(head_axis, 1) if head_axis else 1
+    if (
+        q.shape[0] % batch_div
+        or q.shape[2] % head_div
+        or k.shape[2] % head_div
+    ):
+        return None  # shapes don't divide the mesh: xla fallback
+
+    qkv_spec = P(batch_axes or None, None, head_axis, None)
+    seg_spec = P(batch_axes or None, None)
+    # Mosaic requires every mesh axis manual: bind all axes a parent
+    # shard_map hasn't (size-1 and unused axes just replicate)
+    manual = frozenset(sizes) - frozenset(parent_manual)
+    fn = jax.shard_map(
+        kernel,
+        in_specs=(
+            qkv_spec,
+            qkv_spec,
+            qkv_spec,
+            seg_spec if segment_ids is not None else None,
+        ),
+        out_specs=qkv_spec,
+        axis_names=manual,
+        check_vma=False,
+        **(dict(mesh=None) if parent_manual else dict(mesh=mesh)),
+    )
+    return fn(q, k, v, segment_ids)
+
+
 def attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -222,15 +284,22 @@ def attention(
     impl: str = "auto",
     block_q: int = 0,
     block_kv: int = 0,
+    mesh=None,
 ) -> jnp.ndarray:
-    """[b, s, heads, head_dim] x3 -> [b, s, heads, head_dim]."""
+    """[b, s, heads, head_dim] x3 -> [b, s, heads, head_dim].
+
+    ``mesh`` (a jax.sharding.Mesh) must be passed when batch or heads are
+    sharded and a Pallas kernel may be selected: Mosaic kernels cannot be
+    automatically partitioned, so the kernel runs under a shard_map over
+    the (dp, fsdp) batch axes and the tp head axis.
+    """
     if impl == "pallas" and segment_ids is not None:
         raise ValueError(
             "the pallas flash-attention path does not support segment_ids;"
             " use impl='xla' (or 'auto', which falls back) for packed"
             " cross-document masking"
         )
-    if impl == "splash" or (
+    use_splash = impl == "splash" or (
         # measured fastest on TPU (v5e sweep, docs/performance.md): splash
         # beats the flash kernel at GQA shapes (no KV repeat) — 46.9% vs
         # 39.6% MFU at llama3_1b — so "auto" prefers it when shapes allow
@@ -238,18 +307,34 @@ def attention(
         and segment_ids is None
         and _on_tpu()
         and _pallas_ok(q, k)
-    ):
-        return splash_attention(
-            q,
-            k,
-            v,
-            causal=causal,
-            block_q=block_q,
-            block_kv=block_kv,
-            segment_ids=segment_ids,
+    )
+    if use_splash or impl == "pallas":
+        if use_splash:
+
+            def kernel(q, k, v, seg):  # noqa: ANN001
+                return splash_attention(
+                    q, k, v, causal=causal, block_q=block_q,
+                    block_kv=block_kv, segment_ids=seg,
+                )
+        else:
+
+            def kernel(q, k, v, seg):  # noqa: ANN001
+                return pallas_attention(
+                    q, k, v, causal=causal, block_q=block_q, block_kv=block_kv
+                )
+
+        if mesh is None:
+            return kernel(q, k, v, segment_ids)
+        out = _shard_wrap(
+            kernel, q, k, v, segment_ids, mesh, ("dp", "fsdp"), "tp"
         )
-    if impl == "pallas":
-        return pallas_attention(
-            q, k, v, causal=causal, block_q=block_q, block_kv=block_kv
-        )
+        if out is not None:
+            return out
+        if impl != "auto":
+            raise ValueError(
+                f"impl={impl!r}: batch {q.shape[0]} / heads "
+                f"{q.shape[2]} do not divide the mesh's dp*fsdp / tp axes; "
+                "Pallas kernels need divisible shapes (use impl='auto' to "
+                "fall back to xla attention)"
+            )
     return xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)

@@ -119,11 +119,30 @@ def ring_attention(
     """Causal attention over a sequence-sharded [b, s, h, d] layout.
 
     q/k/v are global arrays whose ``s`` axis is sharded over ``seq_axis``;
-    returns output in the same layout. Works inside jit, including nested
-    inside another partial-manual shard_map region (e.g. a pp pipeline
-    stage): when an ambient abstract mesh is active — some axes already
-    manual — shard_map must take the CONTEXT mesh, not the concrete one.
+    returns output in the same layout. Works inside jit, including inside
+    another shard_map's manual region (e.g. a pp pipeline stage) — but only
+    when that region already manualizes ``seq_axis`` itself: the per-shard
+    kernel then runs directly. Nesting a second shard_map that rebinds an
+    axis the parent bound is rejected by Shardy's verifier, so the parent
+    (``pipeline_apply(manual_axes={"sp"})``) must take the sequence axis
+    manual alongside its own.
     """
+    ctx = jax.sharding.get_abstract_mesh()
+    if not ctx.empty and ctx.manual_axes:
+        if seq_axis in ctx.manual_axes:
+            # the ambient manual region already owns the sequence axis:
+            # q/k/v are per-shard views here, use the collective kernel
+            # directly (no inner shard_map)
+            return _ring_attention_shard(q, k, v, axis_name=seq_axis)
+        raise RuntimeError(
+            "ring_attention called inside a manual region "
+            f"(manual axes {set(ctx.manual_axes)}) that does not include "
+            f"the sequence axis {seq_axis!r}. Nesting a shard_map that "
+            "rebinds parent axes is rejected by the Shardy partitioner — "
+            "manualize the sequence axis in the outer shard_map instead "
+            '(pipeline_apply(..., manual_axes=frozenset({"sp"}), '
+            "x_spec=P(None, 'sp', None)))."
+        )
     # shapes are static at trace time: drop the batch sharding when the
     # (micro)batch is too small to split over dp/fsdp — e.g. inside a
     # pipeline stage where microbatching shrank the batch axis
@@ -132,33 +151,13 @@ def ring_attention(
         batch_div *= mesh.shape.get(a, 1)
     eff_batch_axes = batch_axes if q.shape[0] % max(batch_div, 1) == 0 else ()
     spec = P(eff_batch_axes, seq_axis, head_axis, None)
-    ctx = jax.sharding.get_abstract_mesh()
-    # "nested" means inside another shard_map's MANUAL region — a bare
-    # `with jax.sharding.use_mesh(...)` also sets the abstract mesh but has
-    # no manual axes and must take the standalone path
-    nested = (
-        not ctx.empty
-        and bool(ctx.manual_axes)
-        and dict(ctx.shape) == dict(mesh.shape)
-    )
-    if nested:
-        # inside another partial-manual region: take the CONTEXT mesh and
-        # manualize only our own axes (the parent keeps its own, e.g. pp)
-        kwargs: dict = dict(
-            mesh=None,
-            axis_names=frozenset(
-                {a for a in (seq_axis, *eff_batch_axes, head_axis) if a}
-            ),
-        )
-    else:
-        # standalone: full-manual over the concrete mesh (also keeps eager
-        # calls working — partial-auto shard_map requires jit)
-        kwargs = dict(mesh=mesh)
+    # standalone: full-manual over the concrete mesh (also keeps eager
+    # calls working — partial-auto shard_map requires jit)
     fn = jax.shard_map(
         functools.partial(_ring_attention_shard, axis_name=seq_axis),
+        mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
-        **kwargs,
     )
     return fn(q, k, v)

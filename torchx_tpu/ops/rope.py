@@ -11,13 +11,21 @@ import jax.numpy as jnp
 
 
 def rope_frequencies(
-    head_dim: int, max_seq: int, theta: float = 500000.0
+    head_dim: int, max_seq: int, theta: float = 500000.0, start=0
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """-> (cos, sin), each [max_seq, head_dim//2], float32."""
+    """-> (cos, sin), each [max_seq, head_dim//2], float32.
+
+    ``start`` offsets the position index (static int or traced scalar):
+    sequence-sharded layouts (ring attention under a manualized ``sp``
+    axis) compute the frequencies for their own shard of positions with
+    ``start = axis_index("sp") * local_seq``.
+    """
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
-    t = jnp.arange(max_seq, dtype=jnp.float32)
+    t = jnp.arange(max_seq, dtype=jnp.float32) + jnp.asarray(
+        start, dtype=jnp.float32
+    )
     freqs = jnp.outer(t, inv_freq)  # [seq, head_dim/2]
     return jnp.cos(freqs), jnp.sin(freqs)
 

@@ -74,10 +74,11 @@ def _pipeline_shard(
     body: LayerBody,
     n_micro: int,
     with_aux: bool,
+    extra_axes: tuple,  # manual axes beyond pp (e.g. ("sp",))
     local_layers: Any,  # leaves [L/S, ...] — this stage's layers
     x: jnp.ndarray,  # [n_micro, mb, ...] microbatched input (replicated)
 ):
-    """Runs inside shard_map over ("pp",)."""
+    """Runs inside shard_map over ("pp", *extra_axes)."""
     n_stages = jax.lax.psum(1, "pp")
     stage = jax.lax.axis_index("pp")
     mb_shape = x.shape[1:]
@@ -123,6 +124,12 @@ def _pipeline_shard(
         # over its own tokens, so average over microbatches to match the
         # non-pp semantics (per-layer aux = mean over the full batch)
         aux_total = jax.lax.psum(aux_acc, "pp") / n_micro
+        if extra_axes:
+            # the aux out_spec is P() (replicated), but each extra-axis
+            # shard (e.g. an sp sequence shard) computed aux over its OWN
+            # tokens — average them so the assembled global value is the
+            # full-batch mean rather than one arbitrary shard's
+            aux_total = jax.lax.pmean(aux_total, extra_axes)
         return outputs, aux_total
     return outputs
 
@@ -134,6 +141,8 @@ def pipeline_apply(
     mesh: Mesh,
     n_microbatches: int,
     with_aux: bool = False,
+    manual_axes: frozenset = frozenset(),
+    x_spec: P | None = None,
 ):
     """Apply L stacked layers to x, pipelined over the mesh's "pp" axis.
 
@@ -143,6 +152,15 @@ def pipeline_apply(
     in the microbatch mean this equals the non-pipelined scan exactly; for
     nonlinear aux (MoE balancing) it is the group-wise variant computed per
     microbatch — equivalent balancing pressure, not bitwise loss parity.
+
+    ``manual_axes`` adds mesh axes beyond ``pp`` to the manual region, and
+    ``x_spec`` (a spec for the un-microbatched ``[batch, ...]`` x over
+    those axes) shards the activations into it. A body that runs its own
+    collectives over an axis — ring attention over ``sp`` — must be
+    manualized HERE, at the single shard_map: nesting a second shard_map
+    inside the stage body would rebind ``pp`` and is rejected by Shardy's
+    verifier. The batch entry of ``x_spec`` must be None (microbatching
+    reshapes it); dp/fsdp/tp stay automatic inside the stage either way.
     """
     n_stages = mesh.shape["pp"]
     leaves = jax.tree.leaves(stacked_params)
@@ -157,16 +175,34 @@ def pipeline_apply(
     mb = batch // n_microbatches
     x_micro = x.reshape(n_microbatches, mb, *x.shape[1:])
 
+    if x_spec is not None and len(x_spec) > 0 and x_spec[0] is not None:
+        raise ValueError(
+            f"x_spec batch entry must be None, got {x_spec}: the batch axis "
+            "is reshaped into (microbatch, mb) and cannot be manual-sharded"
+        )
+    # spec for the microbatched x: (n_micro, mb, *feature axes) — the two
+    # leading axes replicated over the manual axes, feature entries from
+    # x_spec (e.g. the sequence axis over "sp")
+    feature_spec = tuple(x_spec)[1:] if x_spec is not None else ()
+    micro_spec = P(None, None, *feature_spec)
+
     layer_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
-    # partial manualization: only the pp axis goes manual; any other mesh
-    # axes (dp/fsdp/tp) remain automatic so GSPMD keeps sharding the math
-    # inside each stage
+    # partial manualization: pp (and any caller-requested axes, e.g. sp for
+    # in-stage ring attention) go manual; other mesh axes (dp/fsdp/tp)
+    # remain automatic so the partitioner keeps sharding the math inside
+    # each stage
     fn = jax.shard_map(
-        functools.partial(_pipeline_shard, body, n_microbatches, with_aux),
+        functools.partial(
+            _pipeline_shard,
+            body,
+            n_microbatches,
+            with_aux,
+            tuple(sorted(manual_axes)),
+        ),
         mesh=mesh,
-        in_specs=(layer_specs, P()),  # layers sharded by stage; x replicated
-        out_specs=(P(), P()) if with_aux else P(),
-        axis_names=frozenset({"pp"}),
+        in_specs=(layer_specs, micro_spec),  # layers sharded by stage
+        out_specs=(micro_spec, P()) if with_aux else micro_spec,
+        axis_names=frozenset({"pp"}) | manual_axes,
         check_vma=False,
     )
     if with_aux:
