@@ -106,3 +106,9 @@ ENV_XLA_FLAGS = "XLA_FLAGS"
 ENV_MEGASCALE_COORDINATOR_ADDRESS = "MEGASCALE_COORDINATOR_ADDRESS"
 ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
 ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+
+# RMSNorm backward selection when the call site says "auto": "never"
+# (default — plain XLA backward, measured fastest on v5e at batch 2),
+# "pallas" (the fused dx+dw kernel; re-evaluate at batch >= 8), or
+# "interpret" (Pallas interpreter — CPU tests). See ops/norms.py.
+ENV_TPX_FUSED_NORM = "TPX_FUSED_NORM"
