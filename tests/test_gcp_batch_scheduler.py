@@ -33,6 +33,9 @@ def _isolated_scopes(tmp_path, monkeypatch):
     monkeypatch.setattr(
         mod, "_scopes_path", lambda: str(tmp_path / "scopes")
     )
+    monkeypatch.setattr(
+        mod, "_fails_path", lambda: str(tmp_path / "scope_fails")
+    )
 
 
 def tpu_role(chips=16, accelerator="v5p", num_replicas=1, **kwargs) -> Role:
@@ -361,6 +364,54 @@ class TestLifecycle:
         list_cmd = calls[-1]
         assert "--project" in list_cmd and "my-proj" in list_cmd
         assert "--location" in list_cmd and "eu-west4" in list_cmd
+
+    def test_list_evicts_scope_after_repeated_failures(self):
+        """A registered scope whose gcloud calls keep failing (revoked /
+        deleted project) must stop adding a failing subprocess to every
+        list() — evicted after 3 unbroken failures (advisor r4)."""
+        submitter = self._sched(lambda cmd, **kw: proc(stdout="{}"))
+        info = submitter.submit_dryrun(
+            AppDef(name="t", roles=[cpu_role()]),
+            {"location": "eu-west4", "project": "dead-proj"},
+        )
+        submitter.schedule(info)
+
+        calls = []
+
+        def failing(cmd, **kwargs):
+            calls.append(cmd)
+            if "config" in cmd:
+                return proc(stdout="(unset)")
+            return proc(rc=1, stderr="PERMISSION_DENIED")
+
+        fresh = self._sched(failing)
+        for _ in range(3):
+            fresh.list()
+        dead_before = sum(
+            1 for c in calls if "list" in c and "dead-proj" in c
+        )
+        assert dead_before == 3
+        fresh.list()  # 4th: evicted — the dead scope is never queried
+        # (list() may still fall back to the DEFAULT scope, which is fine:
+        # the advisor's complaint was the dead scope's eternal failure)
+        assert (
+            sum(1 for c in calls if "list" in c and "dead-proj" in c)
+            == dead_before
+        )
+
+    def test_successful_submit_unevicts_scope(self):
+        from torchx_tpu.schedulers import gcp_batch_scheduler as mod
+
+        for _ in range(mod.SCOPE_EVICT_FAILURES):
+            mod._note_scope_result("dead-proj", "eu-west4", ok=False)
+        assert ("dead-proj", "eu-west4") in mod._evicted_scopes()
+        sched = self._sched(lambda cmd, **kw: proc(stdout="{}"))
+        info = sched.submit_dryrun(
+            AppDef(name="t", roles=[cpu_role()]),
+            {"location": "eu-west4", "project": "dead-proj"},
+        )
+        sched.schedule(info)
+        assert ("dead-proj", "eu-west4") not in mod._evicted_scopes()
 
     def test_list_unions_scopes_dedup(self):
         # session scope == registered scope: one gcloud call, no dup rows

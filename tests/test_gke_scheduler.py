@@ -570,6 +570,46 @@ class TestGKELifecycle:
         sel = core.list_namespaced_pod.call_args.kwargs["label_selector"]
         assert sel == "jobset.sigs.k8s.io/jobset-name=app1"
 
+    def test_describe_surfaces_failed_elastic_controller(
+        self, monkeypatch, fake_k8s
+    ):
+        """A controller Job that exhausted its backoffLimit (e.g. OOMKill
+        loop) means the app runs WITHOUT elastic protection — `tpx status`
+        must say so instead of leaving it to the next slice failure
+        (advisor r4)."""
+        custom = mock.MagicMock()
+        custom.get_namespaced_custom_object.return_value = {"status": {}}
+        core = mock.MagicMock()
+        core.list_namespaced_pod.return_value.items = []
+        sched = self._sched_with_api(monkeypatch, custom=custom, core=core)
+        batch = mock.MagicMock()
+        cond = types.SimpleNamespace(
+            type="Failed", status="True", reason="BackoffLimitExceeded"
+        )
+        batch.read_namespaced_job.return_value = types.SimpleNamespace(
+            status=types.SimpleNamespace(conditions=[cond])
+        )
+        monkeypatch.setattr(sched, "_batch_api", lambda: batch)
+        resp = sched.describe("ml:app1")
+        assert "elastic controller FAILED" in resp.msg
+        assert "BackoffLimitExceeded" in resp.msg
+        name = batch.read_namespaced_job.call_args.kwargs["name"]
+        assert name == "app1-tpx-watch"
+
+    def test_describe_healthy_controller_no_note(self, monkeypatch, fake_k8s):
+        custom = mock.MagicMock()
+        custom.get_namespaced_custom_object.return_value = {"status": {}}
+        core = mock.MagicMock()
+        core.list_namespaced_pod.return_value.items = []
+        sched = self._sched_with_api(monkeypatch, custom=custom, core=core)
+        batch = mock.MagicMock()
+        batch.read_namespaced_job.return_value = types.SimpleNamespace(
+            status=types.SimpleNamespace(conditions=[])
+        )
+        monkeypatch.setattr(sched, "_batch_api", lambda: batch)
+        resp = sched.describe("ml:app1")
+        assert resp.msg == ""
+
     def test_describe_pod_listing_is_best_effort(self, monkeypatch, fake_k8s):
         custom = mock.MagicMock()
         custom.get_namespaced_custom_object.return_value = {"status": {}}

@@ -297,16 +297,26 @@ class TestRunopts:
         cfg = self.make().resolve({"project": "p", "plugin_knob": "x"})
         assert cfg["plugin_knob"] == "x"
 
-    def test_unknown_warns_once_per_key(self):
-        from torchx_tpu.specs import api as specs_api
-
-        specs_api._warned_unknown_opts.discard("plugin_knob2")
+    def test_unknown_warns_once_per_key_per_schema(self):
+        """Same schema: one warning however often it resolves — including
+        across FRESH instances (run_opts() builds a new runopts per
+        submit; per-submit spam is the thing warn-once prevents). A
+        DIFFERENT schema (another scheduler) must still warn for its own
+        unknown key of the same name (advisor r4)."""
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
             self.make().resolve({"project": "p", "plugin_knob2": "x"})
             self.make().resolve({"project": "p", "plugin_knob2": "y"})
         hits = [x for x in w if "plugin_knob2" in str(x.message)]
         assert len(hits) == 1
+
+        other_schema = runopts()
+        other_schema.add("unrelated", type_=str, help="")
+        with warnings.catch_warnings(record=True) as w2:
+            warnings.simplefilter("always")
+            other_schema.resolve({"plugin_knob2": "z"})
+        hits_b = [x for x in w2 if "plugin_knob2" in str(x.message)]
+        assert len(hits_b) == 1
 
     def test_merge(self):
         a = runopts()

@@ -346,7 +346,10 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
         # Resolve the gcloud default NOW so the stored scope is canonical —
         # storing None would later dedupe against explicit-project scopes
         # as if they were different projects (duplicate list() rows)
-        _record_scope(req.project or self._gcloud_project(), req.location)
+        scope_project = req.project or self._gcloud_project()
+        _record_scope(scope_project, req.location)
+        # a successful submit proves the scope live again: un-evict it
+        _note_scope_result(scope_project, req.location, ok=True)
         if req.project:
             return f"{req.project}:{req.location}:{req.name}"
         return f"{req.location}:{req.name}"
@@ -413,12 +416,23 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
             raw.append(
                 (self._session_opts.project, self._session_opts.location)
             )
+        # eviction filters HERE, not in _known_scopes(): _record_scope
+        # uses _known_scopes() as its already-durable check, and an
+        # evicted-but-recorded scope must not be re-appended on resubmit
         raw.extend(
-            sorted(_known_scopes(), key=lambda s: (s[0] or "", s[1]))
+            sorted(
+                _known_scopes() - _evicted_scopes(),
+                key=lambda s: (s[0] or "", s[1]),
+            )
         )
-        if default_project is not None:
+        if default_project is not None and (
+            default_project,
+            GCPBatchOpts.location,
+        ) not in _evicted_scopes():
             # default-project jobs (submitted by gcloud directly or by a
             # pre-registry version) must not vanish once any scope exists
+            # — but a default scope that keeps failing (revoked project)
+            # sits out like any other evicted scope
             raw.append((default_project, GCPBatchOpts.location))
         scopes: list[tuple[Optional[str], str]] = []
         for project, location in raw:
@@ -434,6 +448,7 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
             proc = self._run_cmd(
                 self._gcloud(opts, "list", "--format", "json")
             )
+            _note_scope_result(project, location, proc.returncode == 0)
             if proc.returncode != 0:
                 continue
             try:
@@ -588,6 +603,77 @@ def _known_scopes() -> set[tuple[Optional[str], str]]:
         project, sep, location = value.partition("|")
         if sep and location:
             out.add((project or None, location))
+    return out
+
+
+# -- scope failure tracking / eviction ----------------------------------
+# A recorded scope whose project was deleted or revoked would otherwise
+# add one failing gcloud subprocess to EVERY list() forever (advisor r4).
+# Each failed list per scope appends a line here; a successful list (or a
+# new submit to the scope) clears them, and a scope with >= 3 unbroken
+# failures is skipped by list() until it succeeds again via submit.
+
+GCP_BATCH_SCOPE_FAILS_FILE = ".tpxgcpbatchscopefails"
+SCOPE_EVICT_FAILURES = 3
+
+
+def _fails_path() -> str:
+    import os
+
+    return os.path.join(os.path.expanduser("~"), GCP_BATCH_SCOPE_FAILS_FILE)
+
+
+def _scope_key(project: Optional[str], location: str) -> str:
+    return f"{project or ''}|{location}"
+
+
+def _scope_failures() -> dict[str, int]:
+    out: dict[str, int] = {}
+    try:
+        with open(_fails_path()) as f:
+            for line in f:
+                key = line.strip()
+                if key:
+                    out[key] = out.get(key, 0) + 1
+    except OSError:
+        pass
+    return out
+
+
+def _note_scope_result(project: Optional[str], location: str, ok: bool) -> None:
+    """Best-effort failure bookkeeping (a lost concurrent update costs at
+    most one miscounted failure, which the next list corrects)."""
+    import os
+
+    key = _scope_key(project, location)
+    try:
+        if ok:
+            fails = _scope_failures()
+            if key in fails:
+                remaining = [
+                    line
+                    for k, n in fails.items()
+                    if k != key
+                    for line in [k] * n
+                ]
+                tmp = _fails_path() + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write("".join(f"{line}\n" for line in remaining))
+                os.replace(tmp, _fails_path())
+        else:
+            with open(_fails_path(), "a") as f:
+                f.write(f"{key}\n")
+    except OSError:
+        pass
+
+
+def _evicted_scopes() -> set[tuple[Optional[str], str]]:
+    out: set[tuple[Optional[str], str]] = set()
+    for key, count in _scope_failures().items():
+        if count >= SCOPE_EVICT_FAILURES:
+            project, sep, location = key.partition("|")
+            if sep and location:
+                out.add((project or None, location))
     return out
 
 

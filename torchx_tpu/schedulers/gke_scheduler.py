@@ -720,9 +720,16 @@ def elastic_controller_job(
                     "--max-restarts",
                     str(max_restarts),
                 ],
+                # binary units (Mi/Gi), and 1Gi of limit headroom: the
+                # watch path imports no jax (the launcher layers are
+                # accelerator-free), but role images bundle heavyweight
+                # libraries whose import-time cost we don't control, and
+                # an OOMKill loop here burns backoffLimit until elastic
+                # protection silently lapses — describe() surfaces that
+                # state, the headroom avoids it
                 "resources": {
-                    "limits": {"cpu": "250m", "memory": "256M"},
-                    "requests": {"cpu": "100m", "memory": "128M"},
+                    "limits": {"cpu": "250m", "memory": "1Gi"},
+                    "requests": {"cpu": "100m", "memory": "256Mi"},
                 },
             }
         ],
@@ -912,7 +919,38 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
             if e.status == 404:
                 return None
             raise
-        return describe_jobset(jobset, self._list_pods(namespace, name))
+        resp = describe_jobset(jobset, self._list_pods(namespace, name))
+        note = self._controller_health_note(namespace, name)
+        if note:
+            resp.msg = f"{resp.msg}; {note}" if resp.msg else note
+        return resp
+
+    def _controller_health_note(self, namespace: str, name: str) -> str:
+        """Non-empty when the in-cluster elastic controller Job has failed
+        (backoffLimit exhausted — e.g. an OOMKill loop): from that point
+        the app runs WITHOUT elastic protection, which an operator reading
+        ``tpx status`` must see rather than discover at the next slice
+        failure (advisor r4). Best-effort: no controller Job, no note."""
+        try:
+            job = self._batch_api().read_namespaced_job(
+                name=f"{name}{CONTROLLER_SUFFIX}", namespace=namespace
+            )
+            status = getattr(job, "status", None)
+            conditions = list(getattr(status, "conditions", None) or [])
+            for cond in conditions:
+                if (
+                    getattr(cond, "type", "") == "Failed"
+                    and getattr(cond, "status", "") == "True"
+                ):
+                    reason = getattr(cond, "reason", "") or "Failed"
+                    return (
+                        "elastic controller FAILED "
+                        f"({reason}): slice-failure shrink is no longer "
+                        "armed — run `tpx watch` client-side or resubmit"
+                    )
+        except Exception:  # noqa: BLE001 - health note is best-effort
+            return ""
+        return ""
 
     def _list_pods(self, namespace: str, name: str) -> list[dict[str, Any]]:
         try:

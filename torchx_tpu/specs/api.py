@@ -706,8 +706,14 @@ class runopt:
     help: str
 
 
-# keys already warned about as unknown-passthrough (warn once per process)
-_warned_unknown_opts: set[str] = set()
+# (schema-identity, key) pairs already warned about as unknown-
+# passthrough: warn once per key PER SCHEMA, not per process — a typo'd
+# key on scheduler B must still warn after scheduler A warned about its
+# own key of the same name (advisor r4). Schema identity is the frozen
+# set of declared opt names, NOT id(self): run_opts() builds a fresh
+# runopts per call, so instance identity would re-warn on every submit
+# (and GC'd-id reuse would falsely suppress).
+_warned_unknown_opts: set[tuple[frozenset, str]] = set()
 
 
 class runopts:
@@ -759,9 +765,11 @@ class runopts:
             if opt is None:
                 # the passthrough exists for plugin/forward compat, so a
                 # legitimate plugin key must not warn on every submit:
-                # warn once per key per process
-                if key not in _warned_unknown_opts:
-                    _warned_unknown_opts.add(key)
+                # warn once per key per schema (fresh runopts instances of
+                # the same schema share warned-ness; see module note)
+                schema_id = frozenset(self._opts)
+                if (schema_id, key) not in _warned_unknown_opts:
+                    _warned_unknown_opts.add((schema_id, key))
                     warnings.warn(
                         f"unknown runopt {key!r} passed through unvalidated"
                         f" (known: {sorted(self._opts)})",
