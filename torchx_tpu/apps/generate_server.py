@@ -66,6 +66,13 @@ class _Pending:
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     result: Optional[list[int]] = None
     error: Optional[Exception] = None
+    # serving-latency telemetry (bench_serving.py percentiles): when this
+    # sequence entered the queue, when its device batch dispatched, and
+    # when the batch finished — queue_ms = coalescing/backlog wait,
+    # total_ms = request-observed latency
+    t_enqueue: float = 0.0
+    t_dispatch: float = 0.0
+    t_done: float = 0.0
 
 
 class GenerateService:
@@ -205,6 +212,9 @@ class GenerateService:
 
     def _dispatch(self, group: list[_Pending]) -> None:
         _, max_new, temperature, seed = group[0].key
+        now = time.monotonic()
+        for p in group:
+            p.t_dispatch = now
         try:
             fn = self._decode_fn(max_new, temperature)
             rows = [p.tokens for p in group]
@@ -230,7 +240,9 @@ class GenerateService:
             for p in group:
                 p.error = e
         finally:
+            now = time.monotonic()
             for p in group:
+                p.t_done = now
                 p.done.set()
 
     _JIT_CACHE_MAX = 32
@@ -271,6 +283,22 @@ class GenerateService:
         temperature: float = 0.0,
         seed: int = 0,
     ) -> list[list[int]]:
+        return self.generate_timed(
+            tokens, max_new_tokens, temperature=temperature, seed=seed
+        )[0]
+
+    def generate_timed(
+        self,
+        tokens: list[list[int]],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> tuple[list[list[int]], dict]:
+        """:meth:`generate` plus per-request latency telemetry:
+        ``{"queue_ms", "total_ms"}`` — the coalescing/backlog wait and the
+        end-to-end latency of the request's slowest sequence. The HTTP
+        layer attaches it to responses as ``timing`` so serving benchmarks
+        can report percentiles without server-side scraping."""
         if not tokens or any(not t for t in tokens):
             raise ValueError("tokens must be non-empty sequences")
         longest = max(len(t) for t in tokens)
@@ -288,10 +316,12 @@ class GenerateService:
         # single device batches.
         with self._count_lock:
             self.requests += 1
+        t_enqueue = time.monotonic()
         pendings = [
             _Pending(
                 tokens=list(t),
                 key=(len(t), max_new_tokens, round(temperature, 3), seed),
+                t_enqueue=t_enqueue,
             )
             for t in tokens
         ]
@@ -305,7 +335,16 @@ class GenerateService:
         errors = [p.error for p in pendings if p.error is not None]
         if errors:
             raise errors[0]
-        return [p.result for p in pendings]
+        # request-level timing: the slowest sequence bounds the response
+        timing = {
+            "queue_ms": round(
+                max((p.t_dispatch - p.t_enqueue) for p in pendings) * 1e3, 2
+            ),
+            "total_ms": round(
+                max((p.t_done - p.t_enqueue) for p in pendings) * 1e3, 2
+            ),
+        }
+        return [p.result for p in pendings], timing
 
     def generate_stream(
         self,
@@ -454,7 +493,7 @@ def _make_handler(service: GenerateService):
                         return
                     self._stream(tokens[0], req, text_mode)
                     return
-                out = service.generate(
+                out, timing = service.generate_timed(
                     tokens,
                     max_new_tokens=int(req.get("max_new_tokens", 16)),
                     temperature=float(req.get("temperature", 0.0)),
@@ -469,11 +508,12 @@ def _make_handler(service: GenerateService):
                                     b for b in seq if 0 <= b < 256
                                 ).decode("utf-8", errors="replace")
                                 for seq in out
-                            ]
+                            ],
+                            "timing": timing,
                         },
                     )
                 else:
-                    self._reply(200, {"tokens": out})
+                    self._reply(200, {"tokens": out, "timing": timing})
             except (KeyError, ValueError, TypeError) as e:
                 if getattr(self, "_streamed", False):
                     logger.warning("stream aborted mid-flight: %s", e)
