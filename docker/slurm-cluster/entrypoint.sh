@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Role dispatcher for the vendored slurm test cluster: every container
+# starts munged (shared baked-in key = cluster auth), then runs the role
+# given as the compose command. Waits use bash /dev/tcp so the image needs
+# no extra client packages.
+set -euo pipefail
+
+mkdir -p /run/munge
+chown munge:munge /run/munge
+runuser -u munge -- /usr/sbin/munged
+
+wait_tcp() { # host port
+  local i
+  for i in $(seq 1 60); do
+    if (echo > "/dev/tcp/$1/$2") 2>/dev/null; then
+      return 0
+    fi
+    sleep 2
+  done
+  echo "timed out waiting for $1:$2" >&2
+  return 1
+}
+
+case "${1:-}" in
+  slurmdbd)
+    wait_tcp mysql 3306
+    exec runuser -u slurm -- /usr/sbin/slurmdbd -D -v
+    ;;
+  slurmctld)
+    wait_tcp slurmdbd 6819
+    exec runuser -u slurm -- /usr/sbin/slurmctld -D -v
+    ;;
+  slurmd)
+    wait_tcp slurmctld 6817
+    exec /usr/sbin/slurmd -D -v
+    ;;
+  *)
+    exec "$@"
+    ;;
+esac
