@@ -23,14 +23,19 @@ LINK_RE = re.compile(r"\[[^\]]*\]\(([^)#\s]+)(#[^)]*)?\)")
 
 
 def check_generated() -> list[str]:
-    proc = subprocess.run(
-        [sys.executable, str(REPO / "scripts" / "gen_scheduler_docs.py"), "--check"],
-        capture_output=True,
-        text=True,
-    )
-    if proc.returncode != 0:
-        return [f"generated sections stale:\n{proc.stderr.strip()}"]
-    return []
+    errors = []
+    for script in ("gen_scheduler_docs.py", "gen_api_docs.py"):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / script), "--check"],
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            errors.append(
+                f"{script} --check failed (stale pages or public symbols"
+                f" missing docstrings):\n{proc.stderr.strip()}"
+            )
+    return errors
 
 
 def check_links() -> list[str]:

@@ -25,6 +25,7 @@ class StructuredNameArgument:
     def parse_from(
         cls, name: str, default_app: str = "app", default_role: str = "role"
     ) -> "StructuredNameArgument":
+        """Parse ``app[/role]`` (either part optional) into names."""
         if "/" in name:
             app, _, role = name.partition("/")
             return cls(app_name=app or default_app, role_name=role or default_role)
@@ -48,6 +49,8 @@ class StructuredJArgument:
 
     @classmethod
     def parse_from(cls, j: str, h: Optional[str] = None) -> "StructuredJArgument":
+        """Parse a ``-j`` string, inferring nproc from the named
+        resource ``h`` when the ``x nproc`` part is omitted."""
         from torchx_tpu.components.dist import parse_j
 
         min_replicas, replicas, nproc = parse_j(j)

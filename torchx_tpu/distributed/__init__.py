@@ -51,14 +51,17 @@ def gang_info() -> tuple[int, int, str]:
 
 
 def replica_id() -> int:
+    """This process's global id within the role's gang (0-based)."""
     return gang_info()[0]
 
 
 def num_replicas() -> int:
+    """Total processes in the role's gang."""
     return gang_info()[1]
 
 
 def coordinator_address(port: Optional[int] = None) -> str:
+    """``host:port`` of replica 0 — the jax.distributed coordinator."""
     host = gang_info()[2]
     return f"{host}:{port or settings.TPX_COORDINATOR_PORT}"
 
@@ -99,18 +102,21 @@ def init_from_env(port: Optional[int] = None) -> None:
 
 
 def local_device_count() -> int:
+    """Accelerator devices attached to THIS process."""
     import jax
 
     return jax.local_device_count()
 
 
 def world_device_count() -> int:
+    """Accelerator devices across the whole gang."""
     import jax
 
     return jax.device_count()
 
 
 def is_process_zero() -> bool:
+    """True on the gang's coordinator process (logging/checkpoint guard)."""
     import jax
 
     return jax.process_index() == 0

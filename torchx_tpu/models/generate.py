@@ -29,6 +29,7 @@ KVCache = dict[str, jnp.ndarray]  # {"k": [L,b,S,kvh,hd], "v": ...}
 def init_kv_cache(
     cfg: llama.LlamaConfig, batch: int, max_seq: int
 ) -> KVCache:
+    """Zeroed [layers, batch, max_seq, kv_heads, head_dim] K/V buffers."""
     shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, dtype=cfg.dtype),

@@ -85,6 +85,7 @@ class LlamaConfig:
 
     @property
     def head_dim(self) -> int:
+        """Per-head projection width (dim / n_heads)."""
         return self.dim // self.n_heads
 
     def flops_per_token(self) -> float:
@@ -99,6 +100,7 @@ class LlamaConfig:
         return 6 * n_params + attn
 
     def param_count(self) -> int:
+        """Exact parameter count for this shape (layers + embeddings)."""
         d, f, v = self.dim, self.ffn_dim, self.vocab_size
         hd = self.head_dim
         per_layer = (
@@ -118,6 +120,7 @@ class LlamaConfig:
 
 
 def llama3_8b(**overrides: Any) -> LlamaConfig:
+    """Llama-3-8B (the config defaults: 32L/4096d/32h/8kv/128k vocab)."""
     return LlamaConfig(**overrides)
 
 
@@ -478,6 +481,8 @@ def features_from_embeddings(
 
 
 def lm_head(params: Params, cfg: LlamaConfig) -> jnp.ndarray:
+    """[dim, vocab] output projection (the embedding transposed when
+    tied)."""
     return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
 
 
@@ -558,6 +563,7 @@ def loss_fn(
     cfg: LlamaConfig,
     mesh: Optional[Mesh] = None,
 ) -> jnp.ndarray:
+    """Next-token cross-entropy loss (see :func:`loss_and_aux`)."""
     return loss_and_aux(params, batch, cfg, mesh)[0]
 
 

@@ -37,6 +37,7 @@ class MoEConfig(llama.LlamaConfig):
     router_aux_coef: float = 0.01
 
     def param_count(self) -> int:
+        """Exact parameter count (dense shapes + per-expert FFNs)."""
         dense = super().param_count()
         # replace the dense FFN with E experts + router
         ffn = 3 * self.dim * self.ffn_dim
@@ -59,6 +60,7 @@ class MoEConfig(llama.LlamaConfig):
 
 
 def moe_tiny(**overrides: Any) -> MoEConfig:
+    """Test/debug MoE config: runs anywhere in milliseconds."""
     defaults = dict(
         vocab_size=512,
         dim=64,
@@ -135,6 +137,8 @@ def param_specs(cfg: MoEConfig, pp: bool = False) -> llama.Params:
 
 
 def shard_params(params: llama.Params, cfg: MoEConfig, mesh) -> llama.Params:  # noqa: ANN001
+    """Device-put params onto the mesh per :func:`param_specs` (experts
+    over the ep axis)."""
     from jax.sharding import NamedSharding
 
     return jax.tree.map(
@@ -215,6 +219,8 @@ def forward(
     cfg: MoEConfig,
     mesh=None,  # noqa: ANN001
 ) -> jnp.ndarray:
+    """Logits for a MoE config (the shared llama forward dispatches to
+    the expert FFN when the config carries experts)."""
     return llama.forward(params, tokens, cfg, mesh)
 
 
@@ -224,4 +230,5 @@ def loss_fn(
     cfg: MoEConfig,
     mesh=None,  # noqa: ANN001
 ) -> jnp.ndarray:
+    """Next-token CE + router balancing aux term."""
     return llama.loss_fn(params, batch, cfg, mesh)

@@ -56,6 +56,8 @@ def xla_attention(
     causal: bool = True,
     segment_ids: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
+    """Plain einsum softmax attention (f32 softmax, GQA via KV repeat);
+    runs everywhere and is the numerical reference for the kernels."""
     n_rep = q.shape[2] // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)

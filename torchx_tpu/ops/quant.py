@@ -56,6 +56,7 @@ def quantize(w: jnp.ndarray, axis: int = -1) -> tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def dequantize(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16):  # noqa: ANN001
+    """int8 x per-channel scale -> float weights."""
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
@@ -162,4 +163,5 @@ def maybe_matmul(
 
 
 def size_bytes(params: Params) -> int:
+    """Total bytes of every leaf (quantization-savings accounting)."""
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))

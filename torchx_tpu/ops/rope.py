@@ -35,6 +35,7 @@ def apply_rope(
     cos: jnp.ndarray,  # [seq, head_dim/2] (already sliced to positions)
     sin: jnp.ndarray,
 ) -> jnp.ndarray:
+    """Rotate [batch, seq, heads, head_dim] by the given frequencies."""
     dtype = x.dtype
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     c = cos[None, :, None, :]

@@ -117,6 +117,7 @@ class FitResult:
     generated_code_bytes: int = 0
 
     def row(self) -> str:
+        """This result as one markdown fit-table row."""
         return (
             f"| {self.batch} | {self.seq} | {self.remat_policy} "
             f"| {self.args_bytes / GIB:.1f} | {self.temp_bytes / GIB:.1f} "

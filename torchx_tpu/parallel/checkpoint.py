@@ -29,6 +29,10 @@ logger = logging.getLogger(__name__)
 
 
 class Checkpointer:
+    """Async orbax checkpointing for sharded train state: non-blocking
+    saves on an interval, retention, corrupt-step fallback on restore,
+    and restore-to-the-live-shardings (see restore_latest)."""
+
     def __init__(
         self,
         directory: str,
@@ -87,6 +91,8 @@ class Checkpointer:
             self._mgr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
+        """Newest complete checkpoint step, or None (waits for an
+        in-flight save first)."""
         if self._mgr is not None:
             self.wait()  # an in-flight save IS the latest once finalized
             return self._mgr.latest_step()
@@ -216,6 +222,7 @@ class Checkpointer:
             )
 
     def close(self) -> None:
+        """Flush in-flight saves and release the manager."""
         if self._mgr is not None:
             self.wait()
             self._mgr.close()

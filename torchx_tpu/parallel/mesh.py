@@ -43,6 +43,8 @@ class MeshConfig:
     sp: int = 1
 
     def resolve(self, n_devices: int) -> dict[str, int]:
+        """Concrete axis sizes for ``n_devices`` (the single -1 axis
+        absorbs the remainder); raises when sizes don't multiply out."""
         sizes = {a: getattr(self, a) for a in AXES}
         wild = [a for a, s in sizes.items() if s == -1]
         if len(wild) > 1:
@@ -80,6 +82,7 @@ def make_mesh(
 
 
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    """Shorthand: ``NamedSharding(mesh, P(*spec))``."""
     return NamedSharding(mesh, P(*spec))
 
 

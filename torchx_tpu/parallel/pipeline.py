@@ -37,6 +37,7 @@ LayerBody = Callable[[jnp.ndarray, Any], jnp.ndarray]
 
 
 def make_pp_mesh(n_stages: int, devices=None) -> Mesh:  # noqa: ANN001
+    """A 1-axis ("pp",) mesh over the first ``n_stages`` devices."""
     import numpy as np
 
     devs = list(devices) if devices is not None else jax.devices()[:n_stages]

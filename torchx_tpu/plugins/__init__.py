@@ -20,12 +20,16 @@ from torchx_tpu.plugins._registry import (  # noqa: F401
 
 
 def get_plugin_schedulers() -> Mapping[str, Callable[..., Any]]:
+    """Scheduler factories registered by plugins, keyed by backend name
+    (override built-ins of the same name)."""
     return dict(get_registry().schedulers)
 
 
 def get_plugin_named_resources() -> Mapping[str, Callable[[], Any]]:
+    """Named-resource factories registered by plugins."""
     return dict(get_registry().named_resources)
 
 
 def get_plugin_trackers() -> Mapping[str, Callable[[Optional[str]], Any]]:
+    """Tracker factories registered by plugins (config-string arg)."""
     return dict(get_registry().trackers)

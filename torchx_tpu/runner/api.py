@@ -84,6 +84,8 @@ class Runner:
         return False
 
     def close(self) -> None:
+        """Release every lazily-created scheduler instance (also runs on
+        context-manager exit); the Runner must not be used afterwards."""
         for sched in self._scheduler_instances.values():
             sched.close()
         self._scheduler_instances.clear()
@@ -115,6 +117,9 @@ class Runner:
         workspace: Optional[str] = None,
         parent_run_id: Optional[str] = None,
     ) -> AppDryRunInfo:
+        """:meth:`run_component` up to (and including) the scheduler's
+        dryrun: returns the fully materialized request without submitting
+        — the launcher's central testability/inspection hook."""
         from torchx_tpu.specs.builders import materialize_appdef
         from torchx_tpu.specs.finder import get_component
 
@@ -138,6 +143,7 @@ class Runner:
         workspace: Optional[str] = None,
         parent_run_id: Optional[str] = None,
     ) -> AppHandle:
+        """Run a pre-built AppDef: :meth:`dryrun` then :meth:`schedule`."""
         dryrun_info = self.dryrun(
             app, scheduler, cfg, workspace=workspace, parent_run_id=parent_run_id
         )
@@ -201,6 +207,8 @@ class Runner:
             return sched.materialize_dryrun(app, resolved_cfg)
 
     def schedule(self, dryrun_info: AppDryRunInfo) -> AppHandle:
+        """Submit a request produced by :meth:`dryrun`/:meth:`dryrun_component`
+        and return its ``scheduler://session/app_id`` handle."""
         scheduler = dryrun_info._scheduler
         if not scheduler:
             raise ValueError(
@@ -224,6 +232,8 @@ class Runner:
     # -- monitor path ------------------------------------------------------
 
     def status(self, app_handle: AppHandle) -> Optional[AppStatus]:
+        """Current :class:`AppStatus` of the app, or None when the
+        scheduler no longer knows the id."""
         scheduler, _, app_id = parse_app_handle(app_handle)
         sched = self._scheduler(scheduler)
         with log_event("status", scheduler, app_id, session=self._name):
@@ -250,11 +260,14 @@ class Runner:
             time.sleep(wait_interval)
 
     def cancel(self, app_handle: AppHandle) -> None:
+        """Stop the app but keep it describable (scheduler-side state and
+        logs are preserved where the backend allows)."""
         scheduler, _, app_id = parse_app_handle(app_handle)
         with log_event("cancel", scheduler, app_id, session=self._name):
             self._scheduler(scheduler).cancel(app_id)
 
     def delete(self, app_handle: AppHandle) -> None:
+        """Remove the app from the scheduler entirely (cancel + forget)."""
         scheduler, _, app_id = parse_app_handle(app_handle)
         with log_event("delete", scheduler, app_id, session=self._name):
             self._scheduler(scheduler).delete(app_id)
@@ -309,6 +322,7 @@ class Runner:
             return AppDef(name=app_id, roles=desc.roles)
 
     def list(self, scheduler: str) -> list[ListAppResponse]:
+        """All apps the backend knows about (any session)."""
         with log_event("list", scheduler, session=self._name):
             return self._scheduler(scheduler).list()
 
@@ -323,6 +337,9 @@ class Runner:
         should_tail: bool = False,
         streams: Optional[Stream] = None,
     ) -> Iterable[str]:
+        """Stream one replica's log lines, optionally regex-filtered,
+        time-windowed (``since``/``until``), and followed (``should_tail``)
+        — the unified log access every backend implements."""
         scheduler, _, app_id = parse_app_handle(app_handle)
         with log_event("log_lines", scheduler, app_id, session=self._name):
             sched = self._scheduler(scheduler)
@@ -349,12 +366,15 @@ class Runner:
     # -- scheduler access --------------------------------------------------
 
     def scheduler_backends(self) -> list[str]:
+        """Names of every registered backend (first = default)."""
         return list(self._scheduler_factories)
 
     def scheduler_run_opts(self, scheduler: str) -> runopts:
+        """The named backend's typed run-config schema."""
         return self._scheduler(scheduler).run_opts()
 
     def run_opts(self) -> dict[str, runopts]:
+        """Run-config schemas for every backend, keyed by name."""
         return {name: self._scheduler(name).run_opts() for name in self._scheduler_factories}
 
     def _scheduler(self, scheduler: str) -> Scheduler:

@@ -101,6 +101,8 @@ def get_config(
     key: str,
     dirs: Optional[list[str]] = None,
 ) -> Optional[str]:
+    """One value from a ``[prefix:name]`` section (e.g.
+    ``get_config("component", "dist.spmd", "j")``), or None."""
     cp = _read_all(dirs)
     section = f"{prefix}:{name}"
     if cp.has_section(section) and cp.has_option(section, key):

@@ -22,6 +22,8 @@ _events_logger: Optional[logging.Logger] = None
 
 
 def get_events_logger(destination: Optional[str] = None) -> logging.Logger:
+    """The process-wide telemetry logger (non-propagating; destination
+    from ``TPX_EVENT_DESTINATION``, default "null")."""
     global _events_logger
     if _events_logger is None:
         from torchx_tpu.runner.events.handlers import get_destination_handler
@@ -36,6 +38,7 @@ def get_events_logger(destination: Optional[str] = None) -> logging.Logger:
 
 
 def record(event: TpxEvent) -> None:
+    """Emit one serialized :class:`TpxEvent` to the events logger."""
     get_events_logger().info(event.serialize())
 
 

@@ -37,6 +37,8 @@ def _deferred(module_fn: str) -> SchedulerFactory:
 def get_scheduler_factories(
     skip_defaults: bool = False,
 ) -> dict[str, SchedulerFactory]:
+    """Name -> factory for every backend: the built-in seven (deferred
+    imports) plus plugin-registered ones, which override by name."""
     factories: dict[str, SchedulerFactory] = {}
     if not skip_defaults:
         factories = {k: _deferred(v) for k, v in DEFAULT_SCHEDULER_MODULES.items()}
@@ -50,4 +52,5 @@ def get_scheduler_factories(
 
 
 def get_default_scheduler_name() -> str:
+    """The first registered backend ("local"), the CLI's default."""
     return next(iter(DEFAULT_SCHEDULER_MODULES))

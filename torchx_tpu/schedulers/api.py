@@ -213,17 +213,23 @@ class Scheduler(ABC, Generic[T]):
 
     @abstractmethod
     def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
+        """The backend's view of the app (state, per-replica statuses),
+        or None when the id is unknown."""
         ...
 
     def list(self) -> list[ListAppResponse]:
+        """All apps this backend knows about. Optional."""
         raise NotImplementedError(
             f"{self.backend} scheduler does not support listing apps"
         )
 
     def exists(self, app_id: str) -> bool:
+        """True when the backend still knows ``app_id``."""
         return self.describe(app_id) is not None
 
     def cancel(self, app_id: str) -> None:
+        """Stop the app if it exists (idempotent); state/logs remain
+        describable where the backend allows."""
         if self.exists(app_id):
             self._cancel_existing(app_id)
 
@@ -268,6 +274,9 @@ class Scheduler(ABC, Generic[T]):
         should_tail: bool = False,
         streams: Optional[Stream] = None,
     ) -> Iterable[str]:
+        """Stream one replica's log lines (optionally regex-filtered,
+        time-windowed when ``supports_log_windows``, followed with
+        ``should_tail``). Optional."""
         raise NotImplementedError(
             f"{self.backend} scheduler does not support log iteration"
         )
@@ -275,6 +284,8 @@ class Scheduler(ABC, Generic[T]):
     # -- config / validation ----------------------------------------------
 
     def run_opts(self) -> runopts:
+        """This backend's typed run-config schema (empty by default;
+        StructuredOpts subclasses generate theirs from field docstrings)."""
         return runopts()
 
     def _pre_build_validate(self, app: AppDef, cfg: Mapping[str, CfgVal]) -> None:

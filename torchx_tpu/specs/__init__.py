@@ -149,6 +149,8 @@ def resource(
 
 
 def get_named_resources() -> Mapping[str, Callable[[], Resource]]:
+    """Every registered named resource (generic < gcp < custom env <
+    plugins, later wins), keyed by name."""
     return dict(_factories())
 
 

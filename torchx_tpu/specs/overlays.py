@@ -130,6 +130,8 @@ def _join_lists(existing: Any, patch: Any, merge_key: str) -> list:
 
 
 def set_overlay(role: Role, scheduler: str, overlay: Mapping[str, Any]) -> None:
+    """Attach a validated raw-request patch for ``scheduler`` to the
+    role (applied by that backend at dryrun)."""
     errors = validate_overlay(overlay)
     if errors:
         raise ValueError("invalid overlay:\n  " + "\n  ".join(errors))
@@ -137,4 +139,5 @@ def set_overlay(role: Role, scheduler: str, overlay: Mapping[str, Any]) -> None:
 
 
 def get_overlay(role: Role, scheduler: str) -> Optional[dict[str, Any]]:
+    """The role's overlay for ``scheduler``, or None."""
     return role.metadata.get(OVERLAY_METADATA_KEY, {}).get(scheduler)

@@ -55,37 +55,48 @@ class TrackerBase(ABC):
     def add_artifact(
         self, run_id: str, name: str, path: str, metadata: Optional[Mapping[str, Any]] = None
     ) -> None:
+        """Record a named artifact (path/URL + optional metadata) on a run."""
         ...
 
     @abstractmethod
     def artifacts(self, run_id: str) -> Mapping[str, TrackerArtifact]:
+        """All artifacts of a run, keyed by name."""
         ...
 
     @abstractmethod
     def add_metadata(self, run_id: str, **kwargs: Any) -> None:
+        """Merge key=value metadata onto a run."""
         ...
 
     @abstractmethod
     def metadata(self, run_id: str) -> Mapping[str, Any]:
+        """A run's accumulated metadata."""
         ...
 
     @abstractmethod
     def add_source(
         self, run_id: str, source_id: str, artifact_name: Optional[str] = None
     ) -> None:
+        """Link ``source_id`` (a parent run, optionally one artifact of
+        it) as an input of this run — the lineage edge."""
         ...
 
     @abstractmethod
     def sources(
         self, run_id: str, artifact_name: Optional[str] = None
     ) -> Iterable[TrackerSource]:
+        """The runs (optionally filtered to one artifact) this run
+        consumed."""
         ...
 
     @abstractmethod
     def run_ids(self, **kwargs: str) -> Iterable[str]:
+        """Known run ids, newest last; backends may accept filter
+        kwargs (e.g. ``parent_run_id``)."""
         ...
 
     def lineage(self, run_id: str) -> Lineage:
+        """The run's source edges as a :class:`Lineage` record."""
         return Lineage(run_id=run_id, sources=list(self.sources(run_id)))
 
 
@@ -214,15 +225,18 @@ class AppRun:
         return cls._instance
 
     def add_metadata(self, **kwargs: Any) -> None:
+        """Fan ``key=value`` metadata out to every configured backend."""
         for b in self.backends.values():
             b.add_metadata(self.id, **kwargs)
 
     def add_artifact(
         self, name: str, path: str, metadata: Optional[Mapping[str, Any]] = None
     ) -> None:
+        """Record an artifact on this job's run in every backend."""
         for b in self.backends.values():
             b.add_artifact(self.id, name, path, metadata)
 
     def add_source(self, source_id: str, artifact_name: Optional[str] = None) -> None:
+        """Link a parent run as an input of this job's run."""
         for b in self.backends.values():
             b.add_source(self.id, source_id, artifact_name)

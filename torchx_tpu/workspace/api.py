@@ -33,6 +33,8 @@ class WorkspaceMixin(Generic[T]):
         super().__init__(*args, **kwargs)
 
     def workspace_opts(self) -> runopts:
+        """Extra runopts this workspace type contributes to the
+        scheduler's schema (empty by default)."""
         return runopts()
 
     @abstractmethod
@@ -45,6 +47,9 @@ class WorkspaceMixin(Generic[T]):
     def build_workspaces(
         self, roles: list[Role], cfg: Mapping[str, CfgVal]
     ) -> None:
+        """Build each role's workspace (once per distinct (image,
+        projects) pair — results are cached) and mutate ``role.image`` to
+        the built artifact."""
         cache: dict[tuple[str, tuple[tuple[str, str], ...]], str] = {}
         for role in roles:
             ws = role.workspace
@@ -69,9 +74,12 @@ class WorkspaceMixin(Generic[T]):
 
     # push contract for docker-ish backends (reference api.py:169-179)
     def dryrun_push_images(self, app: Any, cfg: Mapping[str, CfgVal]) -> Any:
+        """Plan remote-image pushes for locally-built images; returns an
+        opaque plan for :meth:`push_images` (None = nothing to push)."""
         return None
 
     def push_images(self, images_to_push: Any) -> None:
+        """Execute the push plan from :meth:`dryrun_push_images`."""
         pass
 
 

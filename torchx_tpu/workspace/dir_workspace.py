@@ -34,6 +34,7 @@ class DirWorkspaceMixin(WorkspaceMixin[None]):
     role.image there."""
 
     def workspace_opts(self) -> runopts:
+        """Adds ``job_dir`` (shared directory the workspace copies into)."""
         opts = runopts()
         opts.add(
             "job_dir",

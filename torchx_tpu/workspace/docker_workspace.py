@@ -61,6 +61,7 @@ class DockerWorkspaceMixin(WorkspaceMixin["dict[str, tuple[str, str]]"]):
         return self.__docker_client
 
     def workspace_opts(self) -> runopts:
+        """Adds ``image_repo`` (remote repo for patched images)."""
         opts = runopts()
         opts.add(
             "image_repo",
@@ -143,6 +144,7 @@ class DockerWorkspaceMixin(WorkspaceMixin["dict[str, tuple[str, str]]"]):
         return images_to_push
 
     def push_images(self, images_to_push: dict[str, tuple[str, str]]) -> None:
+        """Tag + push each locally-built image to its planned repo:tag."""
         if not images_to_push:
             return
         client = self._docker_client
