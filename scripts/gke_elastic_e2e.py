@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Elastic shrink against a REAL control plane (gke_integ.sh §3).
+
+Submits an elastic 2-"slice" app with ``elastic_controller=true`` to the
+kind cluster, lets slice 1 fail for real, and asserts that the
+IN-CLUSTER controller Job — not this harness, which never calls
+watch/resize — shrinks the JobSet to 1 replica and the app then runs to
+completion. This is the end-to-end proof for the round-3/4 requirement
+that elasticity survives operator disconnect: the only actor after
+submission is the controller pod.
+
+The role carries a TPU slice resource so it materializes as one child
+Job per slice (the granularity ``plan_elastic_shrink`` operates on); a
+role overlay strips the TPU node selectors/tolerations/limits so the
+pods schedule on kind's CPU nodes — exactly what overlays exist for.
+
+Usage: gke_elastic_e2e.py <image> [namespace]
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+from torchx_tpu.runner import get_runner
+from torchx_tpu.specs import overlays
+from torchx_tpu.specs.api import AppDef, Resource, Role, TpuSlice
+
+# slice 1 fails once (after the gang is visibly running); slice 0 would
+# finish in 40s — after the shrink, the recreated 1-slice gang re-runs
+# slice 0 only, which completes and takes the app to SUCCEEDED
+APP_SCRIPT = (
+    'if [ "$TPX_SLICE_ID" = "1" ]; then'
+    '  echo "slice 1 failing deliberately"; sleep 5; exit 1; '
+    "fi; "
+    'echo "slice $TPX_SLICE_ID running"; sleep 40; '
+    'echo "slice $TPX_SLICE_ID done"'
+)
+
+STRIP_TPU_SCHEDULING = {
+    "spec": {
+        overlays.JOIN("replicatedJobs"): [
+            {
+                "name": "trainer",
+                "template": {
+                    "spec": {
+                        "template": {
+                            "spec": {
+                                overlays.DEL("nodeSelector"): None,
+                                overlays.DEL("tolerations"): None,
+                                overlays.JOIN("containers"): [
+                                    {
+                                        "name": "trainer",
+                                        overlays.PUT("resources"): {},
+                                    }
+                                ],
+                            }
+                        }
+                    }
+                },
+            }
+        ],
+    },
+}
+
+
+def kubectl(*args: str) -> str:
+    return subprocess.run(
+        ["kubectl", *args], check=True, capture_output=True, text=True
+    ).stdout
+
+
+def main() -> int:
+    image = sys.argv[1]
+    namespace = sys.argv[2] if len(sys.argv) > 2 else "default"
+
+    role = Role(
+        name="trainer",
+        image=image,
+        entrypoint="sh",
+        args=["-c", APP_SCRIPT],
+        num_replicas=2,
+        min_replicas=1,
+        max_retries=0,  # a failed slice stays failed -> shrink, not retry
+        resource=Resource(cpu=1, memMB=256, tpu=TpuSlice("v5e", 4)),
+    )
+    overlays.set_overlay(role, "gke", STRIP_TPU_SCHEDULING)
+    app = AppDef(name="elastic-shrink-e2e", roles=[role])
+
+    runner = get_runner()
+    handle = runner.run(
+        app,
+        "gke",
+        cfg={
+            "namespace": namespace,
+            "elastic_controller": True,
+            "service_account": "tpx-controller",
+        },
+        workspace=None,
+    )
+    print("submitted:", handle, flush=True)
+    name = handle.rsplit("/", 1)[-1].split(":", 1)[1]
+
+    # From here on the ONLY actor is the in-cluster controller Job.
+    # The shrink under test DELETES the JobSet (foreground, waiting for
+    # pod GC) before recreating it at the smaller size, so transient
+    # not-found states are expected mid-test — only a PERSISTENTLY gone
+    # JobSet is a failure.
+    deadline = time.monotonic() + 360
+    final = None
+    gone_since = None
+    while time.monotonic() < deadline:
+        status = runner.status(handle)
+        state = status.state.name if status else "GONE"
+        try:
+            replicas = kubectl(
+                "get",
+                "jobset",
+                name,
+                "-n",
+                namespace,
+                "-o",
+                "jsonpath={.spec.replicatedJobs[0].replicas}",
+            )
+        except subprocess.CalledProcessError:
+            replicas = "<resizing>"
+        print(f"state={state} replicas={replicas}", flush=True)
+        if state == "SUCCEEDED":
+            final = replicas
+            break
+        if state == "CANCELLED":
+            print("FAIL: app was cancelled", file=sys.stderr)
+            return 1
+        if state == "GONE":
+            gone_since = gone_since or time.monotonic()
+            if time.monotonic() - gone_since > 90:
+                print(
+                    "FAIL: JobSet gone for >90s (a resize delete+recreate"
+                    " takes seconds)",
+                    file=sys.stderr,
+                )
+                return 1
+        else:
+            gone_since = None
+        time.sleep(5)
+    else:
+        print("FAIL: app did not finish in time", file=sys.stderr)
+        print(kubectl("get", "jobsets", "-A", "-o", "yaml"), file=sys.stderr)
+        return 1
+
+    if final != "1":
+        print(
+            f"FAIL: expected the controller to shrink to 1 replica,"
+            f" jobset has {final!r}",
+            file=sys.stderr,
+        )
+        return 1
+
+    # the shrink must have been performed by the controller POD
+    controller_logs = kubectl(
+        "logs",
+        "-n",
+        namespace,
+        "-l",
+        f"tpx.sh/controller-for={name}",
+        "--tail=200",
+    )
+    if "shrinking to 1" not in controller_logs:
+        print(
+            "FAIL: controller logs do not show the shrink:\n"
+            + controller_logs,
+            file=sys.stderr,
+        )
+        return 1
+    print("controller-performed shrink verified; app SUCCEEDED")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -73,4 +73,54 @@ tpx log "$APP_ID" | grep -q "hello-from-kind"
 tpx list -s gke | grep -q "$(basename "$APP_ID" | cut -d: -f2)"
 tpx delete "$APP_ID"
 
+# --- 3. elastic shrink performed by the IN-CLUSTER controller ------------
+# Requires an image with torchx_tpu installed loaded into the cluster
+# (the workflow builds docker/e2e/Dockerfile and `kind load`s it); skipped
+# when TPX_E2E_IMAGE is unset so the first two sections stay runnable
+# against any JobSet cluster.
+if [ -n "${TPX_E2E_IMAGE:-}" ]; then
+  # RBAC for the controller pod: watch reads the jobset + controller
+  # cleanup, resize deletes + recreates it
+  kubectl apply -f - <<'EOT'
+apiVersion: v1
+kind: ServiceAccount
+metadata:
+  name: tpx-controller
+  namespace: default
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: Role
+metadata:
+  name: tpx-controller
+  namespace: default
+rules:
+  - apiGroups: ["jobset.x-k8s.io"]
+    resources: ["jobsets"]
+    verbs: ["get", "list", "create", "delete", "patch"]
+  - apiGroups: ["batch"]
+    resources: ["jobs"]
+    verbs: ["get", "list", "delete"]
+  - apiGroups: [""]
+    resources: ["pods", "pods/log"]
+    verbs: ["get", "list"]
+---
+apiVersion: rbac.authorization.k8s.io/v1
+kind: RoleBinding
+metadata:
+  name: tpx-controller
+  namespace: default
+roleRef:
+  apiGroup: rbac.authorization.k8s.io
+  kind: Role
+  name: tpx-controller
+subjects:
+  - kind: ServiceAccount
+    name: tpx-controller
+    namespace: default
+EOT
+  python scripts/gke_elastic_e2e.py "$TPX_E2E_IMAGE" default
+else
+  echo "TPX_E2E_IMAGE unset; skipping the elastic-shrink e2e section"
+fi
+
 echo "gke integration: OK"
