@@ -68,7 +68,7 @@ def _percentile(sorted_vals: list, q: float) -> float:
 
 
 def bench_server(
-    cfg_name: str, int8: bool, steps: int, clients: int, rounds: int = 3
+    cfg_name: str, int8: bool, steps: int, clients: int, rounds: int = 5
 ):
     """Aggregate tokens/sec + per-request latency percentiles through the
     REAL HTTP server under concurrent load: `clients` threads each POST
@@ -79,7 +79,7 @@ def bench_server(
     run-to-run because arrival jitter split dispatch groups differently
     each time): a timed round only COUNTS when its `clients` requests
     coalesced into exactly one device batch — split rounds are discarded
-    and retried (up to 3x per round), so every reported number measures
+    and retried (up to 5x per round), so every reported number measures
     the same work. `rounds` >= 3 timed rounds are aggregated with their
     relative spread; per-request `timing` fields from the server give
     p50/p99 end-to-end latency, queue wait, and per-token latency.
@@ -89,10 +89,12 @@ def bench_server(
 
     from torchx_tpu.apps import generate_server
 
-    # wide coalescing window: the measurement wants the full-batch path,
-    # not arrival-jitter-dependent splits
+    # A huge coalescing window makes grouping deterministic BY
+    # CONSTRUCTION at no timing cost: the batcher dispatches the moment
+    # the max_batch-th (== clients-th) request arrives, so the window
+    # only ever waits for stragglers — it never pads a full round.
     server = generate_server.serve(
-        cfg_name, port=0, int8=int8, batch_window_ms=400.0, max_batch=clients
+        cfg_name, port=0, int8=int8, batch_window_ms=5000.0, max_batch=clients
     )
     port = server.server_address[1]
     t = threading.Thread(target=server.serve_forever, daemon=True)
