@@ -136,6 +136,44 @@ class TestInt8TrainingMatmul:
         l_i8 = float(llama.loss_fn(params, batch, cfg_i8))
         assert abs(l_fp - l_i8) < 0.2, (l_fp, l_i8)
 
+    def test_int8_scope_ffn_only(self):
+        """int8_scope='ffn' quantizes the FFN dots and ONLY those: output
+        differs from bf16 (int8 is active) but is at least as close to
+        bf16 as full-scope int8 (attention path untouched)."""
+        import jax
+        import numpy as np
+        import pytest
+
+        pytest.importorskip("aqt")
+        from torchx_tpu.models import llama
+
+        cfg_bf16 = llama.llama_tiny(remat_policy="full")
+        cfg_ffn = llama.llama_tiny(
+            remat_policy="full", int8_matmuls=True, int8_scope="ffn"
+        )
+        cfg_all = llama.llama_tiny(remat_policy="full", int8_matmuls=True)
+        params = llama.init_params(cfg_bf16, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 100)
+        ref = np.asarray(llama.forward(params, tokens, cfg_bf16))
+        out_ffn = np.asarray(llama.forward(params, tokens, cfg_ffn))
+        out_all = np.asarray(llama.forward(params, tokens, cfg_all))
+        err_ffn = np.abs(out_ffn - ref).mean()
+        err_all = np.abs(out_all - ref).mean()
+        assert err_ffn > 0, "ffn scope quantized nothing"
+        assert err_ffn <= err_all + 1e-6, (
+            f"ffn-only scope should not round more than full scope:"
+            f" {err_ffn} vs {err_all}"
+        )
+        np.testing.assert_allclose(out_ffn, ref, atol=0.15, rtol=0.15)
+
+    def test_int8_scope_validated(self):
+        import pytest
+
+        from torchx_tpu.models import llama
+
+        with pytest.raises(ValueError, match="int8_scope"):
+            llama.llama_tiny(int8_scope="attn")
+
     def test_int8_training_on_sharded_mesh(self):
         """AQT int8 matmuls must compose with GSPMD sharding: users flip
         int8_matmuls on real dp/fsdp/tp meshes, where AQT's internal

@@ -79,9 +79,21 @@ class LlamaConfig:
     # docs/performance.md); master weights stay bf16, quantization is
     # dynamic per step with a straight-through estimator in the backward
     int8_matmuls: bool = False
+    # which projections int8_matmuls quantizes: "all" (attention + FFN)
+    # or "ffn" (gate/up/down only — the largest, most int8-friendly dots;
+    # attention projections at head_dim granularity amortize the dynamic
+    # quant/dequant overhead worst, so selective mode trims overhead at
+    # small batch; measured crossover in docs/performance.md)
+    int8_scope: str = "all"
     # store CE logits in f32 instead of bf16 (exact-f32 cross entropy at
     # 2x the logits HBM traffic; see _token_nll for the measured tradeoff)
     ce_f32_logits: bool = False
+
+    def __post_init__(self) -> None:
+        if self.int8_scope not in ("all", "ffn"):
+            raise ValueError(
+                f"int8_scope must be 'all' or 'ffn', got {self.int8_scope!r}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -282,10 +294,16 @@ def ffn(
         if cfg.int8_matmuls:
             import warnings
 
+            scope = cfg.int8_scope
             warnings.warn(
                 "int8_matmuls does not cover the MoE expert einsums"
-                " (expert-stacked weights need a grouped AQT einsum);"
-                " only the attention projections quantize",
+                " (expert-stacked weights need a grouped AQT einsum); "
+                + (
+                    "only the attention projections quantize"
+                    if scope == "all"
+                    else "with int8_scope='ffn' NOTHING quantizes on a MoE"
+                    " config — the flag is a no-op here"
+                ),
                 stacklevel=2,
             )
         from torchx_tpu.models.moe import moe_ffn
@@ -324,10 +342,11 @@ def _layer(
 
     # attention block
     i8 = cfg.int8_matmuls
+    i8_attn = i8 and cfg.int8_scope == "all"
     attn_in = rms_norm(x, layer["attn_norm"], cfg.norm_eps, mesh=mesh)
-    q = maybe_matmul(attn_in, layer["wq"], int8_training=i8).reshape(b, s, h, hd)
-    k = maybe_matmul(attn_in, layer["wk"], int8_training=i8).reshape(b, s, kvh, hd)
-    v = maybe_matmul(attn_in, layer["wv"], int8_training=i8).reshape(b, s, kvh, hd)
+    q = maybe_matmul(attn_in, layer["wq"], int8_training=i8_attn).reshape(b, s, h, hd)
+    k = maybe_matmul(attn_in, layer["wk"], int8_training=i8_attn).reshape(b, s, kvh, hd)
+    v = maybe_matmul(attn_in, layer["wv"], int8_training=i8_attn).reshape(b, s, kvh, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if cfg.use_ring_attention and mesh is not None and mesh.shape.get("sp", 1) > 1:
@@ -348,7 +367,7 @@ def _layer(
     # flash/splash forward in the backward pass (see "dots_attn")
     attn_out = checkpoint_name(attn_out, "attn_out")
     attn_out = maybe_matmul(
-        attn_out.reshape(b, s, h * hd), layer["wo"], int8_training=i8
+        attn_out.reshape(b, s, h * hd), layer["wo"], int8_training=i8_attn
     )
     x = x + attn_out
     x = _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
