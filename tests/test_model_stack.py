@@ -127,6 +127,37 @@ class TestOps:
         np.testing.assert_allclose(dx, dx_ref, rtol=2e-5, atol=2e-6)
         np.testing.assert_allclose(dw, dw_ref, rtol=2e-5, atol=2e-6)
 
+    def test_attention_shard_wrap_matches_xla(self):
+        """The fully-manual shard_map wrap Mosaic kernels need on sharded
+        meshes (ops/attention._shard_wrap): splash (interpret mode) under
+        the wrap on a dp x fsdp x tp mesh matches plain xla attention."""
+        import importlib
+
+        # torchx_tpu.ops re-exports the attention FUNCTION under the
+        # submodule's name, so plain `import ... as` resolves to the
+        # function; go through importlib for the module itself
+        attn_mod = importlib.import_module("torchx_tpu.ops.attention")
+        from torchx_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2, sp=1))
+        q = jax.random.normal(jax.random.PRNGKey(0), (4, 512, 8, 64))
+        k = jax.random.normal(jax.random.PRNGKey(1), (4, 512, 4, 64))
+        v = jax.random.normal(jax.random.PRNGKey(2), (4, 512, 4, 64))
+
+        def kernel(q, k, v, seg):  # noqa: ANN001
+            return attn_mod.splash_attention(
+                q, k, v, causal=True, interpret=True, segment_ids=seg
+            )
+        out = jax.jit(
+            lambda q, k, v: attn_mod._shard_wrap(
+                kernel, q, k, v, None, mesh, ("dp", "fsdp"), "tp"
+            )
+        )(q, k, v)
+        ref = attn_mod.xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=5e-3, rtol=5e-3
+        )
+
     def test_rope_rotation_preserves_norm(self):
         cos, sin = rope_frequencies(16, 32)
         x = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 2, 16))

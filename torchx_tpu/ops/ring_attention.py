@@ -100,8 +100,16 @@ def _ring_attention_shard(
     o0 = jnp.zeros((b, h, sq, d), dtype=jnp.float32)
     m0 = jnp.full((b, h, sq), _NEG_INF, dtype=jnp.float32)
     l0 = jnp.zeros((b, h, sq), dtype=jnp.float32)
+    # checkpoint each ring step: without it, scan AD saves every block's
+    # [b, h, sq, sk] f32 logits — an [n, b, h, sq, sk] stack that at 8B
+    # long-context scale is tens of GB per device (measured via the AOT
+    # fit: 68 GB of a 78 GB temp footprint at seq 32k, sp=8). Recomputing
+    # the block logits in backward costs one extra qk matmul per block —
+    # the standard blockwise-attention trade. prevent_cse=False: scan's
+    # loop structure already prevents the pathological CSE, so the
+    # default optimization barriers would only block fusion.
     (o, m, l, _), _ = jax.lax.scan(
-        step, (o0, m0, l0, (k, v)), jnp.arange(n)
+        jax.checkpoint(step, prevent_cse=False), (o0, m0, l0, (k, v)), jnp.arange(n)
     )
     out = o / jnp.maximum(l[..., None], 1e-30)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [b, sq, h, d]
