@@ -42,8 +42,11 @@ class TestMoEFFN:
         out, aux = moe.moe_ffn(cfg, layer0, x)
         ref = dense_reference_moe(cfg, layer0, x)
         np.testing.assert_allclose(out, ref, atol=1e-5)
-        # balanced-ish routing keeps the Switch aux loss near 1
-        assert 0.5 < float(aux) < float(cfg.n_experts)
+        # aux = [balance, entropy, overflow] (router health vector):
+        # balanced-ish routing keeps the Switch balance term near 1
+        assert 0.5 < float(aux[0]) < float(cfg.n_experts)
+        assert 0.0 < float(aux[1]) <= 1.0  # normalized entropy
+        assert 0.0 <= float(aux[2]) <= 1.0  # overflow fraction
 
     def test_capacity_drops_tokens(self):
         # capacity 1 slot per expert: most tokens dropped -> output mostly 0
@@ -51,11 +54,13 @@ class TestMoEFFN:
         params = moe.init_params(cfg, jax.random.PRNGKey(0))
         layer0 = jax.tree.map(lambda x: x[0], params["layers"])
         x = jax.random.normal(jax.random.PRNGKey(1), (1, 32, cfg.dim))
-        out, _ = moe.moe_ffn(cfg, layer0, x)
+        out, aux = moe.moe_ffn(cfg, layer0, x)
         # some rows must be exactly zero (dropped), but not all
         row_norms = jnp.linalg.norm(out[0], axis=-1)
         assert (row_norms == 0).any()
         assert (row_norms > 0).any()
+        # the drop shows up in the router-health overflow fraction
+        assert float(aux[2]) > 0.3
 
     def test_param_count(self):
         cfg = moe.moe_tiny()

@@ -272,8 +272,13 @@ def train(
     def _emit_log(entry: dict) -> None:
         # the async copies issued at the log boundary are long since done;
         # float() here is a host-memory read, not a device round-trip
+        aux_vec = entry["aux"]
         moe_note = (
-            f" router_aux={float(entry['aux']):.3f}" if is_moe else ""
+            f" router_aux={float(aux_vec[llama.AUX_BALANCE]):.3f}"
+            f" router_entropy={float(aux_vec[llama.AUX_ENTROPY]):.2f}"
+            f" router_overflow={float(aux_vec[llama.AUX_OVERFLOW]):.1%}"
+            if is_moe
+            else ""
         )
         print(
             f"step {entry['step']} loss={float(entry['loss']):.4f}"

@@ -42,6 +42,14 @@ from torchx_tpu.ops.rope import apply_rope, rope_frequencies
 
 Params = dict[str, Any]
 
+# Layout of the router-health aux vector threaded through every forward:
+# [Switch balance loss, normalized router entropy, capacity-overflow
+# fraction]. Dense layers contribute zeros. Shared by moe.moe_ffn (the
+# producer), the trainer's log line, and the dryrun gate — index through
+# these names, never bare integers.
+AUX_BALANCE, AUX_ENTROPY, AUX_OVERFLOW = 0, 1, 2
+AUX_LEN = 3
+
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
@@ -315,7 +323,7 @@ def ffn(
     up = maybe_matmul(mlp_in, layer["w_up"], int8_training=i8)
     return (
         maybe_matmul(gate * up, layer["w_down"], int8_training=i8),
-        jnp.float32(0),
+        jnp.zeros((AUX_LEN,), jnp.float32),  # aux vector: dense = zeros
     )
 
 
@@ -407,15 +415,17 @@ def forward_features(
     cfg: LlamaConfig,
     mesh: Optional[Mesh] = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """-> (final-norm hidden states [b, s, dim], MoE aux loss total).
+    """-> (final-norm hidden states [b, s, dim], router-health aux).
 
-    aux is 0 for dense models; under pipeline parallelism the per-layer aux
-    is threaded through the pipeline (summed over stages, averaged over
-    microbatches). The MoE balancing term is nonlinear in token statistics,
-    so the microbatch-averaged value differs slightly from the full-batch
-    pp=1 value when routing varies across microbatches — the standard
-    group-wise aux (GShard computes it per dispatch group the same way);
-    router balancing pressure is preserved, exact loss parity is not."""
+    aux is the [AUX_LEN] vector [balance, entropy, overflow] (all zeros
+    for dense models; see moe.moe_ffn). Under pipeline parallelism the
+    per-layer aux threads through the pipeline (summed over stages,
+    averaged over microbatches). The MoE balancing term is nonlinear in
+    token statistics, so the microbatch-averaged value differs slightly
+    from the full-batch pp=1 value when routing varies across microbatches
+    — the standard group-wise aux (GShard computes it per dispatch group
+    the same way); router balancing pressure is preserved, exact loss
+    parity is not."""
     # The table lookup follows the ZeRO-3 pattern of every other fsdp
     # weight: all-gather the (dim-sharded) table at use and gather with
     # batch/seq-sharded indices, so the output is BORN in the activation
@@ -461,7 +471,6 @@ def features_from_embeddings(
         cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
 
     body = _remat(functools.partial(_layer, cfg, mesh, cos, sin), cfg)
-    aux_total = jnp.float32(0)
 
     if pp > 1:
         # pipeline the layer stack over the pp axis (embedding/head stay
@@ -489,13 +498,31 @@ def features_from_embeddings(
             manual_axes=frozenset({"sp"}) if ring_in_pp else frozenset(),
             x_spec=P(None, "sp", None) if ring_in_pp else None,
         )
+        # stages SUM aux over their layers; balance keeps the sum (Switch
+        # semantics) but the monitoring stats (entropy/overflow) are
+        # per-layer means, so divide the layer count back out
+        aux_total = jnp.stack(
+            [
+                aux_total[AUX_BALANCE],
+                aux_total[AUX_ENTROPY] / cfg.n_layers,
+                aux_total[AUX_OVERFLOW] / cfg.n_layers,
+            ]
+        )
     else:
         def scan_step(x, layer_slice):  # noqa: ANN001
             x, aux = body(x, layer_slice)
             return x, aux
 
         x, aux_per_layer = jax.lax.scan(scan_step, x, params["layers"])
-        aux_total = aux_per_layer.sum()
+        # [L, AUX_LEN] per-layer aux: balance sums over layers (matches
+        # the Switch loss), the monitoring stats average
+        aux_total = jnp.stack(
+            [
+                aux_per_layer[:, AUX_BALANCE].sum(),
+                aux_per_layer[:, AUX_ENTROPY].mean(),
+                aux_per_layer[:, AUX_OVERFLOW].mean(),
+            ]
+        )
     return rms_norm(x, params["final_norm"], cfg.norm_eps, mesh=mesh), aux_total
 
 
@@ -592,12 +619,16 @@ def loss_and_aux(
     cfg: LlamaConfig,
     mesh: Optional[Mesh] = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """-> (total loss, raw MoE balancing aux) — the aux (pre-coefficient)
-    surfaces in trainer logs as the router-health signal (≈1 when experts
-    are balanced, grows as routing collapses; 0 for dense models)."""
+    """-> (total loss, router-health aux vector).
+
+    aux is [balance, entropy, overflow] (index via AUX_*): the raw
+    pre-coefficient Switch balance term (≈1 when experts are balanced,
+    grows as routing collapses), the normalized router entropy, and the
+    capacity-overflow fraction — all zeros for dense models. Only
+    aux[AUX_BALANCE] is scaled into the loss."""
     tokens = batch["tokens"]
     x, aux = forward_features(params, tokens[:, :-1], cfg, mesh)
-    aux_term = getattr(cfg, "router_aux_coef", 0.0) * aux
+    aux_term = getattr(cfg, "router_aux_coef", 0.0) * aux[AUX_BALANCE]
     targets = tokens[:, 1:]
     head = lm_head(params, cfg)
     mask = batch.get("loss_mask")

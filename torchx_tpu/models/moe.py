@@ -159,9 +159,19 @@ def moe_ffn(
     """GShard einsum dispatch: route -> dispatch to capacity slots ->
     per-expert SwiGLU -> combine. Static shapes throughout.
 
-    Returns (output, aux): aux is the Switch-style load-balancing loss
-    ``E * Σ_e fraction_routed_e * mean_router_prob_e`` (≈1 when balanced),
-    scaled by the caller with cfg.router_aux_coef."""
+    Returns (output, aux): aux is the router-health vector
+    ``[balance, entropy, overflow]`` —
+
+    * balance: the Switch-style load-balancing loss
+      ``E * Σ_e fraction_routed_e * mean_router_prob_e`` (≈1 when
+      balanced; this component, and only this, is scaled into the loss
+      by cfg.router_aux_coef),
+    * entropy: mean router-distribution entropy normalized by log(E)
+      (1 = uniform routing, →0 as the router collapses onto experts),
+    * overflow: fraction of (token, choice) routings dropped because
+      their expert's capacity buffer was full.
+
+    The trainer surfaces all three at log points (docs/ROADMAP.md #12)."""
     b, s, d = x.shape
     E, k = cfg.n_experts, cfg.top_k
     capacity = max(1, int(cfg.capacity_factor * s * k / E))
@@ -200,7 +210,16 @@ def moe_ffn(
     top1_oh = choice_oh[:, :, 0, :]  # [b, s, E]
     frac_routed = top1_oh.mean(axis=(0, 1))  # [E]
     mean_prob = probs.mean(axis=(0, 1))  # [E]
-    aux = E * jnp.sum(frac_routed * mean_prob)
+    balance = E * jnp.sum(frac_routed * mean_prob)
+    # router health metrics (monitoring only; stop_gradient keeps them
+    # out of the backward pass)
+    p_safe = jnp.maximum(probs, 1e-9)
+    entropy = jax.lax.stop_gradient(
+        (-(p_safe * jnp.log(p_safe)).sum(-1).mean()) / jnp.log(float(E))
+    )
+    overflow = jax.lax.stop_gradient(1.0 - within.astype(jnp.float32).mean())
+    # order fixed by llama.AUX_BALANCE / AUX_ENTROPY / AUX_OVERFLOW
+    aux = jnp.stack([balance, entropy, overflow])
 
     # back to tokens, gate-weighted
     out = jnp.einsum("bsec,becd->bsd", combine.astype(x.dtype), expert_out)

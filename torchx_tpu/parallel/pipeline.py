@@ -51,8 +51,11 @@ def _stage_apply(
 ):
     """Run this stage's local slice of layers (scan over the local stack).
 
-    With ``with_aux`` the body returns ``(x, aux_scalar)`` and the per-layer
-    aux values are summed over the stage's local stack.
+    With ``with_aux`` the body returns ``(x, aux)`` — a scalar or any
+    fixed-shape array (e.g. the MoE router-health vector) — and the
+    per-layer aux values are summed over the stage's local stack. Aux
+    rides the scan's stacked OUTPUTS rather than the carry so its shape
+    never needs declaring up front.
     """
     if not with_aux:
 
@@ -62,13 +65,12 @@ def _stage_apply(
         out, _ = jax.lax.scan(step, x, local_layers)
         return out
 
-    def step_aux(carry, layer_slice):  # noqa: ANN001
-        h, acc = carry
+    def step_aux(h, layer_slice):  # noqa: ANN001
         h, aux = body(h, layer_slice)
-        return (h, acc + jnp.float32(aux)), None
+        return h, jnp.asarray(aux, jnp.float32)
 
-    (out, aux_sum), _ = jax.lax.scan(step_aux, (x, jnp.float32(0)), local_layers)
-    return out, aux_sum
+    out, aux_stack = jax.lax.scan(step_aux, x, local_layers)
+    return out, aux_stack.sum(axis=0)
 
 
 def _pipeline_shard(
@@ -87,7 +89,7 @@ def _pipeline_shard(
     fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
     def step(carry, t):  # noqa: ANN001
-        prev_out, outputs, aux_acc = carry
+        prev_out, outputs = carry
         # stage 0 feeds microbatch t (clamped; garbage beyond M is masked by
         # the output indexing), later stages receive the previous stage's
         # output shifted forward one hop
@@ -96,12 +98,13 @@ def _pipeline_shard(
         )
         incoming = jax.lax.ppermute(prev_out, "pp", fwd_perm)
         my_in = jnp.where(stage == 0, x_t, incoming)
+        aux_t = None
         if with_aux:
             my_out, aux_t = _stage_apply(body, local_layers, my_in, with_aux=True)
             # this stage holds real data only for steps in [stage,
             # stage + n_micro); aux from warmup/drain garbage is masked out
             valid = (t >= stage) & (t - stage < n_micro)
-            aux_acc = aux_acc + jnp.where(valid, aux_t, 0.0)
+            aux_t = jnp.where(valid, aux_t, jnp.zeros_like(aux_t))
         else:
             my_out = _stage_apply(body, local_layers, my_in)
         # the last stage finished microbatch (t - (S-1)) at step t; before
@@ -110,12 +113,12 @@ def _pipeline_shard(
         current = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
         slot = jnp.where(t >= n_stages - 1, my_out, current)
         updated = jax.lax.dynamic_update_index_in_dim(outputs, slot, out_idx, axis=0)
-        return (my_out, updated, aux_acc), None
+        return (my_out, updated), aux_t
 
     outputs0 = jnp.zeros((n_micro, *mb_shape), dtype=x.dtype)
     prev0 = jnp.zeros(mb_shape, dtype=x.dtype)
-    (_, outputs, aux_acc), _ = jax.lax.scan(
-        step, (prev0, outputs0, jnp.float32(0)), jnp.arange(total_steps)
+    (_, outputs), aux_stack = jax.lax.scan(
+        step, (prev0, outputs0), jnp.arange(total_steps)
     )
     # only the last stage holds real outputs; broadcast them to all stages
     outputs = jnp.where(stage == n_stages - 1, outputs, 0)
@@ -124,7 +127,7 @@ def _pipeline_shard(
         # sum per-layer aux across stages; each microbatch's aux is a mean
         # over its own tokens, so average over microbatches to match the
         # non-pp semantics (per-layer aux = mean over the full batch)
-        aux_total = jax.lax.psum(aux_acc, "pp") / n_micro
+        aux_total = jax.lax.psum(aux_stack.sum(axis=0), "pp") / n_micro
         if extra_axes:
             # the aux out_spec is P() (replicated), but each extra-axis
             # shard (e.g. an sp sequence shard) computed aux over its OWN
@@ -147,8 +150,9 @@ def pipeline_apply(
 ):
     """Apply L stacked layers to x, pipelined over the mesh's "pp" axis.
 
-    With ``with_aux`` the body returns ``(x, aux_scalar)`` per layer (e.g.
-    the MoE load-balancing loss) and the call returns ``(out, aux_total)``
+    With ``with_aux`` the body returns ``(x, aux)`` per layer — a scalar
+    or any fixed-shape array (e.g. the MoE router-health vector) — and
+    the call returns ``(out, aux_total)``
     where aux_total sums layers and averages microbatches. For aux linear
     in the microbatch mean this equals the non-pipelined scan exactly; for
     nonlinear aux (MoE balancing) it is the group-wise variant computed per
