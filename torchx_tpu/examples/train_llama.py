@@ -373,6 +373,30 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--ring-attention", action="store_true")
     parser.add_argument(
+        "--remat-policy",
+        default=None,
+        choices=["full", "dots", "dots_attn"],
+        help="rematerialization policy (default: the config's own)",
+    )
+    parser.add_argument(
+        "--int8",
+        action="store_true",
+        help="AQT int8 training matmuls (see docs/performance.md for the"
+        " measured v5e guidance before enabling)",
+    )
+    parser.add_argument(
+        "--int8-scope",
+        default=None,
+        choices=["all", "ffn"],
+        help="which projections to quantize (implies --int8)",
+    )
+    parser.add_argument("--lr", type=float, default=None)
+    parser.add_argument(
+        "--log-every", type=int, default=None,
+        help="steps between log lines, >= 1 (each is a device fence;"
+        " 8+ on TPU)",
+    )
+    parser.add_argument(
         "--data", default=None, help="packed uint32 token file (see datapreproc); synthetic data when unset"
     )
     parser.add_argument(
@@ -389,12 +413,27 @@ def main(argv: Optional[list[str]] = None) -> None:
     cfg = all_configs()[args.config]()
     if args.ring_attention:
         cfg = dataclasses.replace(cfg, use_ring_attention=True)
+    if args.remat_policy:
+        cfg = dataclasses.replace(cfg, remat_policy=args.remat_policy)
+    if args.int8 or args.int8_scope:
+        cfg = dataclasses.replace(
+            cfg, int8_matmuls=True, int8_scope=args.int8_scope or "all"
+        )
+    if args.log_every is not None and args.log_every < 1:
+        parser.error("--log-every must be >= 1")
+    # None = keep train()'s own defaults (single source of truth)
+    overrides = {
+        k: v
+        for k, v in {"log_every": args.log_every, "lr": args.lr}.items()
+        if v is not None
+    }
     metrics = train(
         cfg,
         parse_mesh_arg(args.mesh),
         args.batch,
         args.seq,
         args.steps,
+        **overrides,
         ckpt_dir=args.ckpt_dir,
         ckpt_every=args.ckpt_every,
         data_path=args.data,
