@@ -83,6 +83,19 @@ class TestAbstractState:
         assert r.fits
 
 
+class TestMoEFit:
+    def test_moe_family_dispatch(self):
+        """compile_fit must route MoE configs through moe.init_params /
+        moe.param_specs (the dense specs lack w_router — regression from
+        the Mixtral v5p fit run)."""
+        from torchx_tpu.models import moe
+
+        cfg = moe.moe_tiny()
+        r = compile_fit(cfg, _mesh(), batch=8, seq=128)
+        assert r.peak_bytes > 0
+        assert r.fits
+
+
 @pytest.mark.integ
 class TestNorthStarFit:
     """llama3_8b on the intended v5p-32 sharding (fsdp x tp), CPU upper

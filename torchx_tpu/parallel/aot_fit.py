@@ -82,11 +82,11 @@ def _specs_for_state(state_shapes: Any, param_specs: Any) -> Any:
 
 def abstract_train_state(cfg: Any, mesh: Mesh, optimizer: Any):
     """TrainState of ShapeDtypeStructs carrying the training shardings."""
-    from torchx_tpu.examples.train_llama import TrainState
-    from torchx_tpu.models import llama
+    from torchx_tpu.examples.train_llama import TrainState, _model_fns
 
+    init_fn, specs_fn = _model_fns(cfg)  # dense vs MoE family dispatch
     params_shapes = jax.eval_shape(
-        lambda: llama.init_params(cfg, jax.random.PRNGKey(0))
+        lambda: init_fn(cfg, jax.random.PRNGKey(0))
     )
     opt_shapes = jax.eval_shape(optimizer.init, params_shapes)
     state_shapes = TrainState(
@@ -94,7 +94,7 @@ def abstract_train_state(cfg: Any, mesh: Mesh, optimizer: Any):
         opt_state=opt_shapes,
         step=jax.ShapeDtypeStruct((), jnp.int32),
     )
-    pspecs = llama.param_specs(cfg, pp=mesh.shape.get("pp", 1) > 1)
+    pspecs = specs_fn(cfg, pp=mesh.shape.get("pp", 1) > 1)
     spec_tree = _specs_for_state(state_shapes, pspecs)
     return jax.tree.map(
         lambda s, p: jax.ShapeDtypeStruct(
