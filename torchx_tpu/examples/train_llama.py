@@ -81,12 +81,8 @@ jax.tree_util.register_dataclass(
 
 
 def _model_fns(cfg: llama.LlamaConfig):
-    """Dense vs MoE dispatch (MoE configs carry n_experts)."""
-    if getattr(cfg, "n_experts", 0):
-        from torchx_tpu.models import moe
-
-        return moe.init_params, moe.param_specs
-    return llama.init_params, llama.param_specs
+    """Dense vs MoE dispatch (see :func:`llama.model_fns`)."""
+    return llama.model_fns(cfg)
 
 
 def init_state(

@@ -260,6 +260,17 @@ def param_specs(cfg: LlamaConfig, pp: bool = False) -> Params:
     return specs
 
 
+def model_fns(cfg: LlamaConfig):
+    """(init_params, param_specs) for the config's model family — dense,
+    or MoE when the config carries experts. The single dispatch point the
+    trainer and the AOT-fit machinery share."""
+    if getattr(cfg, "n_experts", 0):
+        from torchx_tpu.models import moe
+
+        return moe.init_params, moe.param_specs
+    return init_params, param_specs
+
+
 def shard_params(params: Params, cfg: LlamaConfig, mesh: Mesh) -> Params:
     """Device-put params onto the mesh per param_specs."""
     specs = param_specs(cfg, pp=mesh.shape.get("pp", 1) > 1)

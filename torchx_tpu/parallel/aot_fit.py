@@ -82,9 +82,10 @@ def _specs_for_state(state_shapes: Any, param_specs: Any) -> Any:
 
 def abstract_train_state(cfg: Any, mesh: Mesh, optimizer: Any):
     """TrainState of ShapeDtypeStructs carrying the training shardings."""
-    from torchx_tpu.examples.train_llama import TrainState, _model_fns
+    from torchx_tpu.examples.train_llama import TrainState
+    from torchx_tpu.models import llama
 
-    init_fn, specs_fn = _model_fns(cfg)  # dense vs MoE family dispatch
+    init_fn, specs_fn = llama.model_fns(cfg)  # dense vs MoE dispatch
     params_shapes = jax.eval_shape(
         lambda: init_fn(cfg, jax.random.PRNGKey(0))
     )
