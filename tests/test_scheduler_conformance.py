@@ -10,13 +10,16 @@ from torchx_tpu.schedulers import (
     get_default_scheduler_name,
     get_scheduler_factories,
 )
-from torchx_tpu.schedulers.api import Scheduler
+from torchx_tpu.schedulers.api import DescribeAppResponse, Scheduler
 from torchx_tpu.specs.api import (
     AppDef,
     AppDryRunInfo,
+    AppState,
+    FailureClass,
     Resource,
     Role,
     TpuSlice,
+    is_terminal,
     runopts,
 )
 
@@ -116,3 +119,88 @@ class TestSchedulerConformance:
     def test_default_scheduler_is_first(self):
         assert get_default_scheduler_name() == next(iter(DEFAULT_SCHEDULER_MODULES))
         assert get_default_scheduler_name() == "local"
+
+    @pytest.mark.parametrize("name", ALL)
+    def test_classify_failure_contract(self, name):
+        """Every backend honors the supervisor's classification contract:
+        PREEMPTED -> PREEMPTION, bare FAILED -> APP (conservative), a
+        describe-attached class wins, non-failures -> None."""
+        sched = make_scheduler(name)
+
+        def resp(state, fclass=None):
+            return DescribeAppResponse(
+                app_id="x", state=state, failure_class=fclass
+            )
+
+        assert (
+            sched.classify_failure(resp(AppState.PREEMPTED))
+            == FailureClass.PREEMPTION
+        )
+        assert sched.classify_failure(resp(AppState.FAILED)) == FailureClass.APP
+        assert (
+            sched.classify_failure(resp(AppState.FAILED, FailureClass.INFRA))
+            == FailureClass.INFRA
+        )
+        for state in (
+            AppState.RUNNING,
+            AppState.PENDING,
+            AppState.SUCCEEDED,
+            AppState.CANCELLED,
+        ):
+            assert sched.classify_failure(resp(state)) is None
+
+
+def _run_local_echo(sched, tmp_path, timeout: float = 20.0) -> str:
+    """Submit a trivial echo app on the local scheduler and wait for a
+    terminal state; returns the app id."""
+    import time
+
+    role = Role(
+        name="echo",
+        image="",
+        entrypoint="echo",
+        args=["conformance"],
+        resource=Resource(cpu=1, memMB=64),
+    )
+    info = sched.submit_dryrun(
+        AppDef(name="conf-lifecycle", roles=[role]), {"log_dir": str(tmp_path)}
+    )
+    app_id = sched.schedule(info)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        desc = sched.describe(app_id)
+        if desc is not None and is_terminal(desc.state):
+            return app_id
+        time.sleep(0.05)
+    raise AssertionError(f"app {app_id} never reached a terminal state")
+
+
+class TestLocalSchedulerLifecycle:
+    """Lifecycle contract checked end-to-end on the one backend that can
+    actually run jobs in CI."""
+
+    def test_terminal_state_stays_terminal(self, tmp_path):
+        sched = make_scheduler("local")
+        try:
+            app_id = _run_local_echo(sched, tmp_path)
+            first = sched.describe(app_id).state
+            assert is_terminal(first)
+            # repeated describes (and a cancel) must never un-terminal it
+            sched.cancel(app_id)
+            for _ in range(3):
+                assert sched.describe(app_id).state == first
+        finally:
+            sched.close()
+
+    def test_exists_false_after_delete(self, tmp_path):
+        sched = make_scheduler("local")
+        try:
+            app_id = _run_local_echo(sched, tmp_path)
+            assert sched.exists(app_id)
+            sched.delete(app_id)
+            assert not sched.exists(app_id)
+            assert sched.describe(app_id) is None
+            assert app_id not in [a.app_id for a in sched.list()]
+            sched.delete(app_id)  # idempotent
+        finally:
+            sched.close()

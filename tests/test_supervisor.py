@@ -1,0 +1,369 @@
+"""Supervisor state-machine tests against a scripted fake scheduler.
+
+The scheduler's "cluster" is a script of terminal outcomes, one per
+submission — so the whole preempt/classify/backoff/resubmit loop runs
+deterministically in-process with injected sleep and rng."""
+
+import json
+import logging
+import os
+import random
+from typing import Mapping, Optional
+
+import pytest
+
+from torchx_tpu.runner.api import Runner
+from torchx_tpu.runner.events import get_events_logger
+from torchx_tpu.runner.events.api import TpxEvent
+from torchx_tpu.schedulers.api import DescribeAppResponse, Scheduler
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppDryRunInfo,
+    AppState,
+    AppStatus,
+    CfgVal,
+    FailureClass,
+    Role,
+    runopts,
+)
+from torchx_tpu.specs.serialize import (
+    supervisor_policy_from_dict,
+    supervisor_policy_to_dict,
+)
+from torchx_tpu.supervisor import (
+    Supervisor,
+    SupervisorPolicy,
+    latest_checkpoint_step,
+)
+from torchx_tpu.settings import CHECKPOINT_MANIFEST, ENV_TPX_RESUME_STEP
+
+
+class ScriptedScheduler(Scheduler[dict]):
+    """Each ``schedule()`` consumes the next scripted terminal outcome;
+    ``describe()`` then reports that attempt as immediately terminal."""
+
+    def __init__(self, session_name: str, script=None, **kwargs):
+        super().__init__("scripted", session_name)
+        self.script = list(script or [])
+        self.apps: dict[str, tuple[AppState, Optional[FailureClass]]] = {}
+        self.submitted_envs: list[dict[str, str]] = []
+        self._counter = 0
+
+    def run_opts(self) -> runopts:
+        return runopts()
+
+    def _submit_dryrun(self, app: AppDef, cfg: Mapping[str, CfgVal]):
+        return AppDryRunInfo({"app": app})
+
+    def schedule(self, dryrun_info) -> str:
+        self._counter += 1
+        app_id = f"job_{self._counter}"
+        outcome = (
+            self.script.pop(0) if self.script else (AppState.SUCCEEDED, None)
+        )
+        self.apps[app_id] = outcome
+        self.submitted_envs.append(dict(dryrun_info._app.roles[0].env))
+        return app_id
+
+    def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
+        if app_id not in self.apps:
+            return None
+        state, fclass = self.apps[app_id]
+        return DescribeAppResponse(
+            app_id=app_id, state=state, failure_class=fclass
+        )
+
+    def _cancel_existing(self, app_id: str) -> None:
+        self.apps[app_id] = (AppState.CANCELLED, None)
+
+
+class _CaptureEvents(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.events: list[TpxEvent] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        self.events.append(TpxEvent.deserialize(record.getMessage()))
+
+
+@pytest.fixture
+def capture_events():
+    handler = _CaptureEvents()
+    logger = get_events_logger()
+    logger.addHandler(handler)
+    yield handler.events
+    logger.removeHandler(handler)
+
+
+def make_runner(script):
+    sched = ScriptedScheduler("sup", script=script)
+    runner = Runner("sup", {"scripted": lambda session_name, **kw: sched})
+    return runner, sched
+
+
+def dryrun(runner):
+    app = AppDef(
+        name="train",
+        roles=[Role(name="trainer", image="i", entrypoint="python")],
+    )
+    return runner.dryrun(app, "scripted")
+
+
+def fast_policy(**kwargs) -> SupervisorPolicy:
+    defaults = dict(
+        backoff_seconds=1.0,
+        backoff_factor=2.0,
+        jitter=0.0,
+        poll_interval=0.01,
+    )
+    defaults.update(kwargs)
+    return SupervisorPolicy(**defaults)
+
+
+def run_supervised(script, policy):
+    runner, sched = make_runner(script)
+    sleeps: list[float] = []
+    with runner:
+        sup = Supervisor(
+            runner,
+            dryrun(runner),
+            policy,
+            sleep=sleeps.append,
+            rng=random.Random(0),
+        )
+        result = sup.run()
+    return result, sched, sleeps
+
+
+PREEMPT = (AppState.PREEMPTED, FailureClass.PREEMPTION)
+APP_FAIL = (AppState.FAILED, FailureClass.APP)
+INFRA_FAIL = (AppState.FAILED, FailureClass.INFRA)
+OK = (AppState.SUCCEEDED, None)
+
+
+class TestSupervisorLoop:
+    def test_preempted_twice_then_succeeds(self, tmp_path, capture_events):
+        """The acceptance scenario: two spot reclaims, each resubmitted
+        with backoff and checkpoint resume, then success within budget."""
+        ckpt = tmp_path / "ckpt"
+        ckpt.mkdir()
+        (ckpt / CHECKPOINT_MANIFEST).write_text(json.dumps({"latest_step": 120}))
+
+        result, sched, sleeps = run_supervised(
+            [PREEMPT, PREEMPT, OK],
+            fast_policy(max_preemptions=3, checkpoint_dir=str(ckpt)),
+        )
+
+        assert result.succeeded
+        assert result.attempts == 3
+        assert result.budget_exhausted is None
+        assert result.retries[FailureClass.PREEMPTION] == 2
+        assert result.retries[FailureClass.APP] == 0
+        assert result.handles == [
+            "scripted://sup/job_1",
+            "scripted://sup/job_2",
+            "scripted://sup/job_3",
+        ]
+        # first attempt starts fresh; every resubmit resumes from step 120
+        assert ENV_TPX_RESUME_STEP not in sched.submitted_envs[0]
+        assert sched.submitted_envs[1][ENV_TPX_RESUME_STEP] == "120"
+        assert sched.submitted_envs[2][ENV_TPX_RESUME_STEP] == "120"
+        assert result.resume_steps == [None, 120, 120]
+        # capped exponential backoff: 1s then 2s (jitter=0)
+        assert sleeps == [1.0, 2.0]
+
+    def test_each_transition_emits_event(self, tmp_path, capture_events):
+        result, _, _ = run_supervised(
+            [PREEMPT, OK], fast_policy(max_preemptions=1)
+        )
+        assert result.succeeded
+        sup_events = [e for e in capture_events if e.api == "supervise"]
+        transitions = [e.app_metadata["transition"] for e in sup_events]
+        assert transitions == ["submitted", "resubmitting", "submitted", "finished"]
+        resub = sup_events[1]
+        assert resub.app_metadata["failure_class"] == "PREEMPTION"
+        assert resub.app_metadata["retry"] == 1
+        assert resub.scheduler == "scripted"
+        assert resub.app_id == "job_1"
+
+    def test_preemption_budget_exhaustion(self):
+        result, _, sleeps = run_supervised(
+            [PREEMPT, PREEMPT, PREEMPT], fast_policy(max_preemptions=2)
+        )
+        assert not result.succeeded
+        assert result.attempts == 3
+        assert result.budget_exhausted == FailureClass.PREEMPTION
+        assert result.retries[FailureClass.PREEMPTION] == 2
+        assert result.status.state == AppState.PREEMPTED
+        assert len(sleeps) == 2  # no backoff after the budget is spent
+
+    def test_fatal_app_error_stays_failed(self):
+        """Default policy: app bugs are deterministic; zero resubmits."""
+        result, sched, sleeps = run_supervised([APP_FAIL], fast_policy())
+        assert not result.succeeded
+        assert result.attempts == 1
+        assert result.budget_exhausted == FailureClass.APP
+        assert result.status.state == AppState.FAILED
+        assert result.status.failure_class == FailureClass.APP
+        assert sleeps == []
+        assert len(sched.submitted_envs) == 1
+
+    def test_budgets_are_independent(self):
+        """Preemptions must not eat the infra budget and vice versa."""
+        result, _, _ = run_supervised(
+            [PREEMPT, INFRA_FAIL, PREEMPT, INFRA_FAIL, OK],
+            fast_policy(max_preemptions=2, max_infra_retries=2),
+        )
+        assert result.succeeded
+        assert result.attempts == 5
+        assert result.retries[FailureClass.PREEMPTION] == 2
+        assert result.retries[FailureClass.INFRA] == 2
+
+    def test_unclassified_failure_defaults_to_app(self):
+        result, _, _ = run_supervised(
+            [(AppState.FAILED, None)], fast_policy(max_app_retries=0)
+        )
+        assert result.budget_exhausted == FailureClass.APP
+
+    def test_cancelled_app_is_not_retried(self):
+        result, sched, _ = run_supervised(
+            [(AppState.CANCELLED, None)], fast_policy(max_preemptions=5)
+        )
+        assert not result.succeeded
+        assert result.attempts == 1
+        assert result.status.state == AppState.CANCELLED
+
+    def test_vanished_app_stops_the_loop(self):
+        """A scheduler that forgot the app (expired/deleted) must halt the
+        supervisor — resubmitting blind could double-run the job."""
+        runner, sched = make_runner([PREEMPT])
+        sched.describe = lambda app_id: None  # type: ignore[method-assign]
+        with runner:
+            result = Supervisor(
+                runner, dryrun(runner), fast_policy(), sleep=lambda s: None
+            ).run()
+        assert result.status is None
+        assert result.attempts == 1
+        assert not result.succeeded
+
+    def test_runner_supervise_wrapper(self, capture_events):
+        runner, sched = make_runner([PREEMPT, OK])
+        with runner:
+            result = runner.supervise(
+                dryrun(runner),
+                fast_policy(max_preemptions=1, backoff_seconds=0.01),
+            )
+        assert result.succeeded
+        top = [
+            e
+            for e in capture_events
+            if e.api == "supervise"
+            and e.app_metadata
+            and "attempts" in e.app_metadata
+        ]
+        assert top and top[-1].app_metadata["attempts"] == 2
+
+    def test_rejects_raw_dryrun_info(self):
+        runner, _ = make_runner([])
+        with runner, pytest.raises(ValueError, match="cannot resubmit"):
+            Supervisor(runner, AppDryRunInfo({"raw": True}))
+
+
+class TestPolicy:
+    def test_budget_for(self):
+        p = SupervisorPolicy(
+            max_preemptions=7, max_infra_retries=2, max_app_retries=1
+        )
+        assert p.budget_for(FailureClass.PREEMPTION) == 7
+        assert p.budget_for(FailureClass.INFRA) == 2
+        assert p.budget_for(FailureClass.APP) == 1
+
+    def test_backoff_caps_and_grows(self):
+        p = SupervisorPolicy(
+            backoff_seconds=5, backoff_factor=2, backoff_max_seconds=30, jitter=0
+        )
+        assert [p.backoff_delay(n) for n in range(1, 6)] == [5, 10, 20, 30, 30]
+
+    def test_jitter_bounds(self):
+        p = SupervisorPolicy(backoff_seconds=10, jitter=0.1)
+        rng = random.Random(7)
+        for n in range(1, 5):
+            base = min(10 * 2 ** (n - 1), p.backoff_max_seconds)
+            assert base * 0.9 <= p.backoff_delay(n, rng) <= base * 1.1
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SupervisorPolicy(max_preemptions=-1)
+        with pytest.raises(ValueError):
+            SupervisorPolicy(backoff_factor=0.5)
+        with pytest.raises(ValueError):
+            SupervisorPolicy(jitter=1.5)
+        with pytest.raises(ValueError):
+            SupervisorPolicy(poll_interval=0)
+
+    def test_serialization_round_trip(self):
+        p = SupervisorPolicy(
+            max_preemptions=5, checkpoint_dir="/ckpt", elastic=True
+        )
+        d = json.loads(json.dumps(supervisor_policy_to_dict(p)))
+        assert supervisor_policy_from_dict(d) == p
+
+    def test_unknown_policy_key_raises(self):
+        with pytest.raises(ValueError, match="unknown supervisor policy keys"):
+            supervisor_policy_from_dict({"max_preemption": 3})
+
+
+class TestCheckpointManifest:
+    def test_manifest_wins(self, tmp_path):
+        (tmp_path / "40").mkdir()
+        (tmp_path / CHECKPOINT_MANIFEST).write_text(
+            json.dumps({"latest_step": 55})
+        )
+        assert latest_checkpoint_step(str(tmp_path)) == 55
+
+    def test_fallback_scans_orbax_and_pickle_layouts(self, tmp_path):
+        assert latest_checkpoint_step(str(tmp_path)) is None
+        (tmp_path / "40").mkdir()
+        (tmp_path / "step_30.pkl").write_bytes(b"")
+        (tmp_path / "50.corrupt").mkdir()  # quarantined: never a candidate
+        assert latest_checkpoint_step(str(tmp_path)) == 40
+
+    def test_corrupt_manifest_falls_back(self, tmp_path):
+        (tmp_path / CHECKPOINT_MANIFEST).write_text("{not json")
+        (tmp_path / "step_7.pkl").write_bytes(b"")
+        assert latest_checkpoint_step(str(tmp_path)) == 7
+
+    def test_missing_directory(self, tmp_path):
+        assert latest_checkpoint_step(str(tmp_path / "nope")) is None
+
+
+class TestWaitTimeout:
+    def test_wait_times_out(self):
+        runner, sched = make_runner([])
+        with runner:
+            app_id = sched.schedule(dryrun(runner))
+            sched.apps[app_id] = (AppState.RUNNING, None)
+            handle = f"scripted://sup/{app_id}"
+            with pytest.raises(TimeoutError, match="still"):
+                runner.wait(handle, wait_interval=0.01, timeout=0.05)
+
+    def test_wait_returns_before_timeout(self):
+        runner, sched = make_runner([OK])
+        with runner:
+            handle = runner.schedule(dryrun(runner))
+            status = runner.wait(handle, wait_interval=0.01, timeout=5)
+        assert status.state == AppState.SUCCEEDED
+
+
+class TestStatusShowsFailureClass:
+    def test_status_format_names_the_class(self):
+        runner, sched = make_runner([PREEMPT])
+        with runner:
+            handle = runner.schedule(dryrun(runner))
+            status = runner.status(handle)
+        assert status.failure_class == FailureClass.PREEMPTION
+        assert "PREEMPTED (preemption)" in status.format()
+        assert "PREEMPTED (preemption)" in str(status)
+
+    def test_plain_states_unchanged(self):
+        assert "SUCCEEDED (" not in AppStatus(state=AppState.SUCCEEDED).format()

@@ -1,0 +1,216 @@
+"""``tpx supervise`` — run a component under the preemption-aware supervisor.
+
+``tpx run`` submits and walks away; ``tpx supervise`` submits and stays:
+it watches the app to a terminal state, classifies the failure
+(preemption / infra / app), and auto-resubmits within per-class retry
+budgets with capped exponential backoff, injecting the latest checkpoint
+step (``--checkpoint-dir``) so each attempt resumes instead of restarting
+from scratch. This is the intended way to train on spot TPU capacity::
+
+    tpx supervise -s tpu_vm -cfg project=p,zone=z,spot=True \\
+        --checkpoint-dir gs://bkt/run1/ckpt --max-preemptions 16 \\
+        dist.spmd -j 2x4 --script train.py
+
+Policy comes from ``--policy policy.json``
+(:func:`~torchx_tpu.specs.serialize.supervisor_policy_from_dict`) with
+individual flags overriding file values.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from torchx_tpu.cli.cmd_base import SubCommand
+from torchx_tpu.cli.cmd_run import CmdRun
+from torchx_tpu.runner import config as tpx_config
+from torchx_tpu.runner.api import Runner, get_runner
+from torchx_tpu.specs.finder import (
+    ComponentNotFoundException,
+    ComponentValidationException,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class CmdSupervise(SubCommand):
+    """Submit a component and babysit it to success (see module docstring)."""
+
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument(
+            "-s",
+            "--scheduler",
+            type=str,
+            default=None,
+            help="scheduler backend to submit to (default: first registered)",
+        )
+        subparser.add_argument(
+            "-cfg",
+            "--scheduler_args",
+            type=str,
+            default="",
+            help="scheduler run config as comma-separated k=v pairs",
+        )
+        subparser.add_argument(
+            "--workspace",
+            type=str,
+            default=None,
+            help="local workspace to package into the job image",
+        )
+        subparser.add_argument(
+            "--parent_run_id", type=str, default=None, help="tracker parent run id"
+        )
+        subparser.add_argument(
+            "--policy",
+            type=str,
+            default=None,
+            help="JSON file with SupervisorPolicy fields; flags below"
+            " override file values",
+        )
+        subparser.add_argument(
+            "--max-preemptions",
+            type=int,
+            default=None,
+            help="resubmits allowed after spot reclaims (default 8)",
+        )
+        subparser.add_argument(
+            "--max-infra-retries",
+            type=int,
+            default=None,
+            help="resubmits allowed after infra failures (default 3)",
+        )
+        subparser.add_argument(
+            "--max-retries",
+            type=int,
+            default=None,
+            help="resubmits allowed after application failures (default 0:"
+            " app bugs fail deterministically)",
+        )
+        subparser.add_argument(
+            "--backoff",
+            type=float,
+            default=None,
+            help="initial resubmit backoff in seconds (default 5; doubles"
+            " per consecutive retry, capped at --backoff-max)",
+        )
+        subparser.add_argument(
+            "--backoff-max",
+            type=float,
+            default=None,
+            help="ceiling on a single backoff delay in seconds (default 300)",
+        )
+        subparser.add_argument(
+            "--poll-interval",
+            type=float,
+            default=None,
+            help="cap on the jittered status poll interval (default 10s)",
+        )
+        subparser.add_argument(
+            "--checkpoint-dir",
+            type=str,
+            default=None,
+            help="checkpoint dir to read the latest step from; injected as"
+            " TPX_RESUME_STEP on every resubmit",
+        )
+        subparser.add_argument(
+            "--elastic",
+            action="store_true",
+            default=None,
+            help="run the backend's elastic watcher during each attempt",
+        )
+        subparser.add_argument(
+            "conf_args",
+            nargs=argparse.REMAINDER,
+            help="component name followed by its arguments"
+            " (e.g. dist.spmd -j 1x4 --script train.py)",
+        )
+
+    def _build_policy(self, args: argparse.Namespace):  # noqa: ANN202
+        from torchx_tpu.specs.serialize import supervisor_policy_from_dict
+        from torchx_tpu.supervisor.policy import SupervisorPolicy
+
+        if args.policy:
+            with open(args.policy) as f:
+                policy = supervisor_policy_from_dict(json.load(f))
+        else:
+            policy = SupervisorPolicy()
+        overrides = {
+            "max_preemptions": args.max_preemptions,
+            "max_infra_retries": args.max_infra_retries,
+            "max_app_retries": args.max_retries,
+            "backoff_seconds": args.backoff,
+            "backoff_max_seconds": args.backoff_max,
+            "poll_interval": args.poll_interval,
+            "checkpoint_dir": args.checkpoint_dir,
+            "elastic": args.elastic,
+        }
+        for name, value in overrides.items():
+            if value is not None:
+                setattr(policy, name, value)
+        policy.__post_init__()  # re-validate after overrides
+        return policy
+
+    def run(self, args: argparse.Namespace) -> None:
+        with get_runner(
+            component_defaults=tpx_config.load_sections("component")
+        ) as runner:
+            self._run(runner, args)
+
+    def _run(self, runner: Runner, args: argparse.Namespace) -> None:
+        scheduler = args.scheduler
+        if scheduler is None:
+            from torchx_tpu.schedulers import get_default_scheduler_name
+
+            scheduler = (
+                tpx_config.get_config("cli", "run", "scheduler")
+                or get_default_scheduler_name()
+            )
+        cfg = runner.scheduler_run_opts(scheduler).cfg_from_str(args.scheduler_args)
+        tpx_config.apply(scheduler, cfg)
+
+        component, component_args = CmdRun()._parse_component(args.conf_args)
+        try:
+            policy = self._build_policy(args)
+            dryrun_info = runner.dryrun_component(
+                component,
+                component_args,
+                scheduler,
+                cfg,
+                workspace=args.workspace,
+                parent_run_id=args.parent_run_id,
+            )
+        except (
+            ComponentValidationException,
+            ComponentNotFoundException,
+            OSError,
+            json.JSONDecodeError,
+        ) as e:
+            print(f"error: {e}", file=sys.stderr)
+            sys.exit(1)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            sys.exit(1)
+
+        try:
+            result = runner.supervise(dryrun_info, policy)
+        except KeyboardInterrupt:
+            logger.warning("ctrl-c: supervisor stopped; the current attempt"
+                           " keeps running (cancel it with `tpx cancel`)")
+            raise
+        for i, (handle, step) in enumerate(
+            zip(result.handles, result.resume_steps), start=1
+        ):
+            resumed = f" (resumed from step {step})" if step is not None else ""
+            print(f"attempt {i}: {handle}{resumed}")
+        if result.status is not None:
+            print(result.status.format())
+        if result.budget_exhausted is not None:
+            print(
+                f"{result.budget_exhausted.value.lower()} retry budget"
+                " exhausted",
+                file=sys.stderr,
+            )
+        if not result.succeeded:
+            sys.exit(1)

@@ -26,6 +26,7 @@ from torchx_tpu.cli.cmd_simple import (
     CmdStatus,
     CmdWatch,
 )
+from torchx_tpu.cli.cmd_supervise import CmdSupervise
 from torchx_tpu.version import __version__
 
 CMDS_ENTRYPOINT_GROUP = "tpx.cli.cmds"
@@ -34,6 +35,7 @@ CMDS_ENTRYPOINT_GROUP = "tpx.cli.cmds"
 def get_sub_cmds() -> dict[str, SubCommand]:
     cmds: dict[str, SubCommand] = {
         "run": CmdRun(),
+        "supervise": CmdSupervise(),
         "status": CmdStatus(),
         "describe": CmdDescribe(),
         "list": CmdList(),

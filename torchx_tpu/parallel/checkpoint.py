@@ -17,6 +17,7 @@ Falls back to a single-host pickle format when orbax is unavailable.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import pickle
@@ -24,6 +25,8 @@ import re
 from typing import Any, Optional
 
 import jax
+
+from torchx_tpu import settings
 
 logger = logging.getLogger(__name__)
 
@@ -82,13 +85,50 @@ class Checkpointer:
             )
             if not self._async:
                 self._mgr.wait_until_finished()
+            if saved:
+                self._write_manifest(step)
             return bool(saved)
-        return self._pickle_save(step, state, force=force)
+        saved = self._pickle_save(step, state, force=force)
+        if saved:
+            self._write_manifest(step)
+        return saved
 
     def wait(self) -> None:
         """Block until in-flight async saves are durably on disk."""
         if self._mgr is not None:
             self._mgr.wait_until_finished()
+
+    def _write_manifest(self, step: int) -> None:
+        """Record ``step`` as the latest save in the MANIFEST.json sidecar.
+
+        The manifest is the jax-free half of the checkpoint-resume contract:
+        the client-side supervisor reads it (supervisor/api.py) to inject
+        ``TPX_RESUME_STEP`` on resubmit without importing this module. It is
+        advisory — in async mode the step may still be finalizing, so in-job
+        restore always trusts the real step listing over the manifest — and
+        written atomically by process 0 only."""
+        if jax.process_index() != 0:
+            return
+        path = os.path.join(self.directory, settings.CHECKPOINT_MANIFEST)
+        tmp = f"{path}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"latest_step": step}, f)
+            os.replace(tmp, path)
+        except OSError as e:  # advisory: never fail a save over the sidecar
+            logger.warning("could not write checkpoint manifest %s: %s", path, e)
+
+    @staticmethod
+    def resume_step_from_env() -> Optional[int]:
+        """Step the supervisor asked this (resubmitted) run to resume from,
+        or None on a fresh run. Reads ``TPX_RESUME_STEP``; training loops
+        pass it to ``restore(...)`` instead of ``restore_latest`` when they
+        want the launcher-chosen step rather than the newest on disk."""
+        raw = os.environ.get(settings.ENV_TPX_RESUME_STEP, "")
+        try:
+            return int(raw)
+        except ValueError:
+            return None
 
     def latest_step(self) -> Optional[int]:
         """Newest complete checkpoint step, or None (waits for an

@@ -13,10 +13,11 @@ import copy
 import json
 import logging
 import os
+import random
 import time
 from datetime import datetime
 from types import TracebackType
-from typing import Any, Iterable, Mapping, Optional, Type
+from typing import Any, Callable, Iterable, Mapping, Optional, Type
 
 from torchx_tpu import settings
 from torchx_tpu.runner.events import log_event
@@ -36,6 +37,7 @@ from torchx_tpu.specs.api import (
     runopts,
 )
 from torchx_tpu.util.session import get_session_id_or_create_new
+from torchx_tpu.util.times import poll_intervals
 
 logger = logging.getLogger(__name__)
 
@@ -233,7 +235,10 @@ class Runner:
 
     def status(self, app_handle: AppHandle) -> Optional[AppStatus]:
         """Current :class:`AppStatus` of the app, or None when the
-        scheduler no longer knows the id."""
+        scheduler no longer knows the id. Terminal failures carry the
+        scheduler's :class:`FailureClass` (``classify_failure`` hook), so
+        ``tpx status`` shows ``FAILED (preemption)`` when the backend can
+        tell."""
         scheduler, _, app_id = parse_app_handle(app_handle)
         sched = self._scheduler(scheduler)
         with log_event("status", scheduler, app_id, session=self._name):
@@ -247,17 +252,44 @@ class Runner:
                 structured_error_msg=desc.structured_error_msg,
                 ui_url=desc.ui_url,
                 roles=desc.roles_statuses,
+                failure_class=sched.classify_failure(desc),
             )
 
     def wait(
-        self, app_handle: AppHandle, wait_interval: float = 10
+        self,
+        app_handle: AppHandle,
+        wait_interval: float = 10,
+        timeout: Optional[float] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
     ) -> Optional[AppStatus]:
-        """Block until the app reaches a terminal state."""
-        while True:
+        """Block until the app reaches a terminal state.
+
+        Polls with jittered incremental backoff (1s ramping up to
+        ``wait_interval``; see :func:`~torchx_tpu.util.times.poll_intervals`)
+        so short jobs return fast without hammering the control plane on
+        long ones. ``timeout`` (seconds) raises :class:`TimeoutError` if no
+        terminal state arrives in time — the app keeps running. ``sleep``
+        and ``rng`` are injectable for deterministic tests."""
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        for interval in poll_intervals(
+            initial=min(1.0, wait_interval), max_interval=wait_interval, rng=rng
+        ):
             status = self.status(app_handle)
             if status is None or status.is_terminal():
                 return status
-            time.sleep(wait_interval)
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"app {app_handle} still {status.state} after"
+                        f" {timeout}s"
+                    )
+                interval = min(interval, remaining)
+            sleep(interval)
+        raise AssertionError("unreachable: poll_intervals is infinite")
 
     def cancel(self, app_handle: AppHandle) -> None:
         """Stop the app but keep it describable (scheduler-side state and
@@ -311,6 +343,48 @@ class Runner:
                 timeout=timeout,
                 max_restarts=max_restarts,
             )
+
+    def supervise(
+        self,
+        dryrun_info: AppDryRunInfo,
+        policy: Optional[Any] = None,
+    ) -> Any:
+        """Run a dryrun under the preemption-aware supervisor: submit,
+        watch to terminal, classify the failure, and auto-resubmit within
+        the policy's per-class retry budgets, resuming from the latest
+        checkpoint step when the policy names a checkpoint dir. With
+        ``policy.elastic`` each attempt additionally runs the backend's
+        elastic watcher (:meth:`watch_elastic`). Blocks until success or
+        budget exhaustion; returns a
+        :class:`~torchx_tpu.supervisor.api.SupervisorResult`.
+
+        ``policy`` is a :class:`~torchx_tpu.supervisor.policy.SupervisorPolicy`
+        (default-constructed when omitted); typed ``Any`` here only to keep
+        the supervisor subsystem an optional import at runner load time."""
+        from torchx_tpu.supervisor.api import Supervisor
+
+        scheduler = dryrun_info._scheduler or ""
+        app = dryrun_info._app
+        with log_event(
+            "supervise",
+            scheduler,
+            app_image=app.roles[0].image if app and app.roles else None,
+            session=self._name,
+        ) as ev:
+            result = Supervisor(self, dryrun_info, policy).run()
+            if result.handle:
+                _, _, app_id = parse_app_handle(result.handle)
+                ev._event.app_id = app_id
+            ev._event.app_metadata = {
+                "attempts": result.attempts,
+                "succeeded": result.succeeded,
+                "budget_exhausted": (
+                    str(result.budget_exhausted)
+                    if result.budget_exhausted
+                    else None
+                ),
+            }
+            return result
 
     def describe(self, app_handle: AppHandle) -> Optional[AppDef]:
         """Best-effort reconstruction of the AppDef from the backend."""

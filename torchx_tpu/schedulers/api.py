@@ -19,6 +19,7 @@ from torchx_tpu.specs.api import (
     AppDryRunInfo,
     AppState,
     CfgVal,
+    FailureClass,
     Role,
     RoleStatus,
     runopts,
@@ -35,7 +36,13 @@ class Stream(str, Enum):
 
 @dataclass
 class DescribeAppResponse:
-    """Scheduler's view of a submitted app (reference api.py:330-345)."""
+    """Scheduler's view of a submitted app (reference api.py:330-345).
+
+    ``failure_class`` carries the backend's classification of a terminal
+    failure when the describe payload itself reveals it (spot reclamation,
+    node disruption); :meth:`Scheduler.classify_failure` reads it before
+    falling back to the conservative default.
+    """
 
     app_id: str = "<NOT_SET>"
     state: AppState = AppState.UNSUBMITTED
@@ -45,6 +52,7 @@ class DescribeAppResponse:
     ui_url: Optional[str] = None
     roles_statuses: list[RoleStatus] = None  # type: ignore[assignment]
     roles: list[Role] = None  # type: ignore[assignment]
+    failure_class: Optional[FailureClass] = None
 
     def __post_init__(self) -> None:
         if self.roles_statuses is None:
@@ -226,6 +234,24 @@ class Scheduler(ABC, Generic[T]):
     def exists(self, app_id: str) -> bool:
         """True when the backend still knows ``app_id``."""
         return self.describe(app_id) is not None
+
+    def classify_failure(
+        self, resp: DescribeAppResponse
+    ) -> Optional[FailureClass]:
+        """Classify a terminal failure for retry policy (supervisor hook).
+
+        Returns None for non-failure states. The default is conservative:
+        PREEMPTED maps to PREEMPTION, everything else that FAILED is an APP
+        failure unless the backend's describe already attached a more
+        specific ``failure_class`` (retrying a buggy app by default burns
+        money; backends that can tell infra faults apart override this or
+        populate the response field).
+        """
+        if resp.state == AppState.PREEMPTED:
+            return resp.failure_class or FailureClass.PREEMPTION
+        if resp.state == AppState.FAILED:
+            return resp.failure_class or FailureClass.APP
+        return None
 
     def cancel(self, app_id: str) -> None:
         """Stop the app if it exists (idempotent); state/logs remain

@@ -60,6 +60,7 @@ from torchx_tpu.specs.api import (
     BindMount,
     CfgVal,
     DeviceMount,
+    FailureClass,
     ReplicaStatus,
     Role,
     RoleStatus,
@@ -1338,6 +1339,66 @@ def jobset_state(jobset: Mapping[str, Any]) -> AppState:
     return AppState.PENDING if status else AppState.SUBMITTED
 
 
+# Pod DisruptionTarget reasons that mean the NODE (not the app) ended the
+# pod: spot/preemptible reclaim, node drain, taint eviction, shutdown.
+# Any DisruptionTarget=True condition is infra-initiated; the reason set
+# here is what GKE emits for TPU spot reclaim and maintenance drains.
+_DISRUPTION_REASONS = frozenset(
+    {
+        "PreemptionByScheduler",
+        "PreemptionByKubeScheduler",
+        "TerminationByKubelet",
+        "DeletionByTaintManager",
+        "EvictionByEvictionAPI",
+        "NodeShutdown",
+    }
+)
+
+
+def _pod_disruption_reason(pods: Iterable[Mapping[str, Any]]) -> Optional[str]:
+    """First node-disruption condition found across the app's pods, or None.
+
+    GKE marks pods killed by spot reclaim / node drain with a
+    ``DisruptionTarget`` condition (status=True); the reason distinguishes
+    scheduler preemption from kubelet/node-shutdown termination. Pod dicts
+    come from the k8s client's ``to_dict()`` (snake_case) or raw watch
+    events (camelCase); both shapes are read."""
+    for pod in pods:
+        status = pod.get("status") or {}
+        for cond in status.get("conditions") or []:
+            if cond.get("type") != "DisruptionTarget":
+                continue
+            if str(cond.get("status")) != "True":
+                continue
+            return str(cond.get("reason") or "DisruptionTarget")
+    return None
+
+
+def classify_jobset_failure(
+    jobset: Mapping[str, Any], pods: list[Mapping[str, Any]]
+) -> tuple[AppState, Optional[FailureClass], str]:
+    """-> (state, failure_class, note) for a FAILED JobSet.
+
+    A JobSet reports Failed for both "the container exited 1" and "the
+    spot node under it vanished"; the retry decision needs them apart.
+    Node-disruption pod conditions (and preemption-shaped Failed-condition
+    messages) reclassify to PREEMPTED/PREEMPTION; everything else stays
+    FAILED with the conservative APP class."""
+    reason = _pod_disruption_reason(pods)
+    if reason is not None:
+        return (
+            AppState.PREEMPTED,
+            FailureClass.PREEMPTION,
+            f"node disruption: {reason}",
+        )
+    for cond in (jobset.get("status") or {}).get("conditions") or []:
+        if cond.get("type") == "Failed" and cond.get("status") == "True":
+            text = f"{cond.get('reason', '')} {cond.get('message', '')}"
+            if re.search(r"preempt|node (was )?(deleted|shut ?down)|spot", text, re.I):
+                return AppState.PREEMPTED, FailureClass.PREEMPTION, text.strip()
+    return AppState.FAILED, FailureClass.APP, ""
+
+
 def _role_completions(jobset: Mapping[str, Any]) -> dict[str, int]:
     """replicatedJob name -> completions (hosts per slice), from the spec."""
     out: dict[str, int] = {}
@@ -1353,6 +1414,10 @@ def describe_jobset(
     jobset: Mapping[str, Any], pods: list[Mapping[str, Any]]
 ) -> DescribeAppResponse:
     state = jobset_state(jobset)
+    failure_class: Optional[FailureClass] = None
+    failure_note = ""
+    if state == AppState.FAILED:
+        state, failure_class, failure_note = classify_jobset_failure(jobset, pods)
     status = jobset.get("status") or {}
     completions = _role_completions(jobset)
     roles: dict[str, RoleStatus] = {}
@@ -1397,7 +1462,9 @@ def describe_jobset(
         f"{jobset.get('metadata', {}).get('name')}",
         state=state,
         num_restarts=restarts,
+        msg=failure_note,
         roles_statuses=sorted(roles.values(), key=lambda r: r.role),
+        failure_class=failure_class,
     )
 
 

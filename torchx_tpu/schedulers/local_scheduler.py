@@ -980,6 +980,18 @@ class LocalScheduler(Scheduler[PopenRequest]):
                 except (ProcessLookupError, PermissionError):
                     pass
 
+    def delete(self, app_id: str) -> None:
+        """Cancel (if still running) and forget the app entirely: the
+        session cache, the external-dir cache, and the per-user registry
+        entry — ``exists``/``describe``/``list`` stop reporting it. Log
+        files on disk are left for the operator to reclaim."""
+        self.cancel(app_id)
+        self._apps.pop(app_id, None)
+        self._external_dirs.pop(app_id, None)
+        from torchx_tpu.util import registry
+
+        registry.remove(_registry_path(), app_id)
+
     def resize(self, app_id: str, role_name: str, num_replicas: int) -> None:
         """Manual gang resize (grow or shrink) — the operator-driven
         counterpart of ``_try_elastic_restart``'s shrink-on-failure. The

@@ -51,6 +51,7 @@ from torchx_tpu.specs.api import (
     AppDryRunInfo,
     AppState,
     CfgVal,
+    FailureClass,
     ReplicaStatus,
     RoleStatus,
     macros,
@@ -529,20 +530,85 @@ class _RemoteLogIterator:
             _time.sleep(self._poll)
 
 
+# spot reclamation / host-event markers in queued-resource error messages
+_QR_PREEMPTION_RE = re.compile(
+    r"preempt|reclaim|spot\s+(instance|capacity|vm).*(terminat|delet)|maintenance event",
+    re.I,
+)
+def _qr_is_spot(data: Mapping[str, Any]) -> bool:
+    """Whether the queued resource runs on reclaimable capacity (created
+    with --spot / best-effort, or nodes with a preemptible/spot
+    schedulingConfig)."""
+    if "spot" in data or "bestEffort" in data or "best_effort" in data:
+        return True
+    for spec in (data.get("tpu") or {}).get("nodeSpec") or []:
+        sc = ((spec.get("node") or {}).get("schedulingConfig")) or {}
+        if sc.get("spot") or sc.get("preemptible"):
+            return True
+    return False
+
+
+def _qr_error_message(data: Mapping[str, Any]) -> str:
+    """Flatten every error message the QR state carries (state.failedData
+    plus per-node provisioningData errors) into one searchable string."""
+    state = data.get("state") or {}
+    parts = []
+    failed = state.get("failedData") or {}
+    err = failed.get("error") or {}
+    if err.get("message"):
+        parts.append(str(err["message"]))
+    for key in ("stateInitiator", "state_initiator"):
+        if state.get(key):
+            parts.append(str(state[key]))
+    return " | ".join(parts)
+
+
+def classify_queued_resource(
+    data: Mapping[str, Any],
+) -> tuple[AppState, Optional[FailureClass]]:
+    """-> (AppState, FailureClass) for a queued-resource describe payload.
+
+    The TPU-specific failure semantics:
+
+    * a **spot** QR collapsing to SUSPENDING/SUSPENDED after being ACTIVE
+      means Cloud TPU reclaimed the capacity — that attempt is over
+      (PREEMPTED), not merely pending;
+    * a FAILED QR is a *control-plane* outcome (provisioning never
+      succeeded — the user workload cannot fail the QR), so the default
+      class is INFRA, upgraded to PREEMPTION when the error message names
+      a reclamation.
+    """
+    state_str = ((data.get("state") or {}).get("state")) or ""
+    state = QR_STATE_MAP.get(state_str, AppState.UNKNOWN)
+    if state_str in ("SUSPENDING", "SUSPENDED") and _qr_is_spot(data):
+        return AppState.PREEMPTED, FailureClass.PREEMPTION
+    if state_str == "FAILED":
+        msg = _qr_error_message(data)
+        if _QR_PREEMPTION_RE.search(msg):
+            return AppState.PREEMPTED, FailureClass.PREEMPTION
+        return state, FailureClass.INFRA
+    return state, None
+
+
 def describe_queued_resource(
     app_id: str, data: Mapping[str, Any]
 ) -> DescribeAppResponse:
     state_str = ((data.get("state") or {}).get("state")) or ""
-    state = QR_STATE_MAP.get(state_str, AppState.UNKNOWN)
+    state, failure_class = classify_queued_resource(data)
     role = RoleStatus(role="tpu")
     nodes = (data.get("tpu") or {}).get("nodeSpec") or []
     for i, _ in enumerate(nodes or [None]):
         role.replicas.append(ReplicaStatus(id=i, state=state, role="tpu"))
+    msg = state_str
+    err = _qr_error_message(data)
+    if err:
+        msg = f"{state_str}: {err}" if state_str else err
     return DescribeAppResponse(
         app_id=app_id,
         state=state,
-        msg=state_str,
+        msg=msg,
         roles_statuses=[role],
+        failure_class=failure_class,
     )
 
 

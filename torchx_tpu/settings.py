@@ -74,6 +74,16 @@ ENV_TPX_ERROR_FILE = "TPX_ERROR_FILE"
 # Per-replica log directory.
 ENV_TPX_LOG_DIR = "TPX_LOG_DIR"
 
+# Checkpoint step a resubmitted (supervised) run should resume from. The
+# supervisor injects it from the checkpoint manifest before every
+# resubmission; Checkpointer.resume_step_from_env() is the in-job reader.
+ENV_TPX_RESUME_STEP = "TPX_RESUME_STEP"
+
+# Manifest file the Checkpointer maintains next to its step dirs: a small
+# JSON record of the latest finalized step, readable by the client-side
+# supervisor WITHOUT importing jax/orbax (see supervisor/api.py).
+CHECKPOINT_MANIFEST = "MANIFEST.json"
+
 # Experiment tracking (reference analog: TORCHX_TRACKERS family,
 # torchx/tracker/api.py:209-239).
 ENV_TPX_TRACKERS = "TPX_TRACKERS"

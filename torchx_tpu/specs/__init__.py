@@ -26,6 +26,7 @@ from torchx_tpu.specs.api import (  # noqa: F401
     BindMount,
     CfgVal,
     DeviceMount,
+    FailureClass,
     InvalidRunConfigException,
     MalformedAppHandleException,
     MountType,

@@ -522,7 +522,13 @@ class AppDef:
 
 
 class AppState(int, Enum):
-    """Lifecycle states (reference analog: torchx/specs/api.py:529-560)."""
+    """Lifecycle states (reference analog: torchx/specs/api.py:529-560).
+
+    PREEMPTED is the TPU-first addition: spot/queued capacity was reclaimed
+    by the provider. It is terminal for the *attempt* (the gang is gone) but
+    retryable by policy — the supervisor treats it as its own failure class
+    with its own budget (see :mod:`torchx_tpu.supervisor`).
+    """
 
     UNSUBMITTED = 0
     SUBMITTED = 1
@@ -532,16 +538,48 @@ class AppState(int, Enum):
     FAILED = 5
     CANCELLED = 6
     UNKNOWN = 7
+    PREEMPTED = 8
 
     def __str__(self) -> str:
         return self.name
 
 
+class FailureClass(str, Enum):
+    """Why a terminal attempt failed — the retry-decision signal.
+
+    Schedulers classify failures via :meth:`Scheduler.classify_failure`
+    (populated from backend detail: spot-reclamation markers, node
+    disruption conditions); the supervisor keeps an independent retry
+    budget per class.
+
+    PREEMPTION: the provider took the capacity back (spot reclaim, node
+        drain/disruption). Always worth retrying — nothing is wrong with
+        the app.
+    INFRA: the control plane failed the attempt (stockout, provisioning
+        error, scheduler fault). Retryable a few times.
+    APP: the application itself exited non-zero. The conservative default
+        for unclassifiable failures — retrying a buggy app burns money.
+    """
+
+    PREEMPTION = "PREEMPTION"
+    INFRA = "INFRA"
+    APP = "APP"
+
+    def __str__(self) -> str:
+        return self.value
+
+
 _TERMINAL_STATES = frozenset(
-    (AppState.SUCCEEDED, AppState.FAILED, AppState.CANCELLED)
+    (AppState.SUCCEEDED, AppState.FAILED, AppState.CANCELLED, AppState.PREEMPTED)
 )
 _STARTED_STATES = frozenset(
-    (AppState.RUNNING, AppState.SUCCEEDED, AppState.FAILED, AppState.CANCELLED)
+    (
+        AppState.RUNNING,
+        AppState.SUCCEEDED,
+        AppState.FAILED,
+        AppState.CANCELLED,
+        AppState.PREEMPTED,
+    )
 )
 
 
@@ -578,6 +616,10 @@ class AppStatus:
     ``structured_error_msg`` carries the JSON error file content written by
     the first failed replica (see settings.ENV_TPX_ERROR_FILE); ``format()``
     pretty-prints it (reference analog: specs/api.py:596-778).
+
+    ``failure_class`` is the scheduler's classification of *why* a terminal
+    failure happened (:class:`FailureClass`), when known — ``tpx status``
+    then shows ``FAILED (preemption)`` instead of a bare FAILED.
     """
 
     state: AppState
@@ -586,9 +628,19 @@ class AppStatus:
     structured_error_msg: str = NONE
     ui_url: Optional[str] = None
     roles: list[RoleStatus] = field(default_factory=list)
+    failure_class: Optional[FailureClass] = None
 
     def is_terminal(self) -> bool:
         return is_terminal(self.state)
+
+    def _state_str(self) -> str:
+        """State plus failure classification when known: ``FAILED (preemption)``."""
+        if self.failure_class is not None and self.state in (
+            AppState.FAILED,
+            AppState.PREEMPTED,
+        ):
+            return f"{self.state} ({self.failure_class.value.lower()})"
+        return str(self.state)
 
     def raise_for_status(self) -> None:
         if self.state != AppState.SUCCEEDED:
@@ -627,9 +679,15 @@ class AppStatus:
 
             return c(state.name, state_color(state.name))
 
+        top = paint(self.state)
+        if self.failure_class is not None and self.state in (
+            AppState.FAILED,
+            AppState.PREEMPTED,
+        ):
+            top = f"{top} ({self.failure_class.value.lower()})"
         lines = [
             f"AppStatus:",
-            f"  state: {paint(self.state)}",
+            f"  state: {top}",
             f"  num_restarts: {self.num_restarts}",
         ]
         if self.msg:
@@ -648,7 +706,10 @@ class AppStatus:
         return "\n".join(lines)
 
     def __str__(self) -> str:
-        return f"AppStatus(state={self.state}, num_restarts={self.num_restarts}, msg={self.msg!r})"
+        return (
+            f"AppStatus(state={self._state_str()},"
+            f" num_restarts={self.num_restarts}, msg={self.msg!r})"
+        )
 
 
 class AppStatusError(Exception):

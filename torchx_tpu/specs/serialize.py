@@ -2,7 +2,9 @@
 
 Powers ``tpx run --stdin`` (reference analog: JSON job-spec mode,
 cli/cmd_run.py:366-399) and programmatic job submission from non-Python
-clients: an AppDef round-trips through a stable JSON shape.
+clients: an AppDef round-trips through a stable JSON shape. Also home of
+the :class:`~torchx_tpu.supervisor.policy.SupervisorPolicy` round-trip
+backing ``tpx supervise --policy policy.json``.
 """
 
 from __future__ import annotations
@@ -136,3 +138,34 @@ def appdef_from_dict(data: Mapping[str, Any]) -> AppDef:
         roles=roles,
         metadata=dict(data.get("metadata") or {}),
     )
+
+
+# =========================================================================
+# SupervisorPolicy <-> dict (supervisor imported lazily: specs is the
+# foundation layer and must not depend on the supervisor at import time)
+# =========================================================================
+
+
+def supervisor_policy_to_dict(policy: Any) -> dict[str, Any]:
+    """-> a JSON-safe dict of every :class:`SupervisorPolicy` field."""
+    from dataclasses import asdict
+
+    return asdict(policy)
+
+
+def supervisor_policy_from_dict(data: Mapping[str, Any]) -> Any:
+    """Build a :class:`SupervisorPolicy` from a (possibly partial) dict;
+    unknown keys raise so a typo'd policy file fails loudly instead of
+    silently running with defaults."""
+    from dataclasses import fields
+
+    from torchx_tpu.supervisor.policy import SupervisorPolicy
+
+    known = {f.name for f in fields(SupervisorPolicy)}
+    unknown = set(data) - known
+    if unknown:
+        raise ValueError(
+            f"unknown supervisor policy keys {sorted(unknown)};"
+            f" valid keys: {sorted(known)}"
+        )
+    return SupervisorPolicy(**dict(data))

@@ -1,0 +1,29 @@
+"""Preemption-aware job supervisor: auto-resubmit with retry budgets,
+capped exponential backoff, and checkpoint-resume wiring.
+
+The missing layer between "the scheduler restarts replicas inside one job"
+(RetryPolicy / JobSet failurePolicy) and "the operator resubmits the job by
+hand": a client-side loop that watches one app to a terminal state,
+classifies *why* it died (:class:`~torchx_tpu.specs.api.FailureClass`),
+and — within independent per-class budgets — re-materializes the original
+:class:`~torchx_tpu.specs.api.AppDryRunInfo` and submits a fresh attempt,
+telling it which checkpoint step to resume from. See
+:class:`~torchx_tpu.supervisor.api.Supervisor` for the state machine and
+:class:`~torchx_tpu.supervisor.policy.SupervisorPolicy` for the knobs.
+"""
+
+from torchx_tpu.supervisor.api import (
+    Supervisor,
+    SupervisorResult,
+    latest_checkpoint_step,
+    supervise,
+)
+from torchx_tpu.supervisor.policy import SupervisorPolicy
+
+__all__ = [
+    "Supervisor",
+    "SupervisorPolicy",
+    "SupervisorResult",
+    "latest_checkpoint_step",
+    "supervise",
+]

@@ -1,0 +1,307 @@
+"""The supervisor engine: babysit one app through preemptions to success.
+
+Scheduler-agnostic by construction — it only speaks the Runner/Scheduler
+contract (``schedule`` / ``status`` / ``cancel``), consumes the
+:class:`~torchx_tpu.specs.api.FailureClass` the backends attach to terminal
+states, and re-materializes fresh submissions from the attempt's
+:class:`~torchx_tpu.specs.api.AppDryRunInfo`. The loop:
+
+    SUBMITTED -> poll -> terminal?
+        SUCCEEDED / CANCELLED          -> done
+        PREEMPTED / FAILED (classified) -> budget left?
+            yes -> backoff -> inject resume step -> resubmit
+            no  -> give up (final status stands)
+
+Checkpoint resume is wired through the jax-free manifest sidecar
+(:data:`~torchx_tpu.settings.CHECKPOINT_MANIFEST`): this module runs on
+the client and must never import jax/orbax, so it reads the JSON the
+in-job :class:`~torchx_tpu.parallel.checkpoint.Checkpointer` maintains and
+falls back to scanning the step layout on disk.
+
+Every transition emits a :class:`~torchx_tpu.runner.events.api.TpxEvent`
+(``api="supervise"``) with the transition name, attempt number, failure
+class, and resume step in ``app_metadata`` — the audit trail for "why did
+my job restart at 3am".
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import os
+import random
+import re
+import time
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Callable, Optional
+
+from torchx_tpu import settings
+from torchx_tpu.runner.events import record
+from torchx_tpu.runner.events.api import TpxEvent
+from torchx_tpu.specs.api import (
+    AppDryRunInfo,
+    AppHandle,
+    AppState,
+    AppStatus,
+    FailureClass,
+    parse_app_handle,
+)
+from torchx_tpu.supervisor.policy import SupervisorPolicy
+from torchx_tpu.util.times import poll_intervals
+
+if TYPE_CHECKING:  # import cycle: runner.api imports specs, we import runner
+    from torchx_tpu.runner.api import Runner
+
+logger = logging.getLogger(__name__)
+
+
+def latest_checkpoint_step(directory: str) -> Optional[int]:
+    """Newest checkpoint step under ``directory``, or None, WITHOUT
+    importing jax/orbax (this runs on the client).
+
+    Prefers the ``MANIFEST.json`` sidecar the in-job Checkpointer writes;
+    falls back to scanning the on-disk step layout (orbax digit-named step
+    dirs, ``step_N.pkl`` pickle files) for checkpoints written by older
+    jobs that predate the manifest. ``.corrupt``-quarantined steps never
+    match either pattern."""
+    manifest = os.path.join(directory, settings.CHECKPOINT_MANIFEST)
+    try:
+        with open(manifest) as f:
+            step = json.load(f).get("latest_step")
+        if isinstance(step, int):
+            return step
+    except (OSError, ValueError):
+        pass
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return None
+    steps = []
+    for name in entries:
+        if name.isdigit() and os.path.isdir(os.path.join(directory, name)):
+            steps.append(int(name))
+        elif m := re.fullmatch(r"step_(\d+)\.pkl", name):
+            steps.append(int(m.group(1)))
+    return max(steps, default=None)
+
+
+@dataclass
+class SupervisorResult:
+    """Outcome of one :meth:`Supervisor.run`: the final status plus the
+    full attempt history for reporting and tests."""
+
+    #: status of the last attempt (terminal), or None if it vanished.
+    status: Optional[AppStatus]
+    #: handle of every attempt, oldest first; the last one is the survivor.
+    handles: list[AppHandle] = field(default_factory=list)
+    #: total submissions (== len(handles)).
+    attempts: int = 0
+    #: resubmissions consumed per failure class.
+    retries: dict[FailureClass, int] = field(default_factory=dict)
+    #: checkpoint step injected on each resubmit (None = fresh start).
+    resume_steps: list[Optional[int]] = field(default_factory=list)
+    #: set when a retry budget ran out and the failure stood.
+    budget_exhausted: Optional[FailureClass] = None
+
+    @property
+    def handle(self) -> Optional[AppHandle]:
+        """Handle of the final attempt."""
+        return self.handles[-1] if self.handles else None
+
+    @property
+    def succeeded(self) -> bool:
+        """True iff the final attempt reached SUCCEEDED."""
+        return self.status is not None and self.status.state == AppState.SUCCEEDED
+
+
+class Supervisor:
+    """Drives one :class:`~torchx_tpu.specs.api.AppDryRunInfo` to completion
+    under a :class:`~torchx_tpu.supervisor.policy.SupervisorPolicy`.
+
+    Construct with a live :class:`~torchx_tpu.runner.api.Runner` (the
+    session that produced the dryrun) and call :meth:`run`. ``sleep`` and
+    ``rng`` are injectable for tests — a scripted fake scheduler plus a
+    recording sleep makes the whole state machine deterministic."""
+
+    def __init__(
+        self,
+        runner: "Runner",
+        dryrun_info: AppDryRunInfo,
+        policy: Optional[SupervisorPolicy] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        if dryrun_info._app is None or not dryrun_info._scheduler:
+            raise ValueError(
+                "dryrun_info was not produced by Runner.dryrun/materialize_dryrun"
+                " (missing _app/_scheduler); the supervisor cannot resubmit it"
+            )
+        self._runner = runner
+        self._dryrun_info = dryrun_info
+        self._policy = policy or SupervisorPolicy()
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+
+    # -- event plumbing ----------------------------------------------------
+
+    def _emit(
+        self, transition: str, app_id: Optional[str], **metadata: object
+    ) -> None:
+        record(
+            TpxEvent(
+                session=self._runner._name,
+                scheduler=self._dryrun_info._scheduler or "",
+                api="supervise",
+                app_id=app_id,
+                app_metadata={"transition": transition, **metadata},
+            )
+        )
+
+    # -- attempt mechanics -------------------------------------------------
+
+    def _submit(self, attempt: int, resume_step: Optional[int]) -> AppHandle:
+        """Re-materialize and submit one attempt. Works on a deep copy of
+        the original AppDef (resume env must not accumulate across
+        attempts) and goes through the scheduler's own materialize so each
+        attempt gets a fresh unique app id."""
+        info = self._dryrun_info
+        app = copy.deepcopy(info._app)
+        assert app is not None  # checked in __init__
+        if resume_step is not None:
+            for role in app.roles:
+                role.env[self._policy.resume_env] = str(resume_step)
+        sched = self._runner._scheduler(info._scheduler)
+        new_info = sched.materialize_dryrun(app, info._cfg or {})
+        handle = self._runner.schedule(new_info)
+        _, _, app_id = parse_app_handle(handle)
+        self._emit(
+            "submitted",
+            app_id,
+            attempt=attempt,
+            resume_step=resume_step,
+        )
+        return handle
+
+    def _await_terminal(self, handle: AppHandle) -> Optional[AppStatus]:
+        """Block until the attempt reaches a terminal state (or vanishes).
+
+        With ``policy.elastic`` the backend's elastic watcher runs first —
+        in-attempt shrink-restarts are its job; only the attempt's terminal
+        outcome comes back to the supervisor."""
+        if self._policy.elastic:
+            try:
+                self._runner.watch_elastic(
+                    handle, poll_interval=self._policy.poll_interval
+                )
+            except ValueError:
+                logger.debug(
+                    "backend has no elastic watcher; falling back to polling"
+                )
+        return self._runner.wait(
+            handle, wait_interval=self._policy.poll_interval, rng=self._rng,
+            sleep=self._sleep,
+        )
+
+    # -- the state machine -------------------------------------------------
+
+    def run(self) -> SupervisorResult:
+        """Run attempts until SUCCEEDED/CANCELLED, a budget is exhausted,
+        or the app vanishes from its scheduler; returns the full
+        :class:`SupervisorResult` history."""
+        policy = self._policy
+        retries: dict[FailureClass, int] = {fc: 0 for fc in FailureClass}
+        result = SupervisorResult(status=None, retries=retries)
+
+        resume_step: Optional[int] = None
+        attempt = 1
+        handle = self._submit(attempt, resume_step)
+        result.handles.append(handle)
+        result.resume_steps.append(resume_step)
+        result.attempts = 1
+
+        while True:
+            status = self._await_terminal(handle)
+            result.status = status
+            _, _, app_id = parse_app_handle(handle)
+            if status is None:
+                # the scheduler forgot the app (expired / deleted from
+                # under us); resubmitting blind could double-run — stop.
+                self._emit("vanished", app_id, attempt=attempt)
+                logger.warning("app %s vanished from its scheduler", app_id)
+                return result
+            if status.state in (AppState.SUCCEEDED, AppState.CANCELLED):
+                self._emit(
+                    "finished",
+                    app_id,
+                    attempt=attempt,
+                    state=str(status.state),
+                )
+                return result
+
+            # terminal failure: classify conservatively (APP) when the
+            # backend attached nothing
+            fclass = status.failure_class or FailureClass.APP
+            retries[fclass] += 1
+            budget = policy.budget_for(fclass)
+            if retries[fclass] > budget:
+                retries[fclass] = budget  # report consumed, not attempted
+                result.budget_exhausted = fclass
+                self._emit(
+                    "budget_exhausted",
+                    app_id,
+                    attempt=attempt,
+                    failure_class=str(fclass),
+                    budget=budget,
+                    state=str(status.state),
+                )
+                logger.error(
+                    "app %s: %s budget (%d) exhausted; final state %s",
+                    app_id,
+                    fclass,
+                    budget,
+                    status.state,
+                )
+                return result
+
+            delay = policy.backoff_delay(retries[fclass], rng=self._rng)
+            if policy.checkpoint_dir:
+                resume_step = latest_checkpoint_step(policy.checkpoint_dir)
+            self._emit(
+                "resubmitting",
+                app_id,
+                attempt=attempt,
+                failure_class=str(fclass),
+                retry=retries[fclass],
+                budget=budget,
+                backoff_seconds=round(delay, 3),
+                resume_step=resume_step,
+                state=str(status.state),
+            )
+            logger.info(
+                "app %s %s (%s); retry %d/%d in %.1fs%s",
+                app_id,
+                status.state,
+                fclass,
+                retries[fclass],
+                budget,
+                delay,
+                f", resuming from step {resume_step}"
+                if resume_step is not None
+                else "",
+            )
+            self._sleep(delay)
+            attempt += 1
+            handle = self._submit(attempt, resume_step)
+            result.handles.append(handle)
+            result.resume_steps.append(resume_step)
+            result.attempts = attempt
+
+
+def supervise(
+    runner: "Runner",
+    dryrun_info: AppDryRunInfo,
+    policy: Optional[SupervisorPolicy] = None,
+) -> SupervisorResult:
+    """Convenience wrapper: build a :class:`Supervisor` and :meth:`run` it."""
+    return Supervisor(runner, dryrun_info, policy).run()

@@ -1,0 +1,107 @@
+"""Retry policy for the supervisor: per-failure-class budgets and backoff.
+
+The central design decision (SURVEY §5, BASELINE config 4): preemptions,
+infra failures, and app failures are NOT the same event and must not share
+one retry counter. A spot v5e slice may be reclaimed a dozen times over a
+long run — that is the product working as priced, and resubmitting is free
+progress as long as checkpoints land. An app bug, on the other hand, will
+fail deterministically forever; resubmitting it burns quota. So each
+:class:`~torchx_tpu.specs.api.FailureClass` gets its own budget, with
+defaults tilted accordingly (many preemptions, a few infra retries, zero
+app retries).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+from torchx_tpu import settings
+from torchx_tpu.specs.api import FailureClass
+
+
+@dataclass
+class SupervisorPolicy:
+    """Knobs for one supervised run; round-trips through
+    :func:`torchx_tpu.specs.serialize.supervisor_policy_to_dict` so the CLI
+    can load it from a JSON file.
+
+    Budgets are *independent*: ``max_preemptions=8`` and
+    ``max_app_retries=0`` means the eighth spot reclaim still resubmits,
+    while the first genuine application error stays FAILED.
+    """
+
+    # -- retry budgets, one per FailureClass -------------------------------
+    #: resubmissions allowed after spot/preemption reclaims.
+    max_preemptions: int = 8
+    #: resubmissions allowed after control-plane / node failures.
+    max_infra_retries: int = 3
+    #: resubmissions allowed after application (exit-code) failures.
+    #: Default 0: an app bug fails deterministically; retrying burns quota.
+    max_app_retries: int = 0
+
+    # -- capped exponential backoff between resubmissions ------------------
+    #: first delay before a resubmit, seconds.
+    backoff_seconds: float = 5.0
+    #: multiplier applied per consecutive retry of the same class.
+    backoff_factor: float = 2.0
+    #: ceiling on any single delay, seconds.
+    backoff_max_seconds: float = 300.0
+    #: ± fraction of random perturbation applied to every delay so many
+    #: supervisors recovering from one zone-wide event decorrelate.
+    jitter: float = 0.1
+
+    # -- monitoring --------------------------------------------------------
+    #: cap on the jittered incremental poll interval while an attempt runs.
+    poll_interval: float = 10.0
+    #: run the elastic watcher (shrink-on-failure) during each attempt when
+    #: the backend has one, instead of plain status polling.
+    elastic: bool = False
+
+    # -- checkpoint resume -------------------------------------------------
+    #: client-visible checkpoint directory to read the step manifest from;
+    #: None disables resume injection (the app's own restore_latest still
+    #: applies in-job).
+    checkpoint_dir: Optional[str] = None
+    #: env var injected into every role with the resume step.
+    resume_env: str = field(default=settings.ENV_TPX_RESUME_STEP)
+
+    def __post_init__(self) -> None:
+        for name in ("max_preemptions", "max_infra_retries", "max_app_retries"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0, got {getattr(self, name)}")
+        if self.backoff_seconds < 0 or self.backoff_max_seconds < 0:
+            raise ValueError("backoff delays must be >= 0")
+        if self.backoff_factor < 1.0:
+            raise ValueError(
+                f"backoff_factor must be >= 1.0, got {self.backoff_factor}"
+            )
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
+        if self.poll_interval <= 0:
+            raise ValueError(f"poll_interval must be > 0, got {self.poll_interval}")
+
+    def budget_for(self, failure_class: FailureClass) -> int:
+        """The retry budget governing one failure class."""
+        return {
+            FailureClass.PREEMPTION: self.max_preemptions,
+            FailureClass.INFRA: self.max_infra_retries,
+            FailureClass.APP: self.max_app_retries,
+        }[failure_class]
+
+    def backoff_delay(
+        self, retry_number: int, rng: Optional[random.Random] = None
+    ) -> float:
+        """Jittered delay (seconds) before retry ``retry_number`` (1-based
+        count of consecutive retries for the failing class): capped
+        exponential ``backoff_seconds * factor**(n-1)``, perturbed by
+        ±``jitter``. A seeded ``rng`` makes tests deterministic."""
+        if retry_number < 1:
+            raise ValueError(f"retry_number must be >= 1, got {retry_number}")
+        base = min(
+            self.backoff_seconds * self.backoff_factor ** (retry_number - 1),
+            self.backoff_max_seconds,
+        )
+        r = rng or random
+        return max(0.0, base * (1.0 + r.uniform(-self.jitter, self.jitter)))

@@ -33,6 +33,7 @@ def state_color(state_name: str) -> str:
         "SUCCEEDED": "green",
         "FAILED": "red",
         "CANCELLED": "yellow",
+        "PREEMPTED": "yellow",
         "PENDING": "cyan",
         "SUBMITTED": "cyan",
     }.get(state_name, "gray")

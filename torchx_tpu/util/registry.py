@@ -61,6 +61,38 @@ def _compact(path: str, keep: Callable[[str], bool]) -> None:
         raise
 
 
+def remove(path: str, key: str) -> None:
+    """Drop every entry for ``key`` (idempotent; missing file is a no-op).
+    Rewrites through the same locked tmp + ``os.replace`` path as
+    compaction, so readers never observe a partial file."""
+    try:
+        with _locked(path):
+            if not os.path.exists(path):
+                return
+            with open(path) as f:
+                lines = f.readlines()
+            kept = [
+                ln for ln in lines if ln.partition(" = ")[0].strip() != key
+            ]
+            if len(kept) == len(lines):
+                return
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(path) or ".", prefix=".reg_"
+            )
+            try:
+                with os.fdopen(fd, "w") as f:
+                    f.writelines(kept)
+                os.replace(tmp, path)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+    except OSError as e:
+        logger.debug("could not remove %s from %s: %s", key, path, e)
+
+
 def lookup(path: str, key: str) -> Optional[str]:
     try:
         with open(path) as f:

@@ -1,14 +1,17 @@
 """Human time parsing for log windows (reference analog:
 torchx/util/datetime.py — generalized from day-granularity to the
-``--since 2h`` style every log CLI actually needs).
+``--since 2h`` style every log CLI actually needs), plus the shared
+jittered poll-interval generator used by ``Runner.wait`` and the
+supervisor loop.
 """
 
 from __future__ import annotations
 
 import math
+import random
 import re
 from datetime import datetime
-from typing import Optional
+from typing import Iterator, Optional
 
 _REL = re.compile(r"^(\d+)([smhdw])$")
 _UNITS = {"s": 1, "m": 60, "h": 3600, "d": 86400, "w": 604800}
@@ -43,3 +46,24 @@ def parse_when(value: Optional[str], now: Optional[float] = None) -> Optional[fl
             f"cannot parse time {value!r}; use epoch seconds, a relative"
             " window like 2h/30m/7d, or an ISO timestamp"
         ) from None
+
+
+def poll_intervals(
+    initial: float = 1.0,
+    factor: float = 1.5,
+    max_interval: float = 10.0,
+    jitter: float = 0.1,
+    rng: Optional[random.Random] = None,
+) -> Iterator[float]:
+    """Infinite stream of sleep intervals for a status-poll loop: starts at
+    ``initial`` seconds, grows by ``factor`` up to ``max_interval``, with
+    each value perturbed by ±``jitter`` fraction so a fleet of clients
+    polling the same control plane decorrelates instead of thundering.
+    Pass a seeded ``rng`` for deterministic tests."""
+    if initial <= 0:
+        raise ValueError(f"initial poll interval must be > 0, got {initial}")
+    rng = rng or random
+    interval = initial
+    while True:
+        yield max(0.0, interval * (1.0 + rng.uniform(-jitter, jitter)))
+        interval = min(interval * factor, max_interval)
