@@ -3,4 +3,27 @@
 # contributor (and CI) runs the same thing. Excludes tests marked `slow`
 # (registered in pyproject.toml); prints DOTS_PASSED and exits with
 # pytest's status.
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+# Observability smoke: run a local app under tracing and assert the JSONL
+# trace is written, parseable, and renderable by `tpx trace`.
+obs_dir=$(mktemp -d /tmp/tpx_obs_smoke.XXXXXX)
+if timeout -k 10 120 env JAX_PLATFORMS=cpu TPX_OBS_DIR="$obs_dir" \
+    python - <<'EOF'
+import glob, json, os, sys
+from torchx_tpu.cli.main import main
+from torchx_tpu.obs import timeline
+
+main(["run", "-s", "local", "--wait", "utils.echo", "--msg", "obs-smoke"])
+paths = glob.glob(os.path.join(os.environ["TPX_OBS_DIR"], "*", "trace.jsonl"))
+assert paths, "no trace.jsonl written"
+records = [json.loads(l) for p in paths for l in open(p) if l.strip()]
+spans = [r for r in records if timeline.is_span(r)]
+assert any(s["name"] == "runner.run_component" for s in spans), spans
+app_ids = {s["attrs"]["app_id"] for s in spans if "app_id" in s.get("attrs", {})}
+assert app_ids, "no span carries an app_id"
+main(["trace", app_ids.pop(), "--metrics"])
+EOF
+then echo "OBS_SMOKE=ok"; else echo "OBS_SMOKE=FAILED"; rc=1; fi
+rm -rf "$obs_dir"
+exit $rc

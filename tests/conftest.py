@@ -29,7 +29,8 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True)
 def _isolated_registries(tmp_path, monkeypatch):
     """Keep per-user registry files (~/.tpx_local_apps, ~/.tpxslurmjobdirs)
-    out of the real home during tests."""
+    and the obs trace/metrics sinks out of the real home during tests."""
+    monkeypatch.setenv("TPX_OBS_DIR", str(tmp_path / "obs"))
     monkeypatch.setattr(
         "torchx_tpu.schedulers.local_scheduler._registry_path",
         lambda: str(tmp_path / "tpx_local_apps"),

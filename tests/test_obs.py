@@ -1,0 +1,603 @@
+"""Observability subsystem tests: span model, metrics registry, durable
+sinks, destination plumbing, timeline reconstruction, and the acceptance
+scenario — a supervised run with an injected preemption producing ONE
+trace with nested spans for both attempts, rendered by ``tpx trace``."""
+
+import json
+import logging
+import os
+from typing import Mapping, Optional
+
+import pytest
+
+from torchx_tpu.obs import metrics as obs_metrics
+from torchx_tpu.obs import sinks, timeline
+from torchx_tpu.obs import trace as obs_trace
+from torchx_tpu.obs.metrics import Counter, Gauge, Histogram, MetricsRegistry
+from torchx_tpu.obs.trace import Span
+from torchx_tpu.runner.api import Runner
+from torchx_tpu.runner.events import record
+from torchx_tpu.runner.events.api import TpxEvent
+from torchx_tpu.schedulers.api import DescribeAppResponse, Scheduler
+from torchx_tpu.settings import (
+    ENV_TPX_PARENT_SPAN,
+    ENV_TPX_SIMULATE_PREEMPTION_EXIT,
+    ENV_TPX_TRACE,
+    ENV_TPX_TRACE_ID,
+)
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppState,
+    CfgVal,
+    FailureClass,
+    Role,
+    runopts,
+)
+from torchx_tpu.supervisor import SupervisorPolicy
+
+
+# -- span model ------------------------------------------------------------
+
+
+class TestSpans:
+    def test_nesting_via_contextvar(self):
+        with obs_trace.span("outer") as outer:
+            assert obs_trace.current_span() is outer
+            with obs_trace.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_span_id == outer.span_id
+            assert obs_trace.current_span() is outer
+        assert obs_trace.current_span() is None
+        assert outer.parent_span_id is None
+        assert outer.duration_usec() is not None
+
+    def test_error_status_on_exception(self):
+        with pytest.raises(RuntimeError):
+            with obs_trace.span("boom") as sp:
+                raise RuntimeError("kapow")
+        assert sp.status == "ERROR"
+        assert "kapow" in sp.attrs["exception"]
+
+    def test_serialize_round_trip_and_unknown_fields_dropped(self):
+        with obs_trace.span("op", scheduler="local") as sp:
+            pass
+        obj = json.loads(sp.serialize())
+        assert obj["kind"] == "span"
+        obj["fancy_new_field"] = {"from": "the future"}
+        restored = Span.deserialize(json.dumps(obj))
+        assert restored.span_id == sp.span_id
+        assert restored.attrs == {"scheduler": "local"}
+        assert not hasattr(restored, "fancy_new_field")
+
+    def test_root_joins_env_trace(self, monkeypatch):
+        monkeypatch.setenv(ENV_TPX_TRACE_ID, "f" * 32)
+        monkeypatch.setenv(ENV_TPX_PARENT_SPAN, "a" * 16)
+        with obs_trace.span("in_job") as sp:
+            assert sp.trace_id == "f" * 32
+            assert sp.parent_span_id == "a" * 16
+
+    def test_inject_env_setdefault_vs_force(self, monkeypatch):
+        with obs_trace.span("client") as sp:
+            env = {ENV_TPX_TRACE_ID: "0" * 32, ENV_TPX_PARENT_SPAN: "old"}
+            obs_trace.inject_env(env)
+            assert env[ENV_TPX_TRACE_ID] == "0" * 32  # inherited id kept
+            assert env[ENV_TPX_PARENT_SPAN] == sp.span_id  # parent refreshed
+            obs_trace.inject_env(env, force=True)
+            assert env[ENV_TPX_TRACE_ID] == sp.trace_id
+
+    def test_disabled_tracing_is_a_noop(self, monkeypatch):
+        monkeypatch.setenv(ENV_TPX_TRACE, "0")
+        with obs_trace.span("off") as sp:
+            assert sp is None
+        assert not os.path.exists(sinks.trace_path())
+        assert sinks.flush_metrics() is None
+        env: dict = {}
+        obs_trace.inject_env(env)
+        assert env == {}
+
+
+class TestEventForwardCompat:
+    def test_deserialize_drops_unknown_fields(self):
+        ev = TpxEvent(session="s", scheduler="local", api="run", app_id="a1")
+        obj = json.loads(ev.serialize())
+        obj["brand_new_field"] = 42
+        restored = TpxEvent.deserialize(json.dumps(obj))
+        assert restored == ev
+
+
+# -- metrics registry ------------------------------------------------------
+
+
+class TestMetrics:
+    def test_counter(self):
+        c = Counter("t_c", "h", ("k",))
+        c.inc(k="a")
+        c.inc(2, k="a")
+        assert c.value(k="a") == 3
+        assert c.value(k="b") == 0
+        with pytest.raises(ValueError, match="cannot decrease"):
+            c.inc(-1, k="a")
+        with pytest.raises(ValueError, match="takes labels"):
+            c.inc(wrong="a")
+        assert c.render() == ['t_c{k="a"} 3']
+
+    def test_gauge(self):
+        g = Gauge("t_g", "h")
+        g.set(1.5)
+        assert g.value() == 1.5
+        g.set(0.5)
+        assert g.render() == ["t_g 0.5"]
+
+    def test_histogram_cumulative_buckets(self):
+        h = Histogram("t_h", "h", buckets=(1.0, 5.0))
+        for v in (0.5, 0.7, 3.0, 100.0):
+            h.observe(v)
+        assert h.count() == 4
+        assert h.sum() == pytest.approx(104.2)
+        assert h.render() == [
+            't_h_bucket{le="1"} 2',
+            't_h_bucket{le="5"} 3',
+            't_h_bucket{le="+Inf"} 4',
+            "t_h_sum 104.2",
+            "t_h_count 4",
+        ]
+
+    def test_registry_get_or_create_and_kind_clash(self):
+        reg = MetricsRegistry()
+        c1 = reg.counter("x", "h")
+        assert reg.counter("x", "h") is c1
+        assert reg.get("x") is c1
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x", "h")
+
+    def test_render_documents_empty_instruments(self):
+        reg = MetricsRegistry()
+        reg.histogram("quiet_seconds", "never observed")
+        text = reg.render()
+        assert "# HELP quiet_seconds never observed" in text
+        assert "# TYPE quiet_seconds histogram" in text
+
+
+# -- destinations ----------------------------------------------------------
+
+
+@pytest.fixture
+def clean_destinations(monkeypatch):
+    from torchx_tpu.runner.events import handlers
+
+    monkeypatch.setattr(handlers, "_DESTINATIONS", dict(handlers._DESTINATIONS))
+    monkeypatch.setattr(handlers, "_RESOLVED_EP_FACTORIES", {})
+    return handlers
+
+
+class TestDestinations:
+    def test_register_destination(self, clean_destinations):
+        handlers = clean_destinations
+        marker = logging.StreamHandler()
+        handlers.register_destination("mine", lambda: marker)
+        assert handlers.get_destination_handler("mine") is marker
+
+    def test_builtin_obs_destinations(self, clean_destinations):
+        handlers = clean_destinations
+        assert isinstance(
+            handlers.get_destination_handler("jsonl"), sinks.JsonlTraceHandler
+        )
+        assert isinstance(
+            handlers.get_destination_handler("prom"), sinks.PromMetricsHandler
+        )
+
+    def test_unknown_falls_back_to_null(self, clean_destinations):
+        handler = clean_destinations.get_destination_handler("nope")
+        assert isinstance(handler, logging.NullHandler)
+
+    def test_broken_entrypoint_falls_back_and_is_not_cached(
+        self, clean_destinations, monkeypatch
+    ):
+        handlers = clean_destinations
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise RuntimeError("broken plugin")
+
+        monkeypatch.setattr(
+            "torchx_tpu.util.entrypoints.load_group",
+            lambda group: {"broken": boom},
+        )
+        assert isinstance(
+            handlers.get_destination_handler("broken"), logging.NullHandler
+        )
+        assert isinstance(
+            handlers.get_destination_handler("broken"), logging.NullHandler
+        )
+        assert len(calls) == 2  # failures retried (and re-warned), not cached
+
+    def test_good_entrypoint_is_resolved_once(
+        self, clean_destinations, monkeypatch
+    ):
+        handlers = clean_destinations
+        loads = []
+
+        def fake_load_group(group):
+            loads.append(group)
+            return {"ep_dest": lambda: logging.StreamHandler}
+
+        monkeypatch.setattr(
+            "torchx_tpu.util.entrypoints.load_group", fake_load_group
+        )
+        h1 = handlers.get_destination_handler("ep_dest")
+        h2 = handlers.get_destination_handler("ep_dest")
+        assert isinstance(h1, logging.StreamHandler)
+        assert isinstance(h2, logging.StreamHandler)
+        assert loads == ["tpx.event_handlers"]  # second hit served from cache
+
+    def test_factory_constructor_failure_falls_back(self, clean_destinations):
+        handlers = clean_destinations
+
+        def bad_factory():
+            raise OSError("disk full")
+
+        handlers.register_destination("bad", bad_factory)
+        assert isinstance(
+            handlers.get_destination_handler("bad"), logging.NullHandler
+        )
+
+
+# -- sinks + timeline ------------------------------------------------------
+
+
+class TestSinksAndTimeline:
+    def test_spans_and_events_share_one_jsonl(self):
+        with obs_trace.span("parent") as parent:
+            record(
+                TpxEvent(session="s", scheduler="local", api="describe")
+            )
+        records = timeline.load_records(sinks.trace_path())
+        spans = [r for r in records if timeline.is_span(r)]
+        events = [r for r in records if not timeline.is_span(r)]
+        assert [s["name"] for s in spans] == ["parent"]
+        assert events[-1]["api"] == "describe"
+        # the event is correlated to the enclosing span at emit time
+        assert events[-1]["trace_id"] == parent.trace_id
+        assert events[-1]["span_id"] == parent.span_id
+        # and events get their clocks stamped at emit (satellite: times.py)
+        assert events[-1]["start_epoch_time_usec"] is not None
+        assert events[-1]["wall_time_usec"] is not None
+        assert events[-1]["cpu_time_usec"] is not None
+
+    def test_load_records_skips_torn_lines(self, tmp_path):
+        p = tmp_path / "trace.jsonl"
+        p.write_text('{"kind": "span", "name": "ok"}\n{"kind": "sp')
+        assert [r["name"] for r in timeline.load_records(str(p))] == ["ok"]
+
+    def test_flush_and_load_metrics(self):
+        reg_counter = obs_metrics.RETRIES
+        before = reg_counter.value(failure_class="TEST_ONLY")
+        reg_counter.inc(failure_class="TEST_ONLY")
+        path = sinks.flush_metrics()
+        assert path is not None and os.path.exists(path)
+        rows = timeline.load_metrics(os.path.dirname(path))
+        hits = [
+            v
+            for n, labels, v in rows
+            if n == "tpx_supervisor_retries_total" and "TEST_ONLY" in labels
+        ]
+        assert hits == [before + 1]
+
+    def test_timeline_orphan_parents_become_roots(self):
+        tid = "a" * 32
+        recs = [
+            json.loads(
+                Span(
+                    name="child",
+                    trace_id=tid,
+                    span_id="c" * 16,
+                    parent_span_id="missing",
+                    start_epoch_usec=10,
+                    end_epoch_usec=20,
+                ).serialize()
+            )
+        ]
+        roots = timeline.build_timeline(recs, tid)
+        assert [r.span.name for r in roots] == ["child"]
+        assert "child" in timeline.render_timeline(roots)
+
+    def test_render_metrics_table_collapses_buckets(self):
+        rows = [
+            ("tpx_launch_seconds_bucket", 'le="1"', 3.0),
+            ("tpx_launch_seconds_count", "", 3.0),
+        ]
+        out = timeline.render_metrics_table(rows)
+        assert "tpx_launch_seconds_count" in out
+        assert "_bucket" not in out
+        out_all = timeline.render_metrics_table(rows, include_buckets=True)
+        assert "tpx_launch_seconds_bucket" in out_all
+
+
+# -- the acceptance scenario ----------------------------------------------
+
+
+class ScriptedScheduler(Scheduler[dict]):
+    """Each ``schedule()`` consumes the next scripted terminal outcome."""
+
+    def __init__(self, session_name: str, script=None, **kwargs):
+        super().__init__("scripted", session_name)
+        self.script = list(script or [])
+        self.apps: dict[str, tuple[AppState, Optional[FailureClass]]] = {}
+        self.submitted_envs: list[dict[str, str]] = []
+        self._counter = 0
+
+    def run_opts(self) -> runopts:
+        return runopts()
+
+    def _submit_dryrun(self, app: AppDef, cfg: Mapping[str, CfgVal]):
+        from torchx_tpu.specs.api import AppDryRunInfo
+
+        return AppDryRunInfo({"app": app})
+
+    def schedule(self, dryrun_info) -> str:
+        self._counter += 1
+        app_id = f"job_{self._counter}"
+        outcome = (
+            self.script.pop(0) if self.script else (AppState.SUCCEEDED, None)
+        )
+        self.apps[app_id] = outcome
+        self.submitted_envs.append(dict(dryrun_info._app.roles[0].env))
+        return app_id
+
+    def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
+        if app_id not in self.apps:
+            return None
+        state, fclass = self.apps[app_id]
+        return DescribeAppResponse(
+            app_id=app_id, state=state, failure_class=fclass
+        )
+
+    def _cancel_existing(self, app_id: str) -> None:
+        self.apps[app_id] = (AppState.CANCELLED, None)
+
+
+PREEMPT = (AppState.PREEMPTED, FailureClass.PREEMPTION)
+OK = (AppState.SUCCEEDED, None)
+
+
+def supervise_with_preemption():
+    """One preemption then success, under Runner.supervise (fast policy)."""
+    sched = ScriptedScheduler("obs", script=[PREEMPT, OK])
+    runner = Runner("obs", {"scripted": lambda session_name, **kw: sched})
+    app = AppDef(
+        name="train",
+        roles=[Role(name="trainer", image="i", entrypoint="python")],
+    )
+    with runner:
+        info = runner.dryrun(app, "scripted")
+        result = runner.supervise(
+            info,
+            SupervisorPolicy(
+                max_preemptions=2,
+                backoff_seconds=0.01,
+                jitter=0.0,
+                poll_interval=0.01,
+            ),
+        )
+    return result, sched
+
+
+class TestSuperviseTrace:
+    def test_one_trace_with_nested_attempt_spans(self):
+        result, sched = supervise_with_preemption()
+        assert result.succeeded and result.attempts == 2
+
+        records = timeline.load_records(sinks.trace_path())
+        spans = [r for r in records if timeline.is_span(r)]
+        root = [s for s in spans if s["name"] == "runner.supervise"][-1]
+        tid = root["trace_id"]
+        in_trace = [s for s in spans if s["trace_id"] == tid]
+        names = [s["name"] for s in in_trace]
+
+        # both attempts, the backoff between them, and their submissions
+        # all live in ONE trace
+        assert names.count("supervisor.attempt") == 2
+        assert names.count("supervisor.backoff") == 1
+        assert names.count("runner.schedule") == 2
+        assert names.count("runner.wait") == 2
+
+        by_id = {s["span_id"]: s for s in in_trace}
+        sup_run = next(s for s in in_trace if s["name"] == "supervisor.run")
+        assert sup_run["parent_span_id"] == root["span_id"]
+        attempts = sorted(
+            (s for s in in_trace if s["name"] == "supervisor.attempt"),
+            key=lambda s: s["attrs"]["attempt"],
+        )
+        for s in attempts:
+            assert by_id[s["parent_span_id"]]["name"] == "supervisor.run"
+        assert attempts[0]["attrs"]["app_id"] == "job_1"
+        assert attempts[0]["attrs"]["failure_class"] == "PREEMPTION"
+        assert attempts[1]["attrs"]["app_id"] == "job_2"
+        assert "resume_step" not in attempts[1]["attrs"]  # no ckpt dir set
+
+        # supervisor transition events carry the same trace id and attach
+        # to the attempt spans that emitted them
+        sup_events = [
+            r
+            for r in records
+            if not timeline.is_span(r) and r.get("api") == "supervise"
+        ]
+        transitions = [
+            e["app_metadata"]["transition"]
+            for e in sup_events
+            if e.get("app_metadata", {}).get("transition")
+        ]
+        assert transitions == ["submitted", "resubmitting", "submitted", "finished"]
+        for e in sup_events:
+            assert e["trace_id"] == tid
+
+    def test_trace_env_repointed_per_attempt(self):
+        result, sched = supervise_with_preemption()
+        env1, env2 = sched.submitted_envs
+        assert env1[ENV_TPX_TRACE_ID] == env2[ENV_TPX_TRACE_ID]
+        # each attempt's in-job spans must hang off THAT attempt's span
+        assert env1[ENV_TPX_PARENT_SPAN] != env2[ENV_TPX_PARENT_SPAN]
+        records = timeline.load_records(sinks.trace_path())
+        spans = {r["span_id"]: r for r in records if timeline.is_span(r)}
+        assert spans[env1[ENV_TPX_PARENT_SPAN]]["name"] == "supervisor.attempt"
+        assert spans[env2[ENV_TPX_PARENT_SPAN]]["name"] == "supervisor.attempt"
+        # and the injected trace is the client's own
+        root = [s for s in spans.values() if s["name"] == "runner.supervise"][-1]
+        assert env1[ENV_TPX_TRACE_ID] == root["trace_id"]
+
+    def test_metrics_flushed_with_retry_and_launch_series(self):
+        supervise_with_preemption()
+        path = sinks.metrics_path()
+        assert os.path.exists(path)
+        text = open(path).read()
+        assert 'tpx_supervisor_retries_total{failure_class="PREEMPTION"}' in text
+        assert "tpx_launch_seconds_bucket" in text
+        assert 'tpx_wait_polls_total{scheduler="scripted"}' in text
+        assert "tpx_supervisor_backoff_seconds_total" in text
+
+    def test_tpx_trace_cli_renders_the_timeline(self, capsys):
+        result, _ = supervise_with_preemption()
+        from torchx_tpu.cli.main import main as cli_main
+
+        cli_main(["trace", result.handle, "--events", "--metrics"])
+        out = capsys.readouterr().out
+        assert "trace " in out
+        assert "runner.supervise" in out
+        assert "supervisor.attempt (job_1)" in out
+        assert "supervisor.attempt (job_2)" in out
+        assert "supervisor.backoff" in out
+        assert "· resubmitting" in out  # --events interleaving
+        assert "tpx_supervisor_retries_total" in out  # --metrics table
+
+    def test_tpx_trace_cli_unknown_identifier(self, capsys):
+        supervise_with_preemption()
+        from torchx_tpu.cli.main import main as cli_main
+
+        with pytest.raises(SystemExit):
+            cli_main(["trace", "no_such_app"])
+        assert "no trace found" in capsys.readouterr().err
+
+
+class TestLocalPreemptionDrill:
+    """The acceptance scenario on the REAL local scheduler: an injected
+    preemption (TPX_SIMULATE_PREEMPTION_EXIT drill knob) supervised end to
+    end, leaving ONE trace with both attempts and the backoff between."""
+
+    def test_local_supervise_injected_preemption_one_trace(self, tmp_path):
+        from torchx_tpu.schedulers.local_scheduler import LocalScheduler
+
+        marker = tmp_path / "preempted-once"
+        # first run "loses its capacity" (exits with the drill code);
+        # the resubmitted attempt finds the marker and succeeds
+        script = (
+            f'if [ -e "{marker}" ]; then exit 0; fi;'
+            f' touch "{marker}"; exit 67'
+        )
+        sched = LocalScheduler(session_name="obs-local", cache_size=10)
+        runner = Runner("obs-local", {"local": lambda session_name, **kw: sched})
+        app = AppDef(
+            name="drill",
+            roles=[
+                Role(
+                    name="w",
+                    image="",
+                    entrypoint="sh",
+                    args=["-c", script],
+                    env={ENV_TPX_SIMULATE_PREEMPTION_EXIT: "67"},
+                )
+            ],
+        )
+        try:
+            with runner:
+                info = runner.dryrun(
+                    app, "local", cfg={"log_dir": str(tmp_path / "logs")}
+                )
+                result = runner.supervise(
+                    info,
+                    SupervisorPolicy(
+                        max_preemptions=2,
+                        backoff_seconds=0.01,
+                        jitter=0.0,
+                        poll_interval=0.05,
+                    ),
+                )
+        finally:
+            sched.close()
+        assert result.succeeded and result.attempts == 2
+
+        records = timeline.load_records(sinks.trace_path())
+        spans = [r for r in records if timeline.is_span(r)]
+        root = [s for s in spans if s["name"] == "runner.supervise"][-1]
+        tid = root["trace_id"]
+        names = [s["name"] for s in spans if s["trace_id"] == tid]
+        assert names.count("supervisor.attempt") == 2
+        assert names.count("supervisor.backoff") == 1
+        assert names.count("scheduler.spawn") == 2  # real Popen submits
+        attempts = sorted(
+            (
+                s
+                for s in spans
+                if s["trace_id"] == tid and s["name"] == "supervisor.attempt"
+            ),
+            key=lambda s: s["attrs"]["attempt"],
+        )
+        # the drill exit code classified as a real preemption
+        assert attempts[0]["attrs"]["failure_class"] == "PREEMPTION"
+        assert attempts[0]["attrs"]["state"] == "PREEMPTED"
+        assert "failure_class" not in attempts[1]["attrs"]
+
+    def test_drill_knob_absent_keeps_failed_semantics(self, tmp_path):
+        from torchx_tpu.schedulers.local_scheduler import LocalScheduler
+
+        sched = LocalScheduler(session_name="obs-nodrill", cache_size=10)
+        try:
+            app = AppDef(
+                name="plain",
+                roles=[
+                    Role(name="w", image="", entrypoint="sh", args=["-c", "exit 67"])
+                ],
+            )
+            app_id = sched.submit(app, {"log_dir": str(tmp_path / "logs")})
+            import time
+
+            from torchx_tpu.specs.api import is_terminal
+
+            for _ in range(200):
+                desc = sched.describe(app_id)
+                if desc is not None and is_terminal(desc.state):
+                    break
+                time.sleep(0.05)
+            assert desc.state == AppState.FAILED
+            assert sched.classify_failure(desc) == FailureClass.APP
+        finally:
+            sched.close()
+
+
+# -- in-job helpers --------------------------------------------------------
+
+
+class TestJobSide:
+    def test_spmd_job_span_noop_without_trace_env(self, monkeypatch):
+        from torchx_tpu.apps.spmd_main import _job_span
+
+        monkeypatch.delenv(ENV_TPX_TRACE_ID, raising=False)
+        with _job_span("job.bootstrap") as sp:
+            assert sp is None
+        assert not os.path.exists(sinks.trace_path())
+
+    def test_spmd_job_span_joins_client_trace(self, monkeypatch):
+        from torchx_tpu.apps.spmd_main import _job_span
+
+        monkeypatch.setenv(ENV_TPX_TRACE_ID, "e" * 32)
+        monkeypatch.setenv(ENV_TPX_PARENT_SPAN, "b" * 16)
+        with _job_span("job.bootstrap") as sp:
+            pass
+        assert sp.trace_id == "e" * 32
+        assert sp.parent_span_id == "b" * 16
+
+    def test_heartbeat_is_instant_and_flushes_metrics(self):
+        sp = obs_trace.heartbeat("job.first_step", launch_to_first_step_s=1.2)
+        assert sp.end_epoch_usec is not None
+        assert sp.attrs["launch_to_first_step_s"] == 1.2
+        assert os.path.exists(sinks.metrics_path())

@@ -26,8 +26,22 @@ import socket
 import sys
 import time
 import traceback
+from contextlib import nullcontext
+from typing import Any, ContextManager
 
 from torchx_tpu import settings
+
+
+def _job_span(name: str, **attrs: Any) -> ContextManager[Any]:
+    """A span joining the client's trace via the injected $TPX_TRACE_ID /
+    $TPX_PARENT_SPAN context, or a no-op when this process was not
+    launched under tracing (keeps bare `python -m spmd_main` runs from
+    minting orphan traces)."""
+    if not os.environ.get(settings.ENV_TPX_TRACE_ID):
+        return nullcontext()
+    from torchx_tpu.obs import trace as obs_trace
+
+    return obs_trace.span(name, **attrs)
 
 
 def _gang() -> tuple[int, int, str]:
@@ -108,9 +122,24 @@ def main(argv: list[str] | None = None) -> None:
         rest = rest[1:]
 
     try:
-        if not args.no_init:
-            initialize_distributed(args.port)
+        with _job_span(
+            "job.bootstrap",
+            replica=os.environ.get(settings.ENV_TPX_REPLICA_ID),
+            no_init=args.no_init or None,
+        ):
+            if not args.no_init:
+                initialize_distributed(args.port)
         sys.argv = [args.script or args.module, *rest]
+        if os.environ.get(settings.ENV_TPX_TRACE_ID):
+            # instantaneous marker: distributed init is done, user code
+            # starts now — the in-job half of launch latency
+            from torchx_tpu.obs import trace as obs_trace
+
+            obs_trace.heartbeat(
+                "job.exec",
+                replica=os.environ.get(settings.ENV_TPX_REPLICA_ID),
+                target=args.script or args.module,
+            )
         if args.script:
             runpy.run_path(args.script, run_name="__main__")
         else:

@@ -82,6 +82,14 @@ class CmdRun(SubCommand):
             self._run(runner, args)
 
     def _run(self, runner: Runner, args: argparse.Namespace) -> None:
+        from torchx_tpu.obs import trace as obs_trace
+
+        # one root span over submit + wait, so `tpx run --wait` leaves a
+        # single trace instead of one per Runner call
+        with obs_trace.span("tpx.run", session=runner._name):
+            self._run_traced(runner, args)
+
+    def _run_traced(self, runner: Runner, args: argparse.Namespace) -> None:
         scheduler = args.scheduler
         if scheduler is None:
             from torchx_tpu.schedulers import get_default_scheduler_name

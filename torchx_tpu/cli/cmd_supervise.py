@@ -25,6 +25,7 @@ import sys
 
 from torchx_tpu.cli.cmd_base import SubCommand
 from torchx_tpu.cli.cmd_run import CmdRun
+from torchx_tpu.obs import trace as obs_trace
 from torchx_tpu.runner import config as tpx_config
 from torchx_tpu.runner.api import Runner, get_runner
 from torchx_tpu.specs.finder import (
@@ -159,6 +160,12 @@ class CmdSupervise(SubCommand):
             self._run(runner, args)
 
     def _run(self, runner: Runner, args: argparse.Namespace) -> None:
+        # one root span over dryrun + supervise: every attempt, backoff,
+        # and in-job heartbeat lands in a single trace for `tpx trace`
+        with obs_trace.span("tpx.supervise", session=runner._name):
+            self._run_traced(runner, args)
+
+    def _run_traced(self, runner: Runner, args: argparse.Namespace) -> None:
         scheduler = args.scheduler
         if scheduler is None:
             from torchx_tpu.schedulers import get_default_scheduler_name

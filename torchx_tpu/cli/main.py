@@ -27,6 +27,7 @@ from torchx_tpu.cli.cmd_simple import (
     CmdWatch,
 )
 from torchx_tpu.cli.cmd_supervise import CmdSupervise
+from torchx_tpu.cli.cmd_trace import CmdTrace
 from torchx_tpu.version import __version__
 
 CMDS_ENTRYPOINT_GROUP = "tpx.cli.cmds"
@@ -40,6 +41,7 @@ def get_sub_cmds() -> dict[str, SubCommand]:
         "describe": CmdDescribe(),
         "list": CmdList(),
         "log": CmdLog(),
+        "trace": CmdTrace(),
         "cancel": CmdCancel(),
         "delete": CmdDelete(),
         "resize": CmdResize(),

@@ -175,6 +175,27 @@ def parse_mesh_arg(spec: str) -> MeshConfig:
     return MeshConfig(**kwargs)
 
 
+def _report_first_step(first_step_s: float, resumed_step: int) -> None:
+    """Join the launcher's trace with a ``job.first_step`` heartbeat and
+    feed the launch-to-first-step histogram (the BASELINE.md north-star
+    metric). No-op when this process was not launched under tracing."""
+    import os
+
+    from torchx_tpu import settings
+
+    if not os.environ.get(settings.ENV_TPX_TRACE_ID):
+        return
+    from torchx_tpu.obs import metrics as obs_metrics
+    from torchx_tpu.obs import trace as obs_trace
+
+    obs_metrics.LAUNCH_TO_FIRST_STEP.observe(first_step_s)
+    obs_trace.heartbeat(
+        "job.first_step",
+        launch_to_first_step_s=round(first_step_s, 3),
+        resumed_step=resumed_step or None,
+    )
+
+
 def train(
     cfg: llama.LlamaConfig,
     mesh_config: MeshConfig,
@@ -241,6 +262,7 @@ def train(
             f" launch-to-first-step={first_step_s:.1f}s",
             flush=True,
         )
+        _report_first_step(first_step_s, resumed_step)
 
     if steps <= 1:
         # single-step smoke: the compile-including step is the only timing

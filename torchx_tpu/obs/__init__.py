@@ -1,0 +1,64 @@
+"""Observability for the launch path: traces, metrics, durable sinks.
+
+The subsystem answers "where did my launch time go" end to end:
+
+* :mod:`torchx_tpu.obs.trace` — the :class:`Span` model with contextvar
+  propagation; every Runner API call, scheduler materialize/schedule,
+  workspace build, and supervisor attempt nests under one trace, and the
+  trace context rides into the job via ``$TPX_TRACE_ID`` /
+  ``$TPX_PARENT_SPAN``;
+* :mod:`torchx_tpu.obs.metrics` — a dependency-free metrics registry
+  (counters / gauges / fixed-bucket histograms) with the launcher's
+  standard instruments (API latency, wait polls, retries per failure
+  class, backoff time, launch latency);
+* :mod:`torchx_tpu.obs.sinks` — durable output under
+  ``~/.torchx_tpu/obs/<session>/``: a JSONL trace/event sink and a
+  Prometheus-textfile metrics exporter, shared with ``TpxEvent`` through
+  the events-logger pipeline;
+* :mod:`torchx_tpu.obs.timeline` — reads it all back for
+  ``tpx trace <app-handle>``.
+"""
+
+from torchx_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+)
+from torchx_tpu.obs.sinks import (
+    JsonlTraceHandler,
+    flush_metrics,
+    obs_root,
+    session_dir,
+    trace_path,
+)
+from torchx_tpu.obs.trace import (
+    Span,
+    current_span,
+    current_trace_id,
+    heartbeat,
+    inject_env,
+    span,
+    tracing_enabled,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlTraceHandler",
+    "MetricsRegistry",
+    "REGISTRY",
+    "Span",
+    "current_span",
+    "current_trace_id",
+    "flush_metrics",
+    "heartbeat",
+    "inject_env",
+    "obs_root",
+    "session_dir",
+    "span",
+    "trace_path",
+    "tracing_enabled",
+]

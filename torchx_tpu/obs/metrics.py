@@ -1,0 +1,320 @@
+"""In-process metrics registry with a Prometheus-textfile exporter.
+
+Counters, gauges, and fixed-bucket histograms, labeled, thread-safe, and
+dependency-free — the launch path is low-rate, so a dict behind a lock is
+the right amount of machinery. :meth:`MetricsRegistry.render` emits the
+Prometheus text exposition format; :func:`torchx_tpu.obs.sinks.flush_metrics`
+writes it atomically to a per-process ``.prom`` textfile that a node
+exporter (or ``tpx trace --metrics``) picks up.
+
+The module-level instruments below are the launcher's standard metrics:
+API latency, poll counts, retries per failure class, backoff time, and
+launch latency (submit-to-app-id client-side, launch-to-first-step
+in-job).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Mapping, Optional, Sequence
+
+LabelValues = tuple[str, ...]
+
+#: default histogram buckets (seconds), tuned for launcher latencies:
+#: sub-second API calls up to multi-minute scheduling waits.
+DEFAULT_BUCKETS: tuple[float, ...] = (
+    0.005,
+    0.025,
+    0.1,
+    0.5,
+    1.0,
+    5.0,
+    15.0,
+    30.0,
+    60.0,
+    120.0,
+    300.0,
+    600.0,
+)
+
+
+def _format_labels(names: Sequence[str], values: LabelValues) -> str:
+    if not names:
+        return ""
+    pairs = ",".join(
+        f'{n}="{_escape(v)}"' for n, v in zip(names, values)
+    )
+    return "{" + pairs + "}"
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _format_value(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+class _Metric:
+    """Shared label plumbing for all instrument types."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str]) -> None:  # noqa: A002
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Mapping[str, str]) -> LabelValues:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} takes labels {self.labelnames},"
+                f" got {tuple(labels)}"
+            )
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def render(self) -> list[str]:
+        """One Prometheus text-format sample line per labeled series."""
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (e.g. polls, retries)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()) -> None:  # noqa: A002
+        super().__init__(name, help, labelnames)
+        self._values: dict[LabelValues, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        """Add ``amount`` (must be >= 0) to the labeled series."""
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        """Current value of the labeled series (0 if never incremented)."""
+        return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        with self._lock:
+            return [
+                f"{self.name}{_format_labels(self.labelnames, k)} {_format_value(v)}"
+                for k, v in sorted(self._values.items())
+            ]
+
+
+class Gauge(_Metric):
+    """A value that can go up and down (e.g. active attempts)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()) -> None:  # noqa: A002
+        super().__init__(name, help, labelnames)
+        self._values: dict[LabelValues, float] = {}
+
+    def set(self, value: float, **labels: str) -> None:
+        """Set the labeled series to ``value``."""
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def value(self, **labels: str) -> float:
+        """Current value of the labeled series (0 if never set)."""
+        return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        with self._lock:
+            return [
+                f"{self.name}{_format_labels(self.labelnames, k)} {_format_value(v)}"
+                for k, v in sorted(self._values.items())
+            ]
+
+
+class Histogram(_Metric):
+    """Fixed-bucket distribution (cumulative buckets, Prometheus style)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,  # noqa: A002
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> None:
+        super().__init__(name, help, labelnames)
+        if list(buckets) != sorted(buckets) or not buckets:
+            raise ValueError(f"histogram {name} buckets must be sorted and non-empty")
+        self.buckets = tuple(float(b) for b in buckets)
+        # per-series: [bucket counts..., +Inf count], sum
+        self._counts: dict[LabelValues, list[int]] = {}
+        self._sums: dict[LabelValues, float] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        """Record one observation into the labeled series."""
+        key = self._key(labels)
+        idx = bisect_left(self.buckets, value)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + float(value)
+
+    def count(self, **labels: str) -> int:
+        """Total observations in the labeled series."""
+        return sum(self._counts.get(self._key(labels), ()))
+
+    def sum(self, **labels: str) -> float:
+        """Sum of observations in the labeled series."""
+        return self._sums.get(self._key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        lines = []
+        with self._lock:
+            for key in sorted(self._counts):
+                counts = self._counts[key]
+                cumulative = 0
+                names = (*self.labelnames, "le")
+                for bound, n in zip(self.buckets, counts):
+                    cumulative += n
+                    values = (*key, _format_value(bound))
+                    lines.append(
+                        f"{self.name}_bucket{_format_labels(names, values)} {cumulative}"
+                    )
+                cumulative += counts[-1]
+                values = (*key, "+Inf")
+                lines.append(
+                    f"{self.name}_bucket{_format_labels(names, values)} {cumulative}"
+                )
+                lines.append(
+                    f"{self.name}_sum{_format_labels(self.labelnames, key)}"
+                    f" {_format_value(self._sums[key])}"
+                )
+                lines.append(
+                    f"{self.name}_count{_format_labels(self.labelnames, key)}"
+                    f" {cumulative}"
+                )
+        return lines
+
+
+class MetricsRegistry:
+    """Name-keyed collection of instruments; ``counter``/``gauge``/
+    ``histogram`` are get-or-create (idempotent across modules), and
+    :meth:`render` emits the whole registry in Prometheus text format."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def __repr__(self) -> str:
+        # stable (no object address): this repr lands in generated docs
+        return f"MetricsRegistry({sorted(self._metrics)})"
+
+    def _get_or_create(self, cls, name: str, *args, **kwargs) -> _Metric:  # noqa: ANN001,ANN002
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise ValueError(
+                        f"metric {name} already registered as {existing.kind}"
+                    )
+                return existing
+            metric = cls(name, *args, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(
+        self, name: str, help: str, labelnames: Sequence[str] = ()  # noqa: A002
+    ) -> Counter:
+        """Get or create a :class:`Counter`."""
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(
+        self, name: str, help: str, labelnames: Sequence[str] = ()  # noqa: A002
+    ) -> Gauge:
+        """Get or create a :class:`Gauge`."""
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help: str,  # noqa: A002
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        """Get or create a :class:`Histogram` with fixed ``buckets``."""
+        return self._get_or_create(Histogram, name, help, labelnames, buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        """The registered instrument, or None."""
+        return self._metrics.get(name)
+
+    def render(self) -> str:
+        """The whole registry in Prometheus text exposition format
+        (HELP/TYPE headers + one line per labeled series). Series-less
+        instruments render headers only, so the page documents every
+        metric the launcher can emit."""
+        out: list[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# TYPE {m.name} {m.kind}")
+            out.extend(m.render())
+        return "\n".join(out) + "\n"
+
+
+#: the process-wide registry every instrument below lives in.
+REGISTRY = MetricsRegistry()
+
+#: latency of each Runner API call, by api + scheduler.
+API_LATENCY = REGISTRY.histogram(
+    "tpx_api_latency_seconds",
+    "Runner API call latency in seconds",
+    ("api", "scheduler"),
+)
+
+#: Runner API call count by api + scheduler + outcome ("ok"/"error").
+API_CALLS = REGISTRY.counter(
+    "tpx_api_calls_total",
+    "Runner API calls",
+    ("api", "scheduler", "status"),
+)
+
+#: status polls issued by Runner.wait, by scheduler.
+WAIT_POLLS = REGISTRY.counter(
+    "tpx_wait_polls_total",
+    "status polls issued while waiting for a terminal state",
+    ("scheduler",),
+)
+
+#: supervisor resubmissions, by failure class.
+RETRIES = REGISTRY.counter(
+    "tpx_supervisor_retries_total",
+    "supervisor resubmissions by failure class",
+    ("failure_class",),
+)
+
+#: total seconds the supervisor spent in backoff sleeps.
+BACKOFF_SECONDS = REGISTRY.counter(
+    "tpx_supervisor_backoff_seconds_total",
+    "total supervisor backoff sleep seconds",
+)
+
+#: client-side launch latency: schedule() call to app_id in hand.
+LAUNCH_SECONDS = REGISTRY.histogram(
+    "tpx_launch_seconds",
+    "scheduler submit latency (schedule call to app id) in seconds",
+    ("scheduler",),
+)
+
+#: in-job launch-to-first-step latency (reported by train heartbeats).
+LAUNCH_TO_FIRST_STEP = REGISTRY.histogram(
+    "tpx_launch_to_first_step_seconds",
+    "process start to first completed training step in seconds",
+)

@@ -1,0 +1,136 @@
+"""Durable observability sinks: JSONL traces + Prometheus textfile metrics.
+
+Everything lands under one session directory,
+``~/.torchx_tpu/obs/<session>/`` (override the root with ``$TPX_OBS_DIR``),
+following the per-user dotfile convention of
+:mod:`torchx_tpu.util.registry`:
+
+* ``trace.jsonl`` — every span and :class:`TpxEvent` the session emitted,
+  one JSON object per line, appended by every participating process (the
+  client AND locally-launched replicas share the session via
+  ``$TPX_INTERNAL_SESSION_ID``, so their spans interleave into one file);
+* ``metrics-<pid>.prom`` — each process's metrics registry in Prometheus
+  text format, rewritten atomically on flush (per-pid files so client and
+  job processes never clobber each other; textfile collectors and
+  ``tpx trace --metrics`` aggregate the glob).
+
+Both are exposed as named event destinations (``jsonl``, ``prom``) through
+the ``tpx.event_handlers`` registry in
+:mod:`torchx_tpu.runner.events.handlers`, and the JSONL sink is also
+attached to the events logger whenever tracing is enabled — spans and
+events share one pipeline either way.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from typing import Optional
+
+from torchx_tpu import settings
+from torchx_tpu.obs.trace import tracing_enabled
+
+logger = logging.getLogger(__name__)
+
+TRACE_FILE = "trace.jsonl"
+METRICS_GLOB = "metrics-*.prom"
+
+
+def obs_root() -> str:
+    """Root of all durable observability output:
+    ``$TPX_OBS_DIR`` or ``~/.torchx_tpu/obs``."""
+    return os.environ.get(settings.ENV_TPX_OBS_DIR) or os.path.join(
+        os.path.expanduser("~"), ".torchx_tpu", "obs"
+    )
+
+
+def default_session_name() -> str:
+    """The session directory name, derived from the process-wide session id
+    exactly like ``get_runner``'s default Runner name — so the client, its
+    subprocesses, and locally-launched replicas (which inherit
+    ``$TPX_INTERNAL_SESSION_ID``) all write into one directory."""
+    from torchx_tpu.util.session import get_session_id_or_create_new
+
+    return f"tpx_{get_session_id_or_create_new()[:8]}"
+
+
+def session_dir(session: Optional[str] = None) -> str:
+    """Directory holding one session's trace + metrics files."""
+    return os.path.join(obs_root(), session or default_session_name())
+
+
+def trace_path(session: Optional[str] = None) -> str:
+    """The session's JSONL trace file path."""
+    return os.path.join(session_dir(session), TRACE_FILE)
+
+
+def metrics_path(session: Optional[str] = None) -> str:
+    """This process's metrics textfile path within the session dir."""
+    return os.path.join(session_dir(session), f"metrics-{os.getpid()}.prom")
+
+
+class JsonlTraceHandler(logging.Handler):
+    """Logging handler appending each record's message (an already
+    serialized span or TpxEvent JSON object) as one line to the session's
+    ``trace.jsonl``.
+
+    The path is resolved per emit — cheap at launcher event rates, and it
+    honors ``$TPX_OBS_DIR``/``$HOME`` changes mid-process (tests, in-job
+    redirection). Single-line ``O_APPEND`` writes keep concurrent
+    processes' records intact. Emission is best-effort: telemetry must
+    never break the launch path."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        if not tracing_enabled():
+            return
+        try:
+            path = trace_path()
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "a") as f:
+                f.write(record.getMessage().rstrip("\n") + "\n")
+        except Exception:  # noqa: BLE001 - never break the caller
+            self.handleError(record)
+
+
+class PromMetricsHandler(logging.Handler):
+    """Logging handler that re-renders the metrics textfile on every event
+    — for operators who point ``$TPX_EVENT_DESTINATION=prom`` at a node
+    exporter's textfile directory and want metrics without traces."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            flush_metrics()
+        except Exception:  # noqa: BLE001
+            self.handleError(record)
+
+
+def flush_metrics(session: Optional[str] = None) -> Optional[str]:
+    """Atomically write this process's metrics registry to its ``.prom``
+    textfile (tmp + ``os.replace``, same torn-read protection as
+    ``util.registry``). No-op with tracing disabled. Returns the path
+    written, or None."""
+    if not tracing_enabled():
+        return None
+    from torchx_tpu.obs.metrics import REGISTRY
+
+    path = metrics_path(session)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path), prefix=".metrics_"
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(REGISTRY.render())
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except OSError as e:
+        logger.debug("could not flush metrics to %s: %s", path, e)
+        return None
+    return path

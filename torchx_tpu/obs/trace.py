@@ -1,0 +1,240 @@
+"""Span/trace model for the launch path.
+
+A :class:`Span` is one timed operation (``runner.schedule``, a supervisor
+attempt, a workspace build, the in-job first step); spans carrying the same
+``trace_id`` form one trace, and ``parent_span_id`` links them into the
+tree ``tpx trace`` renders. Propagation is two-level:
+
+* **in-process** — a ``contextvars.ContextVar`` holds the active span, so
+  nested instrumented calls parent automatically (and correctly across
+  threads/async);
+* **cross-process** — the client injects ``$TPX_TRACE_ID`` /
+  ``$TPX_PARENT_SPAN`` into the job's env at submit
+  (:func:`inject_env`); a process that opens a root span with those set
+  joins the client's trace instead of starting its own.
+
+Completed spans are serialized onto the same non-propagating events logger
+that carries :class:`~torchx_tpu.runner.events.api.TpxEvent` records, so
+one pipeline (and one JSONL sink — see :mod:`torchx_tpu.obs.sinks`) holds
+the full story of a launch.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import uuid
+from contextlib import contextmanager
+from dataclasses import asdict, dataclass, field, fields
+from typing import Any, Iterator, Optional
+
+from torchx_tpu import settings
+from torchx_tpu.util.times import epoch_usec
+
+#: record-discriminator value in the shared JSONL stream ("kind" key).
+SPAN_KIND = "span"
+
+_CURRENT: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "tpx_current_span", default=None
+)
+
+
+def tracing_enabled() -> bool:
+    """True unless ``$TPX_TRACE`` is set to 0/false/off. Checked at every
+    emit (not cached) so tests and operators can flip it at runtime."""
+    return os.environ.get(settings.ENV_TPX_TRACE, "1").lower() not in (
+        "0",
+        "false",
+        "off",
+    )
+
+
+def new_trace_id() -> str:
+    """A fresh 32-hex-char trace id."""
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    """A fresh 16-hex-char span id."""
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass
+class Span:
+    """One timed, attributed operation within a trace.
+
+    ``start_epoch_usec``/``end_epoch_usec`` are wall-clock epoch
+    microseconds (the unit shared with ``TpxEvent`` stamps); ``status`` is
+    ``"OK"`` or ``"ERROR"``. ``attrs`` carries small JSON-safe details
+    (app_id, attempt number, poll count, ...) — never payloads.
+    """
+
+    name: str
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str] = None
+    start_epoch_usec: int = 0
+    end_epoch_usec: Optional[int] = None
+    attrs: dict[str, Any] = field(default_factory=dict)
+    status: str = "OK"
+    session: str = ""
+
+    def duration_usec(self) -> Optional[int]:
+        """Span duration in microseconds, or None while still open."""
+        if self.end_epoch_usec is None:
+            return None
+        return self.end_epoch_usec - self.start_epoch_usec
+
+    def serialize(self) -> str:
+        """One JSON line, discriminated by ``"kind": "span"`` so readers
+        can tell spans from TpxEvent records in the shared JSONL stream."""
+        return json.dumps({"kind": SPAN_KIND, **asdict(self)}, default=str)
+
+    @staticmethod
+    def deserialize(data: str) -> "Span":
+        """Inverse of :meth:`serialize`; unknown fields are dropped so old
+        readers survive new writers (same forward-compatibility contract
+        as ``TpxEvent.deserialize``)."""
+        obj = json.loads(data)
+        known = {f.name for f in fields(Span)}
+        return Span(**{k: v for k, v in obj.items() if k in known})
+
+
+def current_span() -> Optional[Span]:
+    """The active span in this context, or None."""
+    return _CURRENT.get()
+
+
+def current_trace_id() -> Optional[str]:
+    """Trace id of the active span, falling back to the inherited
+    ``$TPX_TRACE_ID`` (an in-job process with no local span yet is still
+    part of the client's trace)."""
+    span = _CURRENT.get()
+    if span is not None:
+        return span.trace_id
+    return os.environ.get(settings.ENV_TPX_TRACE_ID) or None
+
+
+def current_span_id() -> Optional[str]:
+    """Span id of the active span, falling back to ``$TPX_PARENT_SPAN``."""
+    span = _CURRENT.get()
+    if span is not None:
+        return span.span_id
+    return os.environ.get(settings.ENV_TPX_PARENT_SPAN) or None
+
+
+def inject_env(env: dict[str, str], force: bool = False) -> None:
+    """Write the current trace context into a job env dict (the submit-time
+    hook: ``Runner.dryrun`` and the supervisor's resubmit both call this on
+    every role). By default the trace id is inherited if already present
+    (a pre-traced AppDef stays in its trace); the parent span is always
+    refreshed so each attempt's in-job spans hang off that attempt.
+    ``force=True`` overwrites both — the supervisor uses it so resubmitted
+    attempts join the *supervise* trace even when the dryrun was produced
+    under an earlier one."""
+    if not tracing_enabled():
+        return
+    trace_id = current_trace_id()
+    span_id = current_span_id()
+    if trace_id:
+        if force:
+            env[settings.ENV_TPX_TRACE_ID] = trace_id
+        else:
+            env.setdefault(settings.ENV_TPX_TRACE_ID, trace_id)
+    if span_id:
+        env[settings.ENV_TPX_PARENT_SPAN] = span_id
+
+
+def start_span(name: str, session: str = "", **attrs: Any) -> tuple[Optional[Span], Any]:
+    """Open a span and make it current; returns ``(span, token)`` for
+    :func:`end_span`. Returns ``(None, None)`` when tracing is disabled.
+    Prefer the :func:`span` context manager; this split exists for
+    instrumentation that cannot nest a ``with`` block (``log_event``)."""
+    if not tracing_enabled():
+        return None, None
+    parent = _CURRENT.get()
+    if parent is not None:
+        trace_id: str = parent.trace_id
+        parent_id: Optional[str] = parent.span_id
+    else:
+        trace_id = os.environ.get(settings.ENV_TPX_TRACE_ID) or new_trace_id()
+        parent_id = os.environ.get(settings.ENV_TPX_PARENT_SPAN) or None
+    sp = Span(
+        name=name,
+        trace_id=trace_id,
+        span_id=new_span_id(),
+        parent_span_id=parent_id,
+        start_epoch_usec=epoch_usec(),
+        attrs={k: v for k, v in attrs.items() if v is not None},
+        session=session,
+    )
+    token = _CURRENT.set(sp)
+    return sp, token
+
+
+def end_span(
+    span_: Optional[Span], token: Any, exc: Optional[BaseException] = None
+) -> None:
+    """Close a span from :func:`start_span`: restore the previous context,
+    stamp the end time, mark ERROR on exception, and emit it."""
+    if span_ is None:
+        return
+    _CURRENT.reset(token)
+    span_.end_epoch_usec = epoch_usec()
+    if exc is not None:
+        span_.status = "ERROR"
+        span_.attrs.setdefault("exception", f"{type(exc).__name__}: {exc}")
+    record_span(span_)
+
+
+@contextmanager
+def span(name: str, session: str = "", **attrs: Any) -> Iterator[Optional[Span]]:
+    """Context manager: time a block as one span, parented on the current
+    context (or the inherited env context at the root). Yields the open
+    :class:`Span` so callers can add attrs mid-flight, or None when
+    tracing is disabled::
+
+        with trace.span("supervisor.attempt", attempt=2) as sp:
+            ...
+            if sp is not None:
+                sp.attrs["state"] = str(status.state)
+    """
+    sp, token = start_span(name, session=session, **attrs)
+    try:
+        yield sp
+    except BaseException as e:
+        end_span(sp, token, exc=e)
+        raise
+    else:
+        end_span(sp, token)
+
+
+def heartbeat(name: str, session: str = "", **attrs: Any) -> Optional[Span]:
+    """Emit an instantaneous (zero-duration) span — the in-job progress
+    marker (`job.first_step`, throughput snapshots) that joins the
+    client trace via the injected env context. Also flushes the metrics
+    textfile so the marker and its metrics land together."""
+    if not tracing_enabled():
+        return None
+    with span(name, session=session, **attrs) as sp:
+        pass
+    from torchx_tpu.obs import sinks
+
+    sinks.flush_metrics()
+    return sp
+
+
+def record_span(span_: Span) -> None:
+    """Ship one completed span down the shared events pipeline. Root-span
+    completion additionally flushes the session's metrics textfile, so a
+    finished top-level operation always leaves current metrics behind."""
+    if not tracing_enabled():
+        return
+    from torchx_tpu.runner.events import get_events_logger
+
+    get_events_logger().info(span_.serialize())
+    if span_.parent_span_id is None:
+        from torchx_tpu.obs import sinks
+
+        sinks.flush_metrics()

@@ -20,6 +20,8 @@ from types import TracebackType
 from typing import Any, Callable, Iterable, Mapping, Optional, Type
 
 from torchx_tpu import settings
+from torchx_tpu.obs import metrics as obs_metrics
+from torchx_tpu.obs import trace as obs_trace
 from torchx_tpu.runner.events import log_event
 from torchx_tpu.schedulers import (
     SchedulerFactory,
@@ -105,10 +107,16 @@ class Runner:
     ) -> AppHandle:
         """Resolve a component (builtin name / file.py:fn), materialize it
         with the given CLI-style args, and run it."""
-        dryrun_info = self.dryrun_component(
-            component, component_args, scheduler, cfg, workspace, parent_run_id
-        )
-        return self.schedule(dryrun_info)
+        with obs_trace.span(
+            "runner.run_component",
+            session=self._name,
+            component=component,
+            scheduler=scheduler,
+        ):
+            dryrun_info = self.dryrun_component(
+                component, component_args, scheduler, cfg, workspace, parent_run_id
+            )
+            return self.schedule(dryrun_info)
 
     def dryrun_component(
         self,
@@ -146,10 +154,13 @@ class Runner:
         parent_run_id: Optional[str] = None,
     ) -> AppHandle:
         """Run a pre-built AppDef: :meth:`dryrun` then :meth:`schedule`."""
-        dryrun_info = self.dryrun(
-            app, scheduler, cfg, workspace=workspace, parent_run_id=parent_run_id
-        )
-        return self.schedule(dryrun_info)
+        with obs_trace.span(
+            "runner.run", session=self._name, scheduler=scheduler, app=app.name
+        ):
+            dryrun_info = self.dryrun(
+                app, scheduler, cfg, workspace=workspace, parent_run_id=parent_run_id
+            )
+            return self.schedule(dryrun_info)
 
     def dryrun(
         self,
@@ -192,6 +203,7 @@ class Runner:
             session=self._name,
         ):
             self._inject_tracker_env(app, parent_run_id)
+            self._inject_trace_env(app)
             resolved_cfg = sched.run_opts().resolve(cfg)
             sched._pre_build_validate(app, resolved_cfg)
             from torchx_tpu.specs.api import Workspace
@@ -204,7 +216,10 @@ class Runner:
                         role.workspace = (
                             ws if role.workspace is None else ws.merge_into(role.workspace)
                         )
-                sched.build_workspaces(app.roles, resolved_cfg)
+                with obs_trace.span(
+                    "workspace.build", session=self._name, scheduler=scheduler
+                ):
+                    sched.build_workspaces(app.roles, resolved_cfg)
             sched._validate(app, resolved_cfg)
             return sched.materialize_dryrun(app, resolved_cfg)
 
@@ -224,7 +239,11 @@ class Runner:
             app_image=app.roles[0].image if app and app.roles else None,
             session=self._name,
         ) as ev:
+            launch_start = time.perf_counter()
             app_id = sched.schedule(dryrun_info)
+            obs_metrics.LAUNCH_SECONDS.observe(
+                time.perf_counter() - launch_start, scheduler=scheduler
+            )
             handle = make_app_handle(scheduler, self._name, app_id)
             ev._event.app_id = app_id
             if app:
@@ -270,25 +289,40 @@ class Runner:
         so short jobs return fast without hammering the control plane on
         long ones. ``timeout`` (seconds) raises :class:`TimeoutError` if no
         terminal state arrives in time — the app keeps running. ``sleep``
-        and ``rng`` are injectable for deterministic tests."""
+        and ``rng`` are injectable for deterministic tests.
+
+        The whole wait is one ``runner.wait`` span (each status poll nests
+        under it), with the poll count in attrs and the per-scheduler poll
+        counter metric incremented as it goes."""
+        scheduler, _, app_id = parse_app_handle(app_handle)
         deadline = (
             time.monotonic() + timeout if timeout is not None else None
         )
-        for interval in poll_intervals(
-            initial=min(1.0, wait_interval), max_interval=wait_interval, rng=rng
-        ):
-            status = self.status(app_handle)
-            if status is None or status.is_terminal():
-                return status
-            if deadline is not None:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise TimeoutError(
-                        f"app {app_handle} still {status.state} after"
-                        f" {timeout}s"
-                    )
-                interval = min(interval, remaining)
-            sleep(interval)
+        polls = 0
+        with obs_trace.span(
+            "runner.wait", session=self._name, scheduler=scheduler, app_id=app_id
+        ) as sp:
+            for interval in poll_intervals(
+                initial=min(1.0, wait_interval), max_interval=wait_interval, rng=rng
+            ):
+                status = self.status(app_handle)
+                polls += 1
+                obs_metrics.WAIT_POLLS.inc(scheduler=scheduler)
+                if sp is not None:
+                    sp.attrs["polls"] = polls
+                if status is None or status.is_terminal():
+                    if sp is not None and status is not None:
+                        sp.attrs["state"] = str(status.state)
+                    return status
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"app {app_handle} still {status.state} after"
+                            f" {timeout}s"
+                        )
+                    interval = min(interval, remaining)
+                sleep(interval)
         raise AssertionError("unreachable: poll_intervals is infinite")
 
     def cancel(self, app_handle: AppHandle) -> None:
@@ -475,6 +509,13 @@ class Runner:
         for role in app.roles:
             for k, v in env.items():
                 role.env.setdefault(k, v)
+
+    def _inject_trace_env(self, app: AppDef) -> None:
+        """Propagate the client trace context ($TPX_TRACE_ID /
+        $TPX_PARENT_SPAN) into every role's env so in-job spans and
+        heartbeats join this trace (see obs/trace.py)."""
+        for role in app.roles:
+            obs_trace.inject_env(role.env)
 
 
 def get_runner(

@@ -3,8 +3,17 @@
 Reference analog: torchx/runner/events/__init__.py:79-175. Events go to a
 non-propagating logger named ``torchx_tpu.events`` whose destination is
 pluggable via $TPX_EVENT_DESTINATION (default: "null" — drop; "console" —
-stderr; "log" — normal logging). Organizations point this at their
-telemetry pipeline with a logging handler.
+stderr; "log" — normal logging; "jsonl"/"prom" — the durable obs sinks).
+Organizations point this at their telemetry pipeline with a logging
+handler.
+
+This logger is also the span pipeline: :mod:`torchx_tpu.obs.trace`
+serializes completed spans onto it, and when tracing is enabled
+(``$TPX_TRACE``, default on) a JSONL sink is attached so both record kinds
+persist under ``~/.torchx_tpu/obs/<session>/`` regardless of the chosen
+destination. ``log_event`` opens a ``runner.<api>`` span around each call,
+which is how the whole Runner surface shows up in ``tpx trace`` without
+per-method instrumentation.
 """
 
 from __future__ import annotations
@@ -16,35 +25,57 @@ import traceback
 from types import TracebackType
 from typing import Optional, Type
 
+from torchx_tpu import settings
 from torchx_tpu.runner.events.api import TpxEvent
+from torchx_tpu.util.times import epoch_usec, stamp_event
 
 _events_logger: Optional[logging.Logger] = None
 
 
 def get_events_logger(destination: Optional[str] = None) -> logging.Logger:
     """The process-wide telemetry logger (non-propagating; destination
-    from ``TPX_EVENT_DESTINATION``, default "null")."""
+    from ``TPX_EVENT_DESTINATION``, default "null"). The durable JSONL
+    trace sink rides alongside the chosen destination; it checks
+    ``$TPX_TRACE`` per record, so attaching it unconditionally costs
+    nothing when tracing is off."""
     global _events_logger
     if _events_logger is None:
+        from torchx_tpu.obs.sinks import JsonlTraceHandler
         from torchx_tpu.runner.events.handlers import get_destination_handler
 
-        dest = destination or os.environ.get("TPX_EVENT_DESTINATION", "null")
+        dest = destination or os.environ.get(
+            settings.ENV_TPX_EVENT_DESTINATION, "null"
+        )
         logger = logging.getLogger("torchx_tpu.events")
         logger.setLevel(logging.INFO)
         logger.propagate = False  # never leak telemetry into app logs
         logger.addHandler(get_destination_handler(dest))
+        if dest != "jsonl":  # don't write the trace file twice
+            logger.addHandler(JsonlTraceHandler())
         _events_logger = logger
     return _events_logger
 
 
 def record(event: TpxEvent) -> None:
-    """Emit one serialized :class:`TpxEvent` to the events logger."""
+    """Emit one serialized :class:`TpxEvent` to the events logger,
+    stamping any unset time fields (:func:`~torchx_tpu.util.times.stamp_event`)
+    and the current trace/span correlation ids at emit time."""
+    stamp_event(event)
+    if event.trace_id is None or event.span_id is None:
+        from torchx_tpu.obs import trace as obs_trace
+
+        if event.trace_id is None:
+            event.trace_id = obs_trace.current_trace_id()
+        if event.span_id is None:
+            event.span_id = obs_trace.current_span_id()
     get_events_logger().info(event.serialize())
 
 
 class log_event:
     """Context manager measuring cpu/wall time and capturing exceptions for
-    one Runner API call."""
+    one Runner API call. Also opens a ``runner.<api>`` span for the call's
+    duration (sharing the event's timing and trace correlation) and feeds
+    the API latency/call metrics."""
 
     def __init__(
         self,
@@ -65,9 +96,20 @@ class log_event:
         )
 
     def __enter__(self) -> "log_event":
+        from torchx_tpu.obs import trace as obs_trace
+
         self._start_cpu = time.process_time_ns()
         self._start_wall = time.perf_counter_ns()
-        self._event.start_epoch_time_usec = int(time.time() * 1e6)
+        self._event.start_epoch_time_usec = epoch_usec()
+        self._span, self._token = obs_trace.start_span(
+            f"runner.{self._event.api}",
+            session=self._event.session,
+            scheduler=self._event.scheduler or None,
+            app_id=self._event.app_id,
+        )
+        if self._span is not None:
+            self._event.trace_id = self._span.trace_id
+            self._event.span_id = self._span.span_id
         return self
 
     def __exit__(
@@ -76,6 +118,9 @@ class log_event:
         exc: Optional[BaseException],
         tb: Optional[TracebackType],
     ) -> bool:
+        from torchx_tpu.obs import metrics as obs_metrics
+        from torchx_tpu.obs import trace as obs_trace
+
         self._event.cpu_time_usec = (time.process_time_ns() - self._start_cpu) // 1000
         self._event.wall_time_usec = (time.perf_counter_ns() - self._start_wall) // 1000
         if exc is not None:
@@ -88,5 +133,19 @@ class log_event:
                 self._event.exception_source_location = (
                     f"{frame.filename}:{frame.lineno}:{frame.name}"
                 )
+        wall_s = self._event.wall_time_usec / 1e6
+        obs_metrics.API_LATENCY.observe(
+            wall_s, api=self._event.api, scheduler=self._event.scheduler
+        )
+        obs_metrics.API_CALLS.inc(
+            api=self._event.api,
+            scheduler=self._event.scheduler,
+            status="error" if exc is not None else "ok",
+        )
+        if self._span is not None:
+            # the call may have learned the app id mid-flight (schedule)
+            if self._event.app_id:
+                self._span.attrs["app_id"] = self._event.app_id
+        obs_trace.end_span(self._span, self._token, exc=exc)
         record(self._event)
         return False

@@ -3,13 +3,19 @@
 from __future__ import annotations
 
 import json
-from dataclasses import asdict, dataclass
+from dataclasses import asdict, dataclass, fields
 from typing import Optional
 
 
 @dataclass
 class TpxEvent:
-    """One client-API telemetry record."""
+    """One client-API telemetry record.
+
+    ``trace_id``/``span_id`` correlate the event with the active
+    :class:`~torchx_tpu.obs.trace.Span` (stamped at emit by
+    :func:`~torchx_tpu.runner.events.record`), so the JSONL sink's events
+    attach to the right node of the ``tpx trace`` timeline.
+    """
 
     session: str
     scheduler: str
@@ -25,6 +31,8 @@ class TpxEvent:
     raw_exception: Optional[str] = None
     exception_type: Optional[str] = None
     exception_source_location: Optional[str] = None
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
 
     def __str__(self) -> str:
         return self.serialize()
@@ -34,4 +42,9 @@ class TpxEvent:
 
     @staticmethod
     def deserialize(data: str) -> "TpxEvent":
-        return TpxEvent(**json.loads(data))
+        """Parse a serialized event, dropping unknown fields — an old
+        reader must survive records written by a newer emitter (the JSONL
+        sink persists events across versions)."""
+        obj = json.loads(data)
+        known = {f.name for f in fields(TpxEvent)}
+        return TpxEvent(**{k: v for k, v in obj.items() if k in known})

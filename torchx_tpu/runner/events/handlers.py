@@ -2,10 +2,12 @@
 torchx/runner/events/handlers.py).
 
 The events logger routes through one handler chosen by
-$TPX_EVENT_DESTINATION: "null" (default — drop), "console"/"log" (stderr).
-Organizations register richer destinations (e.g. a BigQuery or Cloud
-Logging shipper) with :func:`register_destination` or the
-``tpx.event_handlers`` entry-point group.
+$TPX_EVENT_DESTINATION: "null" (default — drop), "console"/"log" (stderr),
+"jsonl" (durable trace sink under ~/.torchx_tpu/obs/<session>/), "prom"
+(Prometheus textfile metrics flusher). Organizations register richer
+destinations (e.g. a BigQuery or Cloud Logging shipper) with
+:func:`register_destination` or the ``tpx.event_handlers`` entry-point
+group.
 """
 
 from __future__ import annotations
@@ -14,11 +16,32 @@ import logging
 import sys
 from typing import Callable
 
+
+def _jsonl_handler() -> logging.Handler:
+    from torchx_tpu.obs.sinks import JsonlTraceHandler
+
+    return JsonlTraceHandler()
+
+
+def _prom_handler() -> logging.Handler:
+    from torchx_tpu.obs.sinks import PromMetricsHandler
+
+    return PromMetricsHandler()
+
+
 _DESTINATIONS: dict[str, Callable[[], logging.Handler]] = {
     "null": logging.NullHandler,
     "console": lambda: logging.StreamHandler(sys.stderr),
     "log": lambda: logging.StreamHandler(sys.stderr),
+    # durable obs sinks (lazy imports: handlers.py must stay import-light)
+    "jsonl": _jsonl_handler,
+    "prom": _prom_handler,
 }
+
+# Entry-point factories already resolved once: load_group re-reads the
+# installed-distribution metadata on every call, which is milliseconds of
+# filesystem work — far too slow to repeat per get_events_logger miss.
+_RESOLVED_EP_FACTORIES: dict[str, Callable[[], logging.Handler]] = {}
 
 
 def register_destination(name: str, factory: Callable[[], logging.Handler]) -> None:
@@ -26,7 +49,7 @@ def register_destination(name: str, factory: Callable[[], logging.Handler]) -> N
 
 
 def get_destination_handler(dest: str) -> logging.Handler:
-    factory = _DESTINATIONS.get(dest)
+    factory = _DESTINATIONS.get(dest) or _RESOLVED_EP_FACTORIES.get(dest)
     if factory is None:
         from torchx_tpu.util.entrypoints import load_group
 
@@ -42,6 +65,10 @@ def get_destination_handler(dest: str) -> logging.Handler:
                     e,
                 )
                 factory = None
+            else:
+                # cache successes only: a broken handler should be retried
+                # (and re-warned about) on the next resolution
+                _RESOLVED_EP_FACTORIES[dest] = factory
     if factory is None:
         factory = logging.NullHandler
     try:

@@ -200,9 +200,17 @@ class Scheduler(ABC, Generic[T]):
         """Like submit_dryrun but for callers (Runner) that already resolved
         the cfg — the single materialization point; cfg is resolved exactly
         once per submission path."""
-        dryrun_info = self._submit_dryrun(app, resolved_cfg)
-        for role in app.roles:
-            dryrun_info = role.pre_proc_fn(self.backend, dryrun_info)
+        from torchx_tpu.obs import trace as obs_trace
+
+        with obs_trace.span(
+            "scheduler.dryrun",
+            session=self.session_name,
+            scheduler=self.backend,
+            app=app.name,
+        ):
+            dryrun_info = self._submit_dryrun(app, resolved_cfg)
+            for role in app.roles:
+                dryrun_info = role.pre_proc_fn(self.backend, dryrun_info)
         dryrun_info._app = app
         dryrun_info._cfg = resolved_cfg
         dryrun_info._scheduler = self.backend

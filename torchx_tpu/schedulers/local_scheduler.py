@@ -574,14 +574,25 @@ class LocalScheduler(Scheduler[PopenRequest]):
     # -- schedule ---------------------------------------------------------
 
     def schedule(self, dryrun_info: AppDryRunInfo[PopenRequest]) -> str:
+        from torchx_tpu.obs import trace as obs_trace
+
         request = dryrun_info.request
         self._evict_lru()
         self._install_signal_cleanup()
         app = _LocalApp(request.app_id, request.log_dir, request=request)
         try:
-            for role_name, replicas in request.role_params.items():
-                for replica_id, rp in enumerate(replicas):
-                    app.add_replica(role_name, self._popen(role_name, replica_id, rp))
+            with obs_trace.span(
+                "scheduler.spawn",
+                session=self.session_name,
+                scheduler=self.backend,
+                app_id=request.app_id,
+                replicas=sum(len(r) for r in request.role_params.values()),
+            ):
+                for role_name, replicas in request.role_params.items():
+                    for replica_id, rp in enumerate(replicas):
+                        app.add_replica(
+                            role_name, self._popen(role_name, replica_id, rp)
+                        )
         except Exception:
             app.kill()
             raise
@@ -779,6 +790,11 @@ class LocalScheduler(Scheduler[PopenRequest]):
                     if r.is_alive():
                         r.terminate()
                 app.set_state(AppState.CANCELLED)
+            elif self._simulated_preemption(app):
+                for r in app.replicas():
+                    if r.is_alive():
+                        r.terminate()
+                app.set_state(AppState.PREEMPTED)
             elif self._try_elastic_restart(app):
                 return
             else:
@@ -795,6 +811,36 @@ class LocalScheduler(Scheduler[PopenRequest]):
             else:
                 app.set_state(AppState.SUCCEEDED)
                 Path(app.log_dir, "SUCCESS").touch()
+
+    def _simulated_preemption(self, app: _LocalApp) -> bool:
+        """True when a preemption drill is armed and a replica tripped it.
+
+        Opt-in only: a role env must set ``TPX_SIMULATE_PREEMPTION_EXIT``
+        to an exit code, and some replica must have exited with exactly
+        that code. The attempt then terminates PREEMPTED (the base
+        ``classify_failure`` maps it to FailureClass.PREEMPTION), which
+        lets ``tpx supervise`` be drilled against real spot semantics on
+        a laptop. Everything else — elastic restart, FAILED fast-kill —
+        is untouched when the env var is absent.
+        """
+        request = app.request
+        if request is None:
+            return False
+        drill_code: Optional[int] = None
+        for replicas in request.role_params.values():
+            for rp in replicas:
+                raw = rp.env.get(settings.ENV_TPX_SIMULATE_PREEMPTION_EXIT)
+                if raw:
+                    try:
+                        drill_code = int(raw)
+                    except ValueError:
+                        return False
+                    break
+            if drill_code is not None:
+                break
+        if drill_code is None:
+            return False
+        return any(r.proc.poll() == drill_code for r in app.replicas())
 
     def _try_elastic_restart(self, app: _LocalApp) -> bool:
         """Shrink-and-restart a failed elastic gang (BASELINE config 4).

@@ -33,6 +33,18 @@ ENV_TPX_INTERNAL_SESSION_ID = "TPX_INTERNAL_SESSION_ID"
 # reference torchx/runner/api.py:128-134).
 ENV_TPX_PARAMS_PREFIX = "TPX_PARAMS_"
 
+# Telemetry destination for the events logger ("null"/"console"/"log"/
+# "jsonl"/... — see runner/events/handlers.py).
+ENV_TPX_EVENT_DESTINATION = "TPX_EVENT_DESTINATION"
+
+# Tracing master switch: "0"/"false"/"off" disables span emission and the
+# durable JSONL/metrics sinks (default: on — the launch path is low-rate).
+ENV_TPX_TRACE = "TPX_TRACE"
+
+# Root directory for durable observability output; defaults to
+# ~/.torchx_tpu/obs (one subdir per client session). See obs/sinks.py.
+ENV_TPX_OBS_DIR = "TPX_OBS_DIR"
+
 # ---------------------------------------------------------------------------
 # In-job (injected by schedulers into every replica)
 # ---------------------------------------------------------------------------
@@ -74,10 +86,23 @@ ENV_TPX_ERROR_FILE = "TPX_ERROR_FILE"
 # Per-replica log directory.
 ENV_TPX_LOG_DIR = "TPX_LOG_DIR"
 
+# Trace correlation: the client injects these at submit so in-job spans
+# (spmd_main bootstrap, train_llama heartbeats) join the client-side trace
+# instead of starting orphan traces. See obs/trace.py.
+ENV_TPX_TRACE_ID = "TPX_TRACE_ID"
+ENV_TPX_PARENT_SPAN = "TPX_PARENT_SPAN"
+
 # Checkpoint step a resubmitted (supervised) run should resume from. The
 # supervisor injects it from the checkpoint manifest before every
 # resubmission; Checkpointer.resume_step_from_env() is the in-job reader.
 ENV_TPX_RESUME_STEP = "TPX_RESUME_STEP"
+
+# Preemption drill knob for the LOCAL scheduler only: when a role env sets
+# this to an integer exit code, a replica exiting with that code marks the
+# attempt PREEMPTED (classified FailureClass.PREEMPTION) instead of FAILED,
+# so `tpx supervise` retry/backoff/resume handling can be exercised end to
+# end without spot capacity. Unset = no behavior change.
+ENV_TPX_SIMULATE_PREEMPTION_EXIT = "TPX_SIMULATE_PREEMPTION_EXIT"
 
 # Manifest file the Checkpointer maintains next to its step dirs: a small
 # JSON record of the latest finalized step, readable by the client-side

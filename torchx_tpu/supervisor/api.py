@@ -37,6 +37,8 @@ from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable, Optional
 
 from torchx_tpu import settings
+from torchx_tpu.obs import metrics as obs_metrics
+from torchx_tpu.obs import trace as obs_trace
 from torchx_tpu.runner.events import record
 from torchx_tpu.runner.events.api import TpxEvent
 from torchx_tpu.specs.api import (
@@ -168,9 +170,12 @@ class Supervisor:
         info = self._dryrun_info
         app = copy.deepcopy(info._app)
         assert app is not None  # checked in __init__
-        if resume_step is not None:
-            for role in app.roles:
+        for role in app.roles:
+            if resume_step is not None:
                 role.env[self._policy.resume_env] = str(resume_step)
+            # re-point the in-job trace context at THIS attempt (the
+            # deep-copied env still carries the dryrun-time context)
+            obs_trace.inject_env(role.env, force=True)
         sched = self._runner._scheduler(info._scheduler)
         new_info = sched.materialize_dryrun(app, info._cfg or {})
         handle = self._runner.schedule(new_info)
@@ -208,94 +213,132 @@ class Supervisor:
     def run(self) -> SupervisorResult:
         """Run attempts until SUCCEEDED/CANCELLED, a budget is exhausted,
         or the app vanishes from its scheduler; returns the full
-        :class:`SupervisorResult` history."""
+        :class:`SupervisorResult` history.
+
+        Each attempt (submit → wait-to-terminal → classification) is one
+        ``supervisor.attempt`` span and each backoff sleep one
+        ``supervisor.backoff`` span, all nested under the caller's trace —
+        together with the transition events this is the full audit trail
+        ``tpx trace`` renders."""
+        # umbrella span: guarantees all attempts share ONE trace even when
+        # run() is called directly (Runner.supervise adds its own parent)
+        with obs_trace.span(
+            "supervisor.run",
+            session=self._runner._name,
+            scheduler=self._dryrun_info._scheduler,
+        ) as root:
+            result = self._run_attempts()
+            if root is not None:
+                root.attrs["attempts"] = result.attempts
+                if result.status is not None:
+                    root.attrs["state"] = str(result.status.state)
+        return result
+
+    def _run_attempts(self) -> SupervisorResult:
         policy = self._policy
         retries: dict[FailureClass, int] = {fc: 0 for fc in FailureClass}
         result = SupervisorResult(status=None, retries=retries)
 
         resume_step: Optional[int] = None
-        attempt = 1
-        handle = self._submit(attempt, resume_step)
-        result.handles.append(handle)
-        result.resume_steps.append(resume_step)
-        result.attempts = 1
-
+        attempt = 0
         while True:
-            status = self._await_terminal(handle)
-            result.status = status
-            _, _, app_id = parse_app_handle(handle)
-            if status is None:
-                # the scheduler forgot the app (expired / deleted from
-                # under us); resubmitting blind could double-run — stop.
-                self._emit("vanished", app_id, attempt=attempt)
-                logger.warning("app %s vanished from its scheduler", app_id)
-                return result
-            if status.state in (AppState.SUCCEEDED, AppState.CANCELLED):
-                self._emit(
-                    "finished",
-                    app_id,
-                    attempt=attempt,
-                    state=str(status.state),
-                )
-                return result
+            attempt += 1
+            with obs_trace.span(
+                "supervisor.attempt",
+                session=self._runner._name,
+                attempt=attempt,
+                resume_step=resume_step,
+            ) as asp:
+                handle = self._submit(attempt, resume_step)
+                result.handles.append(handle)
+                result.resume_steps.append(resume_step)
+                result.attempts = attempt
 
-            # terminal failure: classify conservatively (APP) when the
-            # backend attached nothing
-            fclass = status.failure_class or FailureClass.APP
-            retries[fclass] += 1
-            budget = policy.budget_for(fclass)
-            if retries[fclass] > budget:
-                retries[fclass] = budget  # report consumed, not attempted
-                result.budget_exhausted = fclass
+                status = self._await_terminal(handle)
+                result.status = status
+                _, _, app_id = parse_app_handle(handle)
+                if asp is not None:
+                    asp.attrs["app_id"] = app_id
+                    if status is not None:
+                        asp.attrs["state"] = str(status.state)
+                if status is None:
+                    # the scheduler forgot the app (expired / deleted from
+                    # under us); resubmitting blind could double-run — stop.
+                    self._emit("vanished", app_id, attempt=attempt)
+                    logger.warning("app %s vanished from its scheduler", app_id)
+                    return result
+                if status.state in (AppState.SUCCEEDED, AppState.CANCELLED):
+                    self._emit(
+                        "finished",
+                        app_id,
+                        attempt=attempt,
+                        state=str(status.state),
+                    )
+                    return result
+
+                # terminal failure: classify conservatively (APP) when the
+                # backend attached nothing
+                fclass = status.failure_class or FailureClass.APP
+                if asp is not None:
+                    asp.attrs["failure_class"] = str(fclass)
+                retries[fclass] += 1
+                budget = policy.budget_for(fclass)
+                if retries[fclass] > budget:
+                    retries[fclass] = budget  # report consumed, not attempted
+                    result.budget_exhausted = fclass
+                    self._emit(
+                        "budget_exhausted",
+                        app_id,
+                        attempt=attempt,
+                        failure_class=str(fclass),
+                        budget=budget,
+                        state=str(status.state),
+                    )
+                    logger.error(
+                        "app %s: %s budget (%d) exhausted; final state %s",
+                        app_id,
+                        fclass,
+                        budget,
+                        status.state,
+                    )
+                    return result
+
+                obs_metrics.RETRIES.inc(failure_class=str(fclass))
+                delay = policy.backoff_delay(retries[fclass], rng=self._rng)
+                if policy.checkpoint_dir:
+                    resume_step = latest_checkpoint_step(policy.checkpoint_dir)
                 self._emit(
-                    "budget_exhausted",
+                    "resubmitting",
                     app_id,
                     attempt=attempt,
                     failure_class=str(fclass),
+                    retry=retries[fclass],
                     budget=budget,
+                    backoff_seconds=round(delay, 3),
+                    resume_step=resume_step,
                     state=str(status.state),
                 )
-                logger.error(
-                    "app %s: %s budget (%d) exhausted; final state %s",
+                logger.info(
+                    "app %s %s (%s); retry %d/%d in %.1fs%s",
                     app_id,
-                    fclass,
-                    budget,
                     status.state,
+                    fclass,
+                    retries[fclass],
+                    budget,
+                    delay,
+                    f", resuming from step {resume_step}"
+                    if resume_step is not None
+                    else "",
                 )
-                return result
-
-            delay = policy.backoff_delay(retries[fclass], rng=self._rng)
-            if policy.checkpoint_dir:
-                resume_step = latest_checkpoint_step(policy.checkpoint_dir)
-            self._emit(
-                "resubmitting",
-                app_id,
-                attempt=attempt,
+            with obs_trace.span(
+                "supervisor.backoff",
+                session=self._runner._name,
                 failure_class=str(fclass),
                 retry=retries[fclass],
-                budget=budget,
-                backoff_seconds=round(delay, 3),
-                resume_step=resume_step,
-                state=str(status.state),
-            )
-            logger.info(
-                "app %s %s (%s); retry %d/%d in %.1fs%s",
-                app_id,
-                status.state,
-                fclass,
-                retries[fclass],
-                budget,
-                delay,
-                f", resuming from step {resume_step}"
-                if resume_step is not None
-                else "",
-            )
-            self._sleep(delay)
-            attempt += 1
-            handle = self._submit(attempt, resume_step)
-            result.handles.append(handle)
-            result.resume_steps.append(resume_step)
-            result.attempts = attempt
+                delay_seconds=round(delay, 3),
+            ):
+                self._sleep(delay)
+            obs_metrics.BACKOFF_SECONDS.inc(delay)
 
 
 def supervise(

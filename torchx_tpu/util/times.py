@@ -2,7 +2,8 @@
 torchx/util/datetime.py — generalized from day-granularity to the
 ``--since 2h`` style every log CLI actually needs), plus the shared
 jittered poll-interval generator used by ``Runner.wait`` and the
-supervisor loop.
+supervisor loop, and the clock helpers every telemetry record is
+stamped with (one definition of "now" for events, spans, and metrics).
 """
 
 from __future__ import annotations
@@ -10,8 +11,46 @@ from __future__ import annotations
 import math
 import random
 import re
+import time
 from datetime import datetime
 from typing import Iterator, Optional
+
+# Wall-clock zero for process-relative stamps: events emitted outside a
+# measured block (e.g. supervisor transitions) carry "time since this
+# module loaded", so consecutive records can still be diffed.
+_WALL_ZERO_NS = time.perf_counter_ns()
+
+
+def epoch_usec() -> int:
+    """Current wall time in integer epoch microseconds — the stamp unit
+    shared by :class:`~torchx_tpu.runner.events.api.TpxEvent` and
+    :class:`~torchx_tpu.obs.trace.Span`."""
+    return int(time.time() * 1e6)
+
+
+def process_wall_usec() -> int:
+    """Monotonic microseconds since this module was first imported
+    (process start, for practical purposes)."""
+    return (time.perf_counter_ns() - _WALL_ZERO_NS) // 1000
+
+
+def process_cpu_usec() -> int:
+    """This process's total CPU time in microseconds."""
+    return time.process_time_ns() // 1000
+
+
+def stamp_event(event) -> None:  # noqa: ANN001 - TpxEvent; avoids an import cycle
+    """Fill any still-``None`` time fields of a telemetry event at emit
+    time: ``start_epoch_time_usec`` gets the wall clock, ``wall``/``cpu``
+    get process-relative clocks (so instantaneous records — supervisor
+    transitions — are diffable). Events measured by ``log_event`` arrive
+    with these already set and are left untouched."""
+    if event.start_epoch_time_usec is None:
+        event.start_epoch_time_usec = epoch_usec()
+    if event.wall_time_usec is None:
+        event.wall_time_usec = process_wall_usec()
+    if event.cpu_time_usec is None:
+        event.cpu_time_usec = process_cpu_usec()
 
 _REL = re.compile(r"^(\d+)([smhdw])$")
 _UNITS = {"s": 1, "m": 60, "h": 3600, "d": 86400, "w": 604800}
