@@ -26,4 +26,38 @@ main(["trace", app_ids.pop(), "--metrics"])
 EOF
 then echo "OBS_SMOKE=ok"; else echo "OBS_SMOKE=FAILED"; rc=1; fi
 rm -rf "$obs_dir"
+
+# Lint smoke: `tpx lint` must pass a known-good AppDef (exit 0), refuse a
+# deliberately broken one (exit 1, >= 3 distinct codes), and emit stable
+# machine-readable --json.
+if timeout -k 10 120 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json, subprocess, sys, tempfile
+from torchx_tpu.specs.api import AppDef, BindMount, Resource, Role, TpuSlice
+from torchx_tpu.specs.serialize import appdef_to_dict
+
+def dump(app):
+    f = tempfile.NamedTemporaryFile("w", suffix=".json", delete=False)
+    json.dump(appdef_to_dict(app), f)
+    f.close()
+    return f.name
+
+good = dump(AppDef(name="good", roles=[Role(name="echo", image="/", entrypoint="echo", args=["hi"])]))
+bad = dump(AppDef(name="bad", roles=[Role(
+    name="trainer", image="img", entrypoint="python",
+    env={"TPX_REPLICA_ID": "0"},
+    mounts=[BindMount(src_path="/a", dst_path="/x"), BindMount(src_path="/b", dst_path="/x")],
+    resource=Resource(tpu=TpuSlice("v5e", 16, "2x2x4")))]))
+
+tpx = [sys.executable, "-m", "torchx_tpu.cli.main", "lint"]
+r = subprocess.run(tpx + ["-s", "local", good], capture_output=True, text=True)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+r = subprocess.run(tpx + ["-s", "tpu_vm", bad], capture_output=True, text=True)
+assert r.returncode == 1, (r.returncode, r.stdout, r.stderr)
+r = subprocess.run(tpx + ["-s", "tpu_vm", "--json", bad], capture_output=True, text=True)
+assert r.returncode == 1, (r.returncode, r.stdout, r.stderr)
+doc = json.loads(r.stdout)
+assert doc["version"] == 1 and doc["summary"]["error"] >= 3, doc
+assert len({d["code"] for d in doc["diagnostics"]}) >= 3, doc
+EOF
+then echo "LINT_SMOKE=ok"; else echo "LINT_SMOKE=FAILED"; rc=1; fi
 exit $rc

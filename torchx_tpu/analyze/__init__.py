@@ -1,0 +1,94 @@
+"""Preflight static analysis: coded diagnostics before a job reaches a queue.
+
+Slice capacity is the scarce resource — a malformed AppDef that dies minutes
+later on a cluster is the most expensive way to find a typo. This subsystem
+statically evaluates an :class:`~torchx_tpu.specs.api.AppDef` (plus the
+target scheduler and run opts) against a pluggable rule registry and emits
+coded diagnostics, each with a severity, a role/field location, a message
+and a fix hint.
+
+Wired in three places:
+
+* ``Runner.dryrun`` / ``Runner.run`` refuse to submit on error-severity
+  diagnostics (raising :class:`LintError`); bypass with ``no_lint=True``,
+  ``--no-lint`` or ``TPX_NO_LINT=1``.
+* ``tpx lint <component|appdef.json> [--scheduler S] [--json]`` runs the
+  same analysis standalone and exits non-zero on errors.
+* component source checks (``specs/file_linter.py``) report through the
+  same :class:`Diagnostic` model, so components and AppDefs share one
+  report format.
+
+Every run emits a ``launcher.lint`` span and diagnostic-count metrics
+(``tpx_lint_runs_total``, ``tpx_lint_diagnostics_total``) through the obs
+pipeline.
+
+Diagnostic codes
+----------------
+
+| code | severity | meaning | fix hint |
+|---|---|---|---|
+| TPX001 | error | component source has a syntax error, or the function was not found | point at ``path/to/file.py:fn`` or a name from ``tpx builtins`` |
+| TPX002 | error | component parameter is missing a type annotation | annotate every parameter |
+| TPX003 | error | component parameter type is not CLI-renderable | use str/int/float/bool, Optional/list/dict of those |
+| TPX004 | error | component takes ``**kwargs`` | enumerate parameters explicitly |
+| TPX005 | error | component return annotation is not ``-> AppDef`` | components must return an AppDef |
+| TPX006 | warning | component has no docstring | add a google-style docstring (it becomes the CLI help) |
+| TPX007 | info | component could not be materialized with the given args; AppDef-level rules skipped | pass component arguments after the name |
+| TPX010 | error | AppDef has no roles | add at least one Role |
+| TPX011 | error | role has no entrypoint | set Role.entrypoint |
+| TPX012 | error | ``num_replicas <= 0`` | set num_replicas >= 1 |
+| TPX013 | error | ``min_replicas`` outside ``(0, num_replicas]`` | lower min_replicas or raise num_replicas |
+| TPX014 | error | duplicate role names in one AppDef | make role names unique |
+| TPX015 | warning | role has no image | container backends need an image |
+| TPX101 | error | no such TPU slice: chip count impossible for the generation (multi-host slices are built from fixed-size host VMs; v5e/v6e pods cap at 256 chips) | use a valid chip count for the generation |
+| TPX102 | error | topology dimensionality does not match the generation (v5e/v6e are 2D meshes, v4/v5p are 3D tori) | use a shape like ``4x8`` (v5e) or ``2x2x4`` (v4) |
+| TPX103 | error | TPU-looking key in ``resource.devices`` | TPU chips are allocated via ``resource.tpu``, never devices |
+| TPX201 | error | role env overrides a launcher-injected identity/rendezvous var (``TPX_REPLICA_ID``, ``MEGASCALE_*``, ...) | remove it — every scheduler injects it |
+| TPX202 | warning | env var uses a reserved prefix (``TPX_``/``TPU_``/``MEGASCALE_``) but is not a documented knob | rename it |
+| TPX203 | info | ``JAX_*`` env var set (JAX runtime config) | make sure it is intentional |
+| TPX204 | warning | ``${...}`` placeholder is not a launcher macro | use ``$${...}`` for runtime shell expansion, or fix the macro name |
+| TPX210 | error | two named ports map to the same number | give each port a distinct number |
+| TPX211 | error | port outside 1-65535 | pick a valid TCP port |
+| TPX220 | error | two mounts share a destination path | each mount needs a distinct dst |
+| TPX221 | warning | mount destination is not absolute | use an absolute container path |
+| TPX300 | info | no capability profile for the scheduler; capability rules skipped | builtin backends declare ``CAPABILITIES`` |
+| TPX301 | error | mounts on a backend that does not materialize them | remove mounts or use local_docker / gke |
+| TPX302 | warning | backend has no ``delete()``: supervised resubmits cannot clean up terminal attempts | expect leftover terminal jobs |
+| TPX303 | error | multi-role AppDef on a single-role backend | split the app or use gke / slurm |
+| TPX304 | error | multi-slice TPU role (``num_replicas > 1``) on a backend without DCN wiring | use num_replicas=1 or gke |
+| TPX305 | error | backend only provisions TPU slices but the role has no ``resource.tpu`` | set resource.tpu or pick another backend |
+| TPX306 | warning | ``max_retries`` set but the backend has no native restarts | run under ``tpx supervise`` |
+| TPX307 | warning | backend builds concrete resource requests but cpu/memMB are unset | set Resource.cpu / Resource.memMB |
+| TPX401 | warning | ``RetryPolicy.REPLICA`` on a TPU role (one host cannot rejoin the ICI collective) | use RetryPolicy.APPLICATION |
+| TPX402 | error | ``max_retries < 0`` | use 0 to disable retries |
+| TPX403 | warning | supervisor preemption budget on a backend that cannot classify preemptions | raise max_app_retries or switch backend |
+| TPX404 | warning | role sets the supervisor's resume env var (it is injected on every resubmission) | let the supervisor drive resume |
+"""
+
+from torchx_tpu.analyze.diagnostics import (
+    Diagnostic,
+    LintError,
+    LintReport,
+    Severity,
+)
+from torchx_tpu.analyze.engine import analyze, analyze_component, capabilities_for
+from torchx_tpu.analyze.rules import (
+    RuleContext,
+    all_rules,
+    register_rule,
+    rule,
+)
+
+__all__ = [
+    "Severity",
+    "Diagnostic",
+    "LintReport",
+    "LintError",
+    "RuleContext",
+    "rule",
+    "register_rule",
+    "all_rules",
+    "analyze",
+    "analyze_component",
+    "capabilities_for",
+]

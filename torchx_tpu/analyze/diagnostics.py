@@ -1,0 +1,178 @@
+"""Diagnostic data model for the preflight analyzer.
+
+One report format for everything the launcher can statically check:
+component source (``specs/file_linter.py``), AppDef structure, TPU topology
+math, env/macro hygiene, scheduler capability fit and supervisor/retry
+coherence all emit :class:`Diagnostic` records that aggregate into a
+:class:`LintReport`. The report renders as human text (``tpx lint``) or
+stable JSON (``tpx lint --json``), and error severity is what the
+``Runner.dryrun`` gate refuses on (:class:`LintError`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Optional
+
+
+class Severity(str, Enum):
+    """How bad a diagnostic is.
+
+    ERROR: the submission is doomed or the launcher's own wiring would be
+        corrupted — the Runner gate refuses to submit.
+    WARNING: likely a mistake, but the job can run; never gates.
+    INFO: advisory context (e.g. capability profile missing); never gates.
+    """
+
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+    @property
+    def rank(self) -> int:
+        """Sort key: errors first, info last."""
+        return {"error": 0, "warning": 1, "info": 2}[self.value]
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One coded finding about an AppDef, component or scheduler pairing.
+
+    Attributes:
+        code: stable ``TPXnnn`` identifier (see docs/api/analyze.md for the
+            full table). The hundreds digit is the family: 0xx spec
+            structure, 1xx TPU topology/resources, 2xx env/macros, 3xx
+            scheduler capability, 4xx supervisor/retry coherence.
+        severity: :class:`Severity`; only errors gate submission.
+        message: what is wrong, concretely.
+        role: role name the finding is about, or None for app-level.
+        field: dotted field path within the role/app (e.g.
+            ``resource.tpu.topology``, ``env.TPX_REPLICA_ID``), or None.
+        hint: how to fix it (one sentence; may be empty).
+    """
+
+    code: str
+    severity: Severity
+    message: str
+    role: Optional[str] = None
+    field: Optional[str] = None
+    hint: str = ""
+
+    @property
+    def location(self) -> str:
+        """``role.field`` / ``role`` / ``field`` / ``app`` — for rendering."""
+        if self.role and self.field:
+            return f"{self.role}.{self.field}"
+        return self.role or self.field or "app"
+
+    def to_dict(self) -> dict[str, Any]:
+        """JSON-stable dict form (keys always present, fixed order)."""
+        return {
+            "code": self.code,
+            "severity": self.severity.value,
+            "role": self.role,
+            "field": self.field,
+            "message": self.message,
+            "hint": self.hint,
+        }
+
+
+@dataclass
+class LintReport:
+    """All diagnostics from one analyzer run over one target.
+
+    Attributes:
+        target: what was analyzed (app name, component name, or file path).
+        scheduler: scheduler the analysis was specialized for, or None.
+        diagnostics: findings, kept in deterministic sorted order
+            (severity, code, role, field).
+    """
+
+    target: str = ""
+    scheduler: Optional[str] = None
+    diagnostics: list[Diagnostic] = field(default_factory=list)
+
+    def extend(self, diags: "list[Diagnostic] | LintReport") -> None:
+        """Append diagnostics (from a list or another report) and re-sort."""
+        if isinstance(diags, LintReport):
+            diags = diags.diagnostics
+        self.diagnostics.extend(diags)
+        self.sort()
+
+    def sort(self) -> None:
+        """Deterministic order: severity rank, then code, then location."""
+        self.diagnostics.sort(
+            key=lambda d: (d.severity.rank, d.code, d.role or "", d.field or "")
+        )
+
+    @property
+    def errors(self) -> list[Diagnostic]:
+        """Error-severity findings — the ones the Runner gate refuses on."""
+        return [d for d in self.diagnostics if d.severity is Severity.ERROR]
+
+    @property
+    def warnings(self) -> list[Diagnostic]:
+        """Warning-severity findings."""
+        return [d for d in self.diagnostics if d.severity is Severity.WARNING]
+
+    @property
+    def has_errors(self) -> bool:
+        """True when at least one error-severity diagnostic is present."""
+        return any(d.severity is Severity.ERROR for d in self.diagnostics)
+
+    def codes(self) -> list[str]:
+        """Distinct diagnostic codes, sorted."""
+        return sorted({d.code for d in self.diagnostics})
+
+    def summary(self) -> dict[str, int]:
+        """Counts by severity, all three keys always present."""
+        out = {"error": 0, "warning": 0, "info": 0}
+        for d in self.diagnostics:
+            out[d.severity.value] += 1
+        return out
+
+    def to_dict(self) -> dict[str, Any]:
+        """Stable JSON form (consumed by ``tpx lint --json`` and CI)."""
+        self.sort()
+        return {
+            "version": 1,
+            "target": self.target,
+            "scheduler": self.scheduler,
+            "diagnostics": [d.to_dict() for d in self.diagnostics],
+            "summary": self.summary(),
+        }
+
+    def render(self) -> str:
+        """Human-readable multi-line report (what ``tpx lint`` prints)."""
+        self.sort()
+        s = self.summary()
+        sched = f" [scheduler: {self.scheduler}]" if self.scheduler else ""
+        head = (
+            f"{self.target or 'app'}: {s['error']} error(s),"
+            f" {s['warning']} warning(s), {s['info']} info{sched}"
+        )
+        lines = [head]
+        for d in self.diagnostics:
+            lines.append(f"  {d.severity.value:<7} {d.code} [{d.location}] {d.message}")
+            if d.hint:
+                lines.append(f"          fix: {d.hint}")
+        if not self.diagnostics:
+            lines.append("  clean: no findings")
+        return "\n".join(lines)
+
+
+class LintError(Exception):
+    """Raised by the ``Runner.dryrun`` gate when error-severity diagnostics
+    exist. Carries the full :class:`LintReport`; the message embeds the
+    rendered report so the refusal is actionable without re-running
+    ``tpx lint``. Bypass with ``no_lint=True`` / ``--no-lint`` /
+    ``TPX_NO_LINT=1``."""
+
+    def __init__(self, report: LintReport) -> None:
+        self.report = report
+        n = len(report.errors)
+        super().__init__(
+            f"preflight lint found {n} error(s); fix them or bypass with"
+            f" --no-lint / TPX_NO_LINT=1\n{report.render()}"
+        )

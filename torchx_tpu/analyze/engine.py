@@ -1,0 +1,196 @@
+"""The analyzer engine: run every registered rule over one target.
+
+Entry points:
+
+* :func:`analyze` — lint an :class:`~torchx_tpu.specs.api.AppDef` (optionally
+  specialized for a target scheduler + run opts + supervisor policy).
+* :func:`analyze_component` — lint a component function's *source*
+  (``specs/file_linter.py`` checks re-expressed as TPX00x diagnostics).
+* :func:`capabilities_for` — resolve a builtin backend's declared
+  :class:`~torchx_tpu.schedulers.api.SchedulerCapabilities`.
+
+Every run opens a ``launcher.lint`` span through the obs pipeline and bumps
+the ``tpx_lint_runs_total`` / ``tpx_lint_diagnostics_total`` counters, so
+preflight rejections are visible in ``tpx trace`` timelines and metrics.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+from typing import Mapping, Optional
+
+from torchx_tpu.analyze.diagnostics import Diagnostic, LintReport, Severity
+from torchx_tpu.analyze.rules import RuleContext, all_rules
+from torchx_tpu.schedulers.api import SchedulerCapabilities
+from torchx_tpu.specs.api import AppDef, CfgVal
+from torchx_tpu.supervisor.policy import SupervisorPolicy
+
+_SEVERITY = {
+    "error": Severity.ERROR,
+    "warning": Severity.WARNING,
+    "info": Severity.INFO,
+}
+
+
+def capabilities_for(scheduler: Optional[str]) -> Optional[SchedulerCapabilities]:
+    """The declared feature profile of a builtin backend, or None when the
+    scheduler is unknown / not importable (capability rules then skip).
+
+    Resolution: the backend module named in
+    :data:`~torchx_tpu.schedulers.DEFAULT_SCHEDULER_MODULES` declares a
+    module-level ``CAPABILITIES`` constant; plugins may instead set the
+    ``capabilities`` class attribute on their Scheduler subclass.
+    """
+    if not scheduler:
+        return None
+    from torchx_tpu.schedulers import DEFAULT_SCHEDULER_MODULES
+
+    module_fn = DEFAULT_SCHEDULER_MODULES.get(scheduler)
+    if module_fn is None:
+        return None
+    modname, _, _ = module_fn.partition(":")
+    try:
+        mod = importlib.import_module(modname)
+    except Exception:  # noqa: BLE001 - missing optional backend deps
+        return None
+    cap = getattr(mod, "CAPABILITIES", None)
+    return cap if isinstance(cap, SchedulerCapabilities) else None
+
+
+def analyze(
+    app: AppDef,
+    scheduler: Optional[str] = None,
+    cfg: Optional[Mapping[str, CfgVal]] = None,
+    policy: Optional[SupervisorPolicy] = None,
+    capabilities: Optional[SchedulerCapabilities] = None,
+    gate: str = "api",
+    session: str = "",
+) -> LintReport:
+    """Run all registered rules over ``app`` and return the report.
+
+    Args:
+        app: the AppDef to analyze.
+        scheduler: target backend name; enables capability rules.
+        cfg: run opts (raw or resolved) for scheduler-aware rules.
+        policy: supervisor policy for retry-coherence rules.
+        capabilities: explicit feature profile; defaults to
+            :func:`capabilities_for` on ``scheduler``.
+        gate: metric label for who ran the lint ("runner"/"cli"/"api").
+        session: session name stamped on the ``launcher.lint`` span.
+    """
+    from torchx_tpu.obs import metrics as obs_metrics
+    from torchx_tpu.obs import trace as obs_trace
+
+    if capabilities is None:
+        capabilities = capabilities_for(scheduler)
+    ctx = RuleContext(
+        app=app,
+        scheduler=scheduler,
+        cfg=cfg or {},
+        capabilities=capabilities,
+        policy=policy,
+    )
+    report = LintReport(target=app.name, scheduler=scheduler)
+    with obs_trace.span(
+        "launcher.lint",
+        session=session,
+        scheduler=scheduler,
+        app=app.name,
+        gate=gate,
+    ) as sp:
+        for _name, fn in all_rules().items():
+            report.extend(list(fn(ctx)))
+        summary = report.summary()
+        if sp is not None:
+            sp.attrs["errors"] = summary["error"]
+            sp.attrs["warnings"] = summary["warning"]
+    obs_metrics.LINT_RUNS.inc(
+        gate=gate, status="errors" if report.has_errors else "clean"
+    )
+    for d in report.diagnostics:
+        obs_metrics.LINT_DIAGNOSTICS.inc(code=d.code, severity=d.severity.value)
+    return report
+
+
+def analyze_component(name: str, gate: str = "api", session: str = "") -> LintReport:
+    """Lint a component function's source: ``dist.spmd`` (builtin) or
+    ``path/to/file.py:fn`` (custom). Returns file-linter findings (TPX00x)
+    as a :class:`LintReport` — including warnings the component finder's
+    hard validation drops."""
+    from torchx_tpu.obs import metrics as obs_metrics
+    from torchx_tpu.obs import trace as obs_trace
+    from torchx_tpu.specs import file_linter
+
+    report = LintReport(target=name)
+    with obs_trace.span("launcher.lint", session=session, app=name, gate=gate) as sp:
+        messages = []
+        if ":" in name:
+            path, _, fn_name = name.rpartition(":")
+            import os
+
+            if not os.path.isfile(path):
+                report.extend(
+                    [
+                        Diagnostic(
+                            code="TPX001",
+                            severity=Severity.ERROR,
+                            message=f"component file not found: {path}",
+                            field=name,
+                            hint="pass path/to/file.py:fn_name",
+                        )
+                    ]
+                )
+            else:
+                messages = file_linter.validate(path, fn_name, include_warnings=True)
+        else:
+            from torchx_tpu.specs.finder import get_components
+
+            components = get_components()
+            if name not in components:
+                report.extend(
+                    [
+                        Diagnostic(
+                            code="TPX001",
+                            severity=Severity.ERROR,
+                            message=(
+                                f"component {name!r} not found;"
+                                f" available: {sorted(components)}"
+                            ),
+                            field=name,
+                            hint="run `tpx builtins` to list components",
+                        )
+                    ]
+                )
+            else:
+                fn = components[name].fn
+                try:
+                    path = inspect.getfile(fn)
+                except TypeError:
+                    path = None
+                if path:
+                    messages = file_linter.validate(
+                        path, fn.__name__, include_warnings=True
+                    )
+        report.extend(
+            [
+                Diagnostic(
+                    code=m.code,
+                    severity=_SEVERITY.get(m.severity, Severity.ERROR),
+                    message=m.description,
+                    field=f"source:{m.line}:{m.char}",
+                    hint="see the component authoring rules in docs/components.md",
+                )
+                for m in messages
+            ]
+        )
+        summary = report.summary()
+        if sp is not None:
+            sp.attrs["errors"] = summary["error"]
+            sp.attrs["warnings"] = summary["warning"]
+    obs_metrics.LINT_RUNS.inc(
+        gate=gate, status="errors" if report.has_errors else "clean"
+    )
+    for d in report.diagnostics:
+        obs_metrics.LINT_DIAGNOSTICS.inc(code=d.code, severity=d.severity.value)
+    return report

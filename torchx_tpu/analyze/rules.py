@@ -1,0 +1,670 @@
+"""Built-in rules + the pluggable rule registry for the preflight analyzer.
+
+Every rule is a callable ``(RuleContext) -> Iterable[Diagnostic]`` registered
+under a stable name. The engine (:mod:`torchx_tpu.analyze.engine`) runs all
+registered rules over one AppDef; plugins and tests can add their own with
+:func:`register_rule` / the :func:`rule` decorator.
+
+Code families (full table in docs/api/analyze.md):
+
+* ``TPX00x`` component source (emitted via ``specs/file_linter.py``)
+* ``TPX01x`` AppDef structure
+* ``TPX1xx`` TPU topology / resources
+* ``TPX2xx`` env vars / macros / ports / mounts
+* ``TPX3xx`` scheduler capability fit
+* ``TPX4xx`` supervisor / retry coherence
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from string import Template
+from typing import Callable, Iterable, Iterator, Mapping, Optional
+
+from torchx_tpu import settings as s
+from torchx_tpu.analyze.diagnostics import Diagnostic, Severity
+from torchx_tpu.schedulers.api import SchedulerCapabilities
+from torchx_tpu.specs.api import (
+    AppDef,
+    CfgVal,
+    RetryPolicy,
+    Role,
+    _TPU_GENERATIONS,
+)
+from torchx_tpu.supervisor.policy import SupervisorPolicy
+
+
+@dataclass
+class RuleContext:
+    """Everything a rule may look at for one analyzer run.
+
+    Attributes:
+        app: the AppDef under analysis (never None).
+        scheduler: target scheduler name, or None when linting
+            scheduler-agnostically.
+        cfg: resolved (or raw) run opts for the scheduler, may be empty.
+        capabilities: the target scheduler's feature profile, or None when
+            the backend is unknown (capability rules then skip).
+        policy: supervisor policy for retry-coherence rules, or None.
+    """
+
+    app: AppDef
+    scheduler: Optional[str] = None
+    cfg: Optional[Mapping[str, CfgVal]] = None
+    capabilities: Optional[SchedulerCapabilities] = None
+    policy: Optional[SupervisorPolicy] = None
+
+
+Rule = Callable[[RuleContext], Iterable[Diagnostic]]
+
+_RULES: dict[str, Rule] = {}
+
+
+def register_rule(name: str, fn: Rule) -> None:
+    """Register (or replace) a rule under a stable name."""
+    _RULES[name] = fn
+
+
+def rule(name: str) -> Callable[[Rule], Rule]:
+    """Decorator form of :func:`register_rule`."""
+
+    def deco(fn: Rule) -> Rule:
+        register_rule(name, fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> dict[str, Rule]:
+    """Snapshot of the registry (name -> rule), insertion-ordered."""
+    return dict(_RULES)
+
+
+# ---------------------------------------------------------------------------
+# Env var ownership
+# ---------------------------------------------------------------------------
+
+#: Env vars the launcher injects into every replica: a role that sets one
+#: corrupts the rendezvous/identity wiring — always an error.
+LAUNCHER_OWNED_ENV = frozenset(
+    {
+        s.ENV_TPX_APP_ID,
+        s.ENV_TPX_JOB_ID,
+        s.ENV_TPX_REPLICA_ID,
+        s.ENV_TPX_ROLE_NAME,
+        s.ENV_TPX_NUM_REPLICAS,
+        s.ENV_TPX_SLICE_ID,
+        s.ENV_TPX_HOST_ID,
+        s.ENV_TPX_HOSTS_PER_SLICE,
+        s.ENV_TPX_MIN_REPLICAS,
+        s.ENV_TPX_COORDINATOR_HOST,
+        s.ENV_MEGASCALE_COORDINATOR_ADDRESS,
+        s.ENV_MEGASCALE_NUM_SLICES,
+        s.ENV_MEGASCALE_SLICE_ID,
+        s.ENV_TPU_WORKER_ID,
+        s.ENV_TPU_WORKER_HOSTNAMES,
+    }
+)
+
+#: Reserved-prefix vars that are nonetheless legitimate user knobs (the
+#: framework documents them as inputs); setting one is not even a warning.
+USER_SETTABLE_ENV = frozenset(
+    {
+        s.ENV_TPX_SIMULATE_PREEMPTION_EXIT,
+        s.ENV_TPX_RESUME_STEP,
+        s.ENV_TPX_FUSED_NORM,
+        s.ENV_TPX_ERROR_FILE,
+        s.ENV_TPX_LOG_DIR,
+        s.ENV_TPX_TRACE,
+        s.ENV_TPX_TRACE_ID,
+        s.ENV_TPX_PARENT_SPAN,
+        s.ENV_TPX_EVENT_DESTINATION,
+        s.ENV_TPX_OBS_DIR,
+        s.ENV_TPX_NO_LINT,
+        s.ENV_TPX_TRACKERS,
+        s.ENV_TPX_PARENT_RUN_ID,
+        s.ENV_TPX_INTERNAL_SESSION_ID,
+        s.ENV_TPU_VISIBLE_CHIPS,
+        s.ENV_TPU_PROCESS_BOUNDS,
+        s.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS,
+        s.ENV_TPU_SKIP_MDS_QUERY,
+        "TPU_STDERR_LOG_LEVEL",
+        "TPU_MIN_LOG_LEVEL",
+        "TPU_LIBRARY_PATH",
+    }
+)
+
+#: Prefixes the launcher considers reserved for platform wiring.
+RESERVED_ENV_PREFIXES = ("TPX_", "TPU_", "MEGASCALE_")
+
+#: Macro identifiers ``macros.Values.substitute`` knows how to resolve.
+KNOWN_MACROS = frozenset(
+    {"img_root", "app_id", "replica_id", "num_replicas", "coordinator_env"}
+)
+
+
+def unknown_macro_names(value: str) -> set[str]:
+    """Identifiers in ``${...}``/``$...`` placeholders that are not launcher
+    macros. ``$$`` escapes (runtime shell expansion) are ignored — that is
+    the documented way to defer expansion to the replica's shell."""
+    out: set[str] = set()
+    for m in Template.pattern.finditer(value):
+        name = m.group("named") or m.group("braced")
+        if name and name not in KNOWN_MACROS:
+            out.add(name)
+    return out
+
+
+def _tpu_roles(app: AppDef) -> Iterator[Role]:
+    for role in app.roles:
+        if role.resource is not None and role.resource.tpu is not None:
+            yield role
+
+
+# ---------------------------------------------------------------------------
+# TPX01x — AppDef structure
+# ---------------------------------------------------------------------------
+
+
+@rule("structure")
+def check_structure(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX010-TPX015: roles exist, are uniquely named, runnable and sized."""
+    app = ctx.app
+    if not app.roles:
+        yield Diagnostic(
+            code="TPX010",
+            severity=Severity.ERROR,
+            message=f"AppDef {app.name!r} has no roles",
+            field="roles",
+            hint="add at least one Role to the AppDef",
+        )
+        return
+    seen: set[str] = set()
+    for role in app.roles:
+        if role.name in seen:
+            yield Diagnostic(
+                code="TPX014",
+                severity=Severity.ERROR,
+                role=role.name,
+                field="name",
+                message=f"duplicate role name {role.name!r}",
+                hint="role names must be unique within an AppDef",
+            )
+        seen.add(role.name)
+        if not role.entrypoint:
+            yield Diagnostic(
+                code="TPX011",
+                severity=Severity.ERROR,
+                role=role.name,
+                field="entrypoint",
+                message=f"role {role.name!r} has no entrypoint",
+                hint="set Role.entrypoint to the command to run",
+            )
+        if role.num_replicas <= 0:
+            yield Diagnostic(
+                code="TPX012",
+                severity=Severity.ERROR,
+                role=role.name,
+                field="num_replicas",
+                message=f"num_replicas must be positive, got {role.num_replicas}",
+                hint="set num_replicas >= 1",
+            )
+        if role.min_replicas is not None and not (
+            0 < role.min_replicas <= role.num_replicas
+        ):
+            yield Diagnostic(
+                code="TPX013",
+                severity=Severity.ERROR,
+                role=role.name,
+                field="min_replicas",
+                message=(
+                    f"min_replicas={role.min_replicas} must satisfy"
+                    f" 0 < min_replicas <= num_replicas={role.num_replicas}"
+                ),
+                hint="lower min_replicas or raise num_replicas",
+            )
+        if not role.image:
+            yield Diagnostic(
+                code="TPX015",
+                severity=Severity.WARNING,
+                role=role.name,
+                field="image",
+                message=f"role {role.name!r} has no image",
+                hint=(
+                    "container backends need an image; the local scheduler"
+                    " treats it as a path root"
+                ),
+            )
+
+
+# ---------------------------------------------------------------------------
+# TPX1xx — TPU topology / resources
+# ---------------------------------------------------------------------------
+
+
+@rule("topology")
+def check_topology(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX101-TPX103: slice sizes that exist, topology shapes that match the
+    generation, and TPU chips kept out of ``resource.devices``."""
+    for role in ctx.app.roles:
+        res = role.resource
+        if res is None:
+            continue
+        tpu = res.tpu
+        if tpu is not None:
+            info = _TPU_GENERATIONS[tpu.accelerator]
+            single = info["single_host_chips"]
+            per_vm = info["multi_host_vm_chips"]
+            if tpu.chips > single and tpu.chips % per_vm:
+                yield Diagnostic(
+                    code="TPX101",
+                    severity=Severity.ERROR,
+                    role=role.name,
+                    field="resource.tpu.chips",
+                    message=(
+                        f"no {tpu.accelerator} slice has {tpu.chips} chips:"
+                        f" multi-host slices are built from {per_vm}-chip"
+                        f" hosts (single-host max is {single})"
+                    ),
+                    hint=(
+                        f"use a chip count <= {single} or a multiple of"
+                        f" {per_vm} (e.g. {max(per_vm, tpu.chips // per_vm * per_vm)})"
+                    ),
+                )
+            elif tpu.accelerator in ("v5e", "v6e") and tpu.chips > 256:
+                yield Diagnostic(
+                    code="TPX101",
+                    severity=Severity.ERROR,
+                    role=role.name,
+                    field="resource.tpu.chips",
+                    message=(
+                        f"{tpu.accelerator} pods top out at 256 chips,"
+                        f" got {tpu.chips}"
+                    ),
+                    hint="use num_replicas > 1 (multi-slice DCN) beyond one pod",
+                )
+            if tpu.topology:
+                dims = tpu.topology.split("x")
+                if tpu.accelerator in ("v5e", "v6e") and len(dims) != 2:
+                    yield Diagnostic(
+                        code="TPX102",
+                        severity=Severity.ERROR,
+                        role=role.name,
+                        field="resource.tpu.topology",
+                        message=(
+                            f"{tpu.accelerator} slices are 2D meshes;"
+                            f" topology {tpu.topology!r} has {len(dims)} dims"
+                        ),
+                        hint='use a 2D shape like "4x8"',
+                    )
+                elif tpu.accelerator in ("v4", "v5p") and len(dims) != 3:
+                    yield Diagnostic(
+                        code="TPX102",
+                        severity=Severity.ERROR,
+                        role=role.name,
+                        field="resource.tpu.topology",
+                        message=(
+                            f"{tpu.accelerator} slices are 3D tori;"
+                            f" topology {tpu.topology!r} has {len(dims)} dims"
+                        ),
+                        hint='use a 3D shape like "2x2x4"',
+                    )
+        for key in res.devices:
+            if "tpu" in key.lower():
+                yield Diagnostic(
+                    code="TPX103",
+                    severity=Severity.ERROR,
+                    role=role.name,
+                    field=f"resource.devices.{key}",
+                    message=(
+                        f"TPU chips do not go in resource.devices ({key!r});"
+                        " they are allocated via resource.tpu"
+                    ),
+                    hint="set resource.tpu = TpuSlice(...) instead",
+                )
+
+
+# ---------------------------------------------------------------------------
+# TPX2xx — env / macros / ports / mounts
+# ---------------------------------------------------------------------------
+
+
+@rule("env")
+def check_env(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX201-TPX203: launcher-owned env overrides (error), reserved-prefix
+    collisions (warning) and JAX runtime config (info)."""
+    for role in ctx.app.roles:
+        for key in role.env:
+            if key in LAUNCHER_OWNED_ENV:
+                yield Diagnostic(
+                    code="TPX201",
+                    severity=Severity.ERROR,
+                    role=role.name,
+                    field=f"env.{key}",
+                    message=(
+                        f"env var {key!r} is injected by the launcher"
+                        " (replica identity / rendezvous wiring); setting it"
+                        " in the role corrupts the gang bootstrap"
+                    ),
+                    hint="remove it from Role.env — every scheduler sets it",
+                )
+            elif key in USER_SETTABLE_ENV:
+                continue
+            elif key.startswith(RESERVED_ENV_PREFIXES):
+                yield Diagnostic(
+                    code="TPX202",
+                    severity=Severity.WARNING,
+                    role=role.name,
+                    field=f"env.{key}",
+                    message=(
+                        f"env var {key!r} uses a reserved prefix"
+                        f" ({'/'.join(RESERVED_ENV_PREFIXES)}) but is not a"
+                        " documented knob"
+                    ),
+                    hint="rename it unless you are targeting platform internals",
+                )
+            elif key.startswith("JAX_"):
+                yield Diagnostic(
+                    code="TPX203",
+                    severity=Severity.INFO,
+                    role=role.name,
+                    field=f"env.{key}",
+                    message=(
+                        f"env var {key!r} configures the JAX runtime;"
+                        " make sure it is intentional"
+                    ),
+                )
+
+
+@rule("macros")
+def check_macros(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX204: ``${...}`` placeholders that no launcher macro resolves."""
+    for role in ctx.app.roles:
+        fields: list[tuple[str, str]] = [("entrypoint", role.entrypoint)]
+        fields += [(f"args[{i}]", a) for i, a in enumerate(role.args)]
+        fields += [(f"env.{k}", v) for k, v in role.env.items()]
+        for i, m in enumerate(role.mounts):
+            for attr in ("src_path", "dst_path"):
+                val = getattr(m, attr, None)
+                if val:
+                    fields.append((f"mounts[{i}].{attr}", val))
+        for where, value in fields:
+            if not isinstance(value, str):
+                continue
+            for name in sorted(unknown_macro_names(value)):
+                yield Diagnostic(
+                    code="TPX204",
+                    severity=Severity.WARNING,
+                    role=role.name,
+                    field=where,
+                    message=(
+                        f"${{{name}}} is not a launcher macro"
+                        f" (known: {', '.join(sorted(KNOWN_MACROS))}); it will"
+                        " pass through to the replica shell unexpanded by the"
+                        " launcher"
+                    ),
+                    hint=(
+                        f"use $${{{name}}} to make runtime shell expansion"
+                        " explicit, or fix the macro name"
+                    ),
+                )
+
+
+@rule("ports")
+def check_ports(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX210-TPX211: duplicate and out-of-range ports in ``port_map``."""
+    for role in ctx.app.roles:
+        by_port: dict[int, str] = {}
+        for name, port in role.port_map.items():
+            if not 0 < port < 65536:
+                yield Diagnostic(
+                    code="TPX211",
+                    severity=Severity.ERROR,
+                    role=role.name,
+                    field=f"port_map.{name}",
+                    message=f"port {port} for {name!r} is out of range 1-65535",
+                    hint="pick a valid TCP port",
+                )
+            elif port in by_port:
+                yield Diagnostic(
+                    code="TPX210",
+                    severity=Severity.ERROR,
+                    role=role.name,
+                    field=f"port_map.{name}",
+                    message=(
+                        f"port {port} is mapped twice"
+                        f" ({by_port[port]!r} and {name!r})"
+                    ),
+                    hint="give each named port a distinct number",
+                )
+            else:
+                by_port[port] = name
+
+
+@rule("mounts")
+def check_mounts(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX220-TPX221: duplicate destinations and relative paths in mounts."""
+    for role in ctx.app.roles:
+        seen: dict[str, int] = {}
+        for i, m in enumerate(role.mounts):
+            dst = getattr(m, "dst_path", None)
+            if not dst:
+                continue
+            if dst in seen:
+                yield Diagnostic(
+                    code="TPX220",
+                    severity=Severity.ERROR,
+                    role=role.name,
+                    field=f"mounts[{i}].dst_path",
+                    message=(
+                        f"mount destination {dst!r} is used by both"
+                        f" mounts[{seen[dst]}] and mounts[{i}]"
+                    ),
+                    hint="each mount needs a distinct destination path",
+                )
+            else:
+                seen[dst] = i
+            if not dst.startswith("/") and "${" not in dst:
+                yield Diagnostic(
+                    code="TPX221",
+                    severity=Severity.WARNING,
+                    role=role.name,
+                    field=f"mounts[{i}].dst_path",
+                    message=f"mount destination {dst!r} is not absolute",
+                    hint="use an absolute container path",
+                )
+
+
+# ---------------------------------------------------------------------------
+# TPX3xx — scheduler capability fit
+# ---------------------------------------------------------------------------
+
+
+@rule("capabilities")
+def check_capabilities(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX300-TPX307: AppDef features the target backend cannot honor."""
+    if ctx.scheduler is None:
+        return
+    cap = ctx.capabilities
+    if cap is None:
+        yield Diagnostic(
+            code="TPX300",
+            severity=Severity.INFO,
+            message=(
+                f"no capability profile for scheduler {ctx.scheduler!r};"
+                " capability rules skipped"
+            ),
+            hint=(
+                "builtin backends declare CAPABILITIES in their module;"
+                " plugins can set Scheduler.capabilities"
+            ),
+        )
+        return
+    app = ctx.app
+    if len(app.roles) > 1 and not cap.multi_role:
+        yield Diagnostic(
+            code="TPX303",
+            severity=Severity.ERROR,
+            field="roles",
+            message=(
+                f"scheduler {ctx.scheduler!r} launches exactly one role per"
+                f" job; AppDef has {len(app.roles)}"
+            ),
+            hint="split the app or pick a multi-role backend (gke, slurm)",
+        )
+    if not cap.delete:
+        yield Diagnostic(
+            code="TPX302",
+            severity=Severity.WARNING,
+            message=(
+                f"scheduler {ctx.scheduler!r} has no delete(); supervised"
+                " resubmission cannot clean up terminal attempts"
+            ),
+            hint="expect leftover terminal jobs when using tpx supervise",
+        )
+    for role in app.roles:
+        if role.mounts and not cap.mounts:
+            yield Diagnostic(
+                code="TPX301",
+                severity=Severity.ERROR,
+                role=role.name,
+                field="mounts",
+                message=(
+                    f"scheduler {ctx.scheduler!r} does not materialize"
+                    f" mounts; {len(role.mounts)} mount(s) would be silently"
+                    " dropped"
+                ),
+                hint="remove the mounts or use local_docker / gke",
+            )
+        if cap.requires_tpu and (role.resource is None or role.resource.tpu is None):
+            yield Diagnostic(
+                code="TPX305",
+                severity=Severity.ERROR,
+                role=role.name,
+                field="resource.tpu",
+                message=(
+                    f"scheduler {ctx.scheduler!r} only provisions TPU slices;"
+                    f" role {role.name!r} has no resource.tpu"
+                ),
+                hint="set resource.tpu = TpuSlice(...) or pick another backend",
+            )
+        if (
+            role.resource is not None
+            and role.resource.tpu is not None
+            and role.num_replicas > 1
+            and not cap.multislice
+        ):
+            yield Diagnostic(
+                code="TPX304",
+                severity=Severity.ERROR,
+                role=role.name,
+                field="num_replicas",
+                message=(
+                    f"scheduler {ctx.scheduler!r} cannot wire multi-slice"
+                    f" DCN training (TPU role with num_replicas="
+                    f"{role.num_replicas})"
+                ),
+                hint="use num_replicas=1 or a multislice backend (gke)",
+            )
+        if role.max_retries > 0 and not cap.native_retries:
+            yield Diagnostic(
+                code="TPX306",
+                severity=Severity.WARNING,
+                role=role.name,
+                field="max_retries",
+                message=(
+                    f"scheduler {ctx.scheduler!r} does not honor"
+                    f" max_retries={role.max_retries} natively"
+                ),
+                hint="run under `tpx supervise` for client-side resubmission",
+            )
+        if (
+            cap.concrete_resources
+            and (role.resource is None or role.resource.tpu is None)
+            and (role.resource is None or role.resource.cpu <= 0 or role.resource.memMB <= 0)
+        ):
+            yield Diagnostic(
+                code="TPX307",
+                severity=Severity.WARNING,
+                role=role.name,
+                field="resource",
+                message=(
+                    f"scheduler {ctx.scheduler!r} builds concrete resource"
+                    " requests but cpu/memMB are unset; backend defaults"
+                    " apply"
+                ),
+                hint="set Resource.cpu and Resource.memMB explicitly",
+            )
+
+
+# ---------------------------------------------------------------------------
+# TPX4xx — supervisor / retry coherence
+# ---------------------------------------------------------------------------
+
+
+@rule("retries")
+def check_retries(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX401-TPX404: retry budgets and policies that cannot do what they
+    promise (gang semantics, preemption classification, resume injection)."""
+    cap = ctx.capabilities
+    policy = ctx.policy
+    for role in ctx.app.roles:
+        if role.max_retries < 0:
+            yield Diagnostic(
+                code="TPX402",
+                severity=Severity.ERROR,
+                role=role.name,
+                field="max_retries",
+                message=f"max_retries must be >= 0, got {role.max_retries}",
+                hint="use 0 to disable retries",
+            )
+        if (
+            role.resource is not None
+            and role.resource.tpu is not None
+            and role.retry_policy == RetryPolicy.REPLICA
+        ):
+            yield Diagnostic(
+                code="TPX401",
+                severity=Severity.WARNING,
+                role=role.name,
+                field="retry_policy",
+                message=(
+                    "RetryPolicy.REPLICA on a TPU role: restarting one host"
+                    " cannot rejoin the ICI collective — the whole gang must"
+                    " restart"
+                ),
+                hint="use RetryPolicy.APPLICATION (the TPU default)",
+            )
+        if policy is not None and policy.resume_env in role.env:
+            yield Diagnostic(
+                code="TPX404",
+                severity=Severity.WARNING,
+                role=role.name,
+                field=f"env.{policy.resume_env}",
+                message=(
+                    f"role sets {policy.resume_env!r} but the supervisor"
+                    " injects it from the checkpoint manifest on every"
+                    " resubmission; the role value will be overwritten"
+                ),
+                hint="drop it from Role.env and let the supervisor drive resume",
+            )
+    if (
+        policy is not None
+        and policy.max_preemptions > 0
+        and cap is not None
+        and not cap.classifies_preemption
+    ):
+        yield Diagnostic(
+            code="TPX403",
+            severity=Severity.WARNING,
+            message=(
+                f"policy allows {policy.max_preemptions} preemption"
+                f" resubmits but scheduler {ctx.scheduler!r} cannot classify"
+                " preemptions — they will be counted as app errors"
+                f" (budget {policy.max_app_retries})"
+            ),
+            hint=(
+                "raise max_app_retries or use a backend that classifies"
+                " preemption (gke, tpu_vm, slurm, local)"
+            ),
+        )

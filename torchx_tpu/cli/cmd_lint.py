@@ -1,0 +1,169 @@
+"""``tpx lint`` — run the preflight analyzer without submitting anything.
+
+Targets:
+
+* a builtin component name (``dist.spmd``) or custom ``file.py:fn`` —
+  lints the component source (TPX00x) and, when the component can be
+  materialized with the given args, the resulting AppDef;
+* an AppDef JSON file (``job.json``, the ``torchx_tpu.specs.serialize``
+  shape) or ``-`` for the same JSON on stdin.
+
+``--scheduler`` specializes the analysis for one backend (capability
+rules), ``--policy`` feeds a supervisor policy JSON for retry-coherence
+rules, and ``--json`` emits the stable machine-readable report.
+
+Exit codes: 0 clean (warnings allowed), 1 error-severity diagnostics,
+2 usage errors (unknown scheduler, unreadable target).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from torchx_tpu.analyze import (
+    Diagnostic,
+    LintReport,
+    Severity,
+    analyze,
+    analyze_component,
+)
+from torchx_tpu.cli.cmd_base import SubCommand
+
+logger = logging.getLogger(__name__)
+
+
+class CmdLint(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument(
+            "-s",
+            "--scheduler",
+            type=str,
+            default=None,
+            help="specialize the analysis for one scheduler backend",
+        )
+        subparser.add_argument(
+            "--json",
+            action="store_true",
+            help="emit the report as stable JSON instead of text",
+        )
+        subparser.add_argument(
+            "--policy",
+            type=str,
+            default=None,
+            help="supervisor policy JSON file for retry-coherence rules",
+        )
+        subparser.add_argument(
+            "conf_args",
+            nargs=argparse.REMAINDER,
+            help="component name / file.py:fn / appdef.json / '-' (stdin),"
+            " optionally followed by component arguments",
+        )
+
+    def run(self, args: argparse.Namespace) -> None:
+        conf_args = args.conf_args
+        if conf_args and conf_args[0] == "--":
+            conf_args = conf_args[1:]
+        if not conf_args:
+            print(
+                "error: lint needs a target: a component name, file.py:fn,"
+                " an AppDef JSON file, or '-' for stdin",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        target, rest = conf_args[0], conf_args[1:]
+
+        scheduler = args.scheduler
+        if scheduler is not None:
+            from torchx_tpu.schedulers import get_scheduler_factories
+
+            available = sorted(get_scheduler_factories())
+            if scheduler not in available:
+                print(
+                    f"error: unknown scheduler {scheduler!r};"
+                    f" available: {available}",
+                    file=sys.stderr,
+                )
+                sys.exit(2)
+
+        policy = None
+        if args.policy:
+            policy = self._load_policy(args.policy)
+
+        report = self._lint_target(target, rest, scheduler, policy)
+        if args.json:
+            print(json.dumps(report.to_dict(), indent=2))
+        else:
+            print(report.render())
+        sys.exit(1 if report.has_errors else 0)
+
+    def _load_policy(self, path: str):  # noqa: ANN001 - SupervisorPolicy
+        from torchx_tpu.specs.serialize import supervisor_policy_from_dict
+
+        try:
+            with open(path) as f:
+                return supervisor_policy_from_dict(json.load(f))
+        except (OSError, json.JSONDecodeError, ValueError, TypeError, KeyError) as e:
+            print(f"error: cannot load policy {path!r}: {e}", file=sys.stderr)
+            sys.exit(2)
+
+    def _lint_target(self, target: str, rest, scheduler, policy) -> LintReport:  # noqa: ANN001
+        from torchx_tpu.specs.serialize import appdef_from_dict
+
+        if target == "-" or target.endswith(".json"):
+            try:
+                if target == "-":
+                    raw = json.load(sys.stdin)
+                else:
+                    with open(target) as f:
+                        raw = json.load(f)
+                app = appdef_from_dict(raw)
+            except (
+                OSError,
+                json.JSONDecodeError,
+                ValueError,
+                KeyError,
+                TypeError,
+                AttributeError,
+            ) as e:
+                print(f"error: invalid job spec {target!r}: {e}", file=sys.stderr)
+                sys.exit(2)
+            report = analyze(app, scheduler=scheduler, policy=policy, gate="cli")
+            report.target = target if target != "-" else app.name
+            return report
+
+        # component target: source lint first, then AppDef lint if it
+        # materializes with the given args
+        report = analyze_component(target, gate="cli")
+        if report.has_errors:
+            return report
+        from torchx_tpu.specs.builders import materialize_appdef
+        from torchx_tpu.specs.finder import get_component
+
+        try:
+            component_def = get_component(target)
+            app = materialize_appdef(component_def.fn, rest)
+        except Exception as e:  # noqa: BLE001 - missing required args etc.
+            report.extend(
+                [
+                    Diagnostic(
+                        code="TPX007",
+                        severity=Severity.INFO,
+                        message=(
+                            f"component not materialized ({e}); AppDef-level"
+                            " rules skipped"
+                        ),
+                        hint=(
+                            "pass the component's arguments after the name"
+                            " to lint the resulting AppDef"
+                        ),
+                    )
+                ]
+            )
+            return report
+        app_report = analyze(app, scheduler=scheduler, policy=policy, gate="cli")
+        report.scheduler = scheduler
+        report.extend(app_report)
+        return report

@@ -13,6 +13,7 @@ import json
 import logging
 import sys
 
+from torchx_tpu.analyze import LintError
 from torchx_tpu.cli.cmd_base import SubCommand
 from torchx_tpu.runner import config as tpx_config
 from torchx_tpu.runner.api import Runner, get_runner
@@ -63,6 +64,11 @@ class CmdRun(SubCommand):
         )
         subparser.add_argument(
             "--parent_run_id", type=str, default=None, help="tracker parent run id"
+        )
+        subparser.add_argument(
+            "--no-lint",
+            action="store_true",
+            help="skip the preflight analyzer gate (see `tpx lint`)",
         )
         subparser.add_argument(
             "--stdin",
@@ -125,6 +131,7 @@ class CmdRun(SubCommand):
                     cfg,
                     workspace=args.workspace,
                     parent_run_id=args.parent_run_id,
+                    no_lint=args.no_lint,
                 )
                 print("=== APPLICATION ===")
                 print(_pretty_app(dryrun_info._app))
@@ -138,7 +145,11 @@ class CmdRun(SubCommand):
                 cfg,
                 workspace=args.workspace,
                 parent_run_id=args.parent_run_id,
+                no_lint=args.no_lint,
             )
+        except LintError as e:
+            print(f"error: {e}", file=sys.stderr)
+            sys.exit(1)
         except (ComponentValidationException, ComponentNotFoundException) as e:
             print(f"error: {e}", file=sys.stderr)
             sys.exit(1)
@@ -167,6 +178,7 @@ class CmdRun(SubCommand):
                     cfg,
                     workspace=args.workspace,
                     parent_run_id=args.parent_run_id,
+                    no_lint=args.no_lint,
                 )
                 print("=== APPLICATION ===")
                 print(_pretty_app(info._app))
@@ -179,8 +191,9 @@ class CmdRun(SubCommand):
                 cfg,
                 workspace=args.workspace,
                 parent_run_id=args.parent_run_id,
+                no_lint=args.no_lint,
             )
-        except ValueError as e:
+        except (LintError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             sys.exit(1)
         print(handle)

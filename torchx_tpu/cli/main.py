@@ -12,6 +12,7 @@ import sys
 from typing import Optional
 
 from torchx_tpu.cli.cmd_base import SubCommand
+from torchx_tpu.cli.cmd_lint import CmdLint
 from torchx_tpu.cli.cmd_log import CmdLog
 from torchx_tpu.cli.cmd_run import CmdRun
 from torchx_tpu.cli.cmd_simple import (
@@ -36,6 +37,7 @@ CMDS_ENTRYPOINT_GROUP = "tpx.cli.cmds"
 def get_sub_cmds() -> dict[str, SubCommand]:
     cmds: dict[str, SubCommand] = {
         "run": CmdRun(),
+        "lint": CmdLint(),
         "supervise": CmdSupervise(),
         "status": CmdStatus(),
         "describe": CmdDescribe(),

@@ -318,3 +318,18 @@ LAUNCH_TO_FIRST_STEP = REGISTRY.histogram(
     "tpx_launch_to_first_step_seconds",
     "process start to first completed training step in seconds",
 )
+
+#: preflight lint runs, by entry point ("runner"/"cli") and outcome
+#: ("clean"/"errors").
+LINT_RUNS = REGISTRY.counter(
+    "tpx_lint_runs_total",
+    "preflight analyzer runs",
+    ("gate", "status"),
+)
+
+#: diagnostics emitted by the preflight analyzer, by code + severity.
+LINT_DIAGNOSTICS = REGISTRY.counter(
+    "tpx_lint_diagnostics_total",
+    "preflight diagnostics emitted",
+    ("code", "severity"),
+)

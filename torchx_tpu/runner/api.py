@@ -104,6 +104,7 @@ class Runner:
         cfg: Optional[Mapping[str, CfgVal]] = None,
         workspace: Optional[str] = None,
         parent_run_id: Optional[str] = None,
+        no_lint: bool = False,
     ) -> AppHandle:
         """Resolve a component (builtin name / file.py:fn), materialize it
         with the given CLI-style args, and run it."""
@@ -114,7 +115,13 @@ class Runner:
             scheduler=scheduler,
         ):
             dryrun_info = self.dryrun_component(
-                component, component_args, scheduler, cfg, workspace, parent_run_id
+                component,
+                component_args,
+                scheduler,
+                cfg,
+                workspace,
+                parent_run_id,
+                no_lint=no_lint,
             )
             return self.schedule(dryrun_info)
 
@@ -126,6 +133,7 @@ class Runner:
         cfg: Optional[Mapping[str, CfgVal]] = None,
         workspace: Optional[str] = None,
         parent_run_id: Optional[str] = None,
+        no_lint: bool = False,
     ) -> AppDryRunInfo:
         """:meth:`run_component` up to (and including) the scheduler's
         dryrun: returns the fully materialized request without submitting
@@ -140,7 +148,12 @@ class Runner:
             self._component_defaults.get(component),
         )
         return self.dryrun(
-            app, scheduler, cfg, workspace=workspace, parent_run_id=parent_run_id
+            app,
+            scheduler,
+            cfg,
+            workspace=workspace,
+            parent_run_id=parent_run_id,
+            no_lint=no_lint,
         )
 
     # -- run path ----------------------------------------------------------
@@ -152,13 +165,19 @@ class Runner:
         cfg: Optional[Mapping[str, CfgVal]] = None,
         workspace: Optional[str] = None,
         parent_run_id: Optional[str] = None,
+        no_lint: bool = False,
     ) -> AppHandle:
         """Run a pre-built AppDef: :meth:`dryrun` then :meth:`schedule`."""
         with obs_trace.span(
             "runner.run", session=self._name, scheduler=scheduler, app=app.name
         ):
             dryrun_info = self.dryrun(
-                app, scheduler, cfg, workspace=workspace, parent_run_id=parent_run_id
+                app,
+                scheduler,
+                cfg,
+                workspace=workspace,
+                parent_run_id=parent_run_id,
+                no_lint=no_lint,
             )
             return self.schedule(dryrun_info)
 
@@ -169,8 +188,15 @@ class Runner:
         cfg: Optional[Mapping[str, CfgVal]] = None,
         workspace: Optional[str] = None,
         parent_run_id: Optional[str] = None,
+        no_lint: bool = False,
     ) -> AppDryRunInfo:
-        """Validate + build workspace + materialize the scheduler request.
+        """Validate + lint + build workspace + materialize the scheduler
+        request.
+
+        The preflight analyzer (:mod:`torchx_tpu.analyze`) gates here:
+        error-severity diagnostics raise
+        :class:`~torchx_tpu.analyze.LintError` before anything is built.
+        Bypass with ``no_lint=True`` (CLI ``--no-lint``) or ``TPX_NO_LINT=1``.
 
         Works on a deep copy: workspace builds mutate role.image and tracker
         env injection mutates role.env; the caller's AppDef stays pristine.
@@ -195,6 +221,21 @@ class Runner:
                 )
 
         sched = self._scheduler(scheduler)
+        if not no_lint and os.environ.get(
+            settings.ENV_TPX_NO_LINT, ""
+        ).strip().lower() not in ("1", "true", "yes", "on"):
+            from torchx_tpu.analyze import LintError, analyze
+
+            report = analyze(
+                app,
+                scheduler=scheduler,
+                cfg=cfg,
+                capabilities=sched.capabilities,  # None -> registry lookup
+                gate="runner",
+                session=self._name,
+            )
+            if report.has_errors:
+                raise LintError(report)
         with log_event(
             "dryrun",
             scheduler,

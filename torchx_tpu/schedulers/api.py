@@ -68,6 +68,49 @@ class ListAppResponse:
     name: str = ""
 
 
+@dataclass(frozen=True)
+class SchedulerCapabilities:
+    """Static feature profile of a scheduler backend.
+
+    Declared as a module-level ``CAPABILITIES`` constant (and ``capabilities``
+    class attribute) by every backend in :mod:`torchx_tpu.schedulers` so the
+    preflight analyzer (:mod:`torchx_tpu.analyze`) can reject AppDefs that
+    use features the target backend cannot honor *before* submission — e.g.
+    mounts on tpu_vm, multi-role apps on gcp_batch, or a retry budget on a
+    backend with no native restart support.
+
+    Attributes:
+        mounts: backend materializes Bind/Volume/Device mounts.
+        multi_role: backend can launch more than one role per app.
+        requires_tpu: backend only accepts roles with a TPU resource.
+        multislice: backend wires multi-slice DCN training
+            (TPU role with ``num_replicas > 1``).
+        delete: backend implements :meth:`Scheduler.delete` — terminal
+            attempts can be cleaned up by the supervisor before resubmit.
+        resize: backend implements :meth:`Scheduler.resize`.
+        logs: backend implements :meth:`Scheduler.log_iter`.
+        native_retries: backend honors ``Role.max_retries`` itself
+            (in-place restarts that do not consume supervisor budgets).
+        concrete_resources: backend builds real resource requests from
+            ``Resource.cpu`` / ``Resource.memMB`` (unset values fall back
+            to backend defaults but are worth a warning).
+        classifies_preemption: backend can distinguish PREEMPTED from FAILED
+            in :meth:`Scheduler.classify_failure` — without it, preemptions
+            burn the supervisor's (default zero) APP_ERROR budget.
+    """
+
+    mounts: bool = False
+    multi_role: bool = True
+    requires_tpu: bool = False
+    multislice: bool = False
+    delete: bool = False
+    resize: bool = False
+    logs: bool = True
+    native_retries: bool = False
+    concrete_resources: bool = False
+    classifies_preemption: bool = False
+
+
 def dquote(s: str) -> str:
     """Double-quote a string for bash: metachars are safe but ``$VAR`` /
     ``${VAR}`` references (runtime macro values like the replica id) still
@@ -174,6 +217,11 @@ class Scheduler(ABC, Generic[T]):
     ``schedule`` (actually submit), ``describe``, ``list``, and
     ``_cancel_existing``; optionally ``log_iter``, ``delete``, ``_validate``.
     """
+
+    # Feature profile consulted by the preflight analyzer; backends override
+    # with their module's CAPABILITIES constant. None = unknown backend
+    # profile, capability rules are skipped.
+    capabilities: Optional[SchedulerCapabilities] = None
 
     def __init__(self, backend: str, session_name: str) -> None:
         self.backend = backend

@@ -26,6 +26,7 @@ from torchx_tpu.schedulers.api import (
     DescribeAppResponse,
     ListAppResponse,
     Scheduler,
+    SchedulerCapabilities,
     Stream,
     filter_regex,
     role_replica_env,
@@ -96,7 +97,24 @@ class DockerJob:
         )
 
 
+# Feature profile for the preflight analyzer (torchx_tpu.analyze): docker
+# materializes mounts and honors MaximumRetryCount, but one daemon on one
+# host cannot wire multi-slice DCN training or classify spot reclamation.
+CAPABILITIES = SchedulerCapabilities(
+    mounts=True,
+    multi_role=True,
+    multislice=False,
+    delete=True,
+    resize=False,
+    logs=True,
+    native_retries=True,
+    concrete_resources=False,  # unset cpu/memMB simply means "no limits"
+    classifies_preemption=False,
+)
+
+
 class DockerScheduler(DockerWorkspaceMixin, Scheduler[DockerJob]):
+    capabilities = CAPABILITIES
     supports_log_windows = True  # docker daemon applies since/until
     def __init__(
         self,

@@ -43,6 +43,7 @@ from torchx_tpu.schedulers.api import (
     safe_int as _safe_int,
     ListAppResponse,
     Scheduler,
+    SchedulerCapabilities,
     Stream,
     filter_regex,
     rfc3339 as _rfc3339,
@@ -276,8 +277,26 @@ def describe_batch_job(
     )
 
 
+# Feature profile for the preflight analyzer (torchx_tpu.analyze): Batch
+# jobs are single-role (one taskGroup), honor maxRetryCount natively, and
+# build concrete machine requests from cpu/memMB.
+CAPABILITIES = SchedulerCapabilities(
+    mounts=False,
+    multi_role=False,
+    multislice=False,
+    delete=True,
+    resize=False,
+    logs=True,
+    native_retries=True,
+    concrete_resources=True,
+    classifies_preemption=False,
+)
+
+
 class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
     """Submits AppDefs as GCP Batch jobs through the gcloud CLI."""
+
+    capabilities = CAPABILITIES
 
     # since/until become server-side Cloud Logging timestamp filters
     supports_log_windows = True

@@ -47,6 +47,7 @@ from torchx_tpu.schedulers.api import (
     DescribeAppResponse,
     ListAppResponse,
     Scheduler,
+    SchedulerCapabilities,
     Stream,
     filter_regex,
 )
@@ -763,8 +764,27 @@ def elastic_controller_job(
 # =========================================================================
 
 
+# Feature profile for the preflight analyzer (torchx_tpu.analyze): the most
+# capable backend — JobSet multi-role, multi-slice DCN, volume mounts,
+# failurePolicy restarts, node-disruption preemption classification, and
+# concrete resource requests from cpu/memMB.
+CAPABILITIES = SchedulerCapabilities(
+    mounts=True,
+    multi_role=True,
+    multislice=True,
+    delete=True,
+    resize=True,
+    logs=True,
+    native_retries=True,
+    concrete_resources=True,
+    classifies_preemption=True,
+)
+
+
 class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
     """Submits AppDefs as JobSets to a GKE (or any JobSet-enabled) cluster."""
+
+    capabilities = CAPABILITIES
 
     def __init__(
         self,

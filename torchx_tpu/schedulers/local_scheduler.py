@@ -37,6 +37,7 @@ from torchx_tpu.schedulers.api import (
     DescribeAppResponse,
     ListAppResponse,
     Scheduler,
+    SchedulerCapabilities,
     Stream,
     filter_regex,
     role_replica_env,
@@ -403,8 +404,27 @@ class _LocalApp:
 # =========================================================================
 
 
+# Feature profile for the preflight analyzer (torchx_tpu.analyze): local
+# subprocesses simulate gangs, multi-slice identity env, elastic restarts
+# and (via TPX_SIMULATE_PREEMPTION_EXIT) preemption classification — but
+# mounts are silently ignored by Popen, so they are declared unsupported.
+CAPABILITIES = SchedulerCapabilities(
+    mounts=False,
+    multi_role=True,
+    multislice=True,
+    delete=True,
+    resize=True,
+    logs=True,
+    native_retries=True,
+    concrete_resources=False,
+    classifies_preemption=True,
+)
+
+
 class LocalScheduler(Scheduler[PopenRequest]):
     """Executes AppDef roles as local subprocesses."""
+
+    capabilities = CAPABILITIES
 
     # combined.log lines are epoch-stamped by the Tee (streams.py), so
     # since/until windows are honored on the default combined stream

@@ -40,6 +40,7 @@ from torchx_tpu.schedulers.api import (
     EPOCH_STAMPER,
     ListAppResponse,
     Scheduler,
+    SchedulerCapabilities,
     Stream,
     filter_regex,
     tpu_hosts_for_role,
@@ -383,9 +384,27 @@ def _materialize_elastic_script(req: SlurmBatchRequest) -> str:
     return "\n".join(lines)
 
 
+# Feature profile for the preflight analyzer (torchx_tpu.analyze): sbatch
+# carries multi-role het jobs and exports a TPX_MAX_RETRIES restart budget,
+# and sacct requeue records classify preemption — but there is no mount
+# materialization, no delete(), and no in-place resize.
+CAPABILITIES = SchedulerCapabilities(
+    mounts=False,
+    multi_role=True,
+    multislice=False,
+    delete=False,
+    resize=False,
+    logs=True,
+    native_retries=True,
+    concrete_resources=True,
+    classifies_preemption=True,
+)
+
+
 class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
     """Submits AppDefs as heterogeneous sbatch jobs."""
 
+    capabilities = CAPABILITIES
     supports_log_windows = True  # wrapper-stamped log lines (_STAMP_WRAPPER)
 
     def __init__(self, session_name: str) -> None:

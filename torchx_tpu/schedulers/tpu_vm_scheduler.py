@@ -41,6 +41,7 @@ from torchx_tpu.schedulers.api import (
     EPOCH_STAMPER,
     ListAppResponse,
     Scheduler,
+    SchedulerCapabilities,
     Stream,
     filter_regex,
     parse_epoch_stamp,
@@ -165,7 +166,26 @@ echo $? > /tmp/tpx/exitcode
 """
 
 
+# Feature profile for the preflight analyzer (torchx_tpu.analyze): queued
+# resources are exactly one TPU role per job — no mounts, no multi-slice,
+# no native retries (resubmission is the supervisor's job, and spot
+# reclamation is classified from the QR state for it).
+CAPABILITIES = SchedulerCapabilities(
+    mounts=False,
+    multi_role=False,
+    requires_tpu=True,
+    multislice=False,
+    delete=True,
+    resize=False,
+    logs=True,
+    native_retries=False,
+    concrete_resources=False,
+    classifies_preemption=True,
+)
+
+
 class TpuVmScheduler(Scheduler[TpuVmRequest]):
+    capabilities = CAPABILITIES
     supports_log_windows = True  # stamped remote log lines
     def __init__(self, session_name: str) -> None:
         super().__init__("tpu_vm", session_name)

@@ -34,6 +34,7 @@ from torchx_tpu.schedulers.api import (
     DescribeAppResponse,
     ListAppResponse,
     Scheduler,
+    SchedulerCapabilities,
     Stream,
     filter_regex,
     rfc3339 as _rfc3339,
@@ -212,8 +213,27 @@ class VertexJob:
         return f"projects/{self.project}/locations/{self.region}"
 
 
+# Feature profile for the preflight analyzer (torchx_tpu.analyze): worker
+# pools map multi-role apps and machine specs are concrete, but CustomJobs
+# have no mounts, no delete()/resize(), and a TPU role is limited to a
+# single slice (num_replicas == 1).
+CAPABILITIES = SchedulerCapabilities(
+    mounts=False,
+    multi_role=True,
+    multislice=False,
+    delete=False,
+    resize=False,
+    logs=True,
+    native_retries=True,
+    concrete_resources=True,
+    classifies_preemption=False,
+)
+
+
 class VertexScheduler(DockerWorkspaceMixin, Scheduler[VertexJob]):
     """Submits AppDefs as Vertex AI CustomJobs (managed TPU training)."""
+
+    capabilities = CAPABILITIES
 
     # since/until become server-side Cloud Logging timestamp filters
     supports_log_windows = True

@@ -45,6 +45,12 @@ ENV_TPX_TRACE = "TPX_TRACE"
 # ~/.torchx_tpu/obs (one subdir per client session). See obs/sinks.py.
 ENV_TPX_OBS_DIR = "TPX_OBS_DIR"
 
+# Escape hatch for the preflight analyzer gate in Runner.dryrun/run:
+# "1"/"true"/"yes"/"on" skips linting entirely (same effect as the
+# ``--no-lint`` CLI flag / ``no_lint=True`` Runner argument). Diagnostics
+# are documented in docs/api/analyze.md; see torchx_tpu/analyze/.
+ENV_TPX_NO_LINT = "TPX_NO_LINT"
+
 # ---------------------------------------------------------------------------
 # In-job (injected by schedulers into every replica)
 # ---------------------------------------------------------------------------

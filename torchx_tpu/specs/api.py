@@ -353,6 +353,15 @@ def parse_mounts(opts: list[str]) -> list[Union[BindMount, VolumeMount, DeviceMo
             )
         else:
             raise ValueError(f"unknown mount type {mtype!r} in {g}")
+    dsts: dict[str, int] = {}
+    for i, m in enumerate(mounts):
+        if m.dst_path in dsts:
+            raise ValueError(
+                f"duplicate mount destination {m.dst_path!r}: mounts"
+                f" #{dsts[m.dst_path] + 1} and #{i + 1} would shadow each"
+                " other (each mount needs a distinct dst)"
+            )
+        dsts[m.dst_path] = i
     return mounts
 
 

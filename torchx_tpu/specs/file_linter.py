@@ -28,11 +28,20 @@ _SUPPORTED_CONTAINERS = {"list", "List", "dict", "Dict", "Optional", "Union"}
 
 @dataclass
 class LinterMessage:
+    """One finding about a component function's source.
+
+    ``code`` is the stable diagnostic code shared with the preflight
+    analyzer (:mod:`torchx_tpu.analyze`): TPX001 syntax/not-found, TPX002
+    missing annotation, TPX003 unsupported type, TPX004 ``**kwargs``,
+    TPX005 return annotation, TPX006 missing docstring (warning).
+    """
+
     name: str
     description: str
     line: int = 0
     char: int = 0
     severity: str = "error"
+    code: str = "TPX001"
 
 
 # =========================================================================
@@ -99,8 +108,19 @@ def _annotation_ok(node: Optional[ast.expr]) -> bool:
         return node.attr in _SUPPORTED_SIMPLE | _SUPPORTED_CONTAINERS
     if isinstance(node, ast.Subscript):
         return _annotation_ok(node.value)
-    if isinstance(node, ast.Constant) and node.value is None:
-        return True
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return True
+        # ``from __future__ import annotations`` style string annotations:
+        # "str | None", "Optional[int]", ... — parse and validate the inner
+        # expression.
+        if isinstance(node.value, str):
+            try:
+                inner = ast.parse(node.value, mode="eval")
+            except SyntaxError:
+                return False
+            return _annotation_ok(inner.body)
+        return False
     if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
         return _annotation_ok(node.left) and _annotation_ok(node.right)
     return False
@@ -116,16 +136,24 @@ def _returns_appdef(node: Optional[ast.expr]) -> bool:
     return False
 
 
-def validate(path: str, component_function: str) -> list[LinterMessage]:
+def validate(
+    path: str, component_function: str, include_warnings: bool = False
+) -> list[LinterMessage]:
     """Parse the file and validate the named component fn is CLI-renderable."""
     with open(path) as f:
         source = f.read()
-    return validate_source(source, component_function, path)
+    return validate_source(source, component_function, path, include_warnings)
 
 
 def validate_source(
-    source: str, component_function: str, path: str = "<string>"
+    source: str,
+    component_function: str,
+    path: str = "<string>",
+    include_warnings: bool = False,
 ) -> list[LinterMessage]:
+    """Validate one component fn in ``source``. Returns error-severity
+    messages only unless ``include_warnings`` is set (the preflight
+    analyzer wants the warnings too)."""
     errors: list[LinterMessage] = []
     try:
         tree = ast.parse(source, filename=path)
@@ -135,6 +163,7 @@ def validate_source(
                 name=component_function,
                 description=f"syntax error: {e}",
                 line=e.lineno or 0,
+                code="TPX001",
             )
         ]
     fn_node: Optional[ast.FunctionDef] = None
@@ -148,16 +177,18 @@ def validate_source(
             LinterMessage(
                 name=component_function,
                 description=f"function {component_function!r} not found in {path}",
+                code="TPX001",
             )
         ]
 
-    def err(desc: str, node: ast.AST) -> None:
+    def err(desc: str, node: ast.AST, code: str) -> None:
         errors.append(
             LinterMessage(
                 name=component_function,
                 description=desc,
                 line=getattr(node, "lineno", 0),
                 char=getattr(node, "col_offset", 0),
+                code=code,
             )
         )
 
@@ -165,21 +196,30 @@ def validate_source(
     all_args = list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
     for arg in all_args:
         if arg.annotation is None:
-            err(f"parameter {arg.arg!r} is missing a type annotation", arg)
+            err(
+                f"parameter {arg.arg!r} is missing a type annotation",
+                arg,
+                "TPX002",
+            )
         elif not _annotation_ok(arg.annotation):
             err(
                 f"parameter {arg.arg!r} has unsupported type"
                 f" {ast.unparse(arg.annotation)} (supported:"
                 " str/int/float/bool, Optional/list/dict of those)",
                 arg,
+                "TPX003",
             )
     if a.vararg is not None and a.vararg.annotation is not None:
         if not _annotation_ok(a.vararg.annotation):
-            err(f"*{a.vararg.arg} has unsupported annotation", a.vararg)
+            err(f"*{a.vararg.arg} has unsupported annotation", a.vararg, "TPX003")
     if a.kwarg is not None:
-        err("**kwargs is not supported in component functions", a.kwarg)
+        err("**kwargs is not supported in component functions", a.kwarg, "TPX004")
     if fn_node.returns is None or not _returns_appdef(fn_node.returns):
-        err("component function must have return annotation -> AppDef", fn_node)
+        err(
+            "component function must have return annotation -> AppDef",
+            fn_node,
+            "TPX005",
+        )
     if ast.get_docstring(fn_node) is None:
         errors.append(
             LinterMessage(
@@ -187,6 +227,9 @@ def validate_source(
                 description=f"{component_function} is missing a docstring",
                 line=fn_node.lineno,
                 severity="warning",
+                code="TPX006",
             )
         )
+    if include_warnings:
+        return errors
     return [e for e in errors if e.severity == "error"]
