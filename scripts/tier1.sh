@@ -60,4 +60,23 @@ assert doc["version"] == 1 and doc["summary"]["error"] >= 3, doc
 assert len({d["code"] for d in doc["diagnostics"]}) >= 3, doc
 EOF
 then echo "LINT_SMOKE=ok"; else echo "LINT_SMOKE=FAILED"; rc=1; fi
+
+# Resilience smoke: a fault-injected local run must succeed anyway —
+# the injected transient describe failures are absorbed by in-seam
+# retries (retry metric non-zero), never surfacing to the user.
+res_dir=$(mktemp -d /tmp/tpx_res_smoke.XXXXXX)
+if timeout -k 10 120 env JAX_PLATFORMS=cpu TPX_OBS_DIR="$res_dir" \
+    TPX_FAULT_PLAN='[{"backend": "local", "op": "describe", "nth": 1, "times": 2, "mode": "transient", "message": "injected 503"}]' \
+    python - <<'EOF'
+from torchx_tpu.cli.main import main
+from torchx_tpu.obs import metrics as obs_metrics
+
+main(["run", "-s", "local", "--wait", "utils.echo", "--msg", "res-smoke"])
+retries = obs_metrics.CONTROL_PLANE_RETRIES.value(
+    backend="local", op="describe", kind="UNAVAILABLE"
+)
+assert retries >= 2, f"expected >= 2 in-seam retries, saw {retries}"
+EOF
+then echo "RESILIENCE_SMOKE=ok"; else echo "RESILIENCE_SMOKE=FAILED"; rc=1; fi
+rm -rf "$res_dir"
 exit $rc

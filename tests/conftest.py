@@ -28,9 +28,17 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _isolated_registries(tmp_path, monkeypatch):
-    """Keep per-user registry files (~/.tpx_local_apps, ~/.tpxslurmjobdirs)
-    and the obs trace/metrics sinks out of the real home during tests."""
+    """Keep per-user registry files (~/.tpx_local_apps, ~/.tpxslurmjobdirs),
+    supervisor ledgers, and the obs trace/metrics sinks out of the real
+    home during tests. Control-plane breakers are process-global state and
+    must not leak trips between tests."""
     monkeypatch.setenv("TPX_OBS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("TPX_SUPERVISOR_DIR", str(tmp_path / "supervisor"))
+    from torchx_tpu.resilience import call as resilience_call
+    from torchx_tpu.resilience import faults as resilience_faults
+
+    resilience_call.reset_breakers()
+    resilience_faults.reset()
     monkeypatch.setattr(
         "torchx_tpu.schedulers.local_scheduler._registry_path",
         lambda: str(tmp_path / "tpx_local_apps"),

@@ -63,6 +63,8 @@ Diagnostic codes
 | TPX402 | error | ``max_retries < 0`` | use 0 to disable retries |
 | TPX403 | warning | supervisor preemption budget on a backend that cannot classify preemptions | raise max_app_retries or switch backend |
 | TPX404 | warning | role sets the supervisor's resume env var (it is injected on every resubmission) | let the supervisor drive resume |
+| TPX501 | warning | supervisor resubmit budgets stack multiplicatively with the backend's native ``max_retries`` restarts | set max_retries=0 under ``tpx supervise`` |
+| TPX502 | error | ``TPX_FAULT_PLAN`` set while submitting to a non-local backend (chaos drill would corrupt real cloud calls) | unset it or drill against local / local_docker |
 """
 
 from torchx_tpu.analyze.diagnostics import (

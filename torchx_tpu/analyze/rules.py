@@ -13,6 +13,7 @@ Code families (full table in docs/api/analyze.md):
 * ``TPX2xx`` env vars / macros / ports / mounts
 * ``TPX3xx`` scheduler capability fit
 * ``TPX4xx`` supervisor / retry coherence
+* ``TPX5xx`` control-plane resilience coherence
 """
 
 from __future__ import annotations
@@ -668,3 +669,73 @@ def check_retries(ctx: RuleContext) -> Iterator[Diagnostic]:
                 " preemption (gke, tpu_vm, slurm, local)"
             ),
         )
+
+
+# ---------------------------------------------------------------------------
+# TPX5xx — control-plane resilience coherence
+# ---------------------------------------------------------------------------
+
+#: backends where a fault plan only sabotages the operator's own machine;
+#: anywhere else it corrupts a real cloud submission.
+_FAULT_PLAN_SAFE_SCHEDULERS = frozenset({"local", "local_docker"})
+
+
+@rule("resilience")
+def check_resilience(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX501-TPX502: resilience knobs that compose into surprises.
+
+    Three restart layers can stack: the backend's native per-role restarts
+    (``Role.max_retries`` honored in place), the supervisor's per-class
+    resubmission budgets, and the control-plane seam's own call retries.
+    The first two multiply — every supervisor resubmit re-arms the full
+    native budget — which is easy to configure by accident and miserable
+    to debug at 3am (TPX501). And a ``TPX_FAULT_PLAN`` chaos drill left in
+    the environment must never ride along into a real cloud submission
+    (TPX502)."""
+    policy = ctx.policy
+    cap = ctx.capabilities
+    if policy is not None and cap is not None and cap.native_retries:
+        supervisor_budget = (
+            policy.max_preemptions
+            + policy.max_infra_retries
+            + policy.max_app_retries
+        )
+        native = max((r.max_retries for r in ctx.app.roles), default=0)
+        if supervisor_budget > 0 and native > 0:
+            worst = (supervisor_budget + 1) * (native + 1) - 1
+            yield Diagnostic(
+                code="TPX501",
+                severity=Severity.WARNING,
+                field="max_retries",
+                message=(
+                    f"supervisor budgets ({supervisor_budget} resubmits)"
+                    f" stack MULTIPLICATIVELY with scheduler"
+                    f" {ctx.scheduler!r}'s native max_retries ({native}):"
+                    f" every resubmit re-arms the full native budget, up to"
+                    f" {worst} total restarts"
+                ),
+                hint=(
+                    "set Role.max_retries=0 under tpx supervise (let the"
+                    " supervisor own restarts), or skip supervise and keep"
+                    " native retries"
+                ),
+            )
+    if ctx.scheduler and ctx.scheduler not in _FAULT_PLAN_SAFE_SCHEDULERS:
+        from torchx_tpu.resilience.faults import fault_plan_active
+
+        if fault_plan_active():
+            yield Diagnostic(
+                code="TPX502",
+                severity=Severity.ERROR,
+                field=s.ENV_TPX_FAULT_PLAN,
+                message=(
+                    f"{s.ENV_TPX_FAULT_PLAN} is set but the target scheduler"
+                    f" is {ctx.scheduler!r}: a fault-injection drill against"
+                    " a real control plane fabricates failures on live cloud"
+                    " calls (retries, breaker trips, even aborted submits)"
+                ),
+                hint=(
+                    "unset TPX_FAULT_PLAN, or drill against the local /"
+                    " local_docker schedulers"
+                ),
+            )

@@ -122,6 +122,29 @@ class CmdSupervise(SubCommand):
             help="run the backend's elastic watcher during each attempt",
         )
         subparser.add_argument(
+            "--poll-miss-budget",
+            type=int,
+            default=None,
+            help="consecutive transient status-poll failures absorbed"
+            " (as poll_degraded warnings) before surfacing (default 3)",
+        )
+        subparser.add_argument(
+            "--session",
+            type=str,
+            default=None,
+            help="name for the durable supervision session (default:"
+            " auto-generated; shown on start for --resume)",
+        )
+        subparser.add_argument(
+            "--resume",
+            type=str,
+            default=None,
+            metavar="SESSION",
+            help="reattach to a crashed supervise session: restore its"
+            " attempt/retry state from the on-disk ledger and keep"
+            " watching the live attempt instead of resubmitting",
+        )
+        subparser.add_argument(
             "conf_args",
             nargs=argparse.REMAINDER,
             help="component name followed by its arguments"
@@ -146,6 +169,7 @@ class CmdSupervise(SubCommand):
             "poll_interval": args.poll_interval,
             "checkpoint_dir": args.checkpoint_dir,
             "elastic": args.elastic,
+            "poll_miss_budget": args.poll_miss_budget,
         }
         for name, value in overrides.items():
             if value is not None:
@@ -166,6 +190,9 @@ class CmdSupervise(SubCommand):
             self._run_traced(runner, args)
 
     def _run_traced(self, runner: Runner, args: argparse.Namespace) -> None:
+        if args.resume:
+            self._run_resume(runner, args)
+            return
         scheduler = args.scheduler
         if scheduler is None:
             from torchx_tpu.schedulers import get_default_scheduler_name
@@ -201,11 +228,36 @@ class CmdSupervise(SubCommand):
             sys.exit(1)
 
         try:
-            result = runner.supervise(dryrun_info, policy)
+            result = runner.supervise(dryrun_info, policy, session=args.session)
         except KeyboardInterrupt:
             logger.warning("ctrl-c: supervisor stopped; the current attempt"
                            " keeps running (cancel it with `tpx cancel`)")
             raise
+        self._report(result)
+
+    def _run_resume(self, runner: Runner, args: argparse.Namespace) -> None:
+        from torchx_tpu.supervisor.api import Supervisor
+
+        try:
+            supervisor = Supervisor.resume(runner, args.resume)
+        except (FileNotFoundError, ValueError, KeyError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            sys.exit(1)
+        print(f"session: {supervisor.session} (reattaching)")
+        try:
+            result = supervisor.run()
+        except KeyboardInterrupt:
+            logger.warning("ctrl-c: supervisor stopped; the current attempt"
+                           " keeps running (cancel it with `tpx cancel`)")
+            raise
+        self._report(result)
+
+    def _report(self, result) -> None:  # noqa: ANN001
+        if result.session:
+            print(
+                f"session: {result.session} (resume after a crash with:"
+                f" tpx supervise --resume {result.session})"
+            )
         for i, (handle, step) in enumerate(
             zip(result.handles, result.resume_steps), start=1
         ):

@@ -333,3 +333,26 @@ LINT_DIAGNOSTICS = REGISTRY.counter(
     "preflight diagnostics emitted",
     ("code", "severity"),
 )
+
+#: control-plane calls issued through the resilient seam, by backend +
+#: logical op + outcome ("ok"/"error"/"rejected" — rejected means the
+#: backend's circuit breaker refused the call).
+CONTROL_PLANE_CALLS = REGISTRY.counter(
+    "tpx_control_plane_calls_total",
+    "control-plane calls issued through the resilient seam",
+    ("backend", "op", "status"),
+)
+
+#: control-plane call retries, by backend + op + classified failure kind.
+CONTROL_PLANE_RETRIES = REGISTRY.counter(
+    "tpx_control_plane_retries_total",
+    "control-plane call retries by failure kind",
+    ("backend", "op", "kind"),
+)
+
+#: per-backend circuit breaker state (0 closed, 1 half-open, 2 open).
+BREAKER_STATE = REGISTRY.gauge(
+    "tpx_control_plane_breaker_state",
+    "control-plane circuit breaker state (0 closed, 1 half-open, 2 open)",
+    ("backend",),
+)

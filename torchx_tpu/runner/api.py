@@ -322,6 +322,7 @@ class Runner:
         timeout: Optional[float] = None,
         sleep: Callable[[float], None] = time.sleep,
         rng: Optional[random.Random] = None,
+        poll_miss_budget: int = 0,
     ) -> Optional[AppStatus]:
         """Block until the app reaches a terminal state.
 
@@ -332,6 +333,14 @@ class Runner:
         terminal state arrives in time — the app keeps running. ``sleep``
         and ``rng`` are injectable for deterministic tests.
 
+        ``poll_miss_budget`` > 0 absorbs that many *consecutive* status
+        polls failing with a transient error (as classified by
+        :func:`torchx_tpu.resilience.errors.classify_exception`, AFTER the
+        scheduler's own in-call retries are spent): each miss degrades to a
+        warning plus a ``poll_degraded`` event instead of surfacing, and a
+        successful poll resets the count. Permanent errors always raise —
+        a long wait must not hide an auth failure.
+
         The whole wait is one ``runner.wait`` span (each status poll nests
         under it), with the poll count in attrs and the per-scheduler poll
         counter metric incremented as it goes."""
@@ -340,13 +349,36 @@ class Runner:
             time.monotonic() + timeout if timeout is not None else None
         )
         polls = 0
+        misses = 0
         with obs_trace.span(
             "runner.wait", session=self._name, scheduler=scheduler, app_id=app_id
         ) as sp:
             for interval in poll_intervals(
                 initial=min(1.0, wait_interval), max_interval=wait_interval, rng=rng
             ):
-                status = self.status(app_handle)
+                try:
+                    status = self.status(app_handle)
+                    misses = 0
+                except Exception as e:
+                    from torchx_tpu.resilience.errors import (
+                        classify_exception,
+                        is_transient,
+                    )
+
+                    misses += 1
+                    kind = classify_exception(e)
+                    if not is_transient(kind) or misses > poll_miss_budget:
+                        raise
+                    self._emit_poll_degraded(
+                        scheduler, app_id, e, kind, misses, poll_miss_budget
+                    )
+                    if deadline is not None and time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            f"app {app_handle} status unknown after {timeout}s"
+                            " (polls failing)"
+                        ) from e
+                    sleep(interval)
+                    continue
                 polls += 1
                 obs_metrics.WAIT_POLLS.inc(scheduler=scheduler)
                 if sp is not None:
@@ -365,6 +397,45 @@ class Runner:
                     interval = min(interval, remaining)
                 sleep(interval)
         raise AssertionError("unreachable: poll_intervals is infinite")
+
+    def _emit_poll_degraded(
+        self,
+        scheduler: str,
+        app_id: str,
+        exc: Exception,
+        kind: object,
+        misses: int,
+        budget: int,
+    ) -> None:
+        """One absorbed status-poll failure: warn + ``poll_degraded``
+        TpxEvent (api="supervise" — this is the supervision audit trail
+        answering "why did status go quiet for two minutes at 3am")."""
+        from torchx_tpu.runner.events import record
+        from torchx_tpu.runner.events.api import TpxEvent
+
+        logger.warning(
+            "status poll for %s failed (%s: %s); absorbed miss %d/%d",
+            app_id,
+            kind,
+            exc,
+            misses,
+            budget,
+        )
+        record(
+            TpxEvent(
+                session=self._name,
+                scheduler=scheduler,
+                api="supervise",
+                app_id=app_id,
+                app_metadata={
+                    "transition": "poll_degraded",
+                    "kind": str(kind),
+                    "error": str(exc)[:500],
+                    "miss": misses,
+                    "budget": budget,
+                },
+            )
+        )
 
     def cancel(self, app_handle: AppHandle) -> None:
         """Stop the app but keep it describable (scheduler-side state and
@@ -423,6 +494,7 @@ class Runner:
         self,
         dryrun_info: AppDryRunInfo,
         policy: Optional[Any] = None,
+        session: Optional[str] = None,
     ) -> Any:
         """Run a dryrun under the preemption-aware supervisor: submit,
         watch to terminal, classify the failure, and auto-resubmit within
@@ -435,7 +507,10 @@ class Runner:
 
         ``policy`` is a :class:`~torchx_tpu.supervisor.policy.SupervisorPolicy`
         (default-constructed when omitted); typed ``Any`` here only to keep
-        the supervisor subsystem an optional import at runner load time."""
+        the supervisor subsystem an optional import at runner load time.
+        ``session`` names the durable supervision session (auto-generated
+        when omitted); ``tpx supervise --resume <session>`` reattaches to
+        it after a client crash."""
         from torchx_tpu.supervisor.api import Supervisor
 
         scheduler = dryrun_info._scheduler or ""
@@ -446,7 +521,7 @@ class Runner:
             app_image=app.roles[0].image if app and app.roles else None,
             session=self._name,
         ) as ev:
-            result = Supervisor(self, dryrun_info, policy).run()
+            result = Supervisor(self, dryrun_info, policy, session=session).run()
             if result.handle:
                 _, _, app_id = parse_app_handle(result.handle)
                 ev._event.app_id = app_id

@@ -9,6 +9,7 @@ with no cluster (reference api.py:410-426).
 
 from __future__ import annotations
 
+import subprocess
 from abc import ABC, abstractmethod
 from dataclasses import dataclass
 from enum import Enum
@@ -226,6 +227,33 @@ class Scheduler(ABC, Generic[T]):
     def __init__(self, backend: str, session_name: str) -> None:
         self.backend = backend
         self.session_name = session_name
+
+    # -- control-plane seam ------------------------------------------------
+
+    def _cmd(
+        self, cmd: list[str], op: str, **kwargs: Any
+    ) -> "subprocess.CompletedProcess":
+        """Run one control-plane CLI call through the resilient seam
+        (:func:`torchx_tpu.resilience.call.resilient_cmd`): default
+        deadline, transient-vs-permanent classification, per-kind retries,
+        the backend's circuit breaker, and ``TPX_FAULT_PLAN`` injection.
+
+        Backends keep ``_run_cmd`` as the raw subprocess seam (and the
+        test monkeypatch point); call sites go through ``_cmd`` with a
+        logical ``op`` name ("describe", "list", ...) so retries and
+        faults are attributable. Non-idempotent ops (submits) must pass
+        ``policy=NON_IDEMPOTENT`` — a call that may have reached the
+        control plane is never replayed."""
+        from torchx_tpu.resilience.call import resilient_cmd
+
+        run_cmd = getattr(self, "_run_cmd", None)
+        if run_cmd is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no _run_cmd subprocess seam"
+            )
+        return resilient_cmd(
+            run_cmd, cmd, backend=self.backend, op=op, **kwargs
+        )
 
     # -- submission path ---------------------------------------------------
 

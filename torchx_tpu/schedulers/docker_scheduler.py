@@ -22,6 +22,8 @@ from dataclasses import dataclass, field
 from typing import Any, Iterable, Mapping, Optional, TYPE_CHECKING
 
 from torchx_tpu import settings
+from torchx_tpu.resilience.call import resilient_call
+from torchx_tpu.resilience.policy import NON_IDEMPOTENT
 from torchx_tpu.schedulers.api import (
     DescribeAppResponse,
     ListAppResponse,
@@ -267,7 +269,14 @@ class DockerScheduler(DockerWorkspaceMixin, Scheduler[DockerJob]):
         self._ensure_network()
         try:
             for c in req.containers:
-                self._client.containers.run(c.image, c.command, **c.kwargs)
+                resilient_call(
+                    lambda c=c: self._client.containers.run(
+                        c.image, c.command, **c.kwargs
+                    ),
+                    backend=self.backend,
+                    op="submit",
+                    policy=NON_IDEMPOTENT,
+                )
         except Exception:
             self._cancel_existing(req.app_id)
             raise
@@ -283,8 +292,12 @@ class DockerScheduler(DockerWorkspaceMixin, Scheduler[DockerJob]):
                 logger.debug("network create: %s", e)
 
     def _containers(self, app_id: str) -> list[Any]:
-        return self._client.containers.list(
-            all=True, filters={"label": f"{LABEL_APP_ID}={app_id}"}
+        return resilient_call(
+            lambda: self._client.containers.list(
+                all=True, filters={"label": f"{LABEL_APP_ID}={app_id}"}
+            ),
+            backend=self.backend,
+            op="describe",
         )
 
     def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
@@ -312,8 +325,12 @@ class DockerScheduler(DockerWorkspaceMixin, Scheduler[DockerJob]):
         )
 
     def list(self) -> list[ListAppResponse]:
-        containers = self._client.containers.list(
-            all=True, filters={"label": LABEL_APP_ID}
+        containers = resilient_call(
+            lambda: self._client.containers.list(
+                all=True, filters={"label": LABEL_APP_ID}
+            ),
+            backend=self.backend,
+            op="list",
         )
         per_app: dict[str, list[AppState]] = {}
         for c in containers:

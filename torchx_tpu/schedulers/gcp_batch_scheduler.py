@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from typing import Any, Iterable, Mapping, Optional
 
 from torchx_tpu import settings
+from torchx_tpu.resilience.policy import NON_IDEMPOTENT
 from torchx_tpu.schedulers.api import (
     DescribeAppResponse,
     dquote as _dquote,
@@ -311,6 +312,9 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
         self._session_opts: Optional[GCPBatchOpts] = None
 
     def _run_cmd(self, cmd: list[str], **kwargs: Any) -> subprocess.CompletedProcess:
+        """Raw gcloud subprocess seam (tests monkeypatch this); call sites
+        go through :meth:`Scheduler._cmd` for deadlines, classified
+        retries, and the backend breaker."""
         return subprocess.run(cmd, capture_output=True, text=True, **kwargs)
 
     def run_opts(self) -> runopts:
@@ -352,8 +356,10 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
             project=req.project, location=req.location
         )
         self.push_images(req.images_to_push)
-        proc = self._run_cmd(
+        proc = self._cmd(
             self._gcloud(req, "submit", req.name, "--config", "-"),
+            op="submit",
+            policy=NON_IDEMPOTENT,
             input=json.dumps(req.config),
         )
         if proc.returncode != 0:
@@ -402,8 +408,9 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
         """Raw ``gcloud batch jobs describe`` payload, or None when the job
         is unknown / the output is unparseable (shared by describe and the
         log-UID resolution)."""
-        proc = self._run_cmd(
-            self._gcloud(job, "describe", job.name, "--format", "json")
+        proc = self._cmd(
+            self._gcloud(job, "describe", job.name, "--format", "json"),
+            op="describe",
         )
         if proc.returncode != 0:
             return None
@@ -464,8 +471,8 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
         seen: set[str] = set()
         for project, location in scopes:
             opts = GCPBatchOpts(project=project, location=location)
-            proc = self._run_cmd(
-                self._gcloud(opts, "list", "--format", "json")
+            proc = self._cmd(
+                self._gcloud(opts, "list", "--format", "json"), op="list"
             )
             _note_scope_result(project, location, proc.returncode == 0)
             if proc.returncode != 0:
@@ -495,7 +502,7 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
 
     def _gcloud_project(self) -> Optional[str]:
         """The gcloud-configured default project, or None."""
-        proc = self._run_cmd(["gcloud", "config", "get-value", "project"])
+        proc = self._cmd(["gcloud", "config", "get-value", "project"], op="config")
         if proc.returncode != 0:
             return None
         val = (proc.stdout or "").strip()
@@ -503,14 +510,18 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
 
     def _cancel_existing(self, app_id: str) -> None:
         job = self._parse_app_id(app_id)
-        proc = self._run_cmd(self._gcloud(job, "cancel", job.name, "--quiet"))
+        proc = self._cmd(
+            self._gcloud(job, "cancel", job.name, "--quiet"), op="cancel"
+        )
         if proc.returncode != 0:
             # older gcloud has no `cancel`; deletion also stops the job
-            self._run_cmd(self._gcloud(job, "delete", job.name, "--quiet"))
+            self._cmd(
+                self._gcloud(job, "delete", job.name, "--quiet"), op="cancel"
+            )
 
     def delete(self, app_id: str) -> None:
         job = self._parse_app_id(app_id)
-        self._run_cmd(self._gcloud(job, "delete", job.name, "--quiet"))
+        self._cmd(self._gcloud(job, "delete", job.name, "--quiet"), op="delete")
 
     def log_iter(
         self,
@@ -557,7 +568,7 @@ class GCPBatchScheduler(DockerWorkspaceMixin, Scheduler[GCPBatchJob]):
         ]
         if job.project:
             cmd += ["--project", job.project]
-        proc = self._run_cmd(cmd)
+        proc = self._cmd(cmd, op="logs")
         if proc.returncode != 0:
             raise RuntimeError(
                 f"gcloud logging read failed: {proc.stderr.strip()}"
@@ -628,9 +639,12 @@ def _known_scopes() -> set[tuple[Optional[str], str]]:
 # -- scope failure tracking / eviction ----------------------------------
 # A recorded scope whose project was deleted or revoked would otherwise
 # add one failing gcloud subprocess to EVERY list() forever (advisor r4).
-# Each failed list per scope appends a line here; a successful list (or a
-# new submit to the scope) clears them, and a scope with >= 3 unbroken
-# failures is skipped by list() until it succeeds again via submit.
+# The bookkeeping is one instance of the shared durable-breaker primitive
+# (:class:`torchx_tpu.resilience.breaker.FailureLedger`): each failed
+# list per scope counts one unbroken failure, a successful list (or a new
+# submit to the scope) clears the streak, and a scope at the threshold is
+# skipped by list() until it succeeds again via submit. The file name and
+# format predate the primitive and are kept for compatibility.
 
 GCP_BATCH_SCOPE_FAILS_FILE = ".tpxgcpbatchscopefails"
 SCOPE_EVICT_FAILURES = 3
@@ -646,53 +660,28 @@ def _scope_key(project: Optional[str], location: str) -> str:
     return f"{project or ''}|{location}"
 
 
+def _scope_ledger() -> "FailureLedger":
+    from torchx_tpu.resilience.breaker import FailureLedger
+
+    return FailureLedger(_fails_path(), threshold=SCOPE_EVICT_FAILURES)
+
+
 def _scope_failures() -> dict[str, int]:
-    out: dict[str, int] = {}
-    try:
-        with open(_fails_path()) as f:
-            for line in f:
-                key = line.strip()
-                if key:
-                    out[key] = out.get(key, 0) + 1
-    except OSError:
-        pass
-    return out
+    return _scope_ledger().failures()
 
 
 def _note_scope_result(project: Optional[str], location: str, ok: bool) -> None:
     """Best-effort failure bookkeeping (a lost concurrent update costs at
     most one miscounted failure, which the next list corrects)."""
-    import os
-
-    key = _scope_key(project, location)
-    try:
-        if ok:
-            fails = _scope_failures()
-            if key in fails:
-                remaining = [
-                    line
-                    for k, n in fails.items()
-                    if k != key
-                    for line in [k] * n
-                ]
-                tmp = _fails_path() + ".tmp"
-                with open(tmp, "w") as f:
-                    f.write("".join(f"{line}\n" for line in remaining))
-                os.replace(tmp, _fails_path())
-        else:
-            with open(_fails_path(), "a") as f:
-                f.write(f"{key}\n")
-    except OSError:
-        pass
+    _scope_ledger().note(_scope_key(project, location), ok)
 
 
 def _evicted_scopes() -> set[tuple[Optional[str], str]]:
     out: set[tuple[Optional[str], str]] = set()
-    for key, count in _scope_failures().items():
-        if count >= SCOPE_EVICT_FAILURES:
-            project, sep, location = key.partition("|")
-            if sep and location:
-                out.add((project or None, location))
+    for key in _scope_ledger().tripped():
+        project, sep, location = key.partition("|")
+        if sep and location:
+            out.add((project or None, location))
     return out
 
 

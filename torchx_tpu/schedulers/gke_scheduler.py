@@ -42,6 +42,8 @@ from dataclasses import dataclass, field
 from typing import Any, Iterable, Iterator, Mapping, Optional, TYPE_CHECKING
 
 from torchx_tpu import settings
+from torchx_tpu.resilience.call import resilient_call
+from torchx_tpu.resilience.policy import NON_IDEMPOTENT
 from torchx_tpu.schedulers.api import (
     safe_int as _safe_int,
     DescribeAppResponse,
@@ -881,12 +883,17 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
         from kubernetes.client.rest import ApiException
 
         try:
-            self._custom_objects_api().create_namespaced_custom_object(
-                group=JOBSET_GROUP,
-                version=JOBSET_VERSION,
-                namespace=req.namespace,
-                plural=JOBSET_PLURAL,
-                body=req.resource,
+            resilient_call(
+                lambda: self._custom_objects_api().create_namespaced_custom_object(
+                    group=JOBSET_GROUP,
+                    version=JOBSET_VERSION,
+                    namespace=req.namespace,
+                    plural=JOBSET_PLURAL,
+                    body=req.resource,
+                ),
+                backend=self.backend,
+                op="submit",
+                policy=NON_IDEMPOTENT,
             )
         except ApiException as e:
             if e.status == 409:
@@ -929,12 +936,16 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
         from kubernetes.client.rest import ApiException
 
         try:
-            jobset = self._custom_objects_api().get_namespaced_custom_object(
-                group=JOBSET_GROUP,
-                version=JOBSET_VERSION,
-                namespace=namespace,
-                plural=JOBSET_PLURAL,
-                name=name,
+            jobset = resilient_call(
+                lambda: self._custom_objects_api().get_namespaced_custom_object(
+                    group=JOBSET_GROUP,
+                    version=JOBSET_VERSION,
+                    namespace=namespace,
+                    plural=JOBSET_PLURAL,
+                    name=name,
+                ),
+                backend=self.backend,
+                op="describe",
             )
         except ApiException as e:
             if e.status == 404:
@@ -985,8 +996,12 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
 
     def list(self) -> list[ListAppResponse]:
         out = []
-        jobsets = self._custom_objects_api().list_cluster_custom_object(
-            group=JOBSET_GROUP, version=JOBSET_VERSION, plural=JOBSET_PLURAL
+        jobsets = resilient_call(
+            lambda: self._custom_objects_api().list_cluster_custom_object(
+                group=JOBSET_GROUP, version=JOBSET_VERSION, plural=JOBSET_PLURAL
+            ),
+            backend=self.backend,
+            op="list",
         )
         for js in jobsets.get("items", []):
             meta = js.get("metadata", {})
@@ -1004,13 +1019,17 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
         cancel=abort-preserving-spec, :901-934). The elastic controller
         Job (if any) is removed — a suspended set must not be 'rescued'."""
         namespace, name = self._parse_app_id(app_id)
-        self._custom_objects_api().patch_namespaced_custom_object(
-            group=JOBSET_GROUP,
-            version=JOBSET_VERSION,
-            namespace=namespace,
-            plural=JOBSET_PLURAL,
-            name=name,
-            body={"spec": {"suspend": True}},
+        resilient_call(
+            lambda: self._custom_objects_api().patch_namespaced_custom_object(
+                group=JOBSET_GROUP,
+                version=JOBSET_VERSION,
+                namespace=namespace,
+                plural=JOBSET_PLURAL,
+                name=name,
+                body={"spec": {"suspend": True}},
+            ),
+            backend=self.backend,
+            op="cancel",
         )
         self._delete_controller(namespace, name)
 
@@ -1043,12 +1062,16 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
         from kubernetes.client.rest import ApiException
 
         try:
-            self._custom_objects_api().delete_namespaced_custom_object(
-                group=JOBSET_GROUP,
-                version=JOBSET_VERSION,
-                namespace=namespace,
-                plural=JOBSET_PLURAL,
-                name=name,
+            resilient_call(
+                lambda: self._custom_objects_api().delete_namespaced_custom_object(
+                    group=JOBSET_GROUP,
+                    version=JOBSET_VERSION,
+                    namespace=namespace,
+                    plural=JOBSET_PLURAL,
+                    name=name,
+                ),
+                backend=self.backend,
+                op="delete",
             )
         except ApiException as e:
             if e.status != 404:

@@ -33,6 +33,7 @@ from pathlib import Path
 from typing import Any, IO, Iterable, Mapping, Optional, TextIO
 
 from torchx_tpu import settings
+from torchx_tpu.resilience.call import resilient_call
 from torchx_tpu.schedulers.api import (
     DescribeAppResponse,
     ListAppResponse,
@@ -72,6 +73,11 @@ APP_CACHE_SIZE = 100
 # (the reference's local scheduler is in-process only; log files were
 # always on disk — this makes the metadata reachable too).
 STATE_FILE = ".tpx_state.json"
+
+#: per-replica exit-code sidecar written by the /bin/sh launch wrapper;
+#: read by _describe_external to recover terminal state after the owning
+#: client process crashed (its in-memory Popen handles died with it).
+EXITCODE_FILE = "exitcode"
 APPS_REGISTRY = ".tpx_local_apps"
 
 
@@ -97,6 +103,34 @@ def _registry_lookup(app_id: str) -> Optional[str]:
     from torchx_tpu.util import registry
 
     return registry.lookup(_registry_path(), app_id)
+
+
+def _recover_sidecar_state(log_dir: str, payload: dict) -> AppState:
+    """Terminal state of a crashed-owner app from exit-code sidecars.
+
+    The owner process died before writing a terminal state (SIGKILL, OOM,
+    power loss), but each replica's /bin/sh launch wrapper durably wrote
+    its exit code. All replicas 0 -> SUCCEEDED; any nonzero -> FAILED; any
+    sidecar missing (replica still running when the machine died, or a
+    pre-sidecar writer) -> UNKNOWN, exactly the pre-recovery behavior. A
+    SUCCESS marker short-circuits (the owner DID finish; only the state
+    file write was lost)."""
+    if os.path.exists(os.path.join(log_dir, "SUCCESS")):
+        return AppState.SUCCEEDED
+    codes: list[int] = []
+    for role_name, replicas in payload.get("roles", {}).items():
+        for r in replicas:
+            rc_file = os.path.join(
+                log_dir, role_name, str(r.get("id", 0)), EXITCODE_FILE
+            )
+            try:
+                with open(rc_file) as f:
+                    codes.append(int(f.read().strip()))
+            except (OSError, ValueError):
+                return AppState.UNKNOWN
+    if not codes:
+        return AppState.UNKNOWN
+    return AppState.SUCCEEDED if all(c == 0 for c in codes) else AppState.FAILED
 
 
 def _state_file_says_cancelled(log_dir: str) -> bool:
@@ -626,8 +660,27 @@ class LocalScheduler(Scheduler[PopenRequest]):
         stdout = open(rp.stdout, "wb")
         stderr = open(rp.stderr, "wb")
         tee = Tee(Path(rp.combined), Path(rp.stdout), Path(rp.stderr))
+        # /bin/sh wrapper persists the replica's exit code next to its logs
+        # (atomic tmp+rename). The launcher's in-memory proc handle dies
+        # with the client process; the sidecar is what lets a RESUMED
+        # supervise client (or any other process) recover SUCCEEDED vs
+        # FAILED after the owner crashed. Exit codes pass through exactly
+        # (`exit "$rc"`), so drills comparing proc.poll() to a specific
+        # code (TPX_SIMULATE_PREEMPTION_EXIT) are unaffected.
+        rc_file = os.path.join(os.path.dirname(rp.stdout), EXITCODE_FILE)
+        try:
+            os.unlink(rc_file)
+        except OSError:
+            pass
+        wrapped = [
+            "/bin/sh",
+            "-c",
+            '"$@"; rc=$?; printf %s "$rc" > "$0.tmp" && mv -f "$0.tmp" "$0"; exit "$rc"',
+            rc_file,
+            *rp.args,
+        ]
         proc = subprocess.Popen(
-            rp.args,
+            wrapped,
             env=rp.env,
             stdout=stdout,
             stderr=stderr,
@@ -689,6 +742,17 @@ class LocalScheduler(Scheduler[PopenRequest]):
     # -- monitoring -------------------------------------------------------
 
     def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
+        # even the in-process backend routes status through the resilient
+        # seam: TPX_FAULT_PLAN drills (inject transient failures into the
+        # supervisor's poll loop) exercise the same retry/breaker/span
+        # machinery that guards gcloud/kubectl on the cloud backends
+        return resilient_call(
+            lambda: self._describe_impl(app_id),
+            backend=self.backend,
+            op="describe",
+        )
+
+    def _describe_impl(self, app_id: str) -> Optional[DescribeAppResponse]:
         app = self._apps.get(app_id)
         if app is None:
             return self._describe_external(app_id)
@@ -763,11 +827,12 @@ class LocalScheduler(Scheduler[PopenRequest]):
                 for replicas in payload.get("roles", {}).values()
                 for r in replicas
             ]
-            state = (
-                AppState.RUNNING
-                if any(_pid_alive(p, st) for p, st in procs)
-                else AppState.UNKNOWN
-            )
+            if any(_pid_alive(p, st) for p, st in procs):
+                state = AppState.RUNNING
+            else:
+                # owner died without writing a terminal state; the launch
+                # wrapper's exit-code sidecars are the crash-safe record
+                state = _recover_sidecar_state(log_dir, payload)
         roles_statuses = [
             RoleStatus(
                 role=name,
@@ -991,6 +1056,11 @@ class LocalScheduler(Scheduler[PopenRequest]):
             app.add_replica(role.name, self._popen(role.name, replica_id, rp))
 
     def list(self) -> list[ListAppResponse]:
+        return resilient_call(
+            lambda: self._list_impl(), backend=self.backend, op="list"
+        )
+
+    def _list_impl(self) -> list[ListAppResponse]:
         out = []
         for app_id, app in self._apps.items():
             self._update_app_state(app)
@@ -1008,11 +1078,14 @@ class LocalScheduler(Scheduler[PopenRequest]):
         return out
 
     def _cancel_existing(self, app_id: str) -> None:
-        app = self._apps.get(app_id)
-        if app is not None:
-            app.kill()
-            return
-        self._cancel_external(app_id)
+        def _do() -> None:
+            app = self._apps.get(app_id)
+            if app is not None:
+                app.kill()
+                return
+            self._cancel_external(app_id)
+
+        resilient_call(_do, backend=self.backend, op="cancel")
 
     def _cancel_external(self, app_id: str) -> None:
         """Kill an app owned by another process: SIGTERM its process groups

@@ -34,6 +34,7 @@ from dataclasses import dataclass
 from typing import Any, Iterable, Mapping, Optional
 
 from torchx_tpu import settings
+from torchx_tpu.resilience.policy import NON_IDEMPOTENT
 from torchx_tpu.schedulers.api import (
     dquote as _dquote,
     DescribeAppResponse,
@@ -412,7 +413,10 @@ class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
         self._mem_probe_cache: dict[str, bool] = {}
 
     def _run_cmd(self, cmd: list[str], **kwargs: Any) -> subprocess.CompletedProcess:
-        """Single subprocess seam — tests monkeypatch this."""
+        """Single subprocess seam — tests monkeypatch this. Call sites go
+        through :meth:`Scheduler._cmd` so every slurm CLI call gets the
+        control-plane deadline, classified retries, and the backend
+        breaker."""
         return subprocess.run(cmd, capture_output=True, text=True, **kwargs)
 
     def run_opts(self) -> runopts:
@@ -502,7 +506,7 @@ class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
         if partition:
             cmd += ["--partition", str(partition)]
         try:
-            proc = self._run_cmd(cmd)
+            proc = self._cmd(cmd, op="probe")
         except (OSError, subprocess.SubprocessError):
             self._mem_probe_cache[key] = True
             return True
@@ -520,7 +524,12 @@ class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
         script_path = os.path.join(job_dir, "tpx_sbatch.sh")
         with open(script_path, "w") as f:
             f.write(req.script())
-        proc = self._run_cmd([*req.cmd, script_path], cwd=job_dir)
+        proc = self._cmd(
+            [*req.cmd, script_path],
+            op="submit",
+            policy=NON_IDEMPOTENT,
+            cwd=job_dir,
+        )
         if proc.returncode != 0:
             raise RuntimeError(
                 f"sbatch failed (rc={proc.returncode}):\n{proc.stderr}"
@@ -538,7 +547,7 @@ class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
         return self._describe_sacct(app_id)
 
     def _describe_squeue(self, app_id: str) -> Optional[DescribeAppResponse]:
-        proc = self._run_cmd(["squeue", "--json", "-j", app_id])
+        proc = self._cmd(["squeue", "--json", "-j", app_id], op="describe")
         if proc.returncode != 0:
             return None
         try:
@@ -551,8 +560,9 @@ class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
         return _describe_from_squeue_jobs(app_id, jobs)
 
     def _describe_sacct(self, app_id: str) -> Optional[DescribeAppResponse]:
-        proc = self._run_cmd(
-            ["sacct", "--parsable2", "-j", app_id, "--format", "JobID,JobName,State"]
+        proc = self._cmd(
+            ["sacct", "--parsable2", "-j", app_id, "--format", "JobID,JobName,State"],
+            op="describe",
         )
         if proc.returncode != 0 or not proc.stdout.strip():
             return None
@@ -585,7 +595,7 @@ class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
         )
 
     def list(self) -> list[ListAppResponse]:
-        proc = self._run_cmd(["squeue", "--json", "--me"])
+        proc = self._cmd(["squeue", "--json", "--me"], op="list")
         if proc.returncode != 0:
             raise RuntimeError(f"squeue failed: {proc.stderr}")
         payload = json.loads(proc.stdout)
@@ -601,7 +611,7 @@ class SlurmScheduler(DirWorkspaceMixin, Scheduler[SlurmBatchRequest]):
         return out
 
     def _cancel_existing(self, app_id: str) -> None:
-        proc = self._run_cmd(["scancel", app_id])
+        proc = self._cmd(["scancel", app_id], op="cancel")
         if proc.returncode != 0:
             raise RuntimeError(f"scancel failed: {proc.stderr}")
 

@@ -35,6 +35,7 @@ from dataclasses import dataclass
 from typing import Any, Iterable, Mapping, Optional
 
 from torchx_tpu import settings
+from torchx_tpu.resilience.policy import NON_IDEMPOTENT
 from torchx_tpu.schedulers.api import (
     dquote as _dquote,
     DescribeAppResponse,
@@ -191,6 +192,8 @@ class TpuVmScheduler(Scheduler[TpuVmRequest]):
         super().__init__("tpu_vm", session_name)
 
     def _run_cmd(self, cmd: list[str], **kwargs: Any) -> subprocess.CompletedProcess:
+        """Raw gcloud seam (monkeypatched in tests); production calls go
+        through :meth:`Scheduler._cmd` for deadlines/retries/breakers."""
         return subprocess.run(cmd, capture_output=True, text=True, **kwargs)
 
     def run_opts(self) -> runopts:
@@ -252,7 +255,7 @@ class TpuVmScheduler(Scheduler[TpuVmRequest]):
 
     def schedule(self, dryrun_info: AppDryRunInfo[TpuVmRequest]) -> str:
         req = dryrun_info.request
-        proc = self._run_cmd(req.create_cmd())
+        proc = self._cmd(req.create_cmd(), op="submit", policy=NON_IDEMPOTENT)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"queued-resource create failed (rc={proc.returncode}):"
@@ -269,7 +272,7 @@ class TpuVmScheduler(Scheduler[TpuVmRequest]):
 
     def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
         zone, name = self._parse_app_id(app_id)
-        proc = self._run_cmd(
+        proc = self._cmd(
             [
                 "gcloud",
                 "compute",
@@ -279,7 +282,8 @@ class TpuVmScheduler(Scheduler[TpuVmRequest]):
                 name,
                 f"--zone={zone}",
                 "--format=json",
-            ]
+            ],
+            op="describe",
         )
         if proc.returncode != 0:
             return None
@@ -290,8 +294,9 @@ class TpuVmScheduler(Scheduler[TpuVmRequest]):
         return describe_queued_resource(app_id, data)
 
     def list(self) -> list[ListAppResponse]:
-        proc = self._run_cmd(
-            ["gcloud", "compute", "tpus", "queued-resources", "list", "--format=json"]
+        proc = self._cmd(
+            ["gcloud", "compute", "tpus", "queued-resources", "list", "--format=json"],
+            op="list",
         )
         if proc.returncode != 0:
             raise RuntimeError(f"queued-resources list failed: {proc.stderr}")
@@ -313,7 +318,7 @@ class TpuVmScheduler(Scheduler[TpuVmRequest]):
 
     def _cancel_existing(self, app_id: str) -> None:
         zone, name = self._parse_app_id(app_id)
-        proc = self._run_cmd(
+        proc = self._cmd(
             [
                 "gcloud",
                 "compute",
@@ -324,7 +329,8 @@ class TpuVmScheduler(Scheduler[TpuVmRequest]):
                 f"--zone={zone}",
                 "--force",
                 "--quiet",
-            ]
+            ],
+            op="cancel",
         )
         if proc.returncode != 0:
             raise RuntimeError(f"queued-resource delete failed: {proc.stderr}")
@@ -389,7 +395,7 @@ class TpuVmScheduler(Scheduler[TpuVmRequest]):
             "except OSError: pass\n"
             "out.write(f'__exitcode__ {ec}\\n')\n"
         )
-        proc = self._run_cmd(
+        proc = self._cmd(
             [
                 "gcloud",
                 "compute",
@@ -401,7 +407,8 @@ class TpuVmScheduler(Scheduler[TpuVmRequest]):
                 f"--worker={worker}",
                 "--command",
                 f"python3 -c {shlex.quote(remote)}",
-            ]
+            ],
+            op="logs",
         )
         if proc.returncode != 0:
             raise RuntimeError(f"log fetch failed: {proc.stderr}")

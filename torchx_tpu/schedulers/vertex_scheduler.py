@@ -30,6 +30,8 @@ from dataclasses import dataclass, field
 from typing import Any, Iterable, Mapping, Optional
 
 from torchx_tpu import settings
+from torchx_tpu.resilience.call import resilient_call
+from torchx_tpu.resilience.policy import NON_IDEMPOTENT
 from torchx_tpu.schedulers.api import (
     DescribeAppResponse,
     ListAppResponse,
@@ -251,6 +253,13 @@ class VertexScheduler(DockerWorkspaceMixin, Scheduler[VertexJob]):
         )
         self.__client = client
 
+    def _run_cmd(self, cmd: list, **kwargs: Any) -> Any:
+        """Raw gcloud seam (monkeypatched in tests); production calls go
+        through :meth:`Scheduler._cmd` for deadlines/retries/breakers."""
+        import subprocess
+
+        return subprocess.run(cmd, capture_output=True, text=True, **kwargs)
+
     @property
     def _client(self) -> Any:
         if self.__client is None:
@@ -331,8 +340,13 @@ class VertexScheduler(DockerWorkspaceMixin, Scheduler[VertexJob]):
     def schedule(self, dryrun_info: AppDryRunInfo[VertexJob]) -> str:
         req = dryrun_info.request
         self.push_images(req.images_to_push)
-        job = self._client.create_custom_job(
-            parent=req.parent, custom_job=req.custom_job
+        job = resilient_call(
+            lambda: self._client.create_custom_job(
+                parent=req.parent, custom_job=req.custom_job
+            ),
+            backend=self.backend,
+            op="submit",
+            policy=NON_IDEMPOTENT,
         )
         # resource name: projects/{p}/locations/{r}/customJobs/{numeric id}
         name = getattr(job, "name", "") or ""
@@ -347,7 +361,11 @@ class VertexScheduler(DockerWorkspaceMixin, Scheduler[VertexJob]):
         if name is None:
             return None
         try:
-            job = self._client.get_custom_job(name=name)
+            job = resilient_call(
+                lambda: self._client.get_custom_job(name=name),
+                backend=self.backend,
+                op="describe",
+            )
         except Exception as e:
             # only a definitive NotFound maps to "no such app"; transport
             # or auth errors must surface so status pollers don't mistake a
@@ -367,7 +385,11 @@ class VertexScheduler(DockerWorkspaceMixin, Scheduler[VertexJob]):
     def _cancel_existing(self, app_id: str) -> None:
         name = _load_job_name(app_id)
         if name is not None:
-            self._client.cancel_custom_job(name=name)
+            resilient_call(
+                lambda: self._client.cancel_custom_job(name=name),
+                backend=self.backend,
+                op="cancel",
+            )
 
     def log_iter(
         self,
@@ -384,8 +406,6 @@ class VertexScheduler(DockerWorkspaceMixin, Scheduler[VertexJob]):
         scheduler needs no logging SDK (same pattern as tpu_vm ssh logs).
         since/until map to server-side ``timestamp`` filters; Vertex keeps
         one combined stream per job, so stream selection raises."""
-        import subprocess
-
         if streams not in (None, Stream.COMBINED):
             raise ValueError(
                 f"vertex job logs are a single combined Cloud Logging"
@@ -404,7 +424,7 @@ class VertexScheduler(DockerWorkspaceMixin, Scheduler[VertexJob]):
             filt += f' AND timestamp>="{_rfc3339(since)}"'
         if until is not None:
             filt += f' AND timestamp<="{_rfc3339(until)}"'
-        proc = subprocess.run(
+        proc = self._cmd(
             [
                 "gcloud",
                 "logging",
@@ -415,8 +435,7 @@ class VertexScheduler(DockerWorkspaceMixin, Scheduler[VertexJob]):
                 "--order=asc",
                 "--freshness=30d",
             ],
-            capture_output=True,
-            text=True,
+            op="logs",
         )
         if proc.returncode != 0:
             raise RuntimeError(f"gcloud logging read failed: {proc.stderr}")

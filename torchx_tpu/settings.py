@@ -51,6 +51,31 @@ ENV_TPX_OBS_DIR = "TPX_OBS_DIR"
 # are documented in docs/api/analyze.md; see torchx_tpu/analyze/.
 ENV_TPX_NO_LINT = "TPX_NO_LINT"
 
+# Per-call deadline (seconds) for control-plane subprocesses (gcloud /
+# kubectl / sbatch / squeue ...) issued through the resilient seam
+# (torchx_tpu/resilience/call.py). Unset = DEFAULT_CONTROL_PLANE_TIMEOUT;
+# "0"/"off"/"none" disables the deadline entirely.
+ENV_TPX_CONTROL_PLANE_TIMEOUT = "TPX_CONTROL_PLANE_TIMEOUT"
+
+# Default for ENV_TPX_CONTROL_PLANE_TIMEOUT: generous enough for a slow
+# gcloud auth refresh, small enough that a hung CLI degrades into one
+# classified TIMEOUT failure instead of wedging a supervise loop.
+DEFAULT_CONTROL_PLANE_TIMEOUT = 60.0
+
+# Deterministic control-plane fault plan (inline JSON or a path to a JSON
+# file) consumed by torchx_tpu/resilience/faults.py: inject transient /
+# permanent / timeout / garbage-stdout failures on the Nth matching call,
+# per backend+op. The control-plane counterpart of the local scheduler's
+# TPX_SIMULATE_PREEMPTION_EXIT job-failure drill. The preflight analyzer
+# errors (TPX502) when a plan is armed for a non-local submit.
+ENV_TPX_FAULT_PLAN = "TPX_FAULT_PLAN"
+
+# Root directory for durable supervisor session state (attempt ledger +
+# resume metadata); defaults to ~/.torchx_tpu/supervisor — one subdir per
+# supervise session, the ~/.torchx_tpu/obs/<session>/ convention. See
+# torchx_tpu/supervisor/ledger.py and `tpx supervise --resume`.
+ENV_TPX_SUPERVISOR_DIR = "TPX_SUPERVISOR_DIR"
+
 # ---------------------------------------------------------------------------
 # In-job (injected by schedulers into every replica)
 # ---------------------------------------------------------------------------

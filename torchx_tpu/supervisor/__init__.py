@@ -18,12 +18,15 @@ from torchx_tpu.supervisor.api import (
     latest_checkpoint_step,
     supervise,
 )
+from torchx_tpu.supervisor.ledger import AttemptLedger, list_sessions
 from torchx_tpu.supervisor.policy import SupervisorPolicy
 
 __all__ = [
+    "AttemptLedger",
     "Supervisor",
     "SupervisorPolicy",
     "SupervisorResult",
     "latest_checkpoint_step",
+    "list_sessions",
     "supervise",
 ]

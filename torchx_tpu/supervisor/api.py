@@ -49,6 +49,8 @@ from torchx_tpu.specs.api import (
     FailureClass,
     parse_app_handle,
 )
+from torchx_tpu.schedulers.ids import make_unique
+from torchx_tpu.supervisor.ledger import AttemptLedger
 from torchx_tpu.supervisor.policy import SupervisorPolicy
 from torchx_tpu.util.times import poll_intervals
 
@@ -105,6 +107,8 @@ class SupervisorResult:
     resume_steps: list[Optional[int]] = field(default_factory=list)
     #: set when a retry budget ran out and the failure stood.
     budget_exhausted: Optional[FailureClass] = None
+    #: durable session name; ``tpx supervise --resume <session>`` reattaches.
+    session: str = ""
 
     @property
     def handle(self) -> Optional[AppHandle]:
@@ -133,6 +137,7 @@ class Supervisor:
         policy: Optional[SupervisorPolicy] = None,
         sleep: Callable[[float], None] = time.sleep,
         rng: Optional[random.Random] = None,
+        session: Optional[str] = None,
     ) -> None:
         if dryrun_info._app is None or not dryrun_info._scheduler:
             raise ValueError(
@@ -144,6 +149,79 @@ class Supervisor:
         self._policy = policy or SupervisorPolicy()
         self._sleep = sleep
         self._rng = rng or random.Random()
+        self.session = session or make_unique("sup")
+        self._ledger = AttemptLedger(self.session)
+        # resume state (populated by :meth:`resume`): reattach here instead
+        # of submitting a fresh first attempt, with restored counters
+        self._resume_handle: Optional[AppHandle] = None
+        self._resume_attempts = 0
+        self._resume_retries: dict[FailureClass, int] = {}
+        self._resume_steps: list[Optional[int]] = []
+
+    # -- crash-safe resume -------------------------------------------------
+
+    @classmethod
+    def resume(
+        cls,
+        runner: "Runner",
+        session: str,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+    ) -> "Supervisor":
+        """Reattach to a supervised session after the client crashed.
+
+        Rebuilds the submission recipe from the session's ``meta.json``
+        (AppDef + cfg + policy re-materialized through the scheduler's own
+        ``materialize_dryrun``) and replays ``ledger.jsonl`` to restore the
+        attempt counter, per-class retry counts, and — crucially — the
+        handle of the last submitted attempt. :meth:`run` then polls that
+        live handle instead of submitting a duplicate: a job that kept
+        running while the supervisor was dead is simply picked back up.
+        """
+        from torchx_tpu.specs.serialize import (
+            appdef_from_dict,
+            supervisor_policy_from_dict,
+        )
+
+        ledger = AttemptLedger(session)
+        meta = ledger.read_meta()
+        scheduler = meta["scheduler"]
+        app = appdef_from_dict(meta["app"])
+        policy = supervisor_policy_from_dict(meta.get("policy") or {})
+        sched = runner._scheduler(scheduler)
+        info = sched.materialize_dryrun(app, meta.get("cfg") or {})
+        sup = cls(runner, info, policy, sleep=sleep, rng=rng, session=session)
+        sup._restore(ledger)
+        if sup._resume_handle is None:
+            raise ValueError(
+                f"session {session!r} has no submitted attempt to reattach"
+                " to (the original client died before its first submit);"
+                " start a fresh supervise instead"
+            )
+        return sup
+
+    def _restore(self, ledger: AttemptLedger) -> None:
+        retries: dict[FailureClass, int] = {fc: 0 for fc in FailureClass}
+        for entry in ledger.entries():
+            transition = entry.get("transition")
+            if transition == "submitted":
+                self._resume_attempts = max(
+                    self._resume_attempts, int(entry.get("attempt") or 0)
+                )
+                handle = entry.get("handle")
+                if handle:
+                    self._resume_handle = str(handle)
+                step = entry.get("resume_step")
+                self._resume_steps.append(
+                    int(step) if step is not None else None
+                )
+            elif transition == "resubmitting":
+                name = str(entry.get("failure_class") or "").rsplit(".", 1)[-1]
+                try:
+                    retries[FailureClass[name]] += 1
+                except KeyError:
+                    pass
+        self._resume_retries = retries
 
     # -- event plumbing ----------------------------------------------------
 
@@ -159,6 +237,34 @@ class Supervisor:
                 app_metadata={"transition": transition, **metadata},
             )
         )
+        # the same transition goes to the durable ledger so a fresh client
+        # can reconstruct the loop's exact state after a crash
+        self._ledger.append(transition, app_id, **metadata)
+
+    def _write_meta(self) -> None:
+        from torchx_tpu.specs.serialize import (
+            appdef_to_dict,
+            supervisor_policy_to_dict,
+        )
+
+        try:
+            meta = {
+                "session": self.session,
+                "scheduler": self._dryrun_info._scheduler or "",
+                "runner_session": self._runner._name,
+                "app": appdef_to_dict(self._dryrun_info._app),
+                "cfg": dict(self._dryrun_info._cfg or {}),
+                "policy": supervisor_policy_to_dict(self._policy),
+            }
+        except (TypeError, ValueError) as e:  # unserializable cfg value
+            logger.warning(
+                "session %s: could not persist resume metadata (%s);"
+                " --resume will not be available",
+                self.session,
+                e,
+            )
+            return
+        self._ledger.write_meta(meta)
 
     # -- attempt mechanics -------------------------------------------------
 
@@ -185,6 +291,7 @@ class Supervisor:
             app_id,
             attempt=attempt,
             resume_step=resume_step,
+            handle=handle,
         )
         return handle
 
@@ -206,6 +313,7 @@ class Supervisor:
         return self._runner.wait(
             handle, wait_interval=self._policy.poll_interval, rng=self._rng,
             sleep=self._sleep,
+            poll_miss_budget=self._policy.poll_miss_budget,
         )
 
     # -- the state machine -------------------------------------------------
@@ -222,6 +330,7 @@ class Supervisor:
         ``tpx trace`` renders."""
         # umbrella span: guarantees all attempts share ONE trace even when
         # run() is called directly (Runner.supervise adds its own parent)
+        self._write_meta()
         with obs_trace.span(
             "supervisor.run",
             session=self._runner._name,
@@ -237,19 +346,37 @@ class Supervisor:
     def _run_attempts(self) -> SupervisorResult:
         policy = self._policy
         retries: dict[FailureClass, int] = {fc: 0 for fc in FailureClass}
-        result = SupervisorResult(status=None, retries=retries)
+        for fc, n in self._resume_retries.items():
+            retries[fc] = n
+        result = SupervisorResult(
+            status=None, retries=retries, session=self.session
+        )
 
+        # a resumed session reattaches to the last submitted attempt (it
+        # may still be running — or already terminal, in which case the
+        # normal classification path below takes over immediately)
+        reattach = self._resume_handle
+        self._resume_handle = None
         resume_step: Optional[int] = None
-        attempt = 0
+        attempt = self._resume_attempts
+        if reattach is not None and self._resume_steps:
+            resume_step = self._resume_steps[-1]
         while True:
-            attempt += 1
+            if reattach is None:
+                attempt += 1
             with obs_trace.span(
                 "supervisor.attempt",
                 session=self._runner._name,
                 attempt=attempt,
                 resume_step=resume_step,
             ) as asp:
-                handle = self._submit(attempt, resume_step)
+                if reattach is not None:
+                    handle = reattach
+                    reattach = None
+                    _, _, rid = parse_app_handle(handle)
+                    self._emit("reattached", rid, attempt=attempt)
+                else:
+                    handle = self._submit(attempt, resume_step)
                 result.handles.append(handle)
                 result.resume_steps.append(resume_step)
                 result.attempts = attempt

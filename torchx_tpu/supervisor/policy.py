@@ -55,6 +55,12 @@ class SupervisorPolicy:
     # -- monitoring --------------------------------------------------------
     #: cap on the jittered incremental poll interval while an attempt runs.
     poll_interval: float = 10.0
+    #: consecutive status polls allowed to fail with a *transient* error
+    #: (classified by :mod:`torchx_tpu.resilience.errors`) before the
+    #: failure surfaces. Within the budget the poll loop degrades to a
+    #: warning + ``poll_degraded`` event and keeps waiting — a control
+    #: plane blip must not make the supervisor lose a healthy job.
+    poll_miss_budget: int = 3
     #: run the elastic watcher (shrink-on-failure) during each attempt when
     #: the backend has one, instead of plain status polling.
     elastic: bool = False
@@ -81,6 +87,10 @@ class SupervisorPolicy:
             raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
         if self.poll_interval <= 0:
             raise ValueError(f"poll_interval must be > 0, got {self.poll_interval}")
+        if self.poll_miss_budget < 0:
+            raise ValueError(
+                f"poll_miss_budget must be >= 0, got {self.poll_miss_budget}"
+            )
 
     def budget_for(self, failure_class: FailureClass) -> int:
         """The retry budget governing one failure class."""
