@@ -125,6 +125,15 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     platform = jax.devices()[0].platform
+
+    # bench under a trace id so the trainer emits the launch.breakdown
+    # span family + first-step heartbeat into the obs JSONL (inspect with
+    # `tpx trace <id>` / the launch-stage histogram)
+    from torchx_tpu import settings as tpx_settings
+    from torchx_tpu.obs import trace as obs_trace
+
+    os.environ.setdefault(tpx_settings.ENV_TPX_TRACE_ID, obs_trace.new_trace_id())
+
     from torchx_tpu.examples.train_llama import train
     from torchx_tpu.models import llama
 
@@ -257,10 +266,19 @@ def main() -> None:
         "platform": platform,
         "input": input_kind,
     }
+    if "launch_breakdown" in metrics:
+        result["launch_breakdown"] = {
+            k: round(v, 2) for k, v in metrics["launch_breakdown"].items()
+        }
     if int8_metrics is not None:
         result["int8_mfu"] = round(int8_metrics["mfu"], 4)
         result["int8_tokens_per_sec_per_chip"] = round(
             int8_metrics["tokens_per_sec_per_chip"], 1
+        )
+        # the int8 leg's OWN launch latency (per-call reference), not the
+        # cumulative process age the pre-fastpath bench reported
+        result["int8_launch_to_first_step_s"] = round(
+            int8_metrics["launch_to_first_step_s"], 1
         )
     print(json.dumps(result))
 

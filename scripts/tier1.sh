@@ -79,4 +79,29 @@ assert retries >= 2, f"expected >= 2 in-seam retries, saw {retries}"
 EOF
 then echo "RESILIENCE_SMOKE=ok"; else echo "RESILIENCE_SMOKE=FAILED"; rc=1; fi
 rm -rf "$res_dir"
+
+# CLI fast-path smoke: the lazy dispatcher must keep `tpx --help` and
+# `tpx list` off the heavy import path — jax (and the run-path command
+# modules) must never enter sys.modules, and help must render inside a
+# tight wall budget (the whole point of the warm-launch fast path).
+if timeout -k 10 20 env JAX_PLATFORMS=cpu python - <<'EOF'
+import sys
+from torchx_tpu.cli.main import main
+
+try:
+    main(["--help"])
+except SystemExit:
+    pass
+forbidden = ["jax", "numpy", "torchx_tpu.cli.cmd_run", "torchx_tpu.cli.cmd_lint"]
+leaked = [m for m in forbidden if m in sys.modules]
+assert not leaked, f"tpx --help imported {leaked}"
+
+try:
+    main(["list", "-s", "local"])
+except SystemExit:
+    pass
+leaked = [m for m in ("jax", "torchx_tpu.cli.cmd_run") if m in sys.modules]
+assert not leaked, f"tpx list imported {leaked}"
+EOF
+then echo "CLI_SMOKE=ok"; else echo "CLI_SMOKE=FAILED"; rc=1; fi
 exit $rc

@@ -31,6 +31,8 @@ from typing import Any, ContextManager
 
 from torchx_tpu import settings
 
+_PROCESS_START = time.monotonic()
+
 
 def _job_span(name: str, **attrs: Any) -> ContextManager[Any]:
     """A span joining the client's trace via the injected $TPX_TRACE_ID /
@@ -126,6 +128,9 @@ def main(argv: list[str] | None = None) -> None:
             "job.bootstrap",
             replica=os.environ.get(settings.ENV_TPX_REPLICA_ID),
             no_init=args.no_init or None,
+            # interpreter+import time already paid before bootstrap began
+            # (the "import" slice of the launch.breakdown family)
+            import_s=round(time.monotonic() - _PROCESS_START, 3),
         ):
             if not args.no_init:
                 initialize_distributed(args.port)

@@ -10,11 +10,11 @@ from __future__ import annotations
 import argparse
 import re
 import sys
-import threading
 
 from torchx_tpu.cli.cmd_base import SubCommand
 from torchx_tpu.runner.api import get_runner
 from torchx_tpu.util.log_tee_helpers import (
+    LineEmitter,
     find_role_replicas,
     wait_for_app_started,
 )
@@ -96,25 +96,21 @@ class CmdLog(SubCommand):
             if not pairs:
                 print("no matching replicas", file=sys.stderr)
                 sys.exit(1)
-            threads = []
-            lock = threading.Lock()
+            replicas: dict[str, list[int]] = {}
             for r, i in pairs:
-                def stream(r=r, i=i):  # noqa: ANN001
-                    for line in runner.log_lines(
-                        app_handle,
-                        r,
-                        i,
-                        regex=args.regex,
-                        since=since,
-                        until=until,
-                        should_tail=args.tail,
-                        streams=streams,
-                    ):
-                        with lock:
-                            print(f"{r}/{i} {line}", flush=True)
-
-                t = threading.Thread(target=stream, daemon=True)
-                t.start()
-                threads.append(t)
-            for t in threads:
-                t.join()
+                replicas.setdefault(r, []).append(i)
+            # concurrent fan-out with a line-atomic emitter: streams are
+            # read in parallel (runner.log_lines_multi pump threads) and
+            # every emitted line is one complete write — no interleaved
+            # partial lines under load
+            emitter = LineEmitter(sys.stdout)
+            for r, i, line in runner.log_lines_multi(
+                app_handle,
+                replicas,
+                regex=args.regex,
+                since=since,
+                until=until,
+                should_tail=args.tail,
+                streams=streams,
+            ):
+                emitter.emit(f"{r}/{i}", line)

@@ -46,13 +46,28 @@ class CmdDescribe(SubCommand):
 class CmdList(SubCommand):
     def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
         subparser.add_argument(
-            "-s", "--scheduler", required=True, help="scheduler backend to list"
+            "-s",
+            "--scheduler",
+            default=None,
+            help="scheduler backend to list (default: every backend,"
+            " queried concurrently)",
         )
 
     def run(self, args: argparse.Namespace) -> None:
         with get_runner() as runner:
-            for app in runner.list(args.scheduler):
-                print(f"{app.app_id}\t{app.state}\t{app.name}")
+            if args.scheduler:
+                for app in runner.list(args.scheduler):
+                    print(f"{app.app_id}\t{app.state}\t{app.name}")
+                return
+            # no -s: fan out across every backend; results print in
+            # registry order, one line per app prefixed by the backend,
+            # and an unreachable backend degrades to a stderr note
+            results, errors = runner.list_all()
+            for name, apps in results.items():
+                for app in apps:
+                    print(f"{name}\t{app.app_id}\t{app.state}\t{app.name}")
+            for name, err in errors.items():
+                print(f"{name}: <unavailable: {err}>", file=sys.stderr)
 
 
 class CmdCancel(SubCommand):

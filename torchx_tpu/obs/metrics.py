@@ -319,6 +319,30 @@ LAUNCH_TO_FIRST_STEP = REGISTRY.histogram(
     "process start to first completed training step in seconds",
 )
 
+#: per-stage breakdown of launch-to-first-step (the ``launch.*`` span
+#: family): import / backend_init / init_state / restore / data_setup /
+#: compile / first_step — makes launch regressions attributable.
+LAUNCH_STAGE_SECONDS = REGISTRY.histogram(
+    "tpx_launch_stage_seconds",
+    "seconds spent per launch bootstrap stage",
+    ("stage",),
+)
+
+#: Runner describe-cache hits (TTL-fresh, pinned-terminal, or coalesced
+#: onto an in-flight fetch), by scheduler.
+DESCRIBE_CACHE_HITS = REGISTRY.counter(
+    "tpx_describe_cache_hits_total",
+    "describe calls served from the Runner describe cache",
+    ("scheduler",),
+)
+
+#: Runner describe-cache misses (a real backend describe was issued).
+DESCRIBE_CACHE_MISSES = REGISTRY.counter(
+    "tpx_describe_cache_misses_total",
+    "describe calls that went through to the scheduler backend",
+    ("scheduler",),
+)
+
 #: preflight lint runs, by entry point ("runner"/"cli") and outcome
 #: ("clean"/"errors").
 LINT_RUNS = REGISTRY.counter(

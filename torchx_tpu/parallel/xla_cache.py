@@ -22,17 +22,25 @@ ENV_TPX_XLA_CACHE_DIR = "TPX_XLA_CACHE_DIR"
 DEFAULT_CACHE_DIR = "~/.cache/tpx/xla"
 
 _configured = False
+_cache_dir_used: str | None = None
 
 
 def setup_compilation_cache(cache_dir: str | None = None) -> str | None:
     """Enable the persistent compilation cache (idempotent).
 
     Resolution: explicit arg > $TPX_XLA_CACHE_DIR > default under ~/.cache.
-    An empty value disables. Returns the directory used (or None).
+    An empty value disables. Returns the directory in use (or None).
+
+    Variant configs of one model (e.g. the int8 bench leg, a remat-policy
+    sweep) lower to DISTINCT programs, each with its own cache entry — the
+    cache keys on the optimized HLO — so every variant must be allowed to
+    persist: the entry-size floor is zeroed and any compile over 1s
+    qualifies. A variant's first compile is honest cold time; every
+    relaunch after that is a cache hit.
     """
-    global _configured
+    global _configured, _cache_dir_used
     if _configured:
-        return None
+        return _cache_dir_used
     import jax
 
     if cache_dir is None:
@@ -44,7 +52,14 @@ def setup_compilation_cache(cache_dir: str | None = None) -> str | None:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        try:
+            # never skip persisting an entry because it is "small": the
+            # medium-sized variant programs are exactly the relaunch wins
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:  # noqa: BLE001 - knob absent on older jax
+            pass
         _configured = True
+        _cache_dir_used = cache_dir
         logger.info("persistent XLA compilation cache at %s", cache_dir)
         return cache_dir
     except Exception as e:  # noqa: BLE001 - cache is an optimization only

@@ -14,14 +14,16 @@ import json
 import logging
 import os
 import random
+import threading
 import time
 from datetime import datetime
 from types import TracebackType
-from typing import Any, Callable, Iterable, Mapping, Optional, Type
+from typing import Any, Callable, Iterable, Iterator, Mapping, Optional, Type
 
 from torchx_tpu import settings
 from torchx_tpu.obs import metrics as obs_metrics
 from torchx_tpu.obs import trace as obs_trace
+from torchx_tpu.runner.describe_cache import DescribeCache
 from torchx_tpu.runner.events import log_event
 from torchx_tpu.schedulers import (
     SchedulerFactory,
@@ -72,6 +74,10 @@ class Runner:
         self._scheduler_instances: dict[str, Scheduler] = {}
         self._component_defaults = dict(component_defaults or {})
         self._scheduler_params = dict(scheduler_params or {})
+        self._describe_cache = DescribeCache()
+        # fan-out paths create scheduler instances from worker threads
+        self._sched_locks_guard = threading.Lock()
+        self._sched_locks: dict[str, threading.Lock] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -293,16 +299,27 @@ class Runner:
 
     # -- monitor path ------------------------------------------------------
 
-    def status(self, app_handle: AppHandle) -> Optional[AppStatus]:
+    def status(
+        self, app_handle: AppHandle, fresh: bool = False
+    ) -> Optional[AppStatus]:
         """Current :class:`AppStatus` of the app, or None when the
         scheduler no longer knows the id. Terminal failures carry the
         scheduler's :class:`FailureClass` (``classify_failure`` hook), so
         ``tpx status`` shows ``FAILED (preemption)`` when the backend can
-        tell."""
+        tell.
+
+        Served through the Runner's describe cache
+        (:mod:`~torchx_tpu.runner.describe_cache`): repeat reads within
+        the TTL and concurrent reads of the same app share one backend
+        call, and terminal states are pinned (never re-fetched).
+        ``fresh=True`` (what :meth:`wait` polls use) refreshes through to
+        the backend — still coalescing with any in-flight fetch."""
         scheduler, _, app_id = parse_app_handle(app_handle)
         sched = self._scheduler(scheduler)
         with log_event("status", scheduler, app_id, session=self._name):
-            desc = sched.describe(app_id)
+            desc = self._describe_cache.get(
+                scheduler, app_id, lambda: sched.describe(app_id), fresh=fresh
+            )
             if desc is None:
                 return None
             return AppStatus(
@@ -357,7 +374,9 @@ class Runner:
                 initial=min(1.0, wait_interval), max_interval=wait_interval, rng=rng
             ):
                 try:
-                    status = self.status(app_handle)
+                    # fresh=True: wait is the cache WRITER — every tick
+                    # refreshes the entry that passive readers share
+                    status = self.status(app_handle, fresh=True)
                     misses = 0
                 except Exception as e:
                     from torchx_tpu.resilience.errors import (
@@ -443,12 +462,14 @@ class Runner:
         scheduler, _, app_id = parse_app_handle(app_handle)
         with log_event("cancel", scheduler, app_id, session=self._name):
             self._scheduler(scheduler).cancel(app_id)
+            self._describe_cache.invalidate(scheduler, app_id)
 
     def delete(self, app_handle: AppHandle) -> None:
         """Remove the app from the scheduler entirely (cancel + forget)."""
         scheduler, _, app_id = parse_app_handle(app_handle)
         with log_event("delete", scheduler, app_id, session=self._name):
             self._scheduler(scheduler).delete(app_id)
+            self._describe_cache.invalidate(scheduler, app_id)
 
     def resize(
         self, app_handle: AppHandle, role_name: str, num_replicas: int
@@ -459,6 +480,7 @@ class Runner:
         scheduler, _, app_id = parse_app_handle(app_handle)
         with log_event("resize", scheduler, app_id, session=self._name):
             self._scheduler(scheduler).resize(app_id, role_name, num_replicas)
+            self._describe_cache.invalidate(scheduler, app_id)
 
     def watch_elastic(
         self,
@@ -537,10 +559,13 @@ class Runner:
             return result
 
     def describe(self, app_handle: AppHandle) -> Optional[AppDef]:
-        """Best-effort reconstruction of the AppDef from the backend."""
+        """Best-effort reconstruction of the AppDef from the backend
+        (served through the describe cache, like :meth:`status`)."""
         scheduler, _, app_id = parse_app_handle(app_handle)
         with log_event("describe", scheduler, app_id, session=self._name):
-            desc = self._scheduler(scheduler).describe(app_id)
+            desc = self._describe_cache.get(
+                scheduler, app_id, lambda: self._scheduler(scheduler).describe(app_id)
+            )
             if desc is None:
                 return None
             return AppDef(name=app_id, roles=desc.roles)
@@ -549,6 +574,49 @@ class Runner:
         """All apps the backend knows about (any session)."""
         with log_event("list", scheduler, session=self._name):
             return self._scheduler(scheduler).list()
+
+    def list_all(
+        self,
+        schedulers: Optional[Iterable[str]] = None,
+        max_workers: int = 8,
+    ) -> tuple[dict[str, list[ListAppResponse]], dict[str, Exception]]:
+        """:meth:`list` fanned out across backends on a bounded thread
+        pool, so one slow/unreachable control plane no longer serializes
+        the whole listing.
+
+        Returns ``(results, errors)``, each keyed by scheduler name.
+        Ordering is deterministic: both dicts iterate in registry order
+        (the order of ``scheduler_backends()``), regardless of which
+        backend answered first. A backend that raises lands in ``errors``
+        and never hides the others' results."""
+        names = (
+            list(schedulers)
+            if schedulers is not None
+            else list(self._scheduler_factories)
+        )
+        for name in names:
+            if name not in self._scheduler_factories:
+                raise UnknownSchedulerError(name, list(self._scheduler_factories))
+        results: dict[str, list[ListAppResponse]] = {}
+        errors: dict[str, Exception] = {}
+        if not names:
+            return results, errors
+        from concurrent.futures import ThreadPoolExecutor
+
+        with obs_trace.span(
+            "runner.list_all", session=self._name, schedulers=",".join(names)
+        ):
+            with ThreadPoolExecutor(
+                max_workers=min(max_workers, len(names)),
+                thread_name_prefix="tpx-list",
+            ) as pool:
+                futures = {name: pool.submit(self.list, name) for name in names}
+            for name in names:
+                try:
+                    results[name] = futures[name].result()
+                except Exception as e:  # noqa: BLE001 - reported per backend
+                    errors[name] = e
+        return results, errors
 
     def log_lines(
         self,
@@ -587,6 +655,88 @@ class Runner:
                 streams,
             )
 
+    def log_lines_multi(
+        self,
+        app_handle: AppHandle,
+        replicas: Mapping[str, Iterable[int]],
+        regex: Optional[str] = None,
+        since: Optional[datetime] = None,
+        until: Optional[datetime] = None,
+        should_tail: bool = False,
+        streams: Optional[Stream] = None,
+    ) -> Iterator[tuple[str, int, str]]:
+        """Merge many replicas' log streams into one iterator of
+        ``(role_name, replica_id, line)`` tuples (lines come with their
+        trailing newline stripped).
+
+        One pump thread per replica feeds a single bounded FIFO queue, so
+        the streams are read concurrently (tailing N replicas costs the
+        latency of one) while PER-REPLICA ordering is preserved exactly;
+        interleaving across replicas is arrival-order. A stream that fails
+        yields one ``<log stream error: ...>`` line for its replica and
+        never takes the other streams down. Abandoning the iterator
+        (``close()``/GC) releases every pump thread."""
+        pairs = [
+            (role, int(rid)) for role, ids in replicas.items() for rid in ids
+        ]
+        if not pairs:
+            return
+        import queue
+
+        q: "queue.Queue[object]" = queue.Queue(maxsize=1024)
+        stop = threading.Event()
+        done = object()  # one per-replica end-of-stream sentinel
+
+        def _offer(item: object) -> None:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.5)
+                    return
+                except queue.Full:
+                    continue
+
+        def _pump(role: str, rid: int) -> None:
+            try:
+                for line in self.log_lines(
+                    app_handle,
+                    role,
+                    rid,
+                    regex=regex,
+                    since=since,
+                    until=until,
+                    should_tail=should_tail,
+                    streams=streams,
+                ):
+                    _offer((role, rid, line.rstrip("\n")))
+                    if stop.is_set():
+                        return
+            except Exception as e:  # noqa: BLE001 - isolated per stream
+                _offer((role, rid, f"<log stream error: {e}>"))
+            finally:
+                _offer(done)
+
+        threads = [
+            threading.Thread(
+                target=_pump,
+                args=(role, rid),
+                daemon=True,
+                name=f"tpx-log-{role}-{rid}",
+            )
+            for role, rid in pairs
+        ]
+        for t in threads:
+            t.start()
+        remaining = len(threads)
+        try:
+            while remaining:
+                item = q.get()
+                if item is done:
+                    remaining -= 1
+                    continue
+                yield item  # type: ignore[misc]
+        finally:
+            stop.set()  # consumer gone: release any blocked pump
+
     # -- scheduler access --------------------------------------------------
 
     def scheduler_backends(self) -> list[str]:
@@ -603,15 +753,24 @@ class Runner:
 
     def _scheduler(self, scheduler: str) -> Scheduler:
         sched = self._scheduler_instances.get(scheduler)
-        if sched is None:
-            factory = self._scheduler_factories.get(scheduler)
-            if factory is None:
-                raise UnknownSchedulerError(
-                    scheduler, list(self._scheduler_factories)
-                )
-            params = dict(self._scheduler_params)
-            sched = factory(session_name=self._name, **params)
-            self._scheduler_instances[scheduler] = sched
+        if sched is not None:
+            return sched
+        factory = self._scheduler_factories.get(scheduler)
+        if factory is None:
+            raise UnknownSchedulerError(
+                scheduler, list(self._scheduler_factories)
+            )
+        # per-name creation lock: fan-out worker threads racing on the
+        # same backend create exactly one instance; distinct backends
+        # still construct (and import) in parallel
+        with self._sched_locks_guard:
+            lock = self._sched_locks.setdefault(scheduler, threading.Lock())
+        with lock:
+            sched = self._scheduler_instances.get(scheduler)
+            if sched is None:
+                params = dict(self._scheduler_params)
+                sched = factory(session_name=self._name, **params)
+                self._scheduler_instances[scheduler] = sched
         return sched
 
     # -- tracker env injection (reference runner/api.py:358-391) -----------

@@ -76,6 +76,18 @@ ENV_TPX_FAULT_PLAN = "TPX_FAULT_PLAN"
 # torchx_tpu/supervisor/ledger.py and `tpx supervise --resume`.
 ENV_TPX_SUPERVISOR_DIR = "TPX_SUPERVISOR_DIR"
 
+# TTL (seconds) for the Runner's describe cache: passive readers
+# (status/describe, supervision double-polls) within the TTL share one
+# backend call; wait() polls always refresh (cache writer) and terminal
+# states are pinned forever (immutable, so never stale). "0" disables
+# caching for non-terminal states. Default DEFAULT_DESCRIBE_CACHE_TTL.
+ENV_TPX_DESCRIBE_CACHE_TTL = "TPX_DESCRIBE_CACHE_TTL"
+
+# Default for ENV_TPX_DESCRIBE_CACHE_TTL: shorter than any poll interval
+# the Runner uses, so back-to-back polls from stacked layers coalesce but
+# successive wait ticks always observe fresh state.
+DEFAULT_DESCRIBE_CACHE_TTL = 1.0
+
 # ---------------------------------------------------------------------------
 # In-job (injected by schedulers into every replica)
 # ---------------------------------------------------------------------------

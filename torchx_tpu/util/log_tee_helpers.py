@@ -24,6 +24,26 @@ def _colored(prefix: str, idx: int, enabled: bool) -> str:
     return colored(prefix, _COLOR_CYCLE[idx % len(_COLOR_CYCLE)], enabled)
 
 
+class LineEmitter:
+    """Thread-safe line-atomic writer shared by every replica stream.
+
+    Each :meth:`emit` performs ONE ``write()`` of a complete
+    newline-terminated line plus a flush, under one lock — concurrent
+    replica threads can interleave whole lines but never partial lines
+    (separate write("text") / write("\\n") calls, as ``print`` issues,
+    interleave under load even when each call is individually atomic)."""
+
+    def __init__(self, out: TextIO = sys.stdout) -> None:
+        self._out = out
+        self._lock = threading.Lock()
+
+    def emit(self, prefix: str, line: str) -> None:
+        text = f"{prefix} {line.rstrip(chr(10))}\n" if prefix else f"{line.rstrip(chr(10))}\n"
+        with self._lock:
+            self._out.write(text)
+            self._out.flush()
+
+
 def find_role_replicas(
     app_status: Optional[AppStatus], role_name: Optional[str]
 ) -> list[tuple[str, int]]:
@@ -46,19 +66,15 @@ def _stream_one(
     replica: int,
     prefix: str,
     should_tail: bool,
-    out: TextIO,
-    lock: threading.Lock,
+    emitter: LineEmitter,
 ) -> None:
     try:
         for line in runner.log_lines(
             app_handle, role, replica, should_tail=should_tail
         ):
-            with lock:
-                out.write(f"{prefix} {line}\n")
-                out.flush()
+            emitter.emit(prefix, line)
     except Exception as e:  # noqa: BLE001 - log streaming is best-effort
-        with lock:
-            out.write(f"{prefix} <log stream error: {e}>\n")
+        emitter.emit(prefix, f"<log stream error: {e}>")
 
 
 def wait_for_app_started(
@@ -88,13 +104,13 @@ def tee_logs(
     status = wait_for_app_started(runner, app_handle)
     replicas = find_role_replicas(status, role_name)
     use_colors = colors if colors is not None else out.isatty()
-    lock = threading.Lock()
+    emitter = LineEmitter(out)
     threads = []
     for idx, (role, replica) in enumerate(replicas):
         prefix = _colored(f"{role}/{replica}", idx, use_colors)
         t = threading.Thread(
             target=_stream_one,
-            args=(runner, app_handle, role, replica, prefix, should_tail, out, lock),
+            args=(runner, app_handle, role, replica, prefix, should_tail, emitter),
             daemon=True,
         )
         t.start()

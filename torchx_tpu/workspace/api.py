@@ -45,32 +45,65 @@ class WorkspaceMixin(Generic[T]):
         ...
 
     def build_workspaces(
-        self, roles: list[Role], cfg: Mapping[str, CfgVal]
+        self, roles: list[Role], cfg: Mapping[str, CfgVal],
+        max_workers: int = 4,
     ) -> None:
         """Build each role's workspace (once per distinct (image,
         projects) pair — results are cached) and mutate ``role.image`` to
-        the built artifact."""
-        cache: dict[tuple[str, tuple[tuple[str, str], ...]], str] = {}
-        for role in roles:
-            ws = role.workspace
-            if not ws:
-                continue
-            key = (role.image, tuple(sorted(ws.projects.items())))
-            if key in cache:
-                role.image = cache[key]
-                continue
-            old_image = role.image
-            self.build_workspace_and_update_role(role, ws, cfg)
-            cache[key] = role.image
-            if role.image != old_image:
-                import logging
+        the built artifact.
 
-                logging.getLogger(__name__).info(
+        Distinct pairs build CONCURRENTLY on a bounded thread pool (each
+        build is mostly subprocess/IO: docker build, snapshot copy), so a
+        multi-role app pays the wall-clock of its slowest build rather
+        than the sum. Role mutation order stays deterministic: the first
+        role carrying each key is the one whose build runs; the rest take
+        the cached image afterwards, in role order."""
+        # capture keys BEFORE building: builds mutate role.image in place
+        role_keys = [
+            (role, (role.image, tuple(sorted(role.workspace.projects.items()))))
+            for role in roles
+            if role.workspace
+        ]
+        keyed: dict[tuple[str, tuple[tuple[str, str], ...]], Role] = {}
+        for role, key in role_keys:
+            keyed.setdefault(key, role)
+        if not keyed:
+            return
+
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def _build(role: Role) -> str:
+            old_image = role.image
+            self.build_workspace_and_update_role(role, role.workspace, cfg)
+            if role.image != old_image:
+                log.info(
                     "built workspace for role %s: %s -> %s",
                     role.name,
                     old_image,
                     role.image,
                 )
+            return role.image
+
+        cache: dict[tuple[str, tuple[tuple[str, str], ...]], str] = {}
+        if len(keyed) == 1:
+            ((key, role),) = keyed.items()
+            cache[key] = _build(role)
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=min(max_workers, len(keyed)),
+                thread_name_prefix="tpx-ws-build",
+            ) as pool:
+                futures = {
+                    key: pool.submit(_build, role) for key, role in keyed.items()
+                }
+            for key in futures:
+                cache[key] = futures[key].result()  # re-raises build errors
+        for role, key in role_keys:
+            role.image = cache[key]
 
     # push contract for docker-ish backends (reference api.py:169-179)
     def dryrun_push_images(self, app: Any, cfg: Mapping[str, CfgVal]) -> Any:
