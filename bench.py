@@ -151,7 +151,13 @@ def main() -> None:
         # the fallback when the [batch, seq, vocab] f32 chunk doesn't fit,
         # and batch >= 3 crashes this tunnel's remote-compile helper
         # (see docs/performance.md)
+        # "auto" resolves per-launch via compiled.memory_analysis(): it
+        # upgrades to dots_attn (no attention recompute in backward) when
+        # the activation footprint fits HBM, and the trial compile IS the
+        # winner's compile (persistent XLA cache), so launch latency pays
+        # only for candidates that did NOT fit
         candidates = [
+            ("auto", 2, {"loss_chunk": 2048}),
             ("dots", 2, {"loss_chunk": 2048}),
             ("dots", 2, {}),
             ("full", 8, {}),
@@ -226,14 +232,26 @@ def main() -> None:
     if metrics is None:
         raise RuntimeError("all bench configurations OOMed")
 
-    # secondary: AQT int8 training matmuls on the same config (measured
-    # +0.3pp MFU at these shapes — quant overhead eats most of the 1.94x
-    # int8 kernel speedup at batch 2; reported for the record)
+    # secondary: AQT int8 training matmuls on the same config. Scope "ffn"
+    # only: r05 measured whole-model int8 BELOW bf16 (12,562 vs 12,912
+    # tok/s/chip) — at batch 2 the attention projections are skinny
+    # matmuls where AQT's per-call quantize/dequantize (scale reduction +
+    # rounding over the [b*s, d] activations) costs more than the int8
+    # MXU gain; the FFN matmuls have the arithmetic intensity to win. If
+    # int8 still loses, the JSON says so explicitly
+    # (int8_slower_than_bf16) instead of leaving a silent regression.
     int8_metrics = None
+    int8_scope = "ffn"
+    # reuse the RESOLVED policy (post-"auto") so the secondary leg doesn't
+    # re-run selection
+    resolved_policy = metrics.get("remat_policy", policy_used)
     if on_tpu and policy_used is not None:
         try:
             int8_cfg = base_cfg(
-                remat_policy=policy_used, int8_matmuls=True, **overrides_used
+                remat_policy=resolved_policy,
+                int8_matmuls=True,
+                int8_scope=int8_scope,
+                **overrides_used,
             )
             int8_metrics = train(
                 int8_cfg,
@@ -270,10 +288,26 @@ def main() -> None:
         result["launch_breakdown"] = {
             k: round(v, 2) for k, v in metrics["launch_breakdown"].items()
         }
+    # steady-state step-time split (data-wait vs compute) + the remat
+    # policy the step actually ran with (post-"auto" resolution)
+    if "remat_policy" in metrics:
+        result["remat_policy"] = metrics["remat_policy"]
+    if "step_time_s" in metrics:
+        result["step_time_s"] = round(metrics["step_time_s"], 5)
+        result["data_wait_s"] = round(metrics["data_wait_s"], 5)
+        result["data_wait_frac"] = round(metrics["data_wait_frac"], 5)
+        result["prefetch_depth"] = metrics.get("prefetch_depth")
     if int8_metrics is not None:
         result["int8_mfu"] = round(int8_metrics["mfu"], 4)
         result["int8_tokens_per_sec_per_chip"] = round(
             int8_metrics["tokens_per_sec_per_chip"], 1
+        )
+        result["int8_scope"] = int8_scope
+        # explicit regression gate: int8 must beat (or tie) bf16 on the
+        # same config, else the JSON flags it rather than hiding it
+        result["int8_slower_than_bf16"] = bool(
+            int8_metrics["tokens_per_sec_per_chip"]
+            < metrics["tokens_per_sec_per_chip"]
         )
         # the int8 leg's OWN launch latency (per-call reference), not the
         # cumulative process age the pre-fastpath bench reported

@@ -80,6 +80,20 @@ EOF
 then echo "RESILIENCE_SMOKE=ok"; else echo "RESILIENCE_SMOKE=FAILED"; rc=1; fi
 rm -rf "$res_dir"
 
+# Remat smoke: the MoE/expert-parallel dryrun leg (the r03 gather shape
+# that used to trip GSPMD's replicate+reslice fallback) must compile with
+# zero involuntary-full-rematerialization warnings and, where Shardy is
+# available, without the GSPMD sharding-propagation deprecation warning.
+remat_log=$(mktemp /tmp/tpx_remat_smoke.XXXXXX)
+if timeout -k 10 420 env _TPX_DRYRUN_LEGS=moe \
+    python -c 'import __graft_entry__ as g; g.dryrun_multichip(8)' \
+    >"$remat_log" 2>&1 \
+  && ! grep -q "Involuntary full rematerialization" "$remat_log" \
+  && ! { grep -q "shardy=on" "$remat_log" \
+         && grep -q "GSPMD sharding propagation is going to be deprecated" "$remat_log"; }
+then echo "REMAT_SMOKE=ok"; else echo "REMAT_SMOKE=FAILED"; rc=1; cat "$remat_log"; fi
+rm -f "$remat_log"
+
 # CLI fast-path smoke: the lazy dispatcher must keep `tpx --help` and
 # `tpx list` off the heavy import path — jax (and the run-path command
 # modules) must never enter sys.modules, and help must render inside a

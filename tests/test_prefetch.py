@@ -1,0 +1,257 @@
+"""Device input prefetch (parallel/prefetch.py) + auto remat policy
+selection (parallel/remat_auto.py).
+
+The prefetcher sits on the trainer's critical path: ordering bugs corrupt
+resumable data streams silently, leaked producer threads hang pytest, and
+swallowed producer errors turn data corruption into an infinite stall. So
+these tests drive the real thread machinery (slow producers, early exits,
+mid-stream exceptions) rather than mocking it; only the remat trials mock
+the fit oracle (a real AOT compile per candidate is tier-2 territory).
+"""
+
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding
+
+from torchx_tpu.models import llama
+from torchx_tpu.parallel.aot_fit import FitResult
+from torchx_tpu.parallel.mesh import BATCH_SPEC, MeshConfig, make_mesh
+from torchx_tpu.parallel.prefetch import Prefetcher, device_prefetch, sharded_put
+from torchx_tpu.parallel.remat_auto import (
+    POLICY_ORDER,
+    choose_remat_policy,
+)
+
+
+def _mesh():
+    return make_mesh(MeshConfig(dp=2, fsdp=2, ep=1, tp=1, sp=2))
+
+
+# ---------------------------------------------------------------------------
+# Prefetcher core
+# ---------------------------------------------------------------------------
+
+
+class TestPrefetcher:
+    def test_preserves_order_under_slow_producer(self):
+        def slow_source():
+            for i in range(10):
+                time.sleep(0.005)
+                yield i
+
+        with Prefetcher(slow_source(), depth=2) as pf:
+            assert list(pf) == list(range(10))
+            assert pf.batches_served == 10
+            # consumer outpaced the producer the whole way: every batch was
+            # waited for, so the wait accounting must have registered it
+            assert pf.data_wait_s > 0
+
+    def test_exhaustion_raises_stopiteration_repeatedly(self):
+        pf = Prefetcher(iter([1]), depth=2)
+        assert next(pf) == 1
+        with pytest.raises(StopIteration):
+            next(pf)
+        with pytest.raises(StopIteration):
+            next(pf)
+        pf.close()
+
+    def test_depth_zero_is_synchronous_passthrough(self):
+        placed = []
+        pf = Prefetcher(
+            iter([1, 2, 3]), depth=0, place=lambda x: placed.append(x) or x * 10
+        )
+        assert pf._thread is None  # no producer thread in passthrough mode
+        assert next(pf) == 10
+        assert placed == [1]  # placement ran inline, not ahead
+        assert list(pf) == [20, 30]
+        assert pf.data_wait_s > 0  # inline production is charged as wait
+        pf.close()
+
+    def test_negative_depth_rejected(self):
+        with pytest.raises(ValueError, match="depth"):
+            Prefetcher(iter([]), depth=-1)
+
+    def test_place_runs_on_producer_thread(self):
+        threads = []
+        pf = Prefetcher(
+            iter([1, 2]),
+            depth=2,
+            place=lambda x: threads.append(threading.current_thread().name) or x,
+        )
+        assert list(pf) == [1, 2]
+        pf.close()
+        assert threads and all(t != threading.main_thread().name for t in threads)
+
+    def test_close_drains_producer_blocked_on_full_queue(self):
+        # infinite source, consumer takes only 3: the producer is parked on
+        # a full queue when close() hits — it must unblock and join
+        pf = Prefetcher(itertools.count(), depth=2)
+        got = [next(pf) for _ in range(3)]
+        assert got == [0, 1, 2]
+        thread = pf._thread
+        pf.close()
+        assert thread is not None and not thread.is_alive()
+        pf.close()  # idempotent
+        with pytest.raises(StopIteration):  # closed iterator is exhausted
+            next(pf)
+
+    def test_context_manager_closes_on_early_exit(self):
+        with Prefetcher(itertools.count(), depth=3) as pf:
+            assert next(pf) == 0
+            thread = pf._thread
+        assert thread is not None and not thread.is_alive()
+
+    def test_producer_exception_propagates_to_consumer(self):
+        def bad_source():
+            yield 1
+            yield 2
+            raise RuntimeError("corrupt shard")
+
+        pf = Prefetcher(bad_source(), depth=2)
+        assert next(pf) == 1
+        assert next(pf) == 2
+        with pytest.raises(RuntimeError, match="corrupt shard"):
+            next(pf)
+        with pytest.raises(StopIteration):  # failure exhausts the stream
+            next(pf)
+        pf.close()
+
+    def test_place_exception_propagates_in_passthrough(self):
+        def bad_place(x):
+            raise ValueError("bad batch")
+
+        pf = Prefetcher(iter([1]), depth=0, place=bad_place)
+        with pytest.raises(ValueError, match="bad batch"):
+            next(pf)
+        pf.close()
+
+
+# ---------------------------------------------------------------------------
+# Sharded placement
+# ---------------------------------------------------------------------------
+
+
+class TestDevicePrefetch:
+    def test_prefetched_batches_are_sharded(self):
+        mesh = _mesh()
+        sharding = NamedSharding(mesh, BATCH_SPEC)
+        source = ({"tokens": np.full((8, 16), i, dtype=np.int32)} for i in range(4))
+        with device_prefetch(source, mesh, depth=2) as pf:
+            batches = list(pf)
+        assert len(batches) == 4
+        for i, batch in enumerate(batches):
+            tok = batch["tokens"]
+            assert isinstance(tok, jax.Array)
+            assert tok.sharding == sharding
+            assert int(tok[0, 0]) == i  # order survived the thread hop
+
+    def test_already_sharded_arrays_pass_through(self):
+        mesh = _mesh()
+        place = sharded_put(mesh)
+        first = place({"tokens": np.zeros((8, 16), dtype=np.int32)})
+        again = place(first)
+        assert again["tokens"] is first["tokens"]
+
+    def test_bare_array_batches(self):
+        mesh = _mesh()
+        place = sharded_put(mesh)
+        out = place(np.zeros((8, 16), dtype=np.int32))
+        assert isinstance(out, jax.Array)
+        assert out.sharding == NamedSharding(mesh, BATCH_SPEC)
+
+
+# ---------------------------------------------------------------------------
+# Auto remat policy selection
+# ---------------------------------------------------------------------------
+
+
+def _fit(policy, fits, peak):
+    return FitResult(
+        batch=8,
+        seq=64,
+        remat_policy=policy,
+        args_bytes=peak // 2,
+        temp_bytes=peak // 2,
+        peak_bytes=peak,
+        fits=fits,
+    )
+
+
+class TestChooseRematPolicy:
+    def setup_method(self):
+        self.cfg = llama.llama_tiny()
+        self.mesh = _mesh()
+
+    def test_picks_cheapest_recompute_that_fits(self):
+        policy, trials = choose_remat_policy(
+            self.cfg,
+            self.mesh,
+            8,
+            64,
+            fit_fn=lambda c: _fit(c.remat_policy, True, 100),
+        )
+        assert policy == POLICY_ORDER[0] == "dots_attn"
+        assert [t.policy for t in trials] == ["dots_attn"]
+        assert trials[0].fits and trials[0].peak_bytes == 100
+
+    def test_falls_through_to_next_policy(self):
+        policy, trials = choose_remat_policy(
+            self.cfg,
+            self.mesh,
+            8,
+            64,
+            fit_fn=lambda c: _fit(c.remat_policy, c.remat_policy == "dots", 100),
+        )
+        assert policy == "dots"
+        assert [(t.policy, t.fits) for t in trials] == [
+            ("dots_attn", False),
+            ("dots", True),
+        ]
+
+    def test_nothing_fits_returns_full(self):
+        policy, trials = choose_remat_policy(
+            self.cfg,
+            self.mesh,
+            8,
+            64,
+            fit_fn=lambda c: _fit(c.remat_policy, False, 10**15),
+        )
+        assert policy == "full"
+        assert [t.policy for t in trials] == list(POLICY_ORDER)
+        assert not any(t.fits for t in trials)
+
+    def test_failed_trial_compile_is_a_nonfit_verdict(self):
+        def flaky(c):
+            if c.remat_policy == "dots_attn":
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            return _fit(c.remat_policy, True, 100)
+
+        policy, trials = choose_remat_policy(self.cfg, self.mesh, 8, 64, fit_fn=flaky)
+        assert policy == "dots"
+        assert trials[0].error is not None and "RESOURCE_EXHAUSTED" in trials[0].error
+        assert not trials[0].fits and trials[0].peak_bytes == 0
+
+    def test_candidates_carry_remat_enabled_and_policy(self):
+        seen = []
+
+        def spy(c):
+            seen.append((c.remat, c.remat_policy))
+            return _fit(c.remat_policy, c.remat_policy == "full", 100)
+
+        choose_remat_policy(self.cfg, self.mesh, 8, 64, fit_fn=spy)
+        assert seen == [(True, p) for p in POLICY_ORDER]
+
+    def test_trainer_rejects_unresolved_auto(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            llama.llama_tiny(), remat=True, remat_policy="auto"
+        )
+        with pytest.raises(ValueError, match="auto"):
+            llama._remat(lambda x: x, cfg)

@@ -43,6 +43,8 @@ Diagnostic codes
 | TPX101 | error | no such TPU slice: chip count impossible for the generation (multi-host slices are built from fixed-size host VMs; v5e/v6e pods cap at 256 chips) | use a valid chip count for the generation |
 | TPX102 | error | topology dimensionality does not match the generation (v5e/v6e are 2D meshes, v4/v5p are 3D tori) | use a shape like ``4x8`` (v5e) or ``2x2x4`` (v4) |
 | TPX103 | error | TPU-looking key in ``resource.devices`` | TPU chips are allocated via ``resource.tpu``, never devices |
+| TPX110 | warning | ``--mesh`` pairs expert parallelism (``ep``) with ``fsdp``/``sp`` sharding: embedding/expert gathers reshard dim-sharded → batch/seq-sharded, which GSPMD partitions by involuntary full rematerialization unless gather outputs carry explicit sharding constraints | pin gather outputs with ``with_sharding_constraint``, or use ``torchx_tpu.examples.train_llama`` which already does |
+| TPX111 | error | unknown mesh axis name in a ``--mesh`` role arg | use the trainer mesh axes ``pp/dp/fsdp/ep/tp/sp`` |
 | TPX201 | error | role env overrides a launcher-injected identity/rendezvous var (``TPX_REPLICA_ID``, ``MEGASCALE_*``, ...) | remove it — every scheduler injects it |
 | TPX202 | warning | env var uses a reserved prefix (``TPX_``/``TPU_``/``MEGASCALE_``) but is not a documented knob | rename it |
 | TPX203 | info | ``JAX_*`` env var set (JAX runtime config) | make sure it is intentional |

@@ -325,6 +325,100 @@ def check_topology(ctx: RuleContext) -> Iterator[Diagnostic]:
                 )
 
 
+#: Mesh axes of the trainer's canonical 6-axis mesh (parallel/mesh.py
+#: ``AXES``, duplicated here because analyze never imports jax).
+MESH_AXES = frozenset({"pp", "dp", "fsdp", "ep", "tp", "sp"})
+
+#: Entrypoint modules known to pin gather outputs with explicit sharding
+#: constraints (models/llama.py forward_features), making expert-parallel
+#: meshes remat-free. Custom trainer modules get the TPX110 warning.
+REMAT_SAFE_MODULES = ("torchx_tpu.examples.train_llama",)
+
+
+def _mesh_specs(role: Role) -> Iterator[str]:
+    """Values of ``--mesh`` arguments in a role's arg list (both the
+    two-token ``--mesh dp=2,...`` and one-token ``--mesh=dp=2,...``
+    spellings)."""
+    args = [str(a) for a in role.args]
+    for i, a in enumerate(args):
+        if a == "--mesh" and i + 1 < len(args):
+            yield args[i + 1]
+        elif a.startswith("--mesh="):
+            yield a.split("=", 1)[1]
+
+
+@rule("mesh")
+def check_mesh(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX110-TPX111: mesh axis specs in role args.
+
+    TPX110 is the launch-time twin of the runtime remat push: a mesh that
+    shards experts (``ep``) while also sharding weights or sequence
+    (``fsdp``/``sp``) makes the embedding/expert gathers transition
+    between a dim-sharded operand layout and a batch/seq-sharded output
+    layout. GSPMD partitions that gather by replicate+reslice —
+    "involuntary full rematerialization", warned on every compile and
+    paid in HBM + latency — unless the model pins the gather outputs with
+    explicit ``with_sharding_constraint``. The stock trainer does; a
+    custom entrypoint module probably does not, so warn before the job
+    ever reaches a pod.
+    """
+    for role in ctx.app.roles:
+        args = [str(a) for a in role.args]
+        safe = any(
+            m in (role.entrypoint or "") or m in args for m in REMAT_SAFE_MODULES
+        )
+        for spec in _mesh_specs(role):
+            sizes: dict[str, int] = {}
+            for pair in spec.split(","):
+                if not pair.strip():
+                    continue
+                axis, _, value = pair.partition("=")
+                axis = axis.strip()
+                try:
+                    sizes[axis] = int(value)
+                except ValueError:
+                    sizes[axis] = 0  # unparseable size: still report the axis
+                if axis not in MESH_AXES:
+                    yield Diagnostic(
+                        code="TPX111",
+                        severity=Severity.ERROR,
+                        role=role.name,
+                        field="args.--mesh",
+                        message=(
+                            f"unknown mesh axis {axis!r} in --mesh {spec!r};"
+                            f" the trainer mesh has axes"
+                            f" {'/'.join(sorted(MESH_AXES))}"
+                        ),
+                        hint="fix the axis name (e.g. fsdp=-1, not fsd=-1)",
+                    )
+            ep = sizes.get("ep", 1)
+            paired = [
+                a for a in ("fsdp", "sp") if sizes.get(a, 1) > 1 or sizes.get(a) == -1
+            ]
+            if (ep > 1 or ep == -1) and paired and not safe:
+                yield Diagnostic(
+                    code="TPX110",
+                    severity=Severity.WARNING,
+                    role=role.name,
+                    field="args.--mesh",
+                    message=(
+                        f"--mesh {spec!r} pairs expert parallelism (ep) with"
+                        f" {'/'.join(paired)} sharding: embedding/expert"
+                        " gathers then reshard dim-sharded -> batch/seq-"
+                        "sharded, which GSPMD partitions by involuntary"
+                        " full rematerialization (replicate + reslice)"
+                        " unless gather outputs carry explicit sharding"
+                        " constraints"
+                    ),
+                    hint=(
+                        "pin gather outputs with with_sharding_constraint"
+                        " (see models/llama.py forward_features), or use"
+                        " torchx_tpu.examples.train_llama which already"
+                        " does"
+                    ),
+                )
+
+
 # ---------------------------------------------------------------------------
 # TPX2xx — env / macros / ports / mounts
 # ---------------------------------------------------------------------------

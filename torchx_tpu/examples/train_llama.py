@@ -29,7 +29,13 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torchx_tpu.models import llama
-from torchx_tpu.parallel.mesh import BATCH_SPEC, MeshConfig, make_mesh
+from torchx_tpu.parallel.mesh import (
+    BATCH_SPEC,
+    MeshConfig,
+    enable_shardy_if_supported,
+    make_mesh,
+)
+from torchx_tpu.parallel.prefetch import Prefetcher, device_prefetch
 
 _PROCESS_START = time.monotonic()
 
@@ -139,12 +145,19 @@ def make_train_step(
     cfg: llama.LlamaConfig,
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
+    state_shardings: Optional[Any] = None,
 ):
     """The jitted SPMD training step: grads + AdamW update, donated state.
 
     All mesh configs — including ring attention inside a pipeline stage
     (the pipeline manualizes pp and sp in one shard_map) — compile under
-    the default Shardy partitioner; no GSPMD fallback remains."""
+    the default Shardy partitioner; no GSPMD fallback remains.
+
+    ``state_shardings`` (a TrainState of NamedShardings) pins the output
+    state to the input's shardings. Without it the compiler may pick
+    different shardings for the returned opt state than the donated input
+    had — then feeding step N's state into step N+1 through an AOT
+    executable trips the strict input-sharding check."""
 
     def step(state: TrainState, batch: dict[str, jnp.ndarray]):
         (loss, aux), grads = jax.value_and_grad(llama.loss_and_aux, has_aux=True)(
@@ -160,7 +173,11 @@ def make_train_step(
             aux,  # raw MoE balancing aux (router health; 0 for dense)
         )
 
-    return jax.jit(step, donate_argnums=(0,))
+    out_shardings = None
+    if state_shardings is not None:
+        scalar = NamedSharding(mesh, P())
+        out_shardings = (state_shardings, scalar, scalar)
+    return jax.jit(step, donate_argnums=(0,), out_shardings=out_shardings)
 
 
 def synthetic_batch(
@@ -223,6 +240,20 @@ def _report_first_step(
     )
 
 
+def _step_heartbeat(**attrs: Any) -> None:
+    """A ``step.window`` trace event per log window — the steady-state
+    counterpart of the ``launch.*`` spans (same TPX_TRACE_ID gating)."""
+    import os
+
+    from torchx_tpu import settings
+
+    if not os.environ.get(settings.ENV_TPX_TRACE_ID):
+        return
+    from torchx_tpu.obs import trace as obs_trace
+
+    obs_trace.heartbeat("step.window", **attrs)
+
+
 def train(
     cfg: llama.LlamaConfig,
     mesh_config: MeshConfig,
@@ -236,6 +267,7 @@ def train(
     ckpt_every: int = 0,
     data_path: Optional[str] = None,
     profile_dir: Optional[str] = None,
+    prefetch: int = 2,
 ) -> dict[str, float]:
     global _FIRST_TRAIN_PENDING
     t_call = time.monotonic()
@@ -258,12 +290,42 @@ def train(
     t0 = time.monotonic()
     with _launch_span("launch.backend_init"):
         setup_compilation_cache()  # relaunches compile in seconds, not minutes
+        # the whole sharding stack (partial-auto shard_map, the embedding
+        # gather constraints) targets Shardy; compiling through legacy
+        # GSPMD instead logs a deprecation warning per compile and its
+        # gather heuristics force involuntary full rematerialization
+        enable_shardy_if_supported()
         mesh = make_mesh(mesh_config)  # first device query: backend init
         n_devices = jax.device_count()
         peak = device_peak_flops() * n_devices
     _stage("backend_init", time.monotonic() - t0)
 
     optimizer = make_optimizer(lr=lr, warmup=warmup)
+
+    if cfg.remat_policy == "auto":
+        if cfg.remat:
+            # resolve "auto" -> the cheapest-recompute policy whose
+            # compiled step fits HBM (trial compiles land in the
+            # persistent XLA cache, so the winner's real compile below is
+            # a cache hit)
+            from torchx_tpu.parallel.remat_auto import choose_remat_policy
+
+            t0 = time.monotonic()
+            with _launch_span("launch.remat_select"):
+                policy, trials = choose_remat_policy(cfg, mesh, batch, seq)
+            cfg = dataclasses.replace(cfg, remat_policy=policy)
+            _stage("remat_select", time.monotonic() - t0)
+            if jax.process_index() == 0:
+                verdicts = ", ".join(
+                    f"{t.policy}={'fits' if t.fits else 'no'}" for t in trials
+                )
+                print(f"remat auto -> {policy} ({verdicts})", flush=True)
+        else:
+            # remat disabled: the policy is never consulted, but "auto"
+            # must not leak into traces/results as if it were concrete
+            cfg = dataclasses.replace(cfg, remat_policy="full")
+    # what the step actually does — "none" when remat is off entirely
+    remat_policy_used = cfg.remat_policy if cfg.remat else "none"
 
     ckpt = None
     latest = None
@@ -287,12 +349,14 @@ def train(
     def _data_setup() -> None:
         t_d = time.monotonic()
         try:
-            from torchx_tpu.examples.data import TokenDataset, device_batches
+            from torchx_tpu.examples.data import TokenDataset
 
             with _launch_span("launch.data_setup"):
-                gen = device_batches(
-                    TokenDataset(data_path, seq, batch, start_step=resumed_step),
+                gen = device_prefetch(
+                    ({"tokens": rows} for rows in
+                     TokenDataset(data_path, seq, batch, start_step=resumed_step)),
                     mesh,
+                    depth=prefetch,
                 )
                 # pull batch 1 now so its host->device transfer overlaps
                 # the compile instead of the first step
@@ -345,7 +409,10 @@ def train(
     # variant configs (e.g. the int8 bench leg) lower to distinct programs
     # that each land in (and relaunch from) the persistent XLA cache.
     t0 = time.monotonic()
-    train_step = make_train_step(cfg, mesh, optimizer)
+    state_shardings = jax.tree.map(lambda x: x.sharding, lower_state)
+    train_step = make_train_step(
+        cfg, mesh, optimizer, state_shardings=state_shardings
+    )
     batch_sds = {
         "tokens": jax.ShapeDtypeStruct(
             (batch, seq + 1),
@@ -379,11 +446,14 @@ def train(
         if resumed_step != (latest or 0):
             # restore fell back past a corrupt newest step: rebuild the
             # stream so data and params resume from the same step
-            from torchx_tpu.examples.data import TokenDataset, device_batches
+            from torchx_tpu.examples.data import TokenDataset
 
             data_box["batches"].close()
-            gen = device_batches(
-                TokenDataset(data_path, seq, batch, start_step=resumed_step), mesh
+            gen = device_prefetch(
+                ({"tokens": rows} for rows in
+                 TokenDataset(data_path, seq, batch, start_step=resumed_step)),
+                mesh,
+                depth=prefetch,
             )
             data_box["first"] = next(gen)
             data_box["batches"] = gen
@@ -397,8 +467,13 @@ def train(
             return next(_batches)
 
     else:
+        import itertools
+
+        # constant device batch: passthrough prefetcher (depth 0) keeps one
+        # code path and an honest (≈0) data-wait account
         data = synthetic_batch(cfg, mesh, batch, seq)
-        next_batch = lambda: data  # noqa: E731
+        _batches = Prefetcher(itertools.repeat(data), depth=0)
+        next_batch = lambda: next(_batches)  # noqa: E731
 
     tokens_per_step = batch * seq
     flops_per_token = cfg.flops_per_token()  # cfg.max_seq already == seq
@@ -429,6 +504,7 @@ def train(
 
     if steps <= 1:
         # single-step smoke: the compile-including step is the only timing
+        _batches.close()
         return {
             "loss": float(loss),
             "tokens_per_sec": tokens_per_step / first_step_s,
@@ -436,6 +512,7 @@ def train(
             "mfu": tokens_per_step / first_step_s * flops_per_token / peak,
             "launch_to_first_step_s": first_step_s,
             "launch_breakdown": dict(breakdown),
+            "remat_policy": remat_policy_used,
         }
 
     # a few untimed warmup steps: dispatch pipelining + allocator settling
@@ -478,41 +555,65 @@ def train(
     global_step = resumed_step + 1 + warmup_steps
     pending = None  # deferred log entry: printed one window late
     window_t0, window_steps = t0, 0
-    for i in range(timed_steps):
-        state, loss, aux = step_fn(state, next_batch())
-        global_step += 1
-        window_steps += 1
-        if ckpt is not None and global_step % ckpt_every == 0:
-            ckpt.save(global_step, state)
-        if (i + 1) % log_every == 0 or i + 1 == timed_steps:
-            jax.block_until_ready(loss)  # completion fence: timing only
-            now = time.monotonic()
-            dt = (now - t0) / (i + 1)
-            tps = tokens_per_step / dt
-            window_dt = (now - window_t0) / window_steps
-            # Logging must not stall the device: a synchronous float(loss)
-            # here is a full device->host round trip (~100ms over a TPU
-            # tunnel) that lands INSIDE the next timed window — measured as
-            # a fake 52.8%->48.9% "MFU decay" in round 2. Instead start an
-            # async copy and print the PREVIOUS window's entry, so the
-            # transfer overlaps the next window's compute.
-            for arr in (loss, aux):
-                copy_async = getattr(arr, "copy_to_host_async", None)
-                if copy_async is not None:
-                    copy_async()
-            if pending is not None and jax.process_index() == 0:
-                _emit_log(pending)
-            pending = {
-                "step": global_step,
-                "loss": loss,
-                "aux": aux,
-                "tps": tps,
-                "mfu": tps * flops_per_token / peak,
-                "window_mfu": tokens_per_step / window_dt * flops_per_token / peak,
-            }
-            window_t0, window_steps = time.monotonic(), 0
-    jax.block_until_ready(state.params)
-    total = time.monotonic() - t0
+    # data-wait accounting anchors: the prefetcher's cumulative wait at
+    # loop entry, and at the last log fence (for per-window splits)
+    wait_anchor = window_wait = _batches.data_wait_s
+    try:
+        for i in range(timed_steps):
+            state, loss, aux = step_fn(state, next_batch())
+            global_step += 1
+            window_steps += 1
+            if ckpt is not None and global_step % ckpt_every == 0:
+                ckpt.save(global_step, state)
+            if (i + 1) % log_every == 0 or i + 1 == timed_steps:
+                jax.block_until_ready(loss)  # completion fence: timing only
+                now = time.monotonic()
+                dt = (now - t0) / (i + 1)
+                tps = tokens_per_step / dt
+                window_dt = (now - window_t0) / window_steps
+                window_mfu = tokens_per_step / window_dt * flops_per_token / peak
+                wait_now = _batches.data_wait_s
+                wait_per_step = (wait_now - window_wait) / window_steps
+                window_wait = wait_now
+                obs_metrics.STEP_SECONDS.observe(window_dt, phase="total")
+                obs_metrics.STEP_SECONDS.observe(wait_per_step, phase="data_wait")
+                _step_heartbeat(
+                    step=global_step,
+                    avg_step_s=round(window_dt, 6),
+                    data_wait_s=round(wait_per_step, 6),
+                    mfu=round(window_mfu, 4),
+                    remat_policy=remat_policy_used,
+                )
+                # Logging must not stall the device: a synchronous
+                # float(loss) here is a full device->host round trip
+                # (~100ms over a TPU tunnel) that lands INSIDE the next
+                # timed window — measured as a fake 52.8%->48.9% "MFU
+                # decay" in round 2. Instead start an async copy and print
+                # the PREVIOUS window's entry, so the transfer overlaps the
+                # next window's compute.
+                for arr in (loss, aux):
+                    copy_async = getattr(arr, "copy_to_host_async", None)
+                    if copy_async is not None:
+                        copy_async()
+                if pending is not None and jax.process_index() == 0:
+                    _emit_log(pending)
+                pending = {
+                    "step": global_step,
+                    "loss": loss,
+                    "aux": aux,
+                    "tps": tps,
+                    "mfu": tps * flops_per_token / peak,
+                    "window_mfu": window_mfu,
+                }
+                window_t0, window_steps = time.monotonic(), 0
+        jax.block_until_ready(state.params)
+        total = time.monotonic() - t0
+        data_wait_s = _batches.data_wait_s - wait_anchor
+    finally:
+        # graceful drain: release the prefetch producer even when the loop
+        # exits early (error, interrupt) — never leave a thread blocked on
+        # a full queue
+        _batches.close()
     if pending is not None and jax.process_index() == 0:
         _emit_log(pending)  # after timing: the flush is off the clock
     if profile_dir and jax.process_index() == 0:
@@ -532,6 +633,13 @@ def train(
         "launch_breakdown": dict(breakdown),
         "final_step": int(state.step),
         "resumed_from_step": resumed_step,
+        # steady-state step-time split: how much of each timed step the
+        # host spent blocked on input vs the device computing
+        "step_time_s": total / timed_steps,
+        "data_wait_s": data_wait_s,
+        "data_wait_frac": data_wait_s / total if total > 0 else 0.0,
+        "remat_policy": remat_policy_used,
+        "prefetch_depth": prefetch,
     }
 
 
@@ -558,8 +666,17 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument(
         "--remat-policy",
         default=None,
-        choices=["full", "dots", "dots_attn"],
-        help="rematerialization policy (default: the config's own)",
+        choices=["full", "dots", "dots_attn", "auto"],
+        help="rematerialization policy (default: the config's own);"
+        " 'auto' AOT-compiles candidates and picks the cheapest-recompute"
+        " policy that fits device HBM",
+    )
+    parser.add_argument(
+        "--prefetch",
+        type=int,
+        default=2,
+        help="device input prefetch depth (batches staged ahead of the"
+        " step; 0 = synchronous)",
     )
     parser.add_argument(
         "--int8",
@@ -621,6 +738,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         ckpt_every=args.ckpt_every,
         data_path=args.data,
         profile_dir=args.profile_dir,
+        prefetch=args.prefetch,
     )
     if jax.process_index() == 0:
         print("final:", metrics, flush=True)

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from torchx_tpu.parallel import mesh as mesh_lib
 from torchx_tpu.ops.attention import attention
 from torchx_tpu.ops.norms import rms_norm
 from torchx_tpu.ops.quant import maybe_matmul
@@ -285,8 +286,7 @@ def shard_params(params: Params, cfg: LlamaConfig, mesh: Mesh) -> Params:
 def _constraint(x: jnp.ndarray, mesh: Optional[Mesh], *spec) -> jnp.ndarray:
     if mesh is None:
         return x
-    ctx = jax.sharding.get_abstract_mesh()
-    manual = set(ctx.manual_axes) if not ctx.empty else set()
+    manual = mesh_lib.manual_axes()
     if manual:
         # inside a shard_map manual region (pp stage, possibly with sp
         # manual too for in-stage ring attention): constraints may only
@@ -401,6 +401,15 @@ def _layer(
 def _remat(body, cfg: LlamaConfig):  # noqa: ANN001
     if not cfg.remat:
         return body
+    if cfg.remat_policy == "auto":
+        # "auto" is a launch-time directive, not a policy: the trainer
+        # resolves it to a concrete policy via memory analysis before the
+        # forward ever traces (parallel/remat_auto.choose_remat_policy)
+        raise ValueError(
+            "remat_policy='auto' must be resolved before tracing — "
+            "call torchx_tpu.parallel.remat_auto.choose_remat_policy"
+            " (the trainer does this at launch)"
+        )
     if cfg.remat_policy == "dots":
         return jax.checkpoint(
             body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
@@ -440,16 +449,20 @@ def forward_features(
     # The table lookup follows the ZeRO-3 pattern of every other fsdp
     # weight: all-gather the (dim-sharded) table at use and gather with
     # batch/seq-sharded indices, so the output is BORN in the activation
-    # sharding. Gathering from the still-sharded table instead makes the
-    # partitioner reshard the output from dim-sharded to batch/seq-sharded
-    # — an axis-moving reshard it can only do by involuntary full
-    # rematerialization (replicate + reslice), warned on every compile.
+    # sharding. Replicating the operand alone is not enough: GSPMD's
+    # gather heuristic may still pick operand-passthrough (output
+    # dim-sharded, indices all-gathered) and then reshard to the
+    # batch/seq layout — an axis-moving reshard it can only do by
+    # involuntary full rematerialization (replicate + reslice), warned on
+    # every compile. Constraining the gather OUTPUT pins the
+    # index-passthrough partitioning, so the reshard (and the indices
+    # all-gather feeding it) never exists.
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     seq_spec = "sp" if sp > 1 and tokens.shape[1] % sp == 0 else None
     tokens = _constraint(tokens, mesh, ("dp", "fsdp"), seq_spec)
     table = _constraint(params["embed"], mesh, None, None)
-    x = table[tokens].astype(cfg.dtype)  # [b, s, d]
-    return features_from_embeddings(params, x, cfg, mesh)
+    x = _constraint(table[tokens], mesh, ("dp", "fsdp"), seq_spec, None)
+    return features_from_embeddings(params, x.astype(cfg.dtype), cfg, mesh)
 
 
 def features_from_embeddings(

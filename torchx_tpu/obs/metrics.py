@@ -319,6 +319,17 @@ LAUNCH_TO_FIRST_STEP = REGISTRY.histogram(
     "process start to first completed training step in seconds",
 )
 
+#: steady-state training step time, by phase: "total" = wall time per
+#: step, "data_wait" = the slice of it the host spent blocked on input
+#: (prefetcher queue waits). Fed at each log fence with the window's
+#: per-step averages — the ``step.*`` trace-family counterpart.
+STEP_SECONDS = REGISTRY.histogram(
+    "tpx_step_seconds",
+    "training step seconds by phase (total / data_wait)",
+    ("phase",),
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
+)
+
 #: per-stage breakdown of launch-to-first-step (the ``launch.*`` span
 #: family): import / backend_init / init_state / restore / data_setup /
 #: compile / first_step — makes launch regressions attributable.

@@ -235,10 +235,9 @@ def _shard_wrap(kernel, q, k, v, segment_ids, mesh, batch_axes, head_axis):
     if all(s == 1 for s in sizes.values()):
         # single-device mesh (the single-chip bench): nothing to partition
         return kernel(q, k, v, segment_ids)
-    ctx = jax.sharding.get_abstract_mesh()
-    parent_manual = (
-        set(ctx.manual_axes) if not ctx.empty and ctx.manual_axes else set()
-    )
+    from torchx_tpu.parallel.mesh import manual_axes
+
+    parent_manual = set(manual_axes())
     batch_axes = tuple(
         a for a in batch_axes if sizes.get(a, 1) > 1 and a not in parent_manual
     )
@@ -261,7 +260,9 @@ def _shard_wrap(kernel, q, k, v, segment_ids, mesh, batch_axes, head_axis):
     # Mosaic requires every mesh axis manual: bind all axes a parent
     # shard_map hasn't (size-1 and unused axes just replicate)
     manual = frozenset(sizes) - frozenset(parent_manual)
-    fn = jax.shard_map(
+    from torchx_tpu.parallel.mesh import shard_map as tpx_shard_map
+
+    fn = tpx_shard_map(
         kernel,
         in_specs=(
             qkv_spec,

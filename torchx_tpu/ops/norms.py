@@ -124,8 +124,9 @@ def _fused_ok(x: jnp.ndarray) -> bool:
         on_tpu = jax.devices()[0].platform == "tpu"
     except RuntimeError:
         return False
-    ctx = jax.sharding.get_abstract_mesh()
-    in_manual = not ctx.empty and bool(ctx.manual_axes)
+    from torchx_tpu.parallel.mesh import manual_axes
+
+    in_manual = bool(manual_axes())
     n = 1
     for s in x.shape[:-1]:
         n *= s
@@ -202,8 +203,9 @@ def rms_norm(
 
         fused = os.environ.get(ENV_TPX_FUSED_NORM, "never")
     interpret = fused == "interpret"
-    ctx = jax.sharding.get_abstract_mesh()
-    if not ctx.empty and ctx.manual_axes:
+    from torchx_tpu.parallel.mesh import manual_axes
+
+    if manual_axes():
         # inside a shard_map manual region (a pipeline stage): opening a
         # nested shard_map over the concrete mesh would rebind the
         # parent's axes (rejected by Shardy) — plain backward, every mode
@@ -228,7 +230,9 @@ def rms_norm(
     if x.ndim != 3 or (batch_div > 1 and x.shape[0] % batch_div):
         return _rms_norm_fwd_math(x, weight, eps)  # unshardable: plain path
     x_spec = P(batch_axes or None, seq_axis, None)
-    fn = jax.shard_map(
+    from torchx_tpu.parallel.mesh import shard_map as tpx_shard_map
+
+    fn = tpx_shard_map(
         lambda xs, ws: _rms_norm_fused(xs, ws, eps, interpret),
         mesh=mesh,
         in_specs=(x_spec, P(None)),

@@ -135,16 +135,18 @@ def ring_attention(
     (``pipeline_apply(manual_axes={"sp"})``) must take the sequence axis
     manual alongside its own.
     """
-    ctx = jax.sharding.get_abstract_mesh()
-    if not ctx.empty and ctx.manual_axes:
-        if seq_axis in ctx.manual_axes:
+    from torchx_tpu.parallel.mesh import manual_axes
+
+    parent_manual = manual_axes()
+    if parent_manual:
+        if seq_axis in parent_manual:
             # the ambient manual region already owns the sequence axis:
             # q/k/v are per-shard views here, use the collective kernel
             # directly (no inner shard_map)
             return _ring_attention_shard(q, k, v, axis_name=seq_axis)
         raise RuntimeError(
             "ring_attention called inside a manual region "
-            f"(manual axes {set(ctx.manual_axes)}) that does not include "
+            f"(manual axes {set(parent_manual)}) that does not include "
             f"the sequence axis {seq_axis!r}. Nesting a shard_map that "
             "rebinds parent axes is rejected by the Shardy partitioner — "
             "manualize the sequence axis in the outer shard_map instead "
@@ -161,7 +163,9 @@ def ring_attention(
     spec = P(eff_batch_axes, seq_axis, head_axis, None)
     # standalone: full-manual over the concrete mesh (also keeps eager
     # calls working — partial-auto shard_map requires jit)
-    fn = jax.shard_map(
+    from torchx_tpu.parallel.mesh import shard_map as tpx_shard_map
+
+    fn = tpx_shard_map(
         functools.partial(_ring_attention_shard, axis_name=seq_axis),
         mesh=mesh,
         in_specs=(spec, spec, spec),

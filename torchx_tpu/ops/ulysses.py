@@ -76,7 +76,9 @@ def ulysses_attention(
             f" got q heads {q.shape[2]}, kv heads {k.shape[2]}"
         )
     spec = P(batch_axes, seq_axis, head_axis, None)
-    fn = jax.shard_map(
+    from torchx_tpu.parallel.mesh import shard_map as tpx_shard_map
+
+    fn = tpx_shard_map(
         functools.partial(_ulysses_shard, axis_name=seq_axis),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -196,7 +196,9 @@ def pipeline_apply(
     # in-stage ring attention) go manual; other mesh axes (dp/fsdp/tp)
     # remain automatic so the partitioner keeps sharding the math inside
     # each stage
-    fn = jax.shard_map(
+    from torchx_tpu.parallel.mesh import shard_map as tpx_shard_map
+
+    fn = tpx_shard_map(
         functools.partial(
             _pipeline_shard,
             body,
