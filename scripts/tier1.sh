@@ -118,4 +118,50 @@ leaked = [m for m in ("jax", "torchx_tpu.cli.cmd_run") if m in sys.modules]
 assert not leaked, f"tpx list imported {leaked}"
 EOF
 then echo "CLI_SMOKE=ok"; else echo "CLI_SMOKE=FAILED"; rc=1; fi
+
+# Gang smoke: a local-scheduler preemption drill supervised with elastic
+# reshape — the first attempt is "preempted" (drill exit code), and the
+# resubmitted attempt must land on a shrunken-mesh dryrun ($TPX_MESH),
+# asserted from the durable attempt ledger.
+gang_dir=$(mktemp -d /tmp/tpx_gang_smoke.XXXXXX)
+if timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    TPX_OBS_DIR="$gang_dir/obs" TPX_SUPERVISOR_DIR="$gang_dir/sup" \
+    python - <<'EOF'
+import os
+from torchx_tpu.runner.api import Runner
+from torchx_tpu.schedulers.local_scheduler import LocalScheduler
+from torchx_tpu.specs.api import AppDef, Role
+from torchx_tpu.supervisor import SupervisorPolicy
+from torchx_tpu.supervisor.ledger import AttemptLedger
+
+# exits with the drill code until the supervisor resubmits with a
+# degraded $TPX_MESH; the reshaped attempt then succeeds
+script = 'if [ -n "$TPX_MESH" ]; then exit 0; fi; exit 67'
+app = AppDef(name="gang-drill", roles=[Role(
+    name="w", image="", entrypoint="sh", args=["-c", script],
+    env={"TPX_SIMULATE_PREEMPTION_EXIT": "67"},
+)])
+sched = LocalScheduler(session_name="gang-smoke", cache_size=10)
+runner = Runner("gang-smoke", {"local": lambda session_name, **kw: sched})
+with runner:
+    info = runner.dryrun(
+        app, "local", cfg={"log_dir": os.environ["TPX_OBS_DIR"] + "/logs"}
+    )
+    result = runner.supervise(info, SupervisorPolicy(
+        max_preemptions=2, backoff_seconds=0.01, jitter=0.0,
+        poll_interval=0.05, elastic_reshape=True, mesh="fsdp=-1",
+        devices_per_replica=8,
+    ), session="gang-smoke")
+assert result.succeeded, result.status
+assert result.attempts == 2, result.attempts
+submitted = [
+    e for e in AttemptLedger("gang-smoke").entries()
+    if e.get("transition") == "submitted"
+]
+assert len(submitted) == 2, submitted
+assert submitted[0].get("mesh") is None, submitted[0]
+assert submitted[1]["mesh"] == "pp=1,dp=1,fsdp=4,ep=1,tp=1,sp=1", submitted[1]
+EOF
+then echo "GANG_SMOKE=ok"; else echo "GANG_SMOKE=FAILED"; rc=1; fi
+rm -rf "$gang_dir"
 exit $rc

@@ -689,3 +689,52 @@ class TestRunNoLintFlag:
         monkeypatch.setattr(_sys, "stdin", io.StringIO(spec))
         main(["run", "-s", "local", "--dryrun", "--no-lint", "--stdin"])
         assert "=== APPLICATION ===" in capsys.readouterr().out
+
+
+class TestRecoveryRules:
+    def test_checkpoint_resume_without_ckpt_flag_warns(self):
+        policy = SupervisorPolicy(checkpoint_dir="/ckpt", max_preemptions=2)
+        report = analyze(app_with(), policy=policy)
+        assert "TPX503" in codes(report)
+        d = next(d for d in report.diagnostics if d.code == "TPX503")
+        assert d.severity is Severity.WARNING
+        assert "step 0" in d.message
+        assert "--ckpt-dir /ckpt" in d.hint
+
+    def test_role_passing_a_ckpt_flag_is_coherent(self):
+        policy = SupervisorPolicy(checkpoint_dir="/ckpt", max_preemptions=2)
+        report = analyze(
+            app_with(args=["--ckpt-dir", "/ckpt"]), policy=policy
+        )
+        assert "TPX503" not in codes(report)
+        # = -joined and snake_case spellings count too
+        report = analyze(
+            app_with(args=["--checkpoint-dir=/ckpt"]), policy=policy
+        )
+        assert "TPX503" not in codes(report)
+        report = analyze(app_with(args=["--ckpt_dir", "/c"]), policy=policy)
+        assert "TPX503" not in codes(report)
+
+    def test_silent_without_checkpoint_dir_or_resume_budgets(self):
+        # no checkpoint_dir: nothing to resume from — not this rule's beat
+        report = analyze(app_with(), policy=SupervisorPolicy(max_preemptions=5))
+        assert "TPX503" not in codes(report)
+        # checkpoint_dir but zero resume-relevant budgets: never resubmits
+        quiet = SupervisorPolicy(
+            checkpoint_dir="/ckpt",
+            max_preemptions=0,
+            max_infra_retries=0,
+            max_hang_retries=0,
+        )
+        assert "TPX503" not in codes(analyze(app_with(), policy=quiet))
+        # no policy at all
+        assert "TPX503" not in codes(analyze(app_with()))
+
+    def test_hang_budget_alone_arms_the_rule(self):
+        policy = SupervisorPolicy(
+            checkpoint_dir="/ckpt",
+            max_preemptions=0,
+            max_infra_retries=0,
+            max_hang_retries=2,
+        )
+        assert "TPX503" in codes(analyze(app_with(), policy=policy))

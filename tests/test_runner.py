@@ -182,3 +182,73 @@ class TestRunnerLocalE2E:
             assert status.state == AppState.SUCCEEDED
             lines = list(runner.log_lines(handle, "echo", 0))
             assert "runner-e2e" in lines
+
+
+class FlakySequenceScheduler(Scheduler[dict]):
+    """``describe()`` follows a script mixing exceptions and states — for
+    the consecutive-miss-reset contract of ``Runner.wait``."""
+
+    def __init__(self, session_name: str, script=None, **kwargs):
+        super().__init__("flaky", session_name)
+        self.script = list(script or [])
+
+    def run_opts(self) -> runopts:
+        return runopts()
+
+    def _submit_dryrun(self, app: AppDef, cfg: Mapping[str, CfgVal]):
+        return AppDryRunInfo({"app": app})
+
+    def schedule(self, dryrun_info) -> str:
+        return "job_1"
+
+    def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
+        item = self.script.pop(0) if self.script else AppState.SUCCEEDED
+        if isinstance(item, BaseException):
+            raise item
+        return DescribeAppResponse(app_id=app_id, state=item)
+
+    def _cancel_existing(self, app_id: str) -> None:
+        pass
+
+
+def _flaky_wait(script, budget):
+    sched = FlakySequenceScheduler("w", script=script)
+    r = Runner("w", {"flaky": lambda session_name, **kw: sched})
+    with r:
+        status = r.wait(
+            "flaky://w/job_1",
+            wait_interval=0.01,
+            sleep=lambda s: None,
+            poll_miss_budget=budget,
+        )
+    return status, sched
+
+
+class TestWaitMissReset:
+    def test_success_resets_consecutive_miss_counter(self):
+        """miss -> success -> miss -> success with budget=1: each miss is
+        the FIRST of its streak, so a week-long wait can absorb any number
+        of isolated blips (a cumulative counter would raise on blip 2)."""
+        status, sched = _flaky_wait(
+            [
+                ConnectionError("blip 1"),
+                AppState.RUNNING,
+                ConnectionError("blip 2"),
+                AppState.SUCCEEDED,
+            ],
+            budget=1,
+        )
+        assert status.state == AppState.SUCCEEDED
+        assert not sched.script  # every scripted poll was consumed
+
+    def test_consecutive_misses_still_exhaust_the_budget(self):
+        """Control for the reset: two misses in a row DO exceed budget=1."""
+        with pytest.raises(ConnectionError, match="back-to-back"):
+            _flaky_wait(
+                [
+                    ConnectionError("blip"),
+                    ConnectionError("back-to-back"),
+                    AppState.SUCCEEDED,
+                ],
+                budget=1,
+            )

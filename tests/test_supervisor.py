@@ -370,3 +370,61 @@ class TestStatusShowsFailureClass:
 
     def test_plain_states_unchanged(self):
         assert "SUCCEEDED (" not in AppStatus(state=AppState.SUCCEEDED).format()
+
+
+class TestLedgerCrashSafety:
+    """The client can die at ANY byte of a ledger write; resume must see
+    exactly the transitions that completed — never a torn line, never a
+    half-replaced meta.json."""
+
+    def test_torn_final_line_skipped_and_restore_replays_complete_lines(self):
+        from torchx_tpu.supervisor.ledger import LEDGER_FILE, AttemptLedger
+
+        ledger = AttemptLedger("torn")
+        ledger.append(
+            "submitted", "job_1", attempt=1,
+            handle="scripted://sup/job_1", resume_step=None,
+        )
+        ledger.append(
+            "resubmitting", "job_1", attempt=1,
+            failure_class="FailureClass.PREEMPTION",
+        )
+        ledger.append(
+            "submitted", "job_2", attempt=2,
+            handle="scripted://sup/job_2", resume_step=7,
+            mesh="pp=1,dp=1,fsdp=4,ep=1,tp=1,sp=1",
+        )
+        # the client is SIGKILLed mid-append: a torn, non-JSON final line
+        with open(os.path.join(ledger.path, LEDGER_FILE), "a") as f:
+            f.write('{"transition": "resubmitting", "app_id": "job_2", "fail')
+        assert [e["transition"] for e in ledger.entries()] == [
+            "submitted", "resubmitting", "submitted",
+        ]
+        # a fresh supervisor restores exactly the completed transitions
+        runner, _ = make_runner([])
+        with runner:
+            sup = Supervisor(
+                runner, dryrun(runner), fast_policy(), session="torn-resumer"
+            )
+            sup._restore(ledger)
+        assert sup._resume_handle == "scripted://sup/job_2"
+        assert sup._resume_attempts == 2
+        assert sup._resume_retries[FailureClass.PREEMPTION] == 1
+        assert sup._resume_steps == [None, 7]
+        assert sup._mesh_spec == "pp=1,dp=1,fsdp=4,ep=1,tp=1,sp=1"
+
+    def test_meta_replace_is_atomic_past_a_dead_writer_tmp(self):
+        from torchx_tpu.supervisor.ledger import META_FILE, AttemptLedger
+
+        ledger = AttemptLedger("meta-atomic")
+        ledger.write_meta({"v": 1})
+        # a previous writer died between tmp-write and rename, leaving a
+        # torn tmp; it must never shadow the committed doc, and the next
+        # write_meta must clean it up (same tmp name, atomic replace)
+        tmp = os.path.join(ledger.path, META_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            f.write('{"v": ')
+        assert ledger.read_meta() == {"v": 1}
+        ledger.write_meta({"v": 2})
+        assert ledger.read_meta() == {"v": 2}
+        assert not os.path.exists(tmp)

@@ -67,6 +67,7 @@ Diagnostic codes
 | TPX404 | warning | role sets the supervisor's resume env var (it is injected on every resubmission) | let the supervisor drive resume |
 | TPX501 | warning | supervisor resubmit budgets stack multiplicatively with the backend's native ``max_retries`` restarts | set max_retries=0 under ``tpx supervise`` |
 | TPX502 | error | ``TPX_FAULT_PLAN`` set while submitting to a non-local backend (chaos drill would corrupt real cloud calls) | unset it or drill against local / local_docker |
+| TPX503 | warning | policy budgets checkpoint-resume retries but no role passes a checkpoint-dir flag (every resubmit restarts from step 0) | pass ``--ckpt-dir`` to the app or drop ``checkpoint_dir`` |
 """
 
 from torchx_tpu.analyze.diagnostics import (

@@ -833,3 +833,52 @@ def check_resilience(ctx: RuleContext) -> Iterator[Diagnostic]:
                     " local_docker schedulers"
                 ),
             )
+
+
+#: role-arg spellings that tell the app where to checkpoint; if none
+#: appears anywhere the app never writes the directory the supervisor
+#: watches for resume steps.
+_CKPT_DIR_FLAGS = ("--ckpt-dir", "--checkpoint-dir", "--ckpt_dir")
+
+
+@rule("recovery")
+def check_recovery(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX503: supervision configured for checkpoint-resume but the job
+    never checkpoints.
+
+    ``SupervisorPolicy.checkpoint_dir`` makes every resubmission inject
+    ``TPX_RESUME_STEP`` from the checkpoint manifest — but the manifest
+    only exists if the *application* saves checkpoints there. A policy
+    with resume retries whose roles pass no checkpoint-dir flag restarts
+    from step 0 on every preemption: the retries "work" while silently
+    discarding all progress. Catch the incoherence before submit."""
+    policy = ctx.policy
+    if policy is None or not policy.checkpoint_dir:
+        return
+    resume_budget = (
+        policy.max_preemptions
+        + policy.max_infra_retries
+        + policy.max_hang_retries
+    )
+    if resume_budget <= 0:
+        return
+    for role in ctx.app.roles:
+        args = list(role.args) + [role.entrypoint]
+        if any(flag in str(a) for a in args for flag in _CKPT_DIR_FLAGS):
+            return
+    yield Diagnostic(
+        code="TPX503",
+        severity=Severity.WARNING,
+        field="checkpoint_dir",
+        message=(
+            f"policy watches checkpoint_dir={policy.checkpoint_dir!r} with"
+            f" {resume_budget} resume retries budgeted, but no role passes a"
+            f" checkpoint-dir flag ({'/'.join(_CKPT_DIR_FLAGS)}) — every"
+            " resubmission will restart from step 0"
+        ),
+        hint=(
+            "point the app at the same directory (e.g."
+            f" --ckpt-dir {policy.checkpoint_dir}) so saved steps feed"
+            " TPX_RESUME_STEP, or drop checkpoint_dir from the policy"
+        ),
+    )

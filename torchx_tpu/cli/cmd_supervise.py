@@ -129,6 +129,60 @@ class CmdSupervise(SubCommand):
             " (as poll_degraded warnings) before surfacing (default 3)",
         )
         subparser.add_argument(
+            "--hang-deadline",
+            type=float,
+            default=None,
+            help="seconds without heartbeats/leases before the gang counts"
+            " as hung (kill + classify HANG + resubmit; default 0: off)",
+        )
+        subparser.add_argument(
+            "--gang-check-interval",
+            type=float,
+            default=None,
+            help="seconds between gang-health checks while an attempt runs"
+            " (default 5)",
+        )
+        subparser.add_argument(
+            "--lease-ttl",
+            type=float,
+            default=None,
+            help="liveness-lease TTL in seconds (default: the hang deadline)",
+        )
+        subparser.add_argument(
+            "--straggler-step-lag",
+            type=int,
+            default=None,
+            help="warn when replicas drift more than this many steps apart"
+            " (default 0: off)",
+        )
+        subparser.add_argument(
+            "--max-hang-retries",
+            type=int,
+            default=None,
+            help="resubmits allowed after gang hangs (default 2)",
+        )
+        subparser.add_argument(
+            "--elastic-reshape",
+            action="store_true",
+            default=None,
+            help="after PREEMPTION/HANG, shrink the mesh's data axes to the"
+            " surviving capacity and resubmit with $TPX_MESH (needs --mesh)",
+        )
+        subparser.add_argument(
+            "--mesh",
+            type=str,
+            default=None,
+            help="the job's launch mesh spec (pp/dp/fsdp/ep/tp/sp, e.g."
+            " dp=2,fsdp=-1); the basis --elastic-reshape degrades from",
+        )
+        subparser.add_argument(
+            "--devices-per-replica",
+            type=int,
+            default=None,
+            help="accelerator devices each replica contributes to the mesh"
+            " (default 1)",
+        )
+        subparser.add_argument(
             "--session",
             type=str,
             default=None,
@@ -170,6 +224,14 @@ class CmdSupervise(SubCommand):
             "checkpoint_dir": args.checkpoint_dir,
             "elastic": args.elastic,
             "poll_miss_budget": args.poll_miss_budget,
+            "hang_deadline_seconds": args.hang_deadline,
+            "gang_check_interval": args.gang_check_interval,
+            "lease_ttl_seconds": args.lease_ttl,
+            "straggler_step_lag": args.straggler_step_lag,
+            "max_hang_retries": args.max_hang_retries,
+            "elastic_reshape": args.elastic_reshape,
+            "mesh": args.mesh,
+            "devices_per_replica": args.devices_per_replica,
         }
         for name, value in overrides.items():
             if value is not None:

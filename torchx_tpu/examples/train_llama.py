@@ -191,13 +191,37 @@ def synthetic_batch(
 
 def parse_mesh_arg(spec: str) -> MeshConfig:
     """``dp=2,fsdp=-1,tp=4`` -> MeshConfig."""
-    kwargs = {}
-    for pair in spec.split(","):
-        if not pair.strip():
-            continue
-        k, _, v = pair.partition("=")
-        kwargs[k.strip()] = int(v)
-    return MeshConfig(**kwargs)
+    from torchx_tpu.parallel.mesh_config import parse_mesh_spec
+
+    return parse_mesh_spec(spec)
+
+
+def _replica_id() -> int:
+    """This process's global replica id in the gang — the launcher-injected
+    ``TPX_REPLICA_ID`` when present (the id the gang monitor expects),
+    falling back to the jax process index."""
+    import os
+
+    from torchx_tpu import settings
+
+    raw = os.environ.get(settings.ENV_TPX_REPLICA_ID, "")
+    try:
+        return int(raw)
+    except ValueError:
+        return jax.process_index()
+
+
+def _renew_liveness_lease(step: int) -> None:
+    """Best-effort per-replica liveness lease alongside each heartbeat, so
+    the supervisor's gang monitor can tell 'this replica is alive' apart
+    from 'the whole gang stopped' even if the shared trace stream stalls.
+    Never lets lease I/O take down training."""
+    try:
+        from torchx_tpu.supervisor.gang import renew_lease
+
+        renew_lease(_replica_id(), step=step)
+    except Exception:  # noqa: BLE001 - liveness is advisory
+        pass
 
 
 def _launch_span(name: str, **attrs: Any):
@@ -236,8 +260,10 @@ def _report_first_step(
         "job.first_step",
         launch_to_first_step_s=round(first_step_s, 3),
         resumed_step=resumed_step or None,
+        replica=_replica_id(),
         **{f"stage_{k}_s": round(v, 3) for k, v in breakdown.items()},
     )
+    _renew_liveness_lease(resumed_step)
 
 
 def _step_heartbeat(**attrs: Any) -> None:
@@ -251,7 +277,43 @@ def _step_heartbeat(**attrs: Any) -> None:
         return
     from torchx_tpu.obs import trace as obs_trace
 
-    obs_trace.heartbeat("step.window", **attrs)
+    obs_trace.heartbeat("step.window", replica=_replica_id(), **attrs)
+    _renew_liveness_lease(int(attrs.get("step", -1)))
+
+
+def _install_preempt_handler() -> tuple[Optional[threading.Event], Any]:
+    """Arm a SIGTERM preemption-grace handler (main thread only).
+
+    TPU preemptions deliver SIGTERM with a short notice window before the
+    hard kill; the default handler would drop the process mid-step and
+    waste everything since the last periodic checkpoint. Instead the
+    handler just sets an event the train loop polls at each step — the
+    loop then forces a final save, *waits for it to be durable*, and exits
+    cleanly inside the window. Returns ``(event, restore)`` where
+    ``restore()`` reinstates the previous handler; ``(None, noop)`` when
+    the handler cannot be installed (non-main thread, e.g. under pytest
+    workers or a nested launcher)."""
+    import signal
+
+    if threading.current_thread() is not threading.main_thread():
+        return None, lambda: None
+    evt = threading.Event()
+
+    def _on_sigterm(signum, frame):  # noqa: ANN001
+        evt.set()
+
+    try:
+        prev = signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):  # no signal support here
+        return None, lambda: None
+
+    def _restore() -> None:
+        try:
+            signal.signal(signal.SIGTERM, prev)
+        except (ValueError, OSError):
+            pass
+
+    return evt, _restore
 
 
 def train(
@@ -558,6 +620,10 @@ def train(
     # data-wait accounting anchors: the prefetcher's cumulative wait at
     # loop entry, and at the last log fence (for per-window splits)
     wait_anchor = window_wait = _batches.data_wait_s
+    # preemption grace: SIGTERM sets the event; the loop fences, forces a
+    # final durable save, and exits cleanly inside the notice window
+    preempt_evt, _restore_sigterm = _install_preempt_handler()
+    preempted = False
     try:
         for i in range(timed_steps):
             state, loss, aux = step_fn(state, next_batch())
@@ -565,6 +631,19 @@ def train(
             window_steps += 1
             if ckpt is not None and global_step % ckpt_every == 0:
                 ckpt.save(global_step, state)
+            if preempt_evt is not None and preempt_evt.is_set():
+                preempted = True
+                jax.block_until_ready(state.params)
+                if ckpt is not None:
+                    ckpt.save(global_step, state, force=True)
+                    ckpt.wait()  # durable BEFORE the hard kill lands
+                if jax.process_index() == 0:
+                    print(
+                        f"preemption notice: checkpointed step {global_step},"
+                        " exiting",
+                        flush=True,
+                    )
+                break
             if (i + 1) % log_every == 0 or i + 1 == timed_steps:
                 jax.block_until_ready(loss)  # completion fence: timing only
                 now = time.monotonic()
@@ -610,6 +689,7 @@ def train(
         total = time.monotonic() - t0
         data_wait_s = _batches.data_wait_s - wait_anchor
     finally:
+        _restore_sigterm()
         # graceful drain: release the prefetch producer even when the loop
         # exits early (error, interrupt) — never leave a thread blocked on
         # a full queue
@@ -640,6 +720,9 @@ def train(
         "data_wait_frac": data_wait_s / total if total > 0 else 0.0,
         "remat_policy": remat_policy_used,
         "prefetch_depth": prefetch,
+        # True when a SIGTERM preemption notice cut the run short (the
+        # final checkpoint is durable; the supervisor resubmits from it)
+        "preempted": preempted,
     }
 
 
@@ -727,9 +810,17 @@ def main(argv: Optional[list[str]] = None) -> None:
         for k, v in {"log_every": args.log_every, "lr": args.lr}.items()
         if v is not None
     }
+    import os
+
+    from torchx_tpu import settings
+
+    # an elastic reshape overrides --mesh: the supervisor injects the
+    # degraded shape for resubmitted attempts as $TPX_MESH, so the job
+    # comes up on the surviving capacity without anyone editing flags
+    mesh_spec = os.environ.get(settings.ENV_TPX_MESH) or args.mesh
     metrics = train(
         cfg,
-        parse_mesh_arg(args.mesh),
+        parse_mesh_arg(mesh_spec),
         args.batch,
         args.seq,
         args.steps,

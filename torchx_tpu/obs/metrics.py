@@ -306,6 +306,21 @@ BACKOFF_SECONDS = REGISTRY.counter(
     "total supervisor backoff sleep seconds",
 )
 
+#: unhealthy gang verdicts from the gang monitor (status still RUNNING),
+#: by verdict kind (HANG / PARTIAL_LOSS / STRAGGLER).
+GANG_UNHEALTHY = REGISTRY.counter(
+    "tpx_gang_unhealthy_total",
+    "unhealthy gang-health verdicts by kind",
+    ("kind",),
+)
+
+#: elastic mesh reshapes computed for a resubmission (dp/fsdp shrunk to
+#: fit surviving capacity).
+GANG_RESHAPES = REGISTRY.counter(
+    "tpx_gang_reshapes_total",
+    "elastic mesh reshapes applied on resubmit",
+)
+
 #: client-side launch latency: schedule() call to app_id in hand.
 LAUNCH_SECONDS = REGISTRY.histogram(
     "tpx_launch_seconds",

@@ -1,5 +1,19 @@
-from torchx_tpu.parallel.mesh import (  # noqa: F401
-    MeshConfig,
-    make_mesh,
-    named_sharding,
-)
+"""Parallelism toolkit: mesh model, shardings, pipeline, checkpointing.
+
+Importing the package must stay jax-free — the client-side supervisor
+pulls :class:`MeshConfig` from here to compute elastic reshapes, and the
+lazy CLI forbids jax at dispatch time — so only the pure-arithmetic shape
+model is imported eagerly; the jax-backed helpers resolve on first access.
+"""
+
+from torchx_tpu.parallel.mesh_config import MeshConfig  # noqa: F401
+
+_LAZY = ("make_mesh", "named_sharding")
+
+
+def __getattr__(name):  # noqa: ANN001, ANN202
+    if name in _LAZY:
+        from torchx_tpu.parallel import mesh
+
+        return getattr(mesh, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -17,6 +17,7 @@ Falls back to a single-host pickle format when orbax is unavailable.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -29,6 +30,31 @@ import jax
 from torchx_tpu import settings
 
 logger = logging.getLogger(__name__)
+
+
+def _digest_path(path: str) -> Optional[str]:
+    """sha256 content digest of one finalized step payload: a file hashes
+    its bytes; a directory hashes every file's relpath + bytes in sorted
+    order (so the digest is stable across listdir order and catches both
+    truncated payloads and missing shard files). None when unreadable."""
+    h = hashlib.sha256()
+    try:
+        if os.path.isdir(path):
+            for root, dirs, files in sorted(os.walk(path)):
+                dirs.sort()
+                for name in sorted(files):
+                    fp = os.path.join(root, name)
+                    h.update(os.path.relpath(fp, path).encode())
+                    with open(fp, "rb") as f:
+                        for chunk in iter(lambda: f.read(1 << 20), b""):
+                            h.update(chunk)
+        else:
+            with open(path, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+    except OSError:
+        return None
+    return h.hexdigest()
 
 
 class Checkpointer:
@@ -72,6 +98,10 @@ class Checkpointer:
             logger.warning("orbax not available; using single-host pickle fallback")
             self._ocp = None
             os.makedirs(self.directory, exist_ok=True)
+        # steps whose content digest still needs computing: async saves
+        # are not on disk at save() time, so digests finalize at the next
+        # synchronization point (wait/close/latest_step/restore)
+        self._pending_digests: set[int] = set()
 
     # -- orbax path --------------------------------------------------------
 
@@ -83,20 +113,68 @@ class Checkpointer:
             saved = self._mgr.save(
                 step, args=self._ocp.args.StandardSave(state), force=force
             )
+            if saved:
+                self._pending_digests.add(step)
+                self._write_manifest(step)
             if not self._async:
                 self._mgr.wait_until_finished()
-            if saved:
-                self._write_manifest(step)
+                self._finalize_digests()
             return bool(saved)
         saved = self._pickle_save(step, state, force=force)
         if saved:
+            self._pending_digests.add(step)
             self._write_manifest(step)
+            self._finalize_digests()
         return saved
 
     def wait(self) -> None:
-        """Block until in-flight async saves are durably on disk."""
+        """Block until in-flight async saves are durably on disk, then
+        record their content digests in the manifest."""
         if self._mgr is not None:
             self._mgr.wait_until_finished()
+        self._finalize_digests()
+
+    # -- manifest + digests ------------------------------------------------
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.directory, settings.CHECKPOINT_MANIFEST)
+
+    def _read_manifest(self) -> dict:
+        try:
+            with open(self._manifest_path()) as f:
+                doc = json.load(f)
+            return doc if isinstance(doc, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def _mutate_manifest(self, latest: Any = "keep", **digest_ops: Any) -> None:
+        """Atomically rewrite MANIFEST.json (process 0 only, advisory —
+        never fails a save). ``latest`` replaces ``latest_step`` unless
+        ``"keep"``; ``set_digests``/``drop_steps`` kwargs update the
+        per-step ``steps`` digest table."""
+        if jax.process_index() != 0:
+            return
+        doc = self._read_manifest()
+        if latest != "keep":
+            doc["latest_step"] = latest
+        steps = doc.get("steps")
+        if not isinstance(steps, dict):
+            steps = {}
+        for step, digest in (digest_ops.get("set_digests") or {}).items():
+            steps[str(step)] = {"digest": digest}
+        for step in digest_ops.get("drop_steps") or ():
+            steps.pop(str(step), None)
+        doc["steps"] = steps
+        path = self._manifest_path()
+        tmp = f"{path}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:  # advisory: never fail a save over the sidecar
+            logger.warning("could not write checkpoint manifest %s: %s", path, e)
 
     def _write_manifest(self, step: int) -> None:
         """Record ``step`` as the latest save in the MANIFEST.json sidecar.
@@ -106,17 +184,61 @@ class Checkpointer:
         ``TPX_RESUME_STEP`` on resubmit without importing this module. It is
         advisory — in async mode the step may still be finalizing, so in-job
         restore always trusts the real step listing over the manifest — and
-        written atomically by process 0 only."""
+        written atomically by process 0 only. Per-step content digests
+        (``steps`` table) land later, at the synchronization point where
+        the payload is durably on disk (:meth:`wait`)."""
+        self._mutate_manifest(latest=step)
+
+    def _step_path(self, step: int) -> Optional[str]:
+        """On-disk payload for a step (orbax dir or pickle file), or None."""
+        for path in (
+            os.path.join(self.directory, str(step)),
+            os.path.join(self.directory, f"step_{step}.pkl"),
+        ):
+            if os.path.exists(path):
+                return path
+        return None
+
+    def _finalize_digests(self) -> None:
+        """Digest every finalized pending step into the manifest, and drop
+        digest entries for steps retention has pruned."""
+        pending, self._pending_digests = self._pending_digests, set()
         if jax.process_index() != 0:
             return
-        path = os.path.join(self.directory, settings.CHECKPOINT_MANIFEST)
-        tmp = f"{path}.tmp"
-        try:
-            with open(tmp, "w") as f:
-                json.dump({"latest_step": step}, f)
-            os.replace(tmp, path)
-        except OSError as e:  # advisory: never fail a save over the sidecar
-            logger.warning("could not write checkpoint manifest %s: %s", path, e)
+        known = (
+            set(self._mgr.all_steps())
+            if self._mgr is not None
+            else set(self._pickle_steps())
+        )
+        digests = {}
+        for step in sorted(pending):
+            if step not in known:
+                continue  # pruned (or never finalized) before digesting
+            path = self._step_path(step)
+            digest = _digest_path(path) if path else None
+            if digest:
+                digests[step] = digest
+        stale = [
+            s
+            for s in self._read_manifest().get("steps", {})
+            if s.isdigit() and int(s) not in known
+        ]
+        if digests or stale:
+            self._mutate_manifest(set_digests=digests, drop_steps=stale)
+
+    def verify_step(self, step: int) -> Optional[bool]:
+        """Check a step's on-disk payload against its recorded digest:
+        True = verified, False = MISMATCH (corrupt / tampered / truncated),
+        None = no digest recorded (pre-digest checkpoint) — callers treat
+        None as "unverifiable, proceed"."""
+        rec = self._read_manifest().get("steps", {}).get(str(step))
+        digest = rec.get("digest") if isinstance(rec, dict) else None
+        if not digest:
+            return None
+        path = self._step_path(step)
+        if path is None:
+            return False
+        return _digest_path(path) == digest
 
     @staticmethod
     def resume_step_from_env() -> Optional[int]:
@@ -166,12 +288,9 @@ class Checkpointer:
             )
         return self._pickle_restore(step, abstract_state)
 
-    def _all_steps(self) -> list[int]:
-        """Known finalized steps, newest first (the ONE place the pickle
-        step layout is parsed; latest_step and _prune derive from it)."""
-        if self._mgr is not None:
-            self.wait()
-            return sorted(self._mgr.all_steps(), reverse=True)
+    def _pickle_steps(self) -> list[int]:
+        """Steps present in the pickle layout, newest first (the ONE place
+        the ``step_N.pkl`` naming is parsed)."""
         return sorted(
             (
                 int(m.group(1))
@@ -181,6 +300,13 @@ class Checkpointer:
             reverse=True,
         )
 
+    def _all_steps(self) -> list[int]:
+        """Known finalized steps, newest first."""
+        if self._mgr is not None:
+            self.wait()
+            return sorted(self._mgr.all_steps(), reverse=True)
+        return self._pickle_steps()
+
     def restore_latest(self, abstract_state: Any) -> tuple[Optional[int], Any]:
         """-> (step, state) from the newest RESTORABLE checkpoint, or
         (None, None).
@@ -188,9 +314,17 @@ class Checkpointer:
         A preemption can kill the process mid-write, leaving the newest
         step present-but-corrupt; resume must not die on it, so restore
         walks newest -> oldest, logging and skipping steps that fail to
-        load. Only when every retained step is unreadable does the error
-        propagate (silently reinitializing from scratch with corrupt
-        checkpoints on disk would hide real data loss)."""
+        load. Steps with a recorded content digest are verified BEFORE the
+        (expensive, possibly silently-wrong) load — a mismatch quarantines
+        the step exactly like a load failure. Only when every retained
+        step is unreadable does the error propagate (silently
+        reinitializing from scratch with corrupt checkpoints on disk would
+        hide real data loss).
+
+        ``abstract_state`` carries the *current* mesh's shardings, which
+        need not match the mesh the checkpoint was saved on — restore
+        re-shards onto whatever the caller built, so a run resumed after an
+        elastic reshape (8-device save, 4-device resume) loads cleanly."""
         steps = self._all_steps()
         if not steps:
             return None, None
@@ -206,6 +340,17 @@ class Checkpointer:
             return step, self.restore(step, abstract_state)
         last_err: Optional[Exception] = None
         for step in steps:
+            if self.verify_step(step) is False:
+                logger.warning(
+                    "checkpoint step %d fails digest verification; trying"
+                    " the previous step",
+                    step,
+                )
+                last_err = RuntimeError(
+                    f"step {step} content digest mismatch"
+                )
+                self._quarantine(step)
+                continue
             try:
                 return step, self.restore(step, abstract_state)
             except Exception as e:  # noqa: BLE001 - per-step corruption
@@ -260,6 +405,17 @@ class Checkpointer:
                     enable_async_checkpointing=self._async,
                 ),
             )
+        # repair the manifest: drop the step's digest and point latest_step
+        # at the newest surviving step, so the client-side supervisor never
+        # injects a quarantined step as TPX_RESUME_STEP on the next attempt
+        survivors = (
+            sorted(self._mgr.all_steps(), reverse=True)
+            if self._mgr is not None
+            else self._pickle_steps()
+        )
+        self._mutate_manifest(
+            latest=survivors[0] if survivors else None, drop_steps=[step]
+        )
 
     def close(self) -> None:
         """Flush in-flight saves and release the manager."""
@@ -281,8 +437,20 @@ class Checkpointer:
             return False
         path = os.path.join(self.directory, f"step_{step}.pkl")
         host_state = jax.tree.map(lambda x: jax.device_get(x), state)
-        with open(path, "wb") as f:
-            pickle.dump(host_state, f)
+        # tmp + fsync + atomic rename: a process killed mid-write (the
+        # exact moment a preemption lands) must never leave a truncated
+        # step_N.pkl that restore_latest would pick up — the .tmp name
+        # never matches the step_N.pkl pattern
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(host_state, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
         self._prune()
         return True
 
@@ -300,5 +468,8 @@ class Checkpointer:
 
     def _prune(self) -> None:
         steps = sorted(self._all_steps())
-        for old in steps[: -self._max_to_keep]:
+        pruned = steps[: -self._max_to_keep]
+        for old in pruned:
             os.unlink(os.path.join(self.directory, f"step_{old}.pkl"))
+        if pruned:
+            self._mutate_manifest(drop_steps=pruned)

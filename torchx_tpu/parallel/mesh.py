@@ -19,48 +19,28 @@ ways.
 
 from __future__ import annotations
 
-import dataclasses
-import math
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("pp", "dp", "fsdp", "ep", "tp", "sp")
+# the shape model is pure arithmetic and is shared with the jax-free
+# client side (supervisor elastic reshape); it lives in mesh_config
+from torchx_tpu.parallel.mesh_config import AXES, MeshConfig
 
-
-@dataclasses.dataclass(frozen=True)
-class MeshConfig:
-    """Mesh axis sizes; -1 on at most one axis means "all remaining
-    devices"."""
-
-    pp: int = 1
-    dp: int = 1
-    fsdp: int = -1
-    ep: int = 1
-    tp: int = 1
-    sp: int = 1
-
-    def resolve(self, n_devices: int) -> dict[str, int]:
-        """Concrete axis sizes for ``n_devices`` (the single -1 axis
-        absorbs the remainder); raises when sizes don't multiply out."""
-        sizes = {a: getattr(self, a) for a in AXES}
-        wild = [a for a, s in sizes.items() if s == -1]
-        if len(wild) > 1:
-            raise ValueError(f"at most one -1 axis allowed, got {wild}")
-        fixed = math.prod(s for s in sizes.values() if s != -1)
-        if wild:
-            if n_devices % fixed:
-                raise ValueError(
-                    f"{n_devices} devices not divisible by fixed axes {sizes}"
-                )
-            sizes[wild[0]] = n_devices // fixed
-        elif fixed != n_devices:
-            raise ValueError(
-                f"mesh {sizes} needs {fixed} devices, have {n_devices}"
-            )
-        return sizes
+__all__ = [
+    "AXES",
+    "MeshConfig",
+    "make_mesh",
+    "named_sharding",
+    "shard_map",
+    "enable_shardy_if_supported",
+    "manual_axes",
+    "BATCH_SPEC",
+    "ACT_SPEC",
+    "ACT_TP_SPEC",
+]
 
 
 def make_mesh(

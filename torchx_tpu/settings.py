@@ -140,6 +140,12 @@ ENV_TPX_PARENT_SPAN = "TPX_PARENT_SPAN"
 # resubmission; Checkpointer.resume_step_from_env() is the in-job reader.
 ENV_TPX_RESUME_STEP = "TPX_RESUME_STEP"
 
+# Mesh spec override (--mesh syntax, e.g. "pp=1,dp=1,fsdp=4,ep=1,tp=1,sp=1")
+# the supervisor injects when an elastic reshape degrades the mesh after a
+# preemption/hang; trainers honor it over their --mesh flag so a resubmitted
+# attempt comes up on the surviving capacity.
+ENV_TPX_MESH = "TPX_MESH"
+
 # Preemption drill knob for the LOCAL scheduler only: when a role env sets
 # this to an integer exit code, a replica exiting with that code marks the
 # attempt PREEMPTED (classified FailureClass.PREEMPTION) instead of FAILED,

@@ -568,11 +568,18 @@ class FailureClass(str, Enum):
         error, scheduler fault). Retryable a few times.
     APP: the application itself exited non-zero. The conservative default
         for unclassifiable failures — retrying a buggy app burns money.
+    HANG: the scheduler still reports RUNNING but the gang stopped making
+        progress (heartbeats went stale, liveness leases expired — see
+        :mod:`torchx_tpu.supervisor.gang`). The supervisor kills the
+        attempt itself and synthesizes this class; budgeted separately
+        because a hang is usually a wedged collective or a lost replica,
+        not an app bug.
     """
 
     PREEMPTION = "PREEMPTION"
     INFRA = "INFRA"
     APP = "APP"
+    HANG = "HANG"
 
     def __str__(self) -> str:
         return self.value

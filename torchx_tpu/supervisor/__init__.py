@@ -10,6 +10,13 @@ and — within independent per-class budgets — re-materializes the original
 telling it which checkpoint step to resume from. See
 :class:`~torchx_tpu.supervisor.api.Supervisor` for the state machine and
 :class:`~torchx_tpu.supervisor.policy.SupervisorPolicy` for the knobs.
+
+Gang health (:mod:`torchx_tpu.supervisor.gang`) extends the loop to
+failures the scheduler cannot see: a :class:`GangMonitor` tails the job's
+heartbeats and liveness leases between status polls, and a HANG /
+PARTIAL_LOSS verdict makes the supervisor kill the attempt, classify it
+``FailureClass.HANG``, and — with ``elastic_reshape`` — resubmit onto a
+degraded mesh that fits the surviving capacity.
 """
 
 from torchx_tpu.supervisor.api import (
@@ -18,15 +25,27 @@ from torchx_tpu.supervisor.api import (
     latest_checkpoint_step,
     supervise,
 )
+from torchx_tpu.supervisor.gang import (
+    GangMonitor,
+    GangState,
+    GangVerdict,
+    read_leases,
+    renew_lease,
+)
 from torchx_tpu.supervisor.ledger import AttemptLedger, list_sessions
 from torchx_tpu.supervisor.policy import SupervisorPolicy
 
 __all__ = [
     "AttemptLedger",
+    "GangMonitor",
+    "GangState",
+    "GangVerdict",
     "Supervisor",
     "SupervisorPolicy",
     "SupervisorResult",
     "latest_checkpoint_step",
     "list_sessions",
+    "read_leases",
+    "renew_lease",
     "supervise",
 ]

@@ -49,7 +49,13 @@ from torchx_tpu.specs.api import (
     FailureClass,
     parse_app_handle,
 )
+from torchx_tpu.parallel.mesh_config import (
+    mesh_sizes_spec,
+    parse_mesh_spec,
+    shrink_data_axes,
+)
 from torchx_tpu.schedulers.ids import make_unique
+from torchx_tpu.supervisor.gang import GangMonitor, GangState, GangVerdict
 from torchx_tpu.supervisor.ledger import AttemptLedger
 from torchx_tpu.supervisor.policy import SupervisorPolicy
 from torchx_tpu.util.times import poll_intervals
@@ -157,6 +163,16 @@ class Supervisor:
         self._resume_attempts = 0
         self._resume_retries: dict[FailureClass, int] = {}
         self._resume_steps: list[Optional[int]] = []
+        # gang health: factory is injectable so tests can hand the monitor
+        # a synthetic trace file / clock; verdict of the attempt the gang
+        # monitor killed, consumed by the reshape step
+        self.monitor_factory: Callable[..., GangMonitor] = GangMonitor
+        self._last_verdict: Optional[GangVerdict] = None
+        # elastic reshape: resolved axis sizes of the mesh the CURRENT
+        # attempt runs on (None until the first reshape when no resume
+        # replayed one); the spec string injected as $TPX_MESH
+        self._current_mesh: Optional[dict[str, int]] = None
+        self._mesh_spec: Optional[str] = None
 
     # -- crash-safe resume -------------------------------------------------
 
@@ -215,6 +231,12 @@ class Supervisor:
                 self._resume_steps.append(
                     int(step) if step is not None else None
                 )
+                mesh = entry.get("mesh")
+                if mesh:
+                    # replay the reshaped mesh so a resumed session keeps
+                    # resubmitting onto the degraded shape, not the launch one
+                    self._mesh_spec = str(mesh)
+                    self._current_mesh = self._sizes_from_spec(str(mesh))
             elif transition == "resubmitting":
                 name = str(entry.get("failure_class") or "").rsplit(".", 1)[-1]
                 try:
@@ -266,6 +288,76 @@ class Supervisor:
             return
         self._ledger.write_meta(meta)
 
+    # -- gang / mesh helpers -----------------------------------------------
+
+    def _total_replicas(self) -> int:
+        app = self._dryrun_info._app
+        assert app is not None  # checked in __init__
+        return max(1, sum(r.num_replicas for r in app.roles))
+
+    def _total_devices(self) -> int:
+        return self._total_replicas() * self._policy.devices_per_replica
+
+    def _sizes_from_spec(self, spec: str) -> Optional[dict[str, int]]:
+        """Resolved axis sizes for a spec: a fully-explicit spec resolves
+        against its own product; a wildcard one against the launch device
+        count. None when the spec cannot resolve (caller skips reshaping)."""
+        try:
+            cfg = parse_mesh_spec(spec)
+            sizes = {
+                a: getattr(cfg, a)
+                for a in ("pp", "dp", "fsdp", "ep", "tp", "sp")
+            }
+            if -1 not in sizes.values():
+                return sizes
+            return cfg.resolve(self._total_devices())
+        except ValueError as e:
+            logger.warning("cannot resolve mesh spec %r: %s", spec, e)
+            return None
+
+    def _maybe_reshape(self, fclass: FailureClass) -> None:
+        """After PREEMPTION/HANG, degrade the mesh for the next attempt.
+
+        With a gang verdict (the monitor killed the attempt and counted
+        survivors) the data axes are refit to the surviving capacity;
+        without one (plain scheduler-reported preemption) the shape
+        degrades one binary step. A shape that cannot shrink further —
+        or a target that cannot preserve the model axes — keeps the
+        current shape: resubmitting at the same size is always safe."""
+        policy = self._policy
+        verdict = self._last_verdict
+        self._last_verdict = None
+        if not policy.elastic_reshape or not policy.mesh:
+            return
+        if fclass not in (FailureClass.PREEMPTION, FailureClass.HANG):
+            return
+        cur = self._current_mesh or self._sizes_from_spec(policy.mesh)
+        if cur is None:
+            return
+        target = None
+        if verdict is not None and 0 < verdict.survivors < verdict.expected:
+            target = verdict.survivors * policy.devices_per_replica
+        try:
+            new = shrink_data_axes(cur, target)
+        except ValueError as e:
+            logger.warning(
+                "keeping mesh %s: %s", mesh_sizes_spec(cur), e
+            )
+            self._current_mesh = cur
+            self._mesh_spec = mesh_sizes_spec(cur)
+            return
+        self._current_mesh = new
+        self._mesh_spec = mesh_sizes_spec(new)
+        obs_metrics.GANG_RESHAPES.inc()
+        logger.info(
+            "elastic reshape: %s -> %s%s",
+            mesh_sizes_spec(cur),
+            self._mesh_spec,
+            f" ({verdict.survivors}/{verdict.expected} replicas survive)"
+            if verdict is not None
+            else "",
+        )
+
     # -- attempt mechanics -------------------------------------------------
 
     def _submit(self, attempt: int, resume_step: Optional[int]) -> AppHandle:
@@ -279,6 +371,10 @@ class Supervisor:
         for role in app.roles:
             if resume_step is not None:
                 role.env[self._policy.resume_env] = str(resume_step)
+            if self._mesh_spec:
+                # degraded shape from an elastic reshape: trainers honor
+                # $TPX_MESH over their --mesh flag
+                role.env[settings.ENV_TPX_MESH] = self._mesh_spec
             # re-point the in-job trace context at THIS attempt (the
             # deep-copied env still carries the dryrun-time context)
             obs_trace.inject_env(role.env, force=True)
@@ -292,6 +388,7 @@ class Supervisor:
             attempt=attempt,
             resume_step=resume_step,
             handle=handle,
+            mesh=self._mesh_spec,
         )
         return handle
 
@@ -300,7 +397,9 @@ class Supervisor:
 
         With ``policy.elastic`` the backend's elastic watcher runs first —
         in-attempt shrink-restarts are its job; only the attempt's terminal
-        outcome comes back to the supervisor."""
+        outcome comes back to the supervisor. With a hang deadline set the
+        gang monitor interleaves with status polling
+        (:meth:`_await_terminal_gang`)."""
         if self._policy.elastic:
             try:
                 self._runner.watch_elastic(
@@ -310,11 +409,81 @@ class Supervisor:
                 logger.debug(
                     "backend has no elastic watcher; falling back to polling"
                 )
+        if self._policy.hang_deadline_seconds > 0:
+            return self._await_terminal_gang(handle)
         return self._runner.wait(
             handle, wait_interval=self._policy.poll_interval, rng=self._rng,
             sleep=self._sleep,
             poll_miss_budget=self._policy.poll_miss_budget,
         )
+
+    def _await_terminal_gang(self, handle: AppHandle) -> Optional[AppStatus]:
+        """Status polling interleaved with gang-health checks.
+
+        ``Runner.wait`` runs in ``gang_check_interval`` slices; every
+        timeout slice the monitor re-reads heartbeats/leases. An unhealthy
+        verdict (HANG / PARTIAL_LOSS) means the scheduler still says
+        RUNNING but the gang is dead: the supervisor kills the attempt
+        itself and synthesizes a terminal FAILED status classified
+        :attr:`FailureClass.HANG` so the normal budget/backoff/resume path
+        takes over. STRAGGLER is warn-only (event + metric, once per
+        verdict change)."""
+        policy = self._policy
+        monitor = self.monitor_factory(
+            expected_replicas=self._total_replicas(),
+            hang_deadline_s=policy.hang_deadline_seconds,
+            lease_ttl_s=policy.lease_ttl_seconds,
+            straggler_step_lag=policy.straggler_step_lag,
+        )
+        _, _, app_id = parse_app_handle(handle)
+        last_state: Optional[GangState] = None
+        while True:
+            try:
+                return self._runner.wait(
+                    handle,
+                    wait_interval=min(
+                        policy.poll_interval, policy.gang_check_interval
+                    ),
+                    timeout=policy.gang_check_interval,
+                    rng=self._rng,
+                    sleep=self._sleep,
+                    poll_miss_budget=policy.poll_miss_budget,
+                )
+            except TimeoutError:
+                pass  # the attempt is still running: gang-check it
+            verdict = monitor.check()
+            if verdict.state != last_state and verdict.state not in (
+                GangState.HEALTHY,
+                GangState.WAITING,
+            ):
+                obs_metrics.GANG_UNHEALTHY.inc(kind=str(verdict.state))
+                self._emit(
+                    "gang_" + str(verdict.state).lower(),
+                    app_id,
+                    detail=verdict.detail,
+                    survivors=verdict.survivors,
+                    expected=verdict.expected,
+                    lost=list(verdict.lost),
+                )
+            last_state = verdict.state
+            if not verdict.unhealthy:
+                continue
+            logger.warning(
+                "app %s gang %s: %s; killing the attempt",
+                app_id,
+                verdict.state,
+                verdict.detail,
+            )
+            self._last_verdict = verdict
+            try:
+                self._runner.cancel(handle)
+            except Exception as e:  # best effort: the kill must not mask
+                logger.warning("cancel of hung app %s failed: %s", app_id, e)
+            return AppStatus(
+                state=AppState.FAILED,
+                msg=f"gang {verdict.state}: {verdict.detail}",
+                failure_class=FailureClass.HANG,
+            )
 
     # -- the state machine -------------------------------------------------
 
@@ -434,6 +603,7 @@ class Supervisor:
                 delay = policy.backoff_delay(retries[fclass], rng=self._rng)
                 if policy.checkpoint_dir:
                     resume_step = latest_checkpoint_step(policy.checkpoint_dir)
+                self._maybe_reshape(fclass)
                 self._emit(
                     "resubmitting",
                     app_id,
@@ -444,6 +614,7 @@ class Supervisor:
                     backoff_seconds=round(delay, 3),
                     resume_step=resume_step,
                     state=str(status.state),
+                    mesh=self._mesh_spec,
                 )
                 logger.info(
                     "app %s %s (%s); retry %d/%d in %.1fs%s",

@@ -83,12 +83,16 @@ class AttemptLedger:
     # -- write side (best-effort: never let bookkeeping kill the run) ------
 
     def write_meta(self, meta: dict[str, Any]) -> None:
-        """Persist the session's rebuild recipe (atomic tmp+rename)."""
+        """Persist the session's rebuild recipe (atomic tmp + fsync +
+        rename: a reader either sees the whole old doc or the whole new
+        one, never a torn meta.json — even through a crash)."""
         try:
             os.makedirs(self.path, exist_ok=True)
             tmp = os.path.join(self.path, META_FILE + ".tmp")
             with open(tmp, "w") as f:
                 json.dump(meta, f, indent=2, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self.path, META_FILE))
         except OSError:
             pass
@@ -106,8 +110,14 @@ class AttemptLedger:
         }
         try:
             os.makedirs(self.path, exist_ok=True)
+            # one complete line per write on an append-mode fd (atomic on
+            # POSIX), fsynced so the transition is durable before the
+            # supervisor acts on it — resume must never replay less than
+            # what the dead client already did
             with open(os.path.join(self.path, LEDGER_FILE), "a") as f:
                 f.write(json.dumps(entry) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
         except (OSError, TypeError, ValueError):
             pass
 

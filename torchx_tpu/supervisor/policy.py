@@ -40,6 +40,11 @@ class SupervisorPolicy:
     #: resubmissions allowed after application (exit-code) failures.
     #: Default 0: an app bug fails deterministically; retrying burns quota.
     max_app_retries: int = 0
+    #: resubmissions allowed after gang hangs / partial gang loss detected
+    #: by the gang monitor (scheduler still says RUNNING, heartbeats
+    #: stale). A hang is usually a wedged collective, worth a couple of
+    #: kill+resubmit cycles.
+    max_hang_retries: int = 2
 
     # -- capped exponential backoff between resubmissions ------------------
     #: first delay before a resubmit, seconds.
@@ -65,6 +70,30 @@ class SupervisorPolicy:
     #: the backend has one, instead of plain status polling.
     elastic: bool = False
 
+    # -- gang health (hang detection while status reads RUNNING) -----------
+    #: seconds without any fresh heartbeat/lease before a replica counts as
+    #: stale; 0 disables gang monitoring entirely (plain wait).
+    hang_deadline_seconds: float = 0.0
+    #: how often the gang monitor re-reads heartbeats between polls.
+    gang_check_interval: float = 5.0
+    #: liveness-lease TTL the monitor uses for replicas that renew leases;
+    #: 0 falls back to ``hang_deadline_seconds``.
+    lease_ttl_seconds: float = 0.0
+    #: warn (event + metric, no kill) when the fastest and slowest replica
+    #: drift more than this many steps apart; 0 disables straggler checks.
+    straggler_step_lag: int = 0
+
+    # -- elastic mesh reshape on resubmit ----------------------------------
+    #: after PREEMPTION/HANG, recompute a degraded mesh (shrink dp/fsdp,
+    #: preserve pp/ep/tp/sp) and inject it as ``TPX_MESH`` on resubmit.
+    #: Requires ``mesh``.
+    elastic_reshape: bool = False
+    #: the job's launch mesh spec (``--mesh`` syntax); basis for reshapes.
+    mesh: Optional[str] = None
+    #: accelerator devices each replica contributes to the mesh (surviving
+    #: replicas × this = the device count a degraded shape must fit).
+    devices_per_replica: int = 1
+
     # -- checkpoint resume -------------------------------------------------
     #: client-visible checkpoint directory to read the step manifest from;
     #: None disables resume injection (the app's own restore_latest still
@@ -74,7 +103,12 @@ class SupervisorPolicy:
     resume_env: str = field(default=settings.ENV_TPX_RESUME_STEP)
 
     def __post_init__(self) -> None:
-        for name in ("max_preemptions", "max_infra_retries", "max_app_retries"):
+        for name in (
+            "max_preemptions",
+            "max_infra_retries",
+            "max_app_retries",
+            "max_hang_retries",
+        ):
             if getattr(self, name) < 0:
                 raise ValueError(f"{name} must be >= 0, got {getattr(self, name)}")
         if self.backoff_seconds < 0 or self.backoff_max_seconds < 0:
@@ -91,6 +125,28 @@ class SupervisorPolicy:
             raise ValueError(
                 f"poll_miss_budget must be >= 0, got {self.poll_miss_budget}"
             )
+        if self.hang_deadline_seconds < 0 or self.lease_ttl_seconds < 0:
+            raise ValueError("gang deadlines must be >= 0")
+        if self.gang_check_interval <= 0:
+            raise ValueError(
+                f"gang_check_interval must be > 0, got {self.gang_check_interval}"
+            )
+        if self.straggler_step_lag < 0:
+            raise ValueError(
+                f"straggler_step_lag must be >= 0, got {self.straggler_step_lag}"
+            )
+        if self.devices_per_replica < 1:
+            raise ValueError(
+                f"devices_per_replica must be >= 1, got {self.devices_per_replica}"
+            )
+        if self.elastic_reshape and not self.mesh:
+            raise ValueError("elastic_reshape requires a mesh spec")
+        if self.mesh is not None:
+            # validate early: a bad spec should fail at policy build, not
+            # mid-recovery (parse only — jax-free)
+            from torchx_tpu.parallel.mesh_config import parse_mesh_spec
+
+            parse_mesh_spec(self.mesh)
 
     def budget_for(self, failure_class: FailureClass) -> int:
         """The retry budget governing one failure class."""
@@ -98,6 +154,7 @@ class SupervisorPolicy:
             FailureClass.PREEMPTION: self.max_preemptions,
             FailureClass.INFRA: self.max_infra_retries,
             FailureClass.APP: self.max_app_retries,
+            FailureClass.HANG: self.max_hang_retries,
         }[failure_class]
 
     def backoff_delay(
