@@ -126,6 +126,34 @@ class ScriptedScheduler(Scheduler[dict]):
         self.cancelled.append(app_id)
 
 
+class WarmupScheduler(ScriptedScheduler):
+    """Reports RUNNING for the first ``warmup_polls`` describes of each
+    app before revealing its scripted outcome — models the compile/warmup
+    window between submission and the first heartbeat, during which gang
+    checks already run."""
+
+    def __init__(self, session_name, script=None, warmup_polls=2, **kwargs):
+        super().__init__(session_name, script=script, **kwargs)
+        self.warmup_polls = warmup_polls
+        self._polls: dict[str, int] = {}
+
+    def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
+        resp = super().describe(app_id)
+        if resp is None:
+            return resp
+        n = self._polls.get(app_id, 0)
+        self._polls[app_id] = n + 1
+        if n < self.warmup_polls and app_id not in self.cancelled:
+            return DescribeAppResponse(app_id=app_id, state=AppState.RUNNING)
+        return resp
+
+
+def make_warmup_runner(script, warmup_polls=2):
+    sched = WarmupScheduler("gang", script=script, warmup_polls=warmup_polls)
+    runner = Runner("gang", {"scripted": lambda session_name, **kw: sched})
+    return runner, sched
+
+
 RUNNING = (AppState.RUNNING, None)
 PREEMPT = (AppState.PREEMPTED, FailureClass.PREEMPTION)
 APP_FAIL = (AppState.FAILED, FailureClass.APP)
@@ -231,14 +259,57 @@ class TestGangMonitor:
         assert v.unhealthy
         assert v.live == (0,) and v.lost == (1,) and v.survivors == 1
 
-    def test_never_seen_replica_counts_as_lost(self, tmp_path):
-        """Replica 1 never produced evidence: once evidence exists at all,
-        the deadline is armed and the silent replica is lost."""
+    def test_never_seen_replica_grace_then_lost(self, tmp_path):
+        """Replica 1 never produced evidence. Ordinary startup skew puts
+        replicas' first flushes seconds apart, so right after arming the
+        silent replica gets the hang deadline as grace (WAITING, not a
+        gang-killing PARTIAL_LOSS); once the deadline passes since arming
+        it counts as lost."""
         tf = tmp_path / "trace.jsonl"
         heartbeat(tf, 0, NOW - 1.0)
-        v = monitor(tf).check()
+        clock = {"now": NOW}
+        m = monitor(tf, clock=lambda: clock["now"])  # deadline 5.0
+        v = m.check()
+        assert v.state == GangState.WAITING
+        assert not v.unhealthy
+        assert v.live == (0,)
+        assert "waiting for first evidence" in v.detail
+        # replica 0 stays fresh; replica 1 still silent past the deadline
+        clock["now"] = NOW + 6.0
+        heartbeat(tf, 0, NOW + 5.5)
+        v = m.check()
         assert v.state == GangState.PARTIAL_LOSS
-        assert v.lost == (1,)
+        assert v.unhealthy
+        assert v.lost == (1,) and v.live == (0,)
+
+    def test_stale_evidence_before_floor_is_ignored(self, tmp_path):
+        """A resubmitted attempt's monitor gets an evidence floor: the
+        dead predecessor's heartbeats and lease files must read as "no
+        evidence yet" (WAITING), not as an instant all-stale HANG while
+        the new gang is still compiling."""
+        tf = tmp_path / "trace.jsonl"
+        heartbeat(tf, 0, NOW - 60.0, step=12)
+        heartbeat(tf, 1, NOW - 45.0, step=12)
+        # a leftover lease file from the dead attempt (backdate the stamp:
+        # renew_lease always writes the real wall clock)
+        path = renew_lease(0, step=12, session="gang-floor-test")
+        rec = json.loads(open(path).read())
+        rec["epoch_usec"] = int((NOW - 40.0) * 1e6)
+        with open(path, "w") as f:
+            f.write(json.dumps(rec))
+        m = monitor(
+            tf,
+            session="gang-floor-test",
+            ignore_evidence_before=NOW - 30.0,
+        )
+        v = m.check()
+        assert v.state == GangState.WAITING
+        assert not v.unhealthy
+        assert m.replicas == {}
+        # evidence stamped after the floor arms the monitor normally
+        heartbeat(tf, 0, NOW - 1.0, step=13)
+        heartbeat(tf, 1, NOW - 1.0, step=13)
+        assert m.check().state == GangState.HEALTHY
 
     def test_straggler_is_warn_only(self, tmp_path):
         tf = tmp_path / "trace.jsonl"
@@ -416,6 +487,49 @@ class TestHangDetection:
             poll_interval=0.05,
             max_hang_retries=1,
         )
+
+        def factory(**kw):
+            # every attempt hangs for real: the resubmitted gang emits one
+            # heartbeat (past the attempt's evidence floor) and then
+            # wedges, going stale within the deadline
+            if kw.get("ignore_evidence_before"):
+                heartbeat(tf, 0, time.time())
+            return GangMonitor(trace_file=str(tf), **kw)
+
+        with runner:
+            sup = Supervisor(
+                runner, dryrun(runner), policy,
+                sleep=time.sleep, rng=random.Random(0),
+            )
+            sup.monitor_factory = factory
+            result = sup.run()
+        assert not result.succeeded
+        assert result.budget_exhausted == FailureClass.HANG
+        assert result.retries[FailureClass.HANG] == 1
+        assert sched.cancelled == ["job_1", "job_2"]
+        assert result.status.failure_class == FailureClass.HANG
+        assert "gang HANG" in result.status.msg
+
+    def test_resubmitted_attempt_survives_stale_evidence(self, tmp_path):
+        """Regression: the resubmitted attempt's fresh monitor tails the
+        SAME session trace and lease files. Attempt 1's stale heartbeats
+        must not arm attempt 2's monitor (instant HANG during warmup,
+        before attempt 2's first heartbeat) — the evidence floor set at
+        resubmission filters them, so attempt 2 warms up under WAITING
+        and runs to completion."""
+        tf = tmp_path / "trace.jsonl"
+        heartbeat(tf, 0, time.time() - 60.0, step=12)
+
+        # attempt 1 hangs; attempt 2 spends several polls "warming up"
+        # (RUNNING, no heartbeat yet) before succeeding — exactly the
+        # window where stale evidence used to kill it
+        runner, sched = make_warmup_runner([RUNNING, OK], warmup_polls=3)
+        policy = gang_policy(
+            hang_deadline_seconds=1.0,
+            gang_check_interval=0.05,
+            poll_interval=0.05,
+            max_hang_retries=1,
+        )
         with runner:
             sup = Supervisor(
                 runner, dryrun(runner), policy,
@@ -425,12 +539,11 @@ class TestHangDetection:
                 trace_file=str(tf), **kw
             )
             result = sup.run()
-        assert not result.succeeded
-        assert result.budget_exhausted == FailureClass.HANG
-        assert result.retries[FailureClass.HANG] == 1
-        assert sched.cancelled == ["job_1", "job_2"]
-        assert result.status.failure_class == FailureClass.HANG
-        assert "gang HANG" in result.status.msg
+        assert result.succeeded
+        assert result.attempts == 2
+        assert result.budget_exhausted is None
+        # only the genuinely hung first attempt was killed
+        assert sched.cancelled == ["job_1"]
 
     def test_healthy_gang_runs_to_completion(self, tmp_path):
         """Fresh heartbeats must never trip the monitor: an attempt that
@@ -549,6 +662,58 @@ class TestElasticReshape:
         # the verdict is consumed: a later plain preemption halves instead
         assert sup._last_verdict is None
 
+    def test_full_healthy_gang_grows_back_to_launch_mesh(self):
+        """Blind preemption halving must not ratchet a healthy job toward
+        dp=1: once the monitor saw the full gang live on the degraded
+        shape, a verdict-less preemption restores the launch mesh (a
+        reschedule is a fresh allocation at the requested size)."""
+        runner, _ = make_runner([])
+        with runner:
+            sup = Supervisor(
+                runner,
+                dryrun(runner),
+                gang_policy(
+                    elastic_reshape=True, mesh="fsdp=-1", devices_per_replica=8
+                ),
+                sleep=lambda s: None,
+            )
+            degraded = parse_mesh_spec("dp=1,fsdp=4,pp=1,ep=1,tp=1,sp=1")
+            sup._current_mesh = {a: getattr(degraded, a) for a in AXES}
+            sup._mesh_spec = mesh_sizes_spec(sup._current_mesh)
+            sup._gang_was_full = True
+            sup._maybe_reshape(FailureClass.PREEMPTION)
+        assert sup._mesh_spec == "pp=1,dp=1,fsdp=8,ep=1,tp=1,sp=1"
+
+    def test_preemption_after_healthy_gang_keeps_launch_mesh(self, tmp_path):
+        """At the launch shape with a demonstrably whole gang, a plain
+        preemption resubmits unchanged — no TPX_MESH override, no blind
+        shrink (end to end: healthy verdict observed by the monitor during
+        attempt 1, preemption, resubmit)."""
+        tf = tmp_path / "trace.jsonl"
+        heartbeat(tf, 0, time.time(), step=5)
+        runner, sched = make_warmup_runner([PREEMPT, OK], warmup_polls=2)
+        policy = gang_policy(
+            max_preemptions=2,
+            elastic_reshape=True,
+            mesh="fsdp=-1",
+            devices_per_replica=8,
+            hang_deadline_seconds=30.0,
+            gang_check_interval=0.05,
+            poll_interval=0.05,
+        )
+        with runner:
+            sup = Supervisor(
+                runner, dryrun(runner), policy,
+                sleep=time.sleep, rng=random.Random(0),
+            )
+            sup.monitor_factory = lambda **kw: GangMonitor(
+                trace_file=str(tf), **kw
+            )
+            result = sup.run()
+        assert result.succeeded and result.attempts == 2
+        assert sched.cancelled == []
+        assert ENV_TPX_MESH not in sched.submitted_envs[1]
+
     def test_elastic_reshape_requires_mesh(self):
         with pytest.raises(ValueError, match="mesh"):
             SupervisorPolicy(elastic_reshape=True)
@@ -578,6 +743,29 @@ class TestElasticReshape:
         assert sup2._mesh_spec == "pp=1,dp=1,fsdp=4,ep=1,tp=1,sp=1"
         assert sup2._current_mesh["fsdp"] == 4
         assert sup2._policy.elastic_reshape  # policy round-tripped via meta
+        # the reattached monitor must not ingest earlier attempts' stale
+        # evidence: the floor is the reattached attempt's submission time
+        assert sup2._evidence_floor > 0
+
+
+# ---------------------------------------------------------------------------
+# in-job liveness lease helper (train_llama)
+# ---------------------------------------------------------------------------
+
+
+class TestLivenessLeaseHelper:
+    def test_first_step_lease_written_when_step_unknown(self):
+        """Regression: ``_renew_liveness_lease(None)`` used to die on
+        ``int(None)`` inside its broad except — silently skipping the
+        first-step lease exactly when lease evidence matters most (before
+        ``step.window`` heartbeats start). None must degrade to the
+        'step unknown' sentinel, not to no lease at all."""
+        from torchx_tpu.examples.train_llama import _renew_liveness_lease
+
+        _renew_liveness_lease(None)
+        leases = read_leases()
+        assert leases, "lease must be written even with no step known"
+        assert all(rec["step"] == -1 for rec in leases.values())
 
 
 # ---------------------------------------------------------------------------

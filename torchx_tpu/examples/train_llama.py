@@ -211,7 +211,7 @@ def _replica_id() -> int:
         return jax.process_index()
 
 
-def _renew_liveness_lease(step: int) -> None:
+def _renew_liveness_lease(step: Optional[int]) -> None:
     """Best-effort per-replica liveness lease alongside each heartbeat, so
     the supervisor's gang monitor can tell 'this replica is alive' apart
     from 'the whole gang stopped' even if the shared trace stream stalls.
@@ -219,7 +219,9 @@ def _renew_liveness_lease(step: int) -> None:
     try:
         from torchx_tpu.supervisor.gang import renew_lease
 
-        renew_lease(_replica_id(), step=step)
+        # step is advisory; None (no step known yet) must not turn into a
+        # swallowed TypeError that silently skips the first-step lease
+        renew_lease(_replica_id(), step=-1 if step is None else int(step))
     except Exception:  # noqa: BLE001 - liveness is advisory
         pass
 
@@ -495,11 +497,29 @@ def train(
         restore_thread.join()
         if "error" in restore_box:
             raise restore_box["error"]
-        state = restore_box["state"]
-        resumed_step = int(restore_box["step"])
-        _stage("restore", restore_box["seconds"])
-        if jax.process_index() == 0:
-            print(f"resumed from checkpoint step {resumed_step}", flush=True)
+        if restore_box.get("state") is None:
+            # every candidate step failed verification and was quarantined
+            # (restore_latest returned (None, None)): train from scratch
+            # instead of dying on the missing state
+            t0 = time.monotonic()
+            with _launch_span("launch.init_state"):
+                state = init_state(cfg, mesh, optimizer)
+            _stage("init_state", time.monotonic() - t0)
+            resumed_step = 0
+            if jax.process_index() == 0:
+                print(
+                    "no restorable checkpoint step (all quarantined);"
+                    " starting fresh",
+                    flush=True,
+                )
+        else:
+            state = restore_box["state"]
+            resumed_step = int(restore_box["step"])
+            _stage("restore", restore_box["seconds"])
+            if jax.process_index() == 0:
+                print(
+                    f"resumed from checkpoint step {resumed_step}", flush=True
+                )
 
     if data_thread is not None:
         data_thread.join()

@@ -168,6 +168,14 @@ class Supervisor:
         # monitor killed, consumed by the reshape step
         self.monitor_factory: Callable[..., GangMonitor] = GangMonitor
         self._last_verdict: Optional[GangVerdict] = None
+        # heartbeats/leases stamped before this epoch belong to a previous
+        # attempt: each resubmission advances the floor so the fresh
+        # monitor never reads the dead attempt's evidence as an instant
+        # HANG while the new gang is still compiling
+        self._evidence_floor = 0.0
+        # did the monitor see every expected replica live on the current
+        # shape? consumed by _maybe_reshape's preemption grow-back
+        self._gang_was_full = False
         # elastic reshape: resolved axis sizes of the mesh the CURRENT
         # attempt runs on (None until the first reshape when no resume
         # replayed one); the spec string injected as $TPX_MESH
@@ -227,6 +235,11 @@ class Supervisor:
                 handle = entry.get("handle")
                 if handle:
                     self._resume_handle = str(handle)
+                    ts = entry.get("time_usec")
+                    if ts:
+                        # evidence older than the reattached attempt's own
+                        # submission came from an earlier attempt
+                        self._evidence_floor = float(ts) / 1e6
                 step = entry.get("resume_step")
                 self._resume_steps.append(
                     int(step) if step is not None else None
@@ -323,7 +336,14 @@ class Supervisor:
         without one (plain scheduler-reported preemption) the shape
         degrades one binary step. A shape that cannot shrink further —
         or a target that cannot preserve the model axes — keeps the
-        current shape: resubmitting at the same size is always safe."""
+        current shape: resubmitting at the same size is always safe.
+
+        The blind binary step must not ratchet a healthy job toward dp=1
+        across a long run's occasional preemptions: once the monitor has
+        seen the full gang live during the attempt (``_gang_was_full``), a
+        verdict-less preemption restores the launch mesh instead — a
+        reschedule is a fresh allocation at the requested size, and the
+        capacity demonstrably came back."""
         policy = self._policy
         verdict = self._last_verdict
         self._last_verdict = None
@@ -337,6 +357,19 @@ class Supervisor:
         target = None
         if verdict is not None and 0 < verdict.survivors < verdict.expected:
             target = verdict.survivors * policy.devices_per_replica
+        elif fclass is FailureClass.PREEMPTION and self._gang_was_full:
+            launch = self._sizes_from_spec(policy.mesh)
+            if launch is not None and launch != cur:
+                self._current_mesh = launch
+                self._mesh_spec = mesh_sizes_spec(launch)
+                obs_metrics.GANG_RESHAPES.inc()
+                logger.info(
+                    "elastic grow-back: %s -> %s (full gang was healthy"
+                    " before the preemption)",
+                    mesh_sizes_spec(cur),
+                    self._mesh_spec,
+                )
+            return  # gang was demonstrably whole: never blind-shrink it
         try:
             new = shrink_data_axes(cur, target)
         except ValueError as e:
@@ -365,6 +398,12 @@ class Supervisor:
         the original AppDef (resume env must not accumulate across
         attempts) and goes through the scheduler's own materialize so each
         attempt gets a fresh unique app id."""
+        if attempt > 1:
+            # floor BEFORE scheduling so nothing the new attempt emits can
+            # land below it; the first attempt keeps floor 0 (pre-submit
+            # evidence can only be ours)
+            self._evidence_floor = time.time()
+        self._gang_was_full = False
         info = self._dryrun_info
         app = copy.deepcopy(info._app)
         assert app is not None  # checked in __init__
@@ -434,6 +473,7 @@ class Supervisor:
             hang_deadline_s=policy.hang_deadline_seconds,
             lease_ttl_s=policy.lease_ttl_seconds,
             straggler_step_lag=policy.straggler_step_lag,
+            ignore_evidence_before=self._evidence_floor,
         )
         _, _, app_id = parse_app_handle(handle)
         last_state: Optional[GangState] = None
@@ -466,6 +506,10 @@ class Supervisor:
                     lost=list(verdict.lost),
                 )
             last_state = verdict.state
+            if verdict.state in (GangState.HEALTHY, GangState.STRAGGLER):
+                # every expected replica live on the current shape —
+                # capacity evidence for the preemption grow-back
+                self._gang_was_full = True
             if not verdict.unhealthy:
                 continue
             logger.warning(

@@ -56,7 +56,9 @@ class GangState(str, enum.Enum):
 
     WAITING: no heartbeat/lease seen yet — the job is still compiling or
         warming up; the hang deadline is not armed (a slow first compile
-        is indistinguishable from a hang without a first signal).
+        is indistinguishable from a hang without a first signal). Also
+        covers the arming window right after the first evidence, while
+        not-yet-seen replicas still have startup-skew grace.
     HEALTHY: every expected replica produced fresh evidence.
     STRAGGLER: all replicas live, but the step spread exceeds the
         configured lag — warn-only, the gang still makes progress.
@@ -183,6 +185,13 @@ class GangMonitor:
     ``clock`` is injectable for tests; it must be comparable with the
     epoch-microsecond stamps heartbeats and leases carry (i.e. epoch
     seconds).
+
+    ``ignore_evidence_before`` (epoch seconds) drops heartbeats and
+    leases stamped earlier — the supervisor sets it to the submission
+    time of a *resubmitted* attempt so the fresh monitor never judges
+    the new gang on its dead predecessor's stale evidence (which would
+    read as an instant HANG during warmup/compile, before the new
+    attempt's first heartbeat).
     """
 
     def __init__(
@@ -195,6 +204,7 @@ class GangMonitor:
         session: Optional[str] = None,
         trace_file: Optional[str] = None,
         clock: Callable[[], float] = time.time,
+        ignore_evidence_before: float = 0.0,
     ) -> None:
         if expected_replicas < 1:
             raise ValueError(
@@ -211,9 +221,14 @@ class GangMonitor:
         self.session = session
         self.trace_file = trace_file or sinks.trace_path(session)
         self.clock = clock
+        self.ignore_evidence_before = ignore_evidence_before
         self.replicas: dict[int, ReplicaHealth] = {}
         self._offset = 0
-        self._started = clock()
+        # set by the first check() that sees any evidence: never-seen
+        # replicas get a hang_deadline_s grace from this instant before
+        # they count as lost (startup skew — replicas flush their first
+        # heartbeat seconds apart)
+        self._armed_at: Optional[float] = None
 
     # -- evidence ingestion -------------------------------------------------
 
@@ -224,8 +239,10 @@ class GangMonitor:
         if not now_lease and self.session is None:
             now_lease = read_leases()
         for rid, rec in now_lease.items():
-            h = self.replicas.setdefault(rid, ReplicaHealth(replica=rid))
             ts = float(rec.get("epoch_usec", 0)) / 1e6
+            if ts < self.ignore_evidence_before:
+                continue  # leftover lease file from a previous attempt
+            h = self.replicas.setdefault(rid, ReplicaHealth(replica=rid))
             h.last_lease = max(h.last_lease, ts)
             step = int(rec.get("step", -1))
             h.last_step = max(h.last_step, step)
@@ -253,13 +270,15 @@ class GangMonitor:
                 continue
             if rec.get("kind") != "span" or rec.get("name") not in HEARTBEAT_SPANS:
                 continue
+            ts = float(rec.get("start_epoch_usec", 0)) / 1e6
+            if ts < self.ignore_evidence_before:
+                continue  # a previous attempt's heartbeat
             attrs = rec.get("attrs") or {}
             try:
                 rid = int(attrs.get("replica", 0))
             except (TypeError, ValueError):
                 rid = 0
             h = self.replicas.setdefault(rid, ReplicaHealth(replica=rid))
-            ts = float(rec.get("start_epoch_usec", 0)) / 1e6
             h.last_heartbeat = max(h.last_heartbeat, ts)
             try:
                 step = int(attrs.get("step", -1))
@@ -279,20 +298,32 @@ class GangMonitor:
                 detail="no heartbeats or leases observed yet",
                 expected=self.expected_replicas,
             )
-        live, lost = [], []
+        if self._armed_at is None:
+            self._armed_at = now
+        live, lost, pending = [], [], []
         for rid in range(self.expected_replicas):
             h = self.replicas.get(rid)
-            fresh = h is not None and (
+            if h is None:
+                # never produced evidence: ordinary startup skew can put
+                # replicas' first flushes seconds apart, so a silent
+                # replica only counts as lost once the hang deadline has
+                # passed since the gang armed (first evidence observed)
+                if now - self._armed_at <= self.hang_deadline_s:
+                    pending.append(rid)
+                else:
+                    lost.append(rid)
+                continue
+            fresh = (
                 now - h.last_heartbeat <= self.hang_deadline_s
                 if h.last_heartbeat
                 else False
             )
-            if not fresh and h is not None and h.last_lease:
+            if not fresh and h.last_lease:
                 fresh = now - h.last_lease <= self.lease_ttl_s
             (live if fresh else lost).append(rid)
         # replicas reporting beyond the expected range still count as live
         # evidence of *something*, but the verdict is over the expected set
-        if not live:
+        if not live and not pending:
             return GangVerdict(
                 state=GangState.HANG,
                 detail=(
@@ -313,6 +344,17 @@ class GangMonitor:
                 expected=self.expected_replicas,
                 live=tuple(live),
                 lost=tuple(lost),
+            )
+        if pending:
+            return GangVerdict(
+                state=GangState.WAITING,
+                detail=(
+                    f"{len(live)}/{self.expected_replicas} replicas"
+                    f" reporting; waiting for first evidence from"
+                    f" {pending} (armed {now - self._armed_at:.1f}s ago)"
+                ),
+                expected=self.expected_replicas,
+                live=tuple(live),
             )
         if self.straggler_step_lag:
             steps = [
