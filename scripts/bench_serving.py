@@ -1,26 +1,46 @@
 #!/usr/bin/env python
 """Serving benchmark: KV-cache decode throughput for the generation stack.
 
-Measures steady-state decode tokens/sec on the available chip for
-llama3_1b, bf16 weights vs int8 weight-only (``quantize_params``), across
-batch sizes — the serving half the reference delegates to TorchServe and
-this repo implements natively (models/generate.py + apps/generate_server).
+Two halves:
 
-Decode at batch b is HBM-bandwidth-bound (every step streams all weights
-+ the KV cache), so the expected ceiling is roughly
+* raw decode (``bench_decode``): steady-state decode tokens/sec for
+  llama3_1b, bf16 vs int8 weight-only, across batch sizes — decode at
+  batch b is HBM-bandwidth-bound, so the ceiling is roughly
+  ``b * HBM_BW / (param_bytes + kv_bytes_per_row * b)``.
 
-    tokens/sec ≈ b * HBM_BW / (param_bytes + kv_bytes_per_row * b)
+* serving under load (``bench_poisson``, the ``--poisson`` mode): an
+  OPEN-LOOP Poisson load generator drives the real serving stack —
+  arrivals follow seeded exponential gaps and are submitted on schedule
+  regardless of completions, so queueing delay is measured instead of
+  hidden (a closed loop self-throttles when the server falls behind).
+  The same deterministic workload trace (same seed → identical prompts,
+  arrival times and sampling seeds) is replayed against both engines at
+  equal ``--max-batch``:
 
-and int8 weights should approach 2x at small batch. Prints one JSON line
-per measured point.
+    - ``continuous``: the :mod:`torchx_tpu.serve.engine` slot-array
+      engine (admit-on-free-slot, paged KV, per-step batching)
+    - ``coalesce``: the legacy batch-to-completion coalescing batcher
 
-Usage:  python scripts/bench_serving.py [--steps 128] [--batches 1,4,8]
+  reporting decode tokens/sec, TTFT/TPOT p50/p99, and goodput (the
+  fraction of requests whose TTFT meets ``--slo-ttft-ms``). For the
+  coalescing baseline all tokens arrive when the batch completes, so its
+  TTFT *is* its total latency — that asymmetry is the point of the
+  comparison. ``--out`` writes the paired result (plus the paged-KV
+  :meth:`~torchx_tpu.serve.kv_pool.PoolPlan.occupancy_report`) as one
+  JSON document (see BENCH_SERVE_r01.json).
+
+Usage:
+    python scripts/bench_serving.py [--steps 128] [--batches 1,4,8]
+    python scripts/bench_serving.py --poisson [--rate 8] [--requests 48] \
+        [--max-batch 4] [--out BENCH_SERVE_r01.json]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import random
+import threading
 import time
 
 import jax
@@ -249,6 +269,199 @@ def bench_stream_ttft(cfg_name: str, int8: bool, steps: int, samples: int = 8):
         server.service.close()
 
 
+def make_workload(
+    *,
+    num_requests: int,
+    rate_rps: float,
+    max_new: int,
+    prompt_lens: tuple[int, ...],
+    seed: int,
+    vocab: int,
+) -> list[dict]:
+    """Deterministic open-loop trace: one dict per request with its
+    arrival offset (cumulative seeded exponential gaps — a Poisson
+    process), prompt, and per-request sampling seed. Replaying the same
+    seed against both engines makes the comparison apples-to-apples."""
+    rng = random.Random(seed)
+    trace = []
+    t = 0.0
+    for i in range(num_requests):
+        t += rng.expovariate(rate_rps)
+        plen = rng.choice(prompt_lens)
+        trace.append(
+            {
+                "arrival_s": t,
+                "prompt": [rng.randrange(1, vocab) for _ in range(plen)],
+                "max_new": max_new,
+                "seed": seed * 1000 + i,
+            }
+        )
+    return trace
+
+
+def bench_poisson(
+    cfg_name: str,
+    engine: str,
+    trace: list[dict],
+    *,
+    max_batch: int,
+    slo_ttft_ms: float,
+    block_size: int = 16,
+    batch_window_ms: float = 25.0,
+    temperature: float = 0.7,
+) -> dict:
+    """Replay one workload trace open-loop against one engine; -> the
+    serving scorecard (tokens/sec, TTFT/TPOT p50/p99, goodput)."""
+    from torchx_tpu.apps.generate_server import GenerateService
+
+    svc = GenerateService(
+        cfg_name,
+        engine=engine,
+        max_batch=max_batch,
+        batch_window_ms=batch_window_ms,
+        block_size=block_size,
+    )
+    try:
+        # warm every (prompt_len, max_new) compile outside the timed window
+        for plen in sorted({len(r["prompt"]) for r in trace}):
+            svc.generate(
+                [list(range(1, plen + 1))],
+                trace[0]["max_new"],
+                temperature=temperature,
+            )
+
+        results: list[dict] = [None] * len(trace)  # type: ignore[list-item]
+
+        def one(i: int, req: dict) -> None:
+            try:
+                seqs, timing = svc.generate_timed(
+                    [req["prompt"]],
+                    req["max_new"],
+                    temperature=temperature,
+                    seed=req["seed"],
+                )
+                results[i] = {
+                    "ok": True,
+                    "generated": len(seqs[0]) - len(req["prompt"]),
+                    "done_at": time.monotonic(),
+                    **timing,
+                }
+            except Exception as e:  # noqa: BLE001 - scored as a miss
+                results[i] = {"ok": False, "error": str(e)[:200]}
+
+        # open loop: submit on the trace's schedule, never waiting for
+        # completions — if the server falls behind, the backlog (and the
+        # latency it causes) is part of the measurement
+        t0 = time.monotonic()
+        workers = []
+        for i, req in enumerate(trace):
+            delay = t0 + req["arrival_s"] - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=one, args=(i, req), daemon=True)
+            th.start()
+            workers.append(th)
+        for th in workers:
+            th.join(timeout=600)
+        done = [r for r in results if r and r.get("ok")]
+        failed = len(trace) - len(done)
+        if not done:
+            raise RuntimeError(f"all {len(trace)} requests failed")
+        duration = max(r["done_at"] for r in done) - t0
+        total_tokens = sum(r["generated"] for r in done)
+        ttfts = sorted(r["ttft_ms"] for r in done)
+        # per-output-token latency after the first token; the coalescing
+        # baseline delivers everything at once, so its per-token cost is
+        # total/steps — there is no cheaper number to give it
+        tpots = sorted(
+            (r["total_ms"] - r["ttft_ms"]) / max(1, r["generated"] - 1)
+            if r["total_ms"] > r["ttft_ms"]
+            else r["total_ms"] / max(1, r["generated"])
+            for r in done
+        )
+        good = sum(1 for r in done if r["ttft_ms"] <= slo_ttft_ms)
+        return {
+            "engine": engine,
+            "requests": len(trace),
+            "failed": failed,
+            "duration_s": round(duration, 2),
+            "decode_tokens_per_sec": round(total_tokens / duration, 1),
+            "ttft_ms": {
+                "p50": round(_percentile(ttfts, 0.50), 1),
+                "p99": round(_percentile(ttfts, 0.99), 1),
+            },
+            "tpot_ms": {
+                "p50": round(_percentile(tpots, 0.50), 2),
+                "p99": round(_percentile(tpots, 0.99), 2),
+            },
+            "goodput": round(good / len(trace), 3),
+            "slo_ttft_ms": slo_ttft_ms,
+        }
+    finally:
+        svc.close()
+
+
+def run_poisson_comparison(args) -> dict:
+    """Both engines, one trace, one JSON document (the --poisson mode)."""
+    from torchx_tpu.models import llama
+    from torchx_tpu.serve.kv_pool import plan_pool
+
+    platform = jax.devices()[0].platform
+    cfg_name = args.config if platform == "tpu" else "tiny"
+    cfg = llama.CONFIGS[cfg_name]()
+    max_new = min(args.steps, cfg.max_seq // 4)
+    prompt_lens = tuple(
+        p for p in (4, 8, 12) if p + max_new <= cfg.max_seq
+    ) or (4,)
+    trace = make_workload(
+        num_requests=args.requests,
+        rate_rps=args.rate,
+        max_new=max_new,
+        prompt_lens=prompt_lens,
+        seed=args.seed,
+        vocab=cfg.vocab_size,
+    )
+    doc = {
+        "bench": "serving under open-loop Poisson load",
+        "config": cfg_name,
+        "platform": platform,
+        "workload": {
+            "requests": args.requests,
+            "rate_rps": args.rate,
+            "max_new_tokens": max_new,
+            "prompt_lens": list(prompt_lens),
+            "seed": args.seed,
+            "max_batch": args.max_batch,
+        },
+        "engines": {},
+    }
+    for engine in ("coalesce", "continuous"):
+        doc["engines"][engine] = bench_poisson(
+            cfg_name,
+            engine,
+            trace,
+            max_batch=args.max_batch,
+            slo_ttft_ms=args.slo_ttft_ms,
+        )
+        print(json.dumps(doc["engines"][engine]))
+    cont, coal = doc["engines"]["continuous"], doc["engines"]["coalesce"]
+    doc["comparison"] = {
+        "decode_tokens_per_sec_speedup": round(
+            cont["decode_tokens_per_sec"] / coal["decode_tokens_per_sec"], 2
+        ),
+        "p99_ttft_reduction": round(
+            1 - cont["ttft_ms"]["p99"] / coal["ttft_ms"]["p99"], 3
+        ),
+        "goodput_delta": round(cont["goodput"] - coal["goodput"], 3),
+    }
+    # the paged-KV half of the story: concurrency at the same HBM budget
+    # (tiny on CPU has no meaningful HBM; report the target-config plan)
+    plan_cfg = llama.CONFIGS[args.config]()
+    doc["kv_pool_occupancy"] = plan_pool(plan_cfg).occupancy_report()
+    print(json.dumps(doc["comparison"]))
+    return doc
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=128)
@@ -260,7 +473,28 @@ def main() -> None:
         help="also measure aggregate throughput through the HTTP server",
     )
     ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument(
+        "--poisson",
+        action="store_true",
+        help="open-loop Poisson comparison: continuous engine vs"
+        " coalescing baseline at equal --max-batch",
+    )
+    ap.add_argument("--rate", type=float, default=8.0, help="arrivals/sec")
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--slo-ttft-ms", type=float, default=1000.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="write the comparison JSON here")
     args = ap.parse_args()
+
+    if args.poisson:
+        doc = run_poisson_comparison(args)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(doc, f, indent=2)
+                f.write("\n")
+            print(f"wrote {args.out}")
+        return
 
     from torchx_tpu.models import llama
     from torchx_tpu.ops import quant

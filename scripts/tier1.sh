@@ -164,4 +164,43 @@ assert submitted[1]["mesh"] == "pp=1,dp=1,fsdp=4,ep=1,tp=1,sp=1", submitted[1]
 EOF
 then echo "GANG_SMOKE=ok"; else echo "GANG_SMOKE=FAILED"; rc=1; fi
 rm -rf "$gang_dir"
+
+# Serving smoke: boot generate_server on the tiny config (CPU, continuous
+# engine, ephemeral port), answer /healthz, decode one /v1/generate, and
+# assert the continuous-batching occupancy gauge is exported on /metricz.
+serve_dir=$(mktemp -d /tmp/tpx_serve_smoke.XXXXXX)
+if timeout -k 10 300 env JAX_PLATFORMS=cpu TPX_OBS_DIR="$serve_dir" \
+    python - <<'EOF'
+import json, threading, urllib.request
+from torchx_tpu.apps.generate_server import serve
+
+ready = threading.Event()
+server = serve("tiny", port=0, ready_event=ready, engine="continuous", max_batch=4)
+assert ready.wait(120), "server never became ready"
+threading.Thread(target=server.serve_forever, daemon=True).start()
+base = f"http://127.0.0.1:{server.server_address[1]}"
+try:
+    with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+        health = json.loads(r.read())
+    assert health["status"] == "ok" and health["engine"] == "continuous", health
+    assert "occupancy" in health and "queue_depth" in health, health
+    req = urllib.request.Request(
+        f"{base}/v1/generate",
+        data=json.dumps({"tokens": [[1, 2, 3]], "max_new_tokens": 4}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        body = json.loads(r.read())
+    (seq,) = body["tokens"]
+    assert seq[:3] == [1, 2, 3] and len(seq) == 7, body
+    with urllib.request.urlopen(f"{base}/metricz", timeout=10) as r:
+        metrics = r.read().decode()
+    assert "tpx_serve_slot_occupancy" in metrics, metrics[:2000]
+    assert "tpx_serve_tokens_total" in metrics, metrics[:2000]
+finally:
+    server.shutdown()
+    server.service.close()
+EOF
+then echo "SERVE_SMOKE=ok"; else echo "SERVE_SMOKE=FAILED"; rc=1; fi
+rm -rf "$serve_dir"
 exit $rc

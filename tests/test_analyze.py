@@ -314,6 +314,30 @@ class TestPortAndMountRules:
         report = analyze(app_with(port_map={"http": 70000}))
         assert codes(report) == ["TPX211"]
 
+    def test_serve_port_without_port_map_warns(self):
+        report = analyze(app_with(args=["--config", "tiny", "--port", "8000"]))
+        assert codes(report) == ["TPX212"]
+        (d,) = report.diagnostics
+        assert d.severity == Severity.WARNING
+        assert "port_map" in d.hint
+
+    def test_serve_port_equals_form_detected(self):
+        report = analyze(app_with(args=["--port=9000"]))
+        assert codes(report) == ["TPX212"]
+
+    def test_mapped_serve_port_is_silent(self):
+        report = analyze(
+            app_with(args=["--port", "8000"], port_map={"http": 8000})
+        )
+        assert report.diagnostics == []
+
+    def test_ephemeral_and_non_numeric_ports_are_silent(self):
+        # port 0 means "OS picks"; a macro value is not statically checkable
+        report = analyze(
+            app_with(args=["--port", "0", "--port", "${replica_id}"])
+        )
+        assert report.diagnostics == []
+
     def test_duplicate_mount_dst(self):
         report = analyze(
             app_with(

@@ -203,3 +203,53 @@ class TestCmdConfigure:
         assert code == 0
         text = (tmp_path / ".tpxconfig").read_text()
         assert "[local]" in text and "log_dir" in text
+
+
+class TestCmdResize:
+    """Satellite coverage for `tpx resize`: dispatch + clean error path."""
+
+    def _patched_runner(self, monkeypatch, resize_fn):
+        from contextlib import contextmanager
+
+        class FakeRunner:
+            def resize(self, handle, role, n):
+                resize_fn(handle, role, n)
+
+        @contextmanager
+        def fake_get_runner(*a, **kw):
+            yield FakeRunner()
+
+        monkeypatch.setattr(
+            "torchx_tpu.cli.cmd_simple.get_runner", fake_get_runner
+        )
+
+    def test_dispatch_and_output(self, monkeypatch):
+        seen = []
+        self._patched_runner(
+            monkeypatch, lambda h, r, n: seen.append((h, r, n))
+        )
+        code, out, _ = run_cli(["resize", "local://s/app_1", "server", "3"])
+        assert code == 0
+        assert seen == [("local://s/app_1", "server", 3)]
+        assert "resized local://s/app_1/server to 3" in out
+
+    def test_terminal_app_errors_cleanly(self, monkeypatch):
+        def boom(h, r, n):
+            raise ValueError(f"cannot resize terminal app {h}")
+
+        self._patched_runner(monkeypatch, boom)
+        code, _, err = run_cli(["resize", "local://s/app_1", "server", "2"])
+        assert code == 1
+        assert "terminal" in err and "Traceback" not in err
+
+    def test_backend_without_resize_errors_cleanly(self, monkeypatch):
+        def unsupported(h, r, n):
+            raise NotImplementedError("stub does not support resizing")
+
+        self._patched_runner(monkeypatch, unsupported)
+        code, _, err = run_cli(["resize", "stub://s/app_1", "server", "2"])
+        assert code == 1 and "resizing" in err
+
+    def test_non_integer_replicas_rejected(self):
+        code, _, err = run_cli(["resize", "local://s/app_1", "server", "lots"])
+        assert code == 2  # argparse usage error

@@ -100,7 +100,7 @@ class TestBatcher:
     one device batch (JetStream-style); incompatible ones don't."""
 
     def test_concurrent_requests_coalesce(self):
-        svc = GenerateService("tiny", batch_window_ms=200, max_batch=8)
+        svc = GenerateService("tiny", batch_window_ms=200, max_batch=8, engine="coalesce")
         try:
             # warm the jit cache so the batch window isn't spent compiling
             svc.generate([[9, 9]], max_new_tokens=2)
@@ -125,7 +125,7 @@ class TestBatcher:
             svc.close()
 
     def test_incompatible_keys_do_not_merge(self):
-        svc = GenerateService("tiny", batch_window_ms=50, max_batch=8)
+        svc = GenerateService("tiny", batch_window_ms=50, max_batch=8, engine="coalesce")
         try:
             svc.generate([[1, 2]], max_new_tokens=2)
             svc.generate([[1, 2, 3]], max_new_tokens=2)  # different length
@@ -139,7 +139,7 @@ class TestBatcher:
             svc.close()
 
     def test_decode_errors_surface_to_caller(self):
-        svc = GenerateService("tiny", batch_window_ms=1)
+        svc = GenerateService("tiny", batch_window_ms=1, engine="coalesce")
         try:
             with pytest.raises(ValueError, match="max_seq"):
                 svc.generate([[1] * 100], max_new_tokens=100)
@@ -147,12 +147,12 @@ class TestBatcher:
             svc.close()
 
     def test_close_is_idempotent(self):
-        svc = GenerateService("tiny", batch_window_ms=1)
+        svc = GenerateService("tiny", batch_window_ms=1, engine="coalesce")
         svc.close()
         svc.close()
 
     def test_generate_after_close_raises(self):
-        svc = GenerateService("tiny", batch_window_ms=1)
+        svc = GenerateService("tiny", batch_window_ms=1, engine="coalesce")
         svc.close()
         with pytest.raises(RuntimeError, match="closed"):
             svc.generate([[1, 2]], max_new_tokens=2)
@@ -161,7 +161,7 @@ class TestBatcher:
         # a mixed-length request enqueues two incompatible pendings; a
         # close() racing the first dispatch must still let BOTH complete
         # (the shutdown sentinel re-arms after the incompatible re-queue)
-        svc = GenerateService("tiny", batch_window_ms=100, max_batch=8)
+        svc = GenerateService("tiny", batch_window_ms=100, max_batch=8, engine="coalesce")
         svc.generate([[5, 6]], max_new_tokens=2)  # warm compile
         svc.generate([[5, 6, 7]], max_new_tokens=2)
         results = []
@@ -281,3 +281,144 @@ class TestStreamValidation:
                 got.append(json.loads(raw))
         assert got[-1] == {"done": True}
         assert sum(len(x.get("tokens", [])) for x in got) == 3
+
+
+class TestContinuousEngineServer:
+    """The default engine is the continuous-batching ServeEngine; its
+    stats surface on /healthz and its drain path returns 503s."""
+
+    def test_healthz_reports_engine_stats(self, server_url):
+        with urllib.request.urlopen(f"{server_url}/healthz", timeout=30) as r:
+            body = json.loads(r.read())
+        assert body["engine"] == "continuous"
+        for k in ("occupancy", "queue_depth", "active_slots", "kv_blocks_free"):
+            assert k in body, body
+
+    def test_metricz_exports_serving_gauges(self, server_url):
+        post(  # make sure at least one request has decoded
+            f"{server_url}/v1/generate",
+            {"tokens": [[2, 3]], "max_new_tokens": 2},
+        )
+        with urllib.request.urlopen(f"{server_url}/metricz", timeout=30) as r:
+            text = r.read().decode()
+        assert "tpx_serve_slot_occupancy" in text
+        assert "tpx_serve_tokens_total" in text
+
+    def test_engine_matches_coalesce_greedy(self):
+        cont = GenerateService("tiny", engine="continuous", max_batch=4)
+        coal = GenerateService(
+            "tiny", engine="coalesce", batch_window_ms=1, max_batch=4
+        )
+        try:
+            for prompt in ([1, 2, 3], [9, 8, 7, 6]):
+                a = cont.generate([prompt], max_new_tokens=4)[0]
+                b = coal.generate([prompt], max_new_tokens=4)[0]
+                assert a == b, (prompt, a, b)
+        finally:
+            cont.close()
+            coal.close()
+
+    def test_seeded_sampling_is_deterministic_over_http(self, server_url):
+        payload = {
+            "tokens": [[4, 5]],
+            "max_new_tokens": 4,
+            "temperature": 0.8,
+            "seed": 7,
+        }
+        _, a = post(f"{server_url}/v1/generate", payload)
+        _, b = post(f"{server_url}/v1/generate", payload)
+        assert a["tokens"] == b["tokens"]
+
+    def test_eos_id_field_respected(self, server_url):
+        _, full = post(
+            f"{server_url}/v1/generate",
+            {"tokens": [[1, 2, 3]], "max_new_tokens": 6},
+        )
+        (seq,) = full["tokens"]
+        eos = seq[4]  # second generated token
+        _, cut = post(
+            f"{server_url}/v1/generate",
+            {"tokens": [[1, 2, 3]], "max_new_tokens": 6, "eos_id": eos},
+        )
+        (short,) = cut["tokens"]
+        assert short == seq[:5] and short[-1] == eos
+
+    def test_bad_engine_name_rejected(self):
+        with pytest.raises(ValueError, match="engine"):
+            GenerateService("tiny", engine="warp-drive")
+
+
+class TestDrain:
+    """SIGTERM drain: stop admission, finish in-flight work, fail
+    /healthz so the pool's router stops sending traffic, exit cleanly."""
+
+    def test_drain_finishes_inflight_then_rejects(self):
+        svc = GenerateService("tiny", engine="continuous", max_batch=4)
+        try:
+            results = []
+            t = threading.Thread(
+                target=lambda: results.append(
+                    svc.generate([[1, 2]], max_new_tokens=4)
+                )
+            )
+            t.start()
+            time.sleep(0.05)  # let it enter the engine
+            assert svc.drain(grace_s=120) is True
+            t.join(timeout=60)
+            assert results and len(results[0][0]) == 6
+            from torchx_tpu.apps.generate_server import ServiceDraining
+
+            with pytest.raises(ServiceDraining):
+                svc.generate([[1]], max_new_tokens=1)
+        finally:
+            svc.close()
+
+    def test_draining_healthz_is_503(self):
+        import urllib.error
+
+        srv = serve("tiny", port=0, engine="continuous")
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            srv.service.drain(grace_s=60)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"{base}/healthz", timeout=30)
+            assert e.value.code == 503
+            assert json.loads(e.value.read())["status"] == "draining"
+            code, body = post(
+                f"{base}/v1/generate", {"tokens": [[1]], "max_new_tokens": 1}
+            )
+            assert code == 503 and "drain" in body["error"]
+        finally:
+            srv.shutdown()
+            srv.service.close()
+
+    def test_make_drain_sequence(self):
+        # the SIGTERM callable: drain the service, then stop serve_forever
+        from torchx_tpu.apps.generate_server import make_drain
+
+        calls = []
+
+        class FakeServer:
+            def shutdown(self):
+                calls.append("shutdown")
+
+        class FakeService:
+            def drain(self, grace_s):
+                calls.append(("drain", grace_s))
+                return True
+
+        make_drain(FakeServer(), FakeService(), grace_s=7.5)()
+        assert calls == [("drain", 7.5), "shutdown"]
+
+    def test_coalesce_drain_also_stops_admission(self):
+        from torchx_tpu.apps.generate_server import ServiceDraining
+
+        svc = GenerateService("tiny", engine="coalesce", batch_window_ms=1)
+        try:
+            svc.generate([[1, 2]], max_new_tokens=2)  # warm
+            assert svc.drain(grace_s=60) is True
+            with pytest.raises(ServiceDraining):
+                svc.generate([[1]], max_new_tokens=1)
+        finally:
+            svc.close()

@@ -252,3 +252,63 @@ class TestWaitMissReset:
                 ],
                 budget=1,
             )
+
+
+class ResizableStubScheduler(StubScheduler):
+    """Stub with resize support, counting backend describes so tests can
+    observe the describe cache being (in)validated."""
+
+    def __init__(self, session_name: str, **kwargs):
+        super().__init__(session_name, **kwargs)
+        self.describe_calls = 0
+        self.resized: list[tuple[str, str, int]] = []
+
+    def describe(self, app_id: str):
+        self.describe_calls += 1
+        return super().describe(app_id)
+
+    def resize(self, app_id: str, role_name: str, num_replicas: int) -> None:
+        if self.apps.get(app_id) in (AppState.CANCELLED, AppState.SUCCEEDED):
+            raise ValueError(f"cannot resize terminal app {app_id}")
+        self.resized.append((app_id, role_name, num_replicas))
+
+
+class TestRunnerResize:
+    """Satellite coverage for Runner.resize: ledger + cache + error path."""
+
+    @pytest.fixture
+    def rig(self, monkeypatch):
+        monkeypatch.setenv("TPX_DESCRIBE_CACHE_TTL", "300")
+        stub = ResizableStubScheduler("test")
+        r = Runner("test", {"stub": lambda session_name, **kw: stub})
+        yield r, stub
+        r.close()
+
+    def test_resize_invalidates_describe_cache(self, rig):
+        runner, stub = rig
+        handle = runner.run(simple_app(), "stub")
+        assert runner.status(handle).state == AppState.RUNNING
+        calls = stub.describe_calls
+        runner.status(handle)  # within TTL: served from cache
+        assert stub.describe_calls == calls
+        runner.resize(handle, "r", 3)
+        assert stub.resized[-1][1:] == ("r", 3)
+        runner.status(handle)  # resize invalidated: backend re-fetched
+        assert stub.describe_calls == calls + 1
+
+    def test_resize_terminal_app_raises(self, rig):
+        runner, stub = rig
+        handle = runner.run(simple_app(), "stub")
+        runner.cancel(handle)
+        with pytest.raises(ValueError, match="terminal"):
+            runner.resize(handle, "r", 2)
+
+    def test_resize_is_ledgered(self, rig):
+        runner, stub = rig
+        from torchx_tpu.obs import sinks, timeline
+
+        handle = runner.run(simple_app(), "stub")
+        runner.resize(handle, "r", 2)
+        records = timeline.load_records(sinks.trace_path())
+        apis = [rec.get("api") for rec in records if rec.get("api")]
+        assert "resize" in apis

@@ -1,0 +1,311 @@
+"""Serving runtime tests: paged KV pool planning/allocation, paged-vs-dense
+decode equivalence, and the continuous-batching engine end to end (slots,
+EOS eviction, preemption under block pressure, drain)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchx_tpu.models import generate as gen, llama
+from torchx_tpu.ops.paged_attention import TRASH_BLOCK
+from torchx_tpu.serve.engine import EngineStopped, ServeEngine, ServeRequest
+from torchx_tpu.serve.kv_pool import (
+    BlockAllocator,
+    SlotTables,
+    plan_pool,
+)
+
+GIB = 1024**3
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.CONFIGS["tiny"]()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def dense_generate(params, cfg, prompt, max_new, temperature=0.0, seed=0):
+    out = gen.generate(
+        params,
+        np.array([prompt], np.int32),
+        cfg,
+        max_new_tokens=max_new,
+        temperature=temperature,
+        rng=jax.random.PRNGKey(seed) if temperature > 0 else None,
+    )
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+# -- plan_pool -------------------------------------------------------------
+
+
+class TestPoolPlan:
+    def test_budget_math_and_oversubscription(self, tiny):
+        cfg, _ = tiny
+        plan = plan_pool(cfg, hbm_bytes=1 * GIB, headroom=0.9, block_size=16)
+        # budget = hbm*headroom - params, filled with whole blocks
+        itemsize = np.dtype(cfg.dtype).itemsize
+        block_bytes = (
+            cfg.n_layers * 2 * 16 * cfg.n_kv_heads * cfg.head_dim * itemsize
+        )
+        budget = int(1 * GIB * 0.9) - cfg.param_count() * itemsize
+        assert plan.num_blocks == budget // block_bytes
+        assert plan.kv_budget_bytes == budget
+        # paged admits more concurrent sequences than the dense cache
+        # at the same budget (the point of the whole exercise)
+        assert plan.max_slots > plan.dense_slots
+        report = plan.occupancy_report()
+        assert report["paged_slots"] == plan.max_slots
+        assert report["dense_slots"] == plan.dense_slots
+
+    def test_params_exceeding_budget_raise(self, tiny):
+        cfg, _ = tiny
+        with pytest.raises(ValueError, match="exceed HBM budget"):
+            plan_pool(cfg, hbm_bytes=1024, headroom=0.9)
+
+    def test_pool_too_small_for_one_sequence_raises(self, tiny):
+        cfg, _ = tiny
+        itemsize = np.dtype(cfg.dtype).itemsize
+        param_bytes = cfg.param_count() * itemsize
+        with pytest.raises(ValueError, match="fits only"):
+            plan_pool(
+                cfg, hbm_bytes=int(param_bytes / 0.9) + 4096, headroom=0.9
+            )
+
+    def test_explicit_max_slots_wins(self, tiny):
+        cfg, _ = tiny
+        plan = plan_pool(cfg, hbm_bytes=1 * GIB, max_slots=3)
+        assert plan.max_slots == 3
+
+
+# -- allocator + tables ----------------------------------------------------
+
+
+class TestBlockAllocator:
+    def test_all_or_nothing(self):
+        a = BlockAllocator(4)  # 3 usable (block 0 is trash)
+        assert a.free_blocks == 3
+        got = a.alloc(2)
+        assert got is not None and TRASH_BLOCK not in got
+        assert a.alloc(2) is None  # only 1 left: refuse, take nothing
+        assert a.free_blocks == 1
+        a.free(got)
+        assert a.free_blocks == 3
+
+    def test_trash_block_protected(self):
+        a = BlockAllocator(4)
+        with pytest.raises(ValueError, match="trash"):
+            a.free([TRASH_BLOCK])
+        with pytest.raises(ValueError, match="blocks"):
+            BlockAllocator(1)
+
+
+class TestSlotTables:
+    def test_assign_release_roundtrip(self):
+        t = SlotTables(max_slots=2, blocks_per_slot=3)
+        assert (t.tables == TRASH_BLOCK).all()
+        t.assign(0, [5, 7])
+        assert list(t.tables[0]) == [5, 7, TRASH_BLOCK]
+        assert t.token_capacity(0, block_size=16) == 32
+        t.assign(0, [9])
+        assert t.blocks_of(0) == [5, 7, 9]
+        with pytest.raises(ValueError, match="exceeds"):
+            t.assign(0, [11])
+        freed = t.release(0)
+        assert freed == [5, 7, 9]
+        assert (t.tables[0] == TRASH_BLOCK).all() and t.lengths[0] == 0
+
+
+# -- paged vs dense equivalence --------------------------------------------
+
+
+class TestPagedEquivalence:
+    def test_prefill_plus_decode_matches_dense_greedy(self, tiny):
+        cfg, params = tiny
+        bs = 8
+        pools = gen.init_kv_pools(cfg, num_blocks=33, block_size=bs)
+        alloc = BlockAllocator(33)
+        prompts = [[1, 2, 3], [4, 5, 6, 7, 8], [9]]
+        max_new = 6
+        width = bs  # all prompts fit one block at width 8
+        pad = np.zeros((4, width), np.int32)  # rows padded to pow2
+        true_lens = np.ones((4,), np.int32)
+        rows_blocks = np.full((4, width // bs), TRASH_BLOCK, np.int32)
+        held = []
+        for i, p in enumerate(prompts):
+            pad[i, : len(p)] = p
+            true_lens[i] = len(p)
+            blocks = alloc.alloc(1)
+            rows_blocks[i, 0] = blocks[0]
+            held.append(blocks)
+        seeds = np.zeros((4,), np.int32)
+        temps = np.zeros((4,), np.float32)
+        keys = jax.vmap(jax.random.PRNGKey)(seeds)
+        first, pools = gen.paged_prefill(
+            params,
+            jnp.asarray(pad),
+            jnp.asarray(true_lens),
+            jnp.asarray(rows_blocks),
+            pools,
+            cfg,
+            keys,
+            jnp.asarray(temps),
+        )
+        # decode the 3 real rows in one fixed slot array
+        tables = SlotTables(max_slots=4, blocks_per_slot=cfg.max_seq // bs)
+        out = [list(p) for p in prompts]
+        last = [int(first[i]) for i in range(3)]
+        lens = list(true_lens[:3])
+        for i in range(3):
+            tables.assign(i, held[i])
+            out[i].append(last[i])
+        for _ in range(max_new - 1):
+            for i in range(3):  # lazy block growth, like the engine
+                if lens[i] + 1 > tables.token_capacity(i, bs):
+                    tables.assign(i, alloc.alloc(1))
+            toks = np.array(last + [0], np.int32)
+            poss = np.array(lens + [0], np.int32)
+            step_keys = jax.vmap(jax.random.PRNGKey)(np.zeros((4,), np.int32))
+            nxt, pools = gen.paged_decode_step(
+                params,
+                jnp.asarray(toks),
+                jnp.asarray(poss),
+                jnp.asarray(tables.tables),
+                pools,
+                cfg,
+                step_keys,
+                jnp.zeros((4,), jnp.float32),
+            )
+            for i in range(3):
+                out[i].append(int(nxt[i]))
+                last[i] = int(nxt[i])
+                lens[i] += 1
+        for i, p in enumerate(prompts):
+            expect = dense_generate(params, cfg, p, max_new)
+            assert out[i] == expect, f"row {i} diverged from dense decode"
+
+
+# -- the engine ------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine(tiny):
+    cfg, params = tiny
+    eng = ServeEngine(
+        params, cfg, max_slots=4, block_size=8, max_prefill_batch=2
+    ).start()
+    yield eng
+    eng.stop()
+
+
+class TestServeEngine:
+    def test_greedy_matches_dense_across_mixed_lengths(self, tiny, engine):
+        cfg, params = tiny
+        prompts = [[1, 2, 3], [7, 8], [4, 5, 6, 7, 8, 9, 10], [11], [3, 1]]
+        reqs = [
+            engine.submit(ServeRequest(prompt=p, max_new_tokens=5))
+            for p in prompts
+        ]
+        for r in reqs:
+            assert r.wait(timeout=120) and r.error is None
+        for p, r in zip(prompts, reqs):
+            assert r.tokens == dense_generate(params, cfg, p, 5)
+
+    def test_continuous_batching_shares_steps(self, tiny, engine):
+        # N concurrent requests must cost far fewer decode steps than
+        # serial batch-to-completion would (slots share every step)
+        steps0 = engine.steps
+        reqs = [
+            engine.submit(ServeRequest(prompt=[i + 1, i + 2], max_new_tokens=6))
+            for i in range(4)
+        ]
+        for r in reqs:
+            assert r.wait(timeout=120)
+        assert engine.steps - steps0 < 4 * 6
+
+    def test_eos_evicts_early(self, tiny, engine):
+        cfg, params = tiny
+        full = dense_generate(params, cfg, [1, 2, 3], 8)
+        eos = full[3 + 2]  # token the model emits 3rd; use it as EOS
+        r = engine.generate([1, 2, 3], max_new_tokens=8, eos_id=eos, timeout=120)
+        assert r.tokens == full[: 3 + 3]  # stopped right after emitting EOS
+        assert r.generated[-1] == eos
+
+    def test_sampled_determinism_and_seed_sensitivity(self, tiny, engine):
+        a = engine.generate([5, 6], 6, temperature=0.8, seed=42, timeout=120)
+        b = engine.generate([5, 6], 6, temperature=0.8, seed=42, timeout=120)
+        c = engine.generate([5, 6], 6, temperature=0.8, seed=43, timeout=120)
+        assert a.tokens == b.tokens
+        assert a.tokens != c.tokens
+
+    def test_submit_validation(self, tiny, engine):
+        cfg, _ = tiny
+        with pytest.raises(ValueError, match="max_seq"):
+            engine.submit(
+                ServeRequest(prompt=[1] * cfg.max_seq, max_new_tokens=4)
+            )
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            engine.submit(ServeRequest(prompt=[1], max_new_tokens=0))
+
+    def test_stats_shape(self, engine):
+        s = engine.stats()
+        for k in (
+            "active_slots",
+            "occupancy",
+            "queue_depth",
+            "kv_blocks_used",
+            "requests_done",
+            "steps",
+        ):
+            assert k in s
+
+    def test_preemption_under_block_pressure_preserves_tokens(self, tiny):
+        cfg, params = tiny
+        # pool deliberately too small for 4 growing sequences: the engine
+        # must preempt the youngest and resume it, with identical output
+        eng = ServeEngine(
+            params, cfg, max_slots=4, block_size=8, num_blocks=20
+        ).start()
+        try:
+            prompts = [[i + 1, i + 2, i + 3] for i in range(4)]
+            reqs = [
+                eng.submit(ServeRequest(prompt=p, max_new_tokens=24))
+                for p in prompts
+            ]
+            for r in reqs:
+                assert r.wait(timeout=240) and r.error is None
+            for p, r in zip(prompts, reqs):
+                assert r.tokens == dense_generate(params, cfg, p, 24)
+        finally:
+            eng.stop()
+
+    def test_drain_then_submit_raises(self, tiny):
+        cfg, params = tiny
+        eng = ServeEngine(params, cfg, max_slots=2, block_size=8).start()
+        try:
+            r = eng.submit(ServeRequest(prompt=[1, 2], max_new_tokens=3))
+            assert eng.drain(timeout=120) is True
+            assert r.done.is_set() and r.error is None
+            with pytest.raises(EngineStopped):
+                eng.submit(ServeRequest(prompt=[1], max_new_tokens=1))
+        finally:
+            eng.stop()
+
+    def test_geometry_validation(self, tiny):
+        cfg, params = tiny
+        with pytest.raises(ValueError, match="power of 2"):
+            ServeEngine(params, cfg, block_size=12)
+        with pytest.raises(ValueError, match="num_blocks"):
+            ServeEngine(params, cfg, block_size=8, num_blocks=4)
+
+    def test_from_plan_geometry(self, tiny):
+        cfg, params = tiny
+        plan = plan_pool(
+            cfg, hbm_bytes=1 * GIB, block_size=8, max_slots=2
+        )
+        eng = ServeEngine.from_plan(params, cfg, plan)
+        assert eng.max_slots == 2 and eng.block_size == 8
+        assert eng.num_blocks == plan.num_blocks

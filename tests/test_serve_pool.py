@@ -1,0 +1,325 @@
+"""Serve-pool controller tests: the pure autoscaler decision, least-loaded
+routing, and the deterministic resize e2e against the real local
+scheduler (fake clock + synthetic probes; no real HTTP, no jax)."""
+
+import time
+
+import pytest
+
+from torchx_tpu.obs import metrics as obs_metrics
+from torchx_tpu.obs import sinks, timeline
+from torchx_tpu.runner.api import Runner
+from torchx_tpu.schedulers.local_scheduler import LocalScheduler
+from torchx_tpu.serve.pool import (
+    AutoscalePolicy,
+    Autoscaler,
+    LeastLoadedRouter,
+    ReplicaStatus,
+    ServePool,
+)
+from torchx_tpu.specs.api import AppDef, Role
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- Autoscaler: pure decision --------------------------------------------
+
+
+class TestAutoscaler:
+    def policy(self, **kw):
+        defaults = dict(
+            min_replicas=1,
+            max_replicas=4,
+            target_queue_depth=4.0,
+            up_streak=2,
+            down_streak=3,
+            cooldown_s=60.0,
+        )
+        defaults.update(kw)
+        return AutoscalePolicy(**defaults)
+
+    def test_scale_up_needs_consecutive_breaches(self):
+        a = Autoscaler(self.policy(), clock=FakeClock())
+        assert a.observe(1, 10.0) == 1  # first breach: streak building
+        assert a.observe(1, 10.0) == 2  # second: scale up
+
+    def test_streak_resets_on_recovery(self):
+        a = Autoscaler(self.policy(), clock=FakeClock())
+        assert a.observe(1, 10.0) == 1
+        assert a.observe(1, 1.5) == 1  # recovered: streak resets
+        assert a.observe(1, 10.0) == 1  # back to one breach, still holding
+
+    def test_cooldown_gates_consecutive_scales(self):
+        clock = FakeClock()
+        a = Autoscaler(self.policy(), clock=clock)
+        a.observe(1, 10.0)
+        assert a.observe(1, 10.0) == 2
+        a.notify_scaled()
+        # still hot, but inside cooldown: hold
+        assert a.observe(2, 10.0) == 2
+        assert a.observe(2, 10.0) == 2
+        clock.advance(61.0)
+        # cooldown over and the streak re-built during it
+        assert a.observe(2, 10.0) == 3
+
+    def test_p99_breach_scales_up_even_with_shallow_queue(self):
+        a = Autoscaler(
+            self.policy(target_p99_s=0.5), clock=FakeClock()
+        )
+        assert a.observe(1, 0.0, p99_s=2.0) == 1
+        assert a.observe(1, 0.0, p99_s=2.0) == 2
+
+    def test_scale_down_after_streak_and_not_during_p99_breach(self):
+        clock = FakeClock()
+        a = Autoscaler(
+            self.policy(target_p99_s=0.5, down_streak=2), clock=clock
+        )
+        # idle queue but p99 still over SLO: never scale down
+        assert a.observe(3, 0.0, p99_s=2.0) == 3
+        assert a.observe(3, 0.0, p99_s=2.0) == 4  # that's a breach: UP
+        a.notify_scaled()
+        clock.advance(61.0)
+        assert a.observe(4, 0.0, p99_s=0.1) == 4
+        assert a.observe(4, 0.0, p99_s=0.1) == 3  # idle + healthy: down
+
+    def test_bounds_respected(self):
+        clock = FakeClock()
+        a = Autoscaler(
+            self.policy(max_replicas=2, down_streak=1), clock=clock
+        )
+        a.observe(2, 10.0)
+        assert a.observe(2, 10.0) == 2  # at ceiling: hold
+        assert a.observe(1, 0.0) == 1  # at floor: hold
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError, match="min_replicas"):
+            AutoscalePolicy(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError, match="target_queue_depth"):
+            AutoscalePolicy(target_queue_depth=0)
+        with pytest.raises(ValueError, match="streak"):
+            AutoscalePolicy(up_streak=0)
+
+
+# -- LeastLoadedRouter -----------------------------------------------------
+
+
+class TestRouter:
+    def statuses(self, depths, healthy=None):
+        healthy = healthy or [True] * len(depths)
+        return [
+            ReplicaStatus(
+                replica_id=i, url=f"http://r{i}", healthy=h, queue_depth=d
+            )
+            for i, (d, h) in enumerate(zip(depths, healthy))
+        ]
+
+    def test_pick_least_loaded(self):
+        r = LeastLoadedRouter()
+        r.update(self.statuses([5.0, 1.0, 3.0]))
+        assert r.pick().replica_id == 1
+
+    def test_pick_skips_unhealthy(self):
+        r = LeastLoadedRouter()
+        r.update(self.statuses([5.0, 1.0], healthy=[True, False]))
+        assert r.pick().replica_id == 0
+
+    def test_pick_none_when_all_down(self):
+        r = LeastLoadedRouter()
+        r.update(self.statuses([1.0], healthy=[False]))
+        assert r.pick() is None
+
+    def test_inflight_spreads_before_probe_catches_up(self):
+        # equal probed depth: our own un-acked sends must round-robin
+        r = LeastLoadedRouter()
+        r.update(self.statuses([0.0, 0.0]))
+        first = r.pick().replica_id
+        second = r.pick().replica_id
+        assert {first, second} == {0, 1}
+        r.record(first, 0.01)
+        assert r.pick().replica_id == first  # freed slot goes first again
+
+    def test_p99_window(self):
+        r = LeastLoadedRouter(window=100)
+        assert r.p99_s() is None
+        for _ in range(99):
+            r.record(0, 0.010)
+        r.record(0, 5.0)
+        assert r.p99_s() == 5.0
+
+    def test_queue_depth_mean_over_healthy(self):
+        r = LeastLoadedRouter()
+        r.update(self.statuses([2.0, 4.0, 100.0], healthy=[True, True, False]))
+        assert r.queue_depth() == 3.0
+
+
+# -- ServePool e2e: real local scheduler, synthetic load, fake clock -------
+
+
+def sleeper_app(replicas: int = 1) -> AppDef:
+    return AppDef(
+        name="fake-serve",
+        roles=[
+            Role(
+                name="server",
+                image="",
+                entrypoint="sh",
+                args=["-c", "sleep 300"],
+                num_replicas=replicas,
+                port_map={"http": 8000},
+            )
+        ],
+    )
+
+
+class SyntheticLoad:
+    """Injectable probe: every replica healthy at the scripted depth."""
+
+    def __init__(self) -> None:
+        self.depth = 0.0
+
+    def __call__(self, replica_id: int, url: str) -> ReplicaStatus:
+        return ReplicaStatus(
+            replica_id=replica_id, url=url, healthy=True, queue_depth=self.depth
+        )
+
+
+class TestServePoolE2E:
+    @pytest.fixture
+    def runner(self):
+        sched = LocalScheduler(session_name="pool-test", cache_size=10)
+        r = Runner("pool-test", {"local": lambda session_name, **kw: sched})
+        yield r, sched
+        r.close()
+
+    def pool(self, runner, sched, clock, load, **pol):
+        defaults = dict(
+            min_replicas=1,
+            max_replicas=3,
+            target_queue_depth=4.0,
+            up_streak=2,
+            down_streak=2,
+            cooldown_s=30.0,
+        )
+        defaults.update(pol)
+        return ServePool(
+            runner,
+            sleeper_app(),
+            scheduler="local",
+            policy=AutoscalePolicy(**defaults),
+            probe=load,
+            clock=clock,
+            sleep=lambda s: None,
+        )
+
+    def live_replicas(self, sched, app_id):
+        return len(sched._apps[app_id].roles.get("server", []))
+
+    def test_load_scales_up_through_ledgered_resize(self, runner):
+        r, sched = runner
+        clock, load = FakeClock(), SyntheticLoad()
+        pool = self.pool(r, sched, clock, load)
+        handle = pool.start()
+        app_id = handle.rsplit("/", 1)[-1]
+        try:
+            before = obs_metrics.SERVE_REPLICAS.value()
+            assert before == 1
+            load.depth = 10.0  # queue builds
+            assert pool.step() is None  # hysteresis: one breach holds
+            assert pool.step() == 2  # second breach scales up
+            assert pool.replicas == 2
+            assert self.live_replicas(sched, app_id) == 2  # gang resized
+            assert obs_metrics.SERVE_REPLICAS.value() == 2
+            # the scale rode the ordinary Runner.resize ledger
+            records = timeline.load_records(sinks.trace_path())
+            resizes = [r_ for r_ in records if r_.get("api") == "resize"]
+            assert resizes and resizes[-1]["app_id"] == app_id
+            scale_spans = [
+                r_
+                for r_ in records
+                if timeline.is_span(r_) and r_.get("name") == "serve.scale"
+            ]
+            assert scale_spans and scale_spans[-1]["attrs"]["direction"] == "up"
+        finally:
+            pool.stop()
+
+    def test_idle_scales_down_only_after_cooldown(self, runner):
+        r, sched = runner
+        clock, load = FakeClock(), SyntheticLoad()
+        pool = self.pool(r, sched, clock, load)
+        handle = pool.start()
+        app_id = handle.rsplit("/", 1)[-1]
+        try:
+            load.depth = 10.0
+            pool.step()
+            assert pool.step() == 2
+            load.depth = 0.0  # load stops
+            # inside cooldown: idle observations accumulate but hold
+            assert pool.step() is None
+            assert pool.step() is None
+            assert pool.replicas == 2
+            clock.advance(31.0)
+            assert pool.step() == 1  # cooldown over, streak satisfied
+            assert self.live_replicas(sched, app_id) == 1
+            assert pool.scale_events == [(1, 2), (2, 1)]
+            assert obs_metrics.SERVE_SCALE_EVENTS.value(direction="down") >= 1
+        finally:
+            pool.stop()
+
+    def test_resize_error_surfaces(self, runner):
+        r, sched = runner
+        clock, load = FakeClock(), SyntheticLoad()
+        pool = self.pool(r, sched, clock, load)
+        handle = pool.start()
+        r.cancel(handle)
+        # wait for the gang to actually die so resize sees terminal state
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = r.status(handle, fresh=True)
+            if st is not None and st.state.name in ("CANCELLED", "FAILED"):
+                break
+            time.sleep(0.05)
+        load.depth = 10.0
+        pool.step()
+        with pytest.raises(ValueError, match="terminal"):
+            pool.step()
+
+    def test_run_loop_exits_on_terminal_app(self, runner):
+        r, sched = runner
+        clock, load = FakeClock(), SyntheticLoad()
+        pool = self.pool(r, sched, clock, load)
+        pool.start()
+        pool.stop()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = r.status(pool.handle, fresh=True)
+            if st is not None and st.state.name in ("CANCELLED", "FAILED"):
+                break
+            time.sleep(0.05)
+        pool.run(interval_s=0.0, iterations=50)  # returns, does not spin
+
+
+class TestServePoolCli:
+    def test_cli_registered_and_help(self, capsys):
+        from torchx_tpu.cli.main import get_sub_cmds
+
+        assert "serve-pool" in get_sub_cmds()
+
+    def test_replica_url_stride(self):
+        pool = ServePool(
+            runner=None,
+            app=sleeper_app(),
+            base_port=8000,
+            port_stride=2,
+            probe=SyntheticLoad(),
+        )
+        assert pool.replica_url(0) == "http://127.0.0.1:8000"
+        assert pool.replica_url(3) == "http://127.0.0.1:8006"

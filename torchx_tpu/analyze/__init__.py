@@ -51,6 +51,7 @@ Diagnostic codes
 | TPX204 | warning | ``${...}`` placeholder is not a launcher macro | use ``$${...}`` for runtime shell expansion, or fix the macro name |
 | TPX210 | error | two named ports map to the same number | give each port a distinct number |
 | TPX211 | error | port outside 1-65535 | pick a valid TCP port |
+| TPX212 | warning | serve-shaped role binds ``--port`` with no matching ``port_map`` entry | map the port so routers/serve pools can reach it |
 | TPX220 | error | two mounts share a destination path | each mount needs a distinct dst |
 | TPX221 | warning | mount destination is not absolute | use an absolute container path |
 | TPX300 | info | no capability profile for the scheduler; capability rules skipped | builtin backends declare ``CAPABILITIES`` |

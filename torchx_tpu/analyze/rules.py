@@ -536,6 +536,52 @@ def check_ports(ctx: RuleContext) -> Iterator[Diagnostic]:
                 by_port[port] = name
 
 
+@rule("serve_ports")
+def check_serve_ports(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX212: a serve-shaped role (its args bind a ``--port``) whose port
+    has no ``port_map`` entry — routers and serve pools discover replica
+    endpoints through the port map, so an unmapped server port is
+    unreachable through every launcher surface that consumes it."""
+    for role in ctx.app.roles:
+        args = [str(a) for a in role.args]
+        ports: list[tuple[int, int]] = []  # (arg index, port)
+        for i, a in enumerate(args):
+            if a == "--port" and i + 1 < len(args):
+                raw = args[i + 1]
+            elif a.startswith("--port="):
+                raw = a.split("=", 1)[1]
+            else:
+                continue
+            try:
+                ports.append((i, int(raw)))
+            except ValueError:
+                continue
+        mapped = set(role.port_map.values())
+        for i, port in ports:
+            if port == 0:
+                continue  # ephemeral: the server reports its bound port
+            if port not in mapped:
+                yield Diagnostic(
+                    code="TPX212",
+                    severity=Severity.WARNING,
+                    role=role.name,
+                    field=f"args[{i}]",
+                    message=(
+                        f"role binds --port {port} but port_map has no"
+                        f" entry for it"
+                        + (
+                            f" (mapped: {sorted(mapped)})"
+                            if mapped
+                            else " (port_map is empty)"
+                        )
+                    ),
+                    hint=(
+                        f'add port_map={{"http": {port}}} to the role so'
+                        " routers and serve pools can reach it"
+                    ),
+                )
+
+
 @rule("mounts")
 def check_mounts(ctx: RuleContext) -> Iterator[Diagnostic]:
     """TPX220-TPX221: duplicate destinations and relative paths in mounts."""

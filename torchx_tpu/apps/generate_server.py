@@ -12,18 +12,33 @@ same binary runs under every scheduler backend.
 
 API (JSON):
     GET  /healthz            -> {"status": "ok", "model": ..., "requests": N}
+                                (503 {"status": "draining"} during SIGTERM
+                                grace)
+    GET  /metricz            -> tpx_* metrics, Prometheus text format
     POST /v1/generate        {"tokens": [[...]], "max_new_tokens": 16,
                               "temperature": 0.0}
                           or {"text": "...", ...} (byte-level codec, the
                               same tokenization datapreproc defaults to)
                           -> {"tokens": [[...]]} / {"text": [...]}
 
-Same-length prompts batch together; each distinct (prompt_len,
-max_new_tokens) pair compiles once and is then served from the jit cache.
-Concurrent requests are coalesced by a batcher thread (JetStream-style):
-compatible sequences from different clients merge into one device batch
-within a few-ms window, so serving throughput scales with concurrency up
-to ``--max-batch`` instead of serializing forward passes.
+Two serving engines, selected by ``--engine``:
+
+* ``continuous`` (default): the :mod:`torchx_tpu.serve.engine`
+  continuous-batching loop — a fixed ``--max-batch`` slot array decoding
+  over a paged KV cache, with requests admitted into free slots between
+  steps and completions returned the step they finish. Arbitrary prompt
+  lengths, temperatures, and seeds share one device step.
+* ``coalesce``: the legacy batch-to-completion batcher — compatible
+  sequences (same prompt length / max_new / temperature) from concurrent
+  clients merge into one device batch within a few-ms window, and each
+  batch decodes to completion before the next dispatch. Kept as the
+  serving-bench baseline and for bit-exact parity with
+  :func:`torchx_tpu.models.generate.generate`.
+
+On SIGTERM the server drains instead of dying mid-request: admission
+stops, ``/healthz`` flips to 503 (so routers and the serve pool stop
+sending traffic), in-flight slots decode to completion, then the process
+exits 0.
 """
 
 from __future__ import annotations
@@ -56,13 +71,21 @@ def _assert_platform() -> None:
         jax.config.update("jax_platforms", platforms)
 
 
+class ServiceDraining(RuntimeError):
+    """Raised for requests arriving during the SIGTERM drain window; the
+    HTTP layer maps it to 503 so load balancers retry elsewhere."""
+
+
 @dataclasses.dataclass
 class _Pending:
     """One sequence awaiting decode, owned by a handler thread until the
     batcher thread fills ``result`` (or ``error``) and sets ``done``."""
 
     tokens: list[int]
-    key: tuple  # (prompt_len, max_new_tokens, temperature, seed)
+    key: tuple  # (prompt_len, max_new_tokens, temperature) — seed is NOT
+    # part of the key: rows carry their own seed and sample from their own
+    # folded stream, so differently-seeded requests share a device batch
+    seed: int = 0
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     result: Optional[list[int]] = None
     error: Optional[Exception] = None
@@ -76,23 +99,26 @@ class _Pending:
 
 
 class GenerateService:
-    """Model + jitted decode, shared by all handler threads.
+    """Model + serving engine, shared by all handler threads.
 
-    Decode requests are coalesced JetStream-style: handler threads enqueue
-    sequences and a single batcher thread drains the queue in a short
-    window, merging compatible sequences (same prompt length / max_new /
-    temperature / seed) into ONE device batch — concurrent clients share
-    MXU work instead of serializing whole forward passes behind a lock.
+    ``engine="continuous"`` (default) runs the
+    :class:`torchx_tpu.serve.engine.ServeEngine` continuous-batching loop:
+    ``max_batch`` decode slots over a paged KV pool, admission/eviction
+    every step, any mix of prompt lengths / temperatures / seeds in one
+    compiled step.
 
-    Seed semantics under coalescing: one ``PRNGKey(seed)`` drives the whole
-    merged batch, so at ``temperature > 0`` a request's sampled tokens
-    depend on its row position within whatever batch it merged into — the
-    same (prompt, seed) pair is NOT reproducible across runs with other
-    concurrent traffic. Results are deterministic at ``temperature == 0``
-    (greedy ignores the rng), with the batcher effectively disabled
-    (``max_batch=1``), or when a client is alone in the window. Per-row
-    key folding is deliberately not done: it would break token parity with
-    :func:`torchx_tpu.models.generate.generate` at the same seed.
+    ``engine="coalesce"`` keeps the legacy batch-to-completion batcher:
+    handler threads enqueue sequences and a single batcher thread drains
+    the queue in a short window, merging compatible sequences (same prompt
+    length / max_new / temperature) into ONE device batch that decodes to
+    completion before the next dispatch.
+
+    Seed semantics (both engines): every sequence samples from its own
+    per-row PRNG stream derived from its request seed, so a (prompt, seed,
+    temperature) triple reproduces the same tokens regardless of what
+    other traffic it batched with. In coalesce mode a lone request is
+    token-identical to :func:`torchx_tpu.models.generate.generate` at the
+    same seed (per-row keys stack to exactly the single-key draw).
     """
 
     def __init__(
@@ -103,7 +129,14 @@ class GenerateService:
         seed: int = 0,
         batch_window_ms: float = 3.0,
         max_batch: int = 16,
+        engine: str = "continuous",
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
     ) -> None:
+        if engine not in ("continuous", "coalesce"):
+            raise ValueError(
+                f"unknown engine {engine!r}; have 'continuous', 'coalesce'"
+            )
         from torchx_tpu.examples.train_llama import all_configs
 
         configs = all_configs()
@@ -139,8 +172,22 @@ class GenerateService:
         self.batched_sequences = 0
         self.batch_window_s = batch_window_ms / 1000.0
         self.max_batch = max_batch
+        self.engine_mode = engine
+        self.draining = False
         self._closed = False
         self._count_lock = threading.Lock()
+        self._engine = None
+        if engine == "continuous":
+            from torchx_tpu.serve.engine import ServeEngine
+
+            self._engine = ServeEngine(
+                self.params,
+                self.cfg,
+                max_slots=max_batch,
+                block_size=block_size,
+                num_blocks=num_blocks,
+            ).start()
+            return
         self._submit_lock = threading.Lock()  # orders enqueue vs close
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
         self._batcher = threading.Thread(
@@ -149,8 +196,13 @@ class GenerateService:
         self._batcher.start()
 
     def close(self) -> None:
-        """Stop the batcher thread (idempotent). Work enqueued before close
+        """Stop the serving engine (idempotent). Work enqueued before close
         drains to completion; work racing close fails fast — never hangs."""
+        if self._engine is not None:
+            self._closed = True
+            self._engine.drain(timeout=60)
+            self._engine.stop()
+            return
         with self._submit_lock:
             # under the same lock generate() enqueues with, so every put
             # either lands before the sentinel (drained by the batcher) or
@@ -163,6 +215,20 @@ class GenerateService:
             # loop will finish it, drain its backlog, and exit on the
             # sentinel — nothing is stranded, we just stop waiting
             logger.warning("batcher still draining at close(); detaching")
+
+    def drain(self, grace_s: float = 30.0) -> bool:
+        """SIGTERM grace: stop admitting (:attr:`draining` flips healthz to
+        503 and fails new requests fast), finish everything in flight.
+        True when fully drained within ``grace_s``."""
+        self.draining = True
+        if self._engine is not None:
+            return self._engine.drain(timeout=grace_s)
+        deadline = time.monotonic() + grace_s
+        with self._submit_lock:
+            self._closed = True
+            self._queue.put(None)
+        self._batcher.join(timeout=max(0.0, deadline - time.monotonic()))
+        return not self._batcher.is_alive()
 
     # -- batcher thread ----------------------------------------------------
 
@@ -211,7 +277,7 @@ class GenerateService:
             self._dispatch(group)
 
     def _dispatch(self, group: list[_Pending]) -> None:
-        _, max_new, temperature, seed = group[0].key
+        _, max_new, temperature = group[0].key
         now = time.monotonic()
         for p in group:
             p.t_dispatch = now
@@ -231,7 +297,16 @@ class GenerateService:
             bucket = min(bucket, self.max_batch)
             rows = rows + [rows[0]] * (bucket - len(rows))
             batch = jnp.asarray(rows, dtype=jnp.int32)
-            out = jax.device_get(fn(self.params, batch, jax.random.PRNGKey(seed)))
+            if temperature <= 0:
+                rng = jax.random.PRNGKey(0)  # greedy never reads it
+            else:
+                # one PRNG stream per row, from each request's own seed —
+                # differently-seeded requests coalesce, and each row draws
+                # exactly what a solo call with its seed would
+                seeds = [p.seed for p in group]
+                seeds += [group[0].seed] * (bucket - len(group))
+                rng = jnp.stack([jax.random.PRNGKey(s) for s in seeds])
+            out = jax.device_get(fn(self.params, batch, rng))
             self.batches += 1
             self.batched_sequences += len(group)
             for row, p in enumerate(group):
@@ -282,9 +357,11 @@ class GenerateService:
         max_new_tokens: int,
         temperature: float = 0.0,
         seed: int = 0,
+        eos_id: Optional[int] = None,
     ) -> list[list[int]]:
         return self.generate_timed(
-            tokens, max_new_tokens, temperature=temperature, seed=seed
+            tokens, max_new_tokens, temperature=temperature, seed=seed,
+            eos_id=eos_id,
         )[0]
 
     def generate_timed(
@@ -293,12 +370,16 @@ class GenerateService:
         max_new_tokens: int,
         temperature: float = 0.0,
         seed: int = 0,
+        eos_id: Optional[int] = None,
     ) -> tuple[list[list[int]], dict]:
         """:meth:`generate` plus per-request latency telemetry:
-        ``{"queue_ms", "total_ms"}`` — the coalescing/backlog wait and the
-        end-to-end latency of the request's slowest sequence. The HTTP
-        layer attaches it to responses as ``timing`` so serving benchmarks
-        can report percentiles without server-side scraping."""
+        ``{"queue_ms", "total_ms", "ttft_ms"}`` — the admission/backlog
+        wait, the end-to-end latency of the request's slowest sequence,
+        and the time to its first decoded token. The HTTP layer attaches
+        it to responses as ``timing`` so serving benchmarks can report
+        percentiles without server-side scraping. ``eos_id`` stops a
+        sequence early on that token (continuous engine only; the
+        coalescing baseline always decodes the full budget)."""
         if not tokens or any(not t for t in tokens):
             raise ValueError("tokens must be non-empty sequences")
         longest = max(len(t) for t in tokens)
@@ -307,20 +388,27 @@ class GenerateService:
                 f"prompt length {longest} + {max_new_tokens} new tokens"
                 f" exceeds max_seq {self.cfg.max_seq}"
             )
+        if self.draining:
+            raise ServiceDraining("server is draining; retry elsewhere")
         if self._closed:
             raise RuntimeError("generate service is closed")
+        with self._count_lock:
+            self.requests += 1
+        if self._engine is not None:
+            return self._generate_engine(
+                tokens, max_new_tokens, temperature, seed, eos_id
+            )
         # one _Pending per sequence, keyed by EXACT length (padding would
         # pollute the causal context — correctness over cleverness; one
         # compile per distinct (length, max_new) pair, cached by jit). The
         # batcher thread merges compatible sequences ACROSS requests into
         # single device batches.
-        with self._count_lock:
-            self.requests += 1
         t_enqueue = time.monotonic()
         pendings = [
             _Pending(
                 tokens=list(t),
-                key=(len(t), max_new_tokens, round(temperature, 3), seed),
+                key=(len(t), max_new_tokens, round(temperature, 3)),
+                seed=seed,
                 t_enqueue=t_enqueue,
             )
             for t in tokens
@@ -335,16 +423,62 @@ class GenerateService:
         errors = [p.error for p in pendings if p.error is not None]
         if errors:
             raise errors[0]
-        # request-level timing: the slowest sequence bounds the response
+        # request-level timing: the slowest sequence bounds the response.
+        # batch-to-completion delivers all tokens at once, so the first
+        # token arrives when the batch does: ttft == total
+        total_ms = round(
+            max((p.t_done - p.t_enqueue) for p in pendings) * 1e3, 2
+        )
         timing = {
             "queue_ms": round(
                 max((p.t_dispatch - p.t_enqueue) for p in pendings) * 1e3, 2
             ),
-            "total_ms": round(
-                max((p.t_done - p.t_enqueue) for p in pendings) * 1e3, 2
-            ),
+            "total_ms": total_ms,
+            "ttft_ms": total_ms,
         }
         return [p.result for p in pendings], timing
+
+    def _generate_engine(
+        self,
+        tokens: list[list[int]],
+        max_new_tokens: int,
+        temperature: float,
+        seed: int,
+        eos_id: Optional[int],
+    ) -> tuple[list[list[int]], dict]:
+        from torchx_tpu.serve.engine import EngineStopped, ServeRequest
+
+        reqs = [
+            ServeRequest(
+                prompt=list(t),
+                max_new_tokens=max_new_tokens,
+                temperature=round(temperature, 3),
+                seed=seed,
+                eos_id=eos_id,
+            )
+            for t in tokens
+        ]
+        try:
+            for r in reqs:
+                self._engine.submit(r)
+        except EngineStopped as e:
+            raise ServiceDraining(str(e)) from e
+        for r in reqs:
+            r.wait()
+        errors = [r.error for r in reqs if r.error is not None]
+        if errors:
+            raise RuntimeError(errors[0])
+        with self._count_lock:
+            self.batches = self._engine.steps
+            self.batched_sequences += len(reqs)
+        timing = {
+            "queue_ms": round(max(r.ttft_s for r in reqs) * 1e3, 2),
+            "total_ms": round(
+                max(r.t_done - r.t_enqueue for r in reqs) * 1e3, 2
+            ),
+            "ttft_ms": round(max(r.ttft_s for r in reqs) * 1e3, 2),
+        }
+        return [r.tokens for r in reqs], timing
 
     def generate_stream(
         self,
@@ -359,6 +493,8 @@ class GenerateService:
         Streaming bypasses the batcher — a stream holds the device for its
         whole decode, so it trades coalescing for time-to-first-token;
         token-identical to the batch path at the same seed."""
+        if self.draining:
+            raise ServiceDraining("server is draining; retry elsewhere")
         if self._closed:
             raise RuntimeError("generate service is closed")
         if not tokens:
@@ -408,18 +544,30 @@ def _make_handler(service: GenerateService):
 
         def do_GET(self) -> None:  # noqa: N802
             if self.path == "/healthz":
-                self._reply(
-                    200,
-                    {
-                        "status": "ok",
-                        "model": service.name,
-                        "int8": service.int8,
-                        "ckpt_step": service.ckpt_step,
-                        "requests": service.requests,
-                        "batches": service.batches,
-                        "batched_sequences": service.batched_sequences,
-                    },
-                )
+                body = {
+                    "status": "draining" if service.draining else "ok",
+                    "model": service.name,
+                    "engine": service.engine_mode,
+                    "int8": service.int8,
+                    "ckpt_step": service.ckpt_step,
+                    "requests": service.requests,
+                    "batches": service.batches,
+                    "batched_sequences": service.batched_sequences,
+                }
+                if service._engine is not None:
+                    body.update(service._engine.stats())
+                # a draining replica must fail its health check so routers
+                # and the serve pool stop sending it traffic
+                self._reply(503 if service.draining else 200, body)
+            elif self.path == "/metricz":
+                from torchx_tpu.obs.metrics import REGISTRY
+
+                text = REGISTRY.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -493,11 +641,13 @@ def _make_handler(service: GenerateService):
                         return
                     self._stream(tokens[0], req, text_mode)
                     return
+                eos = req.get("eos_id")
                 out, timing = service.generate_timed(
                     tokens,
                     max_new_tokens=int(req.get("max_new_tokens", 16)),
                     temperature=float(req.get("temperature", 0.0)),
                     seed=int(req.get("seed", 0)),
+                    eos_id=None if eos is None else int(eos),
                 )
                 if text_mode:
                     self._reply(
@@ -514,6 +664,8 @@ def _make_handler(service: GenerateService):
                     )
                 else:
                     self._reply(200, {"tokens": out, "timing": timing})
+            except ServiceDraining as e:
+                self._reply(503, {"error": str(e)})
             except (KeyError, ValueError, TypeError) as e:
                 if getattr(self, "_streamed", False):
                     logger.warning("stream aborted mid-flight: %s", e)
@@ -538,6 +690,9 @@ def serve(
     ready_event: Optional[threading.Event] = None,
     batch_window_ms: float = 3.0,
     max_batch: int = 16,
+    engine: str = "continuous",
+    block_size: int = 16,
+    num_blocks: Optional[int] = None,
 ) -> ThreadingHTTPServer:
     service = GenerateService(
         config,
@@ -545,12 +700,60 @@ def serve(
         int8=int8,
         batch_window_ms=batch_window_ms,
         max_batch=max_batch,
+        engine=engine,
+        block_size=block_size,
+        num_blocks=num_blocks,
     )
     server = ThreadingHTTPServer(("", port), _make_handler(service))
     server.service = service  # for tests / shutdown hooks
     if ready_event is not None:
         ready_event.set()
     return server
+
+
+def make_drain(
+    server: ThreadingHTTPServer,
+    service: GenerateService,
+    grace_s: float = 30.0,
+) -> Any:
+    """The SIGTERM drain sequence, as a callable (testable without
+    signals): stop admission + fail ``/healthz``, let in-flight slots
+    decode out, then shut the HTTP loop down so :func:`main` returns and
+    the process exits 0 inside the preemption notice window."""
+
+    def _drain() -> None:
+        logger.warning("SIGTERM: draining (grace %.0fs)", grace_s)
+        ok = service.drain(grace_s)
+        if not ok:
+            logger.warning("drain grace expired with requests in flight")
+        server.shutdown()
+
+    return _drain
+
+
+def _install_drain_handler(
+    server: ThreadingHTTPServer,
+    service: GenerateService,
+    grace_s: float = 30.0,
+) -> bool:
+    """Arm SIGTERM -> graceful drain (mirrors train_llama's preemption
+    handler: main thread only, previous handler semantics preserved by
+    process exit). The handler thread exists because ``server.shutdown``
+    must not run on the thread ``serve_forever`` occupies."""
+    import signal
+
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    drain = make_drain(server, service, grace_s)
+
+    def _on_sigterm(signum, frame):  # noqa: ANN001
+        threading.Thread(target=drain, name="tpx-drain", daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):  # no signal support here
+        return False
+    return True
 
 
 def main(argv: Optional[list[str]] = None) -> None:
@@ -563,12 +766,47 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--batch-window-ms",
         type=float,
         default=3.0,
-        help="how long the batcher waits to coalesce concurrent requests",
+        help="how long the coalescing batcher waits for concurrent requests",
     )
     parser.add_argument(
-        "--max-batch", type=int, default=16, help="max sequences per device batch"
+        "--max-batch",
+        type=int,
+        default=16,
+        help="decode slots (continuous) / max sequences per batch (coalesce)",
+    )
+    parser.add_argument(
+        "--engine",
+        choices=("continuous", "coalesce"),
+        default="continuous",
+        help="continuous batching over paged KV (default), or the legacy"
+        " batch-to-completion coalescer",
+    )
+    parser.add_argument(
+        "--block-size", type=int, default=16, help="paged KV-cache block size"
+    )
+    parser.add_argument(
+        "--num-blocks",
+        type=int,
+        default=None,
+        help="paged KV pool size in blocks (default: sized from max-batch)",
+    )
+    parser.add_argument(
+        "--drain-grace-s",
+        type=float,
+        default=30.0,
+        help="SIGTERM drain budget before shutdown proceeds anyway",
+    )
+    parser.add_argument(
+        "--port-stride",
+        type=int,
+        default=0,
+        help="listen on port + stride * TPX_REPLICA_ID, so a serve pool's"
+        " replicas co-located by the local scheduler get distinct ports",
     )
     args = parser.parse_args(argv)
+    if args.port_stride and args.port:
+        replica_id = int(os.environ.get("TPX_REPLICA_ID", "0") or "0")
+        args.port += args.port_stride * replica_id
     _assert_platform()
     t0 = time.monotonic()
     server = serve(
@@ -578,13 +816,21 @@ def main(argv: Optional[list[str]] = None) -> None:
         args.int8,
         batch_window_ms=args.batch_window_ms,
         max_batch=args.max_batch,
+        engine=args.engine,
+        block_size=args.block_size,
+        num_blocks=args.num_blocks,
     )
+    _install_drain_handler(server, server.service, args.drain_grace_s)
+    # report the BOUND port: with --port 0 the OS picks one, and whatever
+    # launched us (serve pool, smoke test) reads it from this line
+    port = server.server_address[1]
     print(
-        f"generate_server: {args.config} on :{args.port}"
+        f"generate_server: {args.config} [{args.engine}] on :{port}"
         f" (loaded in {time.monotonic() - t0:.1f}s)",
         flush=True,
     )
     server.serve_forever()
+    server.server_close()
 
 
 if __name__ == "__main__":

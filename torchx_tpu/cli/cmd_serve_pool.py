@@ -1,0 +1,146 @@
+"""``tpx serve-pool`` — launcher-driven autoscaling generate_server pool.
+
+Submits N ``generate_server`` replicas as one role through the Runner,
+starts a least-loaded HTTP router in front of them, and runs the
+probe -> autoscale -> ``Runner.resize`` control loop until interrupted::
+
+    tpx serve-pool --config llama3_1b --replicas 2 --max-replicas 6 \\
+        --base-port 8000 --router-port 9000 \\
+        --target-queue-depth 4 --target-p99-ms 500
+
+Every scale event is an ordinary ledgered resize — ``tpx trace`` shows
+``serve.scale`` spans next to the ``runner.resize`` calls they made, and
+``tpx_serve_replicas`` / ``tpx_serve_scale_events_total`` land in the
+metrics sink. Ctrl-C cancels the app; replicas drain via their SIGTERM
+handlers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+
+from torchx_tpu.cli.cmd_base import SubCommand
+from torchx_tpu.runner.api import get_runner
+
+logger = logging.getLogger(__name__)
+
+
+class CmdServePool(SubCommand):
+    """Run the serving control plane (see module docstring)."""
+
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument("--config", required=True, help="model config")
+        subparser.add_argument(
+            "-s",
+            "--scheduler",
+            default="local",
+            help="scheduler backend for the replicas",
+        )
+        subparser.add_argument(
+            "--replicas", type=int, default=1, help="initial replica count"
+        )
+        subparser.add_argument("--min-replicas", type=int, default=1)
+        subparser.add_argument("--max-replicas", type=int, default=4)
+        subparser.add_argument(
+            "--base-port",
+            type=int,
+            default=8000,
+            help="replica i serves on base-port + port-stride * i",
+        )
+        subparser.add_argument("--port-stride", type=int, default=1)
+        subparser.add_argument(
+            "--router-port",
+            type=int,
+            default=9000,
+            help="least-loaded proxy port (0 = ephemeral)",
+        )
+        subparser.add_argument(
+            "--target-queue-depth",
+            type=float,
+            default=4.0,
+            help="per-replica queue depth that triggers scale-up",
+        )
+        subparser.add_argument(
+            "--target-p99-ms",
+            type=float,
+            default=None,
+            help="TTFT p99 SLO in ms; breaches also trigger scale-up",
+        )
+        subparser.add_argument(
+            "--cooldown-s",
+            type=float,
+            default=60.0,
+            help="minimum seconds between resizes",
+        )
+        subparser.add_argument(
+            "--interval",
+            type=float,
+            default=5.0,
+            help="control-loop probe interval seconds",
+        )
+        subparser.add_argument(
+            "--iterations",
+            type=int,
+            default=None,
+            help="stop after N control iterations (default: run forever)",
+        )
+        subparser.add_argument(
+            "--engine", choices=("continuous", "coalesce"), default="continuous"
+        )
+        subparser.add_argument("--max-batch", type=int, default=16)
+        subparser.add_argument("--ckpt-dir", default=None)
+
+    def run(self, args: argparse.Namespace) -> None:
+        # heavy imports deferred: `tpx --help` must stay jax-free
+        from torchx_tpu.components.serve import generate_server
+        from torchx_tpu.serve.pool import (
+            AutoscalePolicy,
+            ServePool,
+            serve_router,
+        )
+
+        app = generate_server(
+            args.config,
+            port=args.base_port,
+            ckpt_dir=args.ckpt_dir,
+            engine=args.engine,
+            max_batch=args.max_batch,
+            num_replicas=args.replicas,
+            port_stride=args.port_stride,
+        )
+        policy = AutoscalePolicy(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            target_queue_depth=args.target_queue_depth,
+            target_p99_s=(
+                args.target_p99_ms / 1000.0
+                if args.target_p99_ms is not None
+                else None
+            ),
+            cooldown_s=args.cooldown_s,
+        )
+        with get_runner() as runner:
+            pool = ServePool(
+                runner,
+                app,
+                scheduler=args.scheduler,
+                base_port=args.base_port,
+                port_stride=args.port_stride,
+                policy=policy,
+            )
+            handle = pool.start()
+            router = serve_router(pool, args.router_port)
+            rport = router.server_address[1]
+            threading.Thread(
+                target=router.serve_forever, name="tpx-router", daemon=True
+            ).start()
+            print(f"serve pool {handle}: routing on :{rport}", flush=True)
+            try:
+                pool.run(interval_s=args.interval, iterations=args.iterations)
+            except KeyboardInterrupt:
+                print("interrupted; cancelling pool", flush=True)
+            finally:
+                router.shutdown()
+                pool.stop()

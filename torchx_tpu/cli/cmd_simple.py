@@ -102,7 +102,13 @@ class CmdResize(SubCommand):
 
     def run(self, args: argparse.Namespace) -> None:
         with get_runner() as runner:
-            runner.resize(args.app_handle, args.role_name, args.num_replicas)
+            try:
+                runner.resize(args.app_handle, args.role_name, args.num_replicas)
+            except (ValueError, NotImplementedError) as e:
+                # terminal app, unknown role, or a backend without resize:
+                # an operator mistake, not a stack trace
+                print(f"error: {e}", file=sys.stderr)
+                sys.exit(1)
             print(
                 f"resized {args.app_handle}/{args.role_name}"
                 f" to {args.num_replicas}"

@@ -43,6 +43,7 @@ BUILTIN_CMDS: dict[str, tuple[str, str]] = {
     "builtins": ("torchx_tpu.cli.cmd_simple", "CmdBuiltins"),
     "configure": ("torchx_tpu.cli.cmd_simple", "CmdConfigure"),
     "tracker": ("torchx_tpu.cli.cmd_tracker", "CmdTracker"),
+    "serve-pool": ("torchx_tpu.cli.cmd_serve_pool", "CmdServePool"),
 }
 
 

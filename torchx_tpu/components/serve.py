@@ -63,11 +63,18 @@ def generate_server(
     memMB: int = 16384,
     batch_window_ms: float = 3.0,
     max_batch: int = 16,
+    engine: str = "continuous",
+    block_size: int = 16,
+    num_blocks: Optional[int] = None,
+    num_replicas: int = 1,
+    port_stride: int = 0,
 ) -> specs.AppDef:
     """Serve KV-cache generation for a model family over HTTP
-    (POST /v1/generate, GET /healthz) — the TPU-native serving half the
-    reference delegates to TorchServe. Concurrent requests coalesce into
-    shared device batches (JetStream-style batcher thread).
+    (POST /v1/generate, GET /healthz, GET /metricz) — the TPU-native
+    serving half the reference delegates to TorchServe. The default
+    ``continuous`` engine runs continuous batching over a paged KV cache
+    (:mod:`torchx_tpu.serve.engine`); ``coalesce`` selects the legacy
+    batch-to-completion batcher thread.
 
     Args:
         config: model config name (e.g. ``llama3_1b``)
@@ -78,8 +85,14 @@ def generate_server(
         tpu: TPU accelerator type (e.g. ``v5litepod-8``); CPU when unset
         cpu: cpu count for CPU serving
         memMB: memory for CPU serving
-        batch_window_ms: how long the batcher waits to coalesce requests
-        max_batch: max sequences per coalesced device batch
+        batch_window_ms: coalesce-engine batching window
+        max_batch: decode slots (continuous) / max coalesced batch
+        engine: ``continuous`` (paged KV) or ``coalesce`` (legacy)
+        block_size: paged KV-cache block size (continuous engine)
+        num_blocks: paged KV pool size in blocks (default: from max_batch)
+        num_replicas: server replicas (a serve pool resizes this)
+        port_stride: replica i listens on ``port + stride * i`` so a pool's
+            co-located replicas get distinct ports
     """
     args = [
         "-m",
@@ -92,7 +105,15 @@ def generate_server(
         str(batch_window_ms),
         "--max-batch",
         str(max_batch),
+        "--engine",
+        engine,
+        "--block-size",
+        str(block_size),
     ]
+    if num_blocks is not None:
+        args += ["--num-blocks", str(num_blocks)]
+    if port_stride:
+        args += ["--port-stride", str(port_stride)]
     if ckpt_dir:
         args += ["--ckpt-dir", ckpt_dir]
     if int8:
@@ -106,6 +127,7 @@ def generate_server(
                 image=image,
                 entrypoint="python",
                 args=args,
+                num_replicas=num_replicas,
                 port_map={"http": port},
                 resource=resource,
             )

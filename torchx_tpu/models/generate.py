@@ -20,10 +20,12 @@ import jax.numpy as jnp
 
 from torchx_tpu.models import llama
 from torchx_tpu.ops.norms import rms_norm
+from torchx_tpu.ops.paged_attention import append_kv, paged_attention
 from torchx_tpu.ops.quant import maybe_matmul as mm
 from torchx_tpu.ops.rope import apply_rope, rope_frequencies
 
 KVCache = dict[str, jnp.ndarray]  # {"k": [L,b,S,kvh,hd], "v": ...}
+KVPools = dict[str, jnp.ndarray]  # {"k": [L,num_blocks,block_size,kvh,hd]}
 
 
 def init_kv_cache(
@@ -128,12 +130,30 @@ def forward_with_cache(
 
 def _sample(logits_t: jnp.ndarray, key: jax.Array, temperature: float) -> jnp.ndarray:
     """Greedy at temperature 0, else categorical — the ONE sampling rule
-    both the batch and streaming paths use (parity depends on it)."""
+    both the batch and streaming paths use (parity depends on it).
+
+    ``key`` may be a single key (one sampling stream for the whole batch —
+    the original behavior) or a ``[b, 2]`` stack of per-row keys, which
+    draws each row from its own stream so requests with different seeds
+    can share one device batch."""
     if temperature <= 0:
         return jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+    if key.ndim == 2:  # per-row keys
+        draw = jax.vmap(lambda l, k: jax.random.categorical(k, l / temperature))
+        return draw(logits_t, key).astype(jnp.int32)
     return jax.random.categorical(key, logits_t / temperature, axis=-1).astype(
         jnp.int32
     )
+
+
+def _split_keys(key: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """``jax.random.split`` that also accepts a ``[b, 2]`` stack of per-row
+    keys (vmapped split, preserving one independent stream per row)."""
+    if key.ndim == 2:
+        ks = jax.vmap(jax.random.split)(key)  # [b, 2, 2]
+        return ks[:, 0], ks[:, 1]
+    k0, k1 = jax.random.split(key)
+    return k0, k1
 
 
 def _prefill(
@@ -149,7 +169,7 @@ def _prefill(
     step 0's draw is independent of step 1's."""
     cache = init_kv_cache(cfg, prompt.shape[0], total)
     logits, cache = forward_with_cache(params, prompt, cache, jnp.int32(0), cfg)
-    rng, first_key = jax.random.split(rng)
+    rng, first_key = _split_keys(rng)
     return cache, _sample(logits[:, -1], first_key, temperature), rng
 
 
@@ -166,7 +186,13 @@ def generate(
     Works for dense and MoE configs alike (the cached layer dispatches to
     the GShard expert FFN when the config carries experts). Note MoE
     capacity is computed per call width, so aggressive ``capacity_factor``
-    settings can drop different tokens at prefill vs full forward."""
+    settings can drop different tokens at prefill vs full forward.
+
+    ``rng`` may be a single PRNG key (one sampling stream shared by the
+    batch) or a ``[b, 2]`` stack of per-row keys, giving every row its own
+    stream — this is how requests with different seeds coalesce into one
+    device batch. Row ``i`` of a stacked call draws the same tokens as a
+    single-row call seeded with row ``i``'s key."""
     b, t0 = prompt.shape
     total = t0 + max_new_tokens
     if total > cfg.max_seq:
@@ -183,7 +209,7 @@ def generate(
 
     def step(carry, i):  # noqa: ANN001
         cache, tok, out, key = carry
-        key, sub = jax.random.split(key)
+        key, sub = _split_keys(key)
         logits, cache = forward_with_cache(
             params, tok[:, None], cache, t0 + i, cfg
         )
@@ -217,7 +243,7 @@ def _stream_fns(cfg: llama.LlamaConfig, total: int, temperature: float, chunk: i
         # cache writes are never read again
         def step(carry, i):  # noqa: ANN001
             cache, tok, key = carry
-            key, sub = jax.random.split(key)
+            key, sub = _split_keys(key)
             logits, cache = forward_with_cache(params, tok[:, None], cache, start + i, cfg)
             nxt = _sample(logits[:, -1], sub, temperature)
             return (cache, nxt, key), nxt
@@ -275,3 +301,165 @@ def generate_stream(
             produced += n
 
     return run()
+
+
+# ---------------------------------------------------------------------------
+# Paged-KV serving path (continuous batching; see torchx_tpu/serve/)
+# ---------------------------------------------------------------------------
+#
+# Same layer math as the dense path above — rms_norm / rope / ffn / lm_head
+# are shared, and the attention softmax masks exactly the positions the
+# dense mask admits — but K/V live in a block-table pool
+# ([L, num_blocks, block_size, kvh, hd]) instead of per-request
+# [L, b, max_seq, ...] buffers, and every slot carries its own position,
+# RNG stream, and temperature so unrelated requests share one jitted step.
+
+
+def init_kv_pools(
+    cfg: llama.LlamaConfig, num_blocks: int, block_size: int
+) -> KVPools:
+    """Zeroed paged K/V pools, ``[layers, num_blocks, block_size, kvh, hd]``
+    (block 0 is the trash block — see :mod:`torchx_tpu.ops.paged_attention`)."""
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype=cfg.dtype),
+        "v": jnp.zeros(shape, dtype=cfg.dtype),
+    }
+
+
+def _rope_rows(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """:func:`apply_rope` for one token per row at per-row positions:
+    ``x`` [rows, heads, hd], ``cos``/``sin`` [rows, hd/2] (same float32
+    rotation, so paged decode matches the dense path bit-for-bit)."""
+    dtype = x.dtype
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    return jnp.concatenate((x1 * c - x2 * s, x2 * c + x1 * s), axis=-1).astype(dtype)
+
+
+def _sample_rows(
+    logits: jnp.ndarray,  # [rows, vocab]
+    keys: jnp.ndarray,  # [rows, 2] per-row PRNG keys
+    temps: jnp.ndarray,  # [rows] — <= 0 means greedy for that row
+) -> jnp.ndarray:
+    """Per-row :func:`_sample` where temperature is data, not static: each
+    row greedy-decodes or draws from its own stream at its own temperature
+    (a continuous batch mixes requests with different sampling params)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+    draw = jax.vmap(lambda l, k: jax.random.categorical(k, l))
+    sampled = draw(logits / safe_t, keys).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
+def _paged_layer_step(
+    cfg: llama.LlamaConfig,
+    cos: jnp.ndarray,  # [slots, hd/2] rope rows at each slot's position
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,  # [slots] — cache index the new token writes to
+    tables: jnp.ndarray,  # [slots, blocks_per_slot] int32
+    x: jnp.ndarray,  # [slots, 1, d]
+    layer: llama.Params,
+    k_pool: jnp.ndarray,  # [num_blocks, bs, kvh, hd] this layer's pool
+    v_pool: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    slots = x.shape[0]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    attn_in = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = _rope_rows(mm(attn_in, layer["wq"]).reshape(slots, h, hd), cos, sin)
+    k = _rope_rows(mm(attn_in, layer["wk"]).reshape(slots, kvh, hd), cos, sin)
+    v = mm(attn_in, layer["wv"]).reshape(slots, kvh, hd)
+    k_pool = append_kv(k_pool, tables, positions, k)
+    v_pool = append_kv(v_pool, tables, positions, v)
+    attn = paged_attention(q, k_pool, v_pool, tables, positions + 1)
+    x = x + mm(attn.reshape(slots, 1, h * hd), layer["wo"])
+    mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    down, _aux = llama.ffn(cfg, layer, mlp_in)
+    x = x + down
+    return x, k_pool, v_pool
+
+
+def _lm_head_rows(params: llama.Params, x: jnp.ndarray, cfg: llama.LlamaConfig):
+    # [rows, d] -> [rows, vocab] f32, same head dispatch as forward_with_cache
+    head = llama.lm_head(params, cfg)
+    if isinstance(head, dict):  # int8-quantized lm_head: keep f32 accum
+        return mm(x, head, out_dtype=jnp.float32)
+    return jnp.einsum("rd,dv->rv", x, head, preferred_element_type=jnp.float32)
+
+
+def paged_decode_step(
+    params: llama.Params,
+    tokens: jnp.ndarray,  # [slots] int32 — last sampled token per slot
+    positions: jnp.ndarray,  # [slots] int32 — where each token's K/V goes
+    tables: jnp.ndarray,  # [slots, blocks_per_slot] int32 block tables
+    pools: KVPools,
+    cfg: llama.LlamaConfig,
+    keys: jnp.ndarray,  # [slots, 2] per-slot PRNG keys for THIS position
+    temps: jnp.ndarray,  # [slots] f32 — <= 0 greedy
+) -> tuple[jnp.ndarray, KVPools]:
+    """One continuous-batching decode step over the whole slot array.
+
+    -> (next token [slots], updated pools). Every slot advances one token
+    against its own block table at its own position; inactive slots
+    (table all trash, position 0) compute garbage that lands in the trash
+    block and is never read. Static shapes: one XLA compile per
+    (slots, pool geometry), regardless of which requests occupy the slots.
+    Jit with ``donate_argnums`` on ``pools`` so the pool updates in place.
+    """
+    slots = tokens.shape[0]
+    x = params["embed"][tokens].astype(cfg.dtype)[:, None, :]  # [slots, 1, d]
+    cos_full, sin_full = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    cos, sin = cos_full[positions], sin_full[positions]  # [slots, hd/2]
+
+    def scan_step(carry, layer_and_pools):  # noqa: ANN001
+        x = carry
+        layer, k_p, v_p = layer_and_pools
+        x, k_p, v_p = _paged_layer_step(
+            cfg, cos, sin, positions, tables, x, layer, k_p, v_p
+        )
+        return x, (k_p, v_p)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        scan_step, x, (params["layers"], pools["k"], pools["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)[:, 0, :]  # [slots, d]
+    logits = _lm_head_rows(params, x, cfg)
+    nxt = _sample_rows(logits, keys, temps)
+    return nxt, {"k": k_new, "v": v_new}
+
+
+def paged_prefill(
+    params: llama.Params,
+    prompts: jnp.ndarray,  # [b, t] int32, right-padded to the bucket width
+    true_lens: jnp.ndarray,  # [b] int32 — real prompt lengths
+    block_ids: jnp.ndarray,  # [b, t // block_size] physical blocks per row
+    pools: KVPools,
+    cfg: llama.LlamaConfig,
+    keys: jnp.ndarray,  # [b, 2] per-row PRNG keys for the first token
+    temps: jnp.ndarray,  # [b] f32
+) -> tuple[jnp.ndarray, KVPools]:
+    """Prefill a bucket of prompts straight into the paged pools.
+
+    Runs the dense stacked-layer prefill over the right-padded bucket
+    (causal masking keeps every position < ``true_lens[i]`` exact despite
+    the padding), scatters the bucket's K/V into each row's assigned
+    blocks, and samples the first output token from the logits at
+    ``true_lens[i] - 1``. ``t`` must be a multiple of the pool block size;
+    rows that need fewer blocks pad ``block_ids`` with the trash block.
+    -> (first token [b], updated pools).
+    """
+    b, t = prompts.shape
+    cache = init_kv_cache(cfg, b, t)
+    logits, cache = forward_with_cache(params, prompts, cache, jnp.int32(0), cfg)
+    bs = pools["k"].shape[2]
+    nb = t // bs
+    kvh, hd = cfg.n_kv_heads, cfg.head_dim
+    k = cache["k"].reshape(cfg.n_layers, b, nb, bs, kvh, hd)
+    v = cache["v"].reshape(cfg.n_layers, b, nb, bs, kvh, hd)
+    pools = {
+        "k": pools["k"].at[:, block_ids].set(k, mode="drop"),
+        "v": pools["v"].at[:, block_ids].set(v, mode="drop"),
+    }
+    last = logits[jnp.arange(b), true_lens - 1]  # [b, vocab]
+    return _sample_rows(last, keys, temps), pools

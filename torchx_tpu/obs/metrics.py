@@ -406,3 +406,77 @@ BREAKER_STATE = REGISTRY.gauge(
     "control-plane circuit breaker state (0 closed, 1 half-open, 2 open)",
     ("backend",),
 )
+
+#: serving-engine slot occupancy: fraction of decode slots holding an
+#: active sequence this step (sustained occupancy is what keeps
+#: HBM-bandwidth-bound decode fed — the continuous-batching win).
+SERVE_OCCUPANCY = REGISTRY.gauge(
+    "tpx_serve_slot_occupancy",
+    "fraction of decode slots active in the serving engine",
+)
+
+#: decode slots currently holding an active sequence.
+SERVE_SLOTS_ACTIVE = REGISTRY.gauge(
+    "tpx_serve_slots_active",
+    "decode slots currently active in the serving engine",
+)
+
+#: requests admitted but not yet completed, waiting for a free slot.
+SERVE_QUEUE_DEPTH = REGISTRY.gauge(
+    "tpx_serve_queue_depth",
+    "requests waiting for a decode slot in the serving engine",
+)
+
+#: paged KV blocks currently allocated to live sequences.
+SERVE_KV_BLOCKS_USED = REGISTRY.gauge(
+    "tpx_serve_kv_blocks_used",
+    "paged KV-cache blocks held by active sequences",
+)
+
+#: decode tokens produced, by phase ("prefill" first tokens vs "decode").
+SERVE_TOKENS = REGISTRY.counter(
+    "tpx_serve_tokens_total",
+    "tokens produced by the serving engine",
+    ("phase",),
+)
+
+#: completed requests, by outcome ("ok"/"error").
+SERVE_REQUESTS = REGISTRY.counter(
+    "tpx_serve_requests_total",
+    "requests completed by the serving engine",
+    ("status",),
+)
+
+#: sequences preempted (blocks reclaimed, request requeued) because the
+#: KV pool ran out of free blocks mid-decode.
+SERVE_PREEMPTIONS = REGISTRY.counter(
+    "tpx_serve_preemptions_total",
+    "sequences preempted for KV-pool pressure and requeued",
+)
+
+#: time-to-first-token per request, seconds.
+SERVE_TTFT_SECONDS = REGISTRY.histogram(
+    "tpx_serve_ttft_seconds",
+    "request time-to-first-token in seconds",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
+)
+
+#: per-token decode latency (time-per-output-token) per request, seconds.
+SERVE_TPOT_SECONDS = REGISTRY.histogram(
+    "tpx_serve_tpot_seconds",
+    "request mean time-per-output-token in seconds",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0),
+)
+
+#: serve-pool replica count, as last applied by the autoscaler.
+SERVE_REPLICAS = REGISTRY.gauge(
+    "tpx_serve_replicas",
+    "generate_server replicas the serve pool is currently running",
+)
+
+#: serve-pool autoscaling decisions, by direction ("up"/"down").
+SERVE_SCALE_EVENTS = REGISTRY.counter(
+    "tpx_serve_scale_events_total",
+    "serve-pool autoscale resizes applied",
+    ("direction",),
+)

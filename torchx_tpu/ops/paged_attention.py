@@ -1,0 +1,122 @@
+"""Paged (block-table) KV-cache attention for continuous-batching decode.
+
+The dense decode cache (:mod:`torchx_tpu.models.generate`) reserves
+``[L, batch, max_seq, kvh, hd]`` per sequence — worst-case ``max_seq``
+whether or not the request ever decodes that far. Serving at high
+concurrency wastes most of that HBM: the vLLM observation is that KV
+memory should be allocated in fixed-size *blocks* as tokens actually
+arrive, with a per-sequence *block table* mapping logical positions to
+physical blocks in one shared pool.
+
+This module is the device-side half: pure, jittable functions over a
+fixed ``[num_blocks, block_size, kvh, hd]`` pool per layer —
+
+* :func:`gather_kv` — block-table gather back to a contiguous
+  ``[slots, S, kvh, hd]`` view (S = blocks_per_slot * block_size);
+* :func:`paged_attention` — single-query-token GQA attention against the
+  gathered view, masked by per-slot valid lengths;
+* :func:`append_kv` — scatter one new K/V token per slot into the pool at
+  its block-table position;
+* :func:`write_prefill` — bulk-write a prefilled prompt's K/V into the
+  blocks a slot was assigned.
+
+Everything is static-shape (XLA compiles once per pool geometry); the
+host-side allocator that assigns blocks lives in
+:mod:`torchx_tpu.serve.kv_pool`. Block 0 is reserved as the trash block:
+unassigned table entries point at it, writes from inactive slots land in
+it, and the length mask keeps its contents out of every softmax.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: Physical block index every unassigned block-table entry points at.
+#: Writes from inactive/padded slots land here; masked attention never
+#: reads it as valid context.
+TRASH_BLOCK = 0
+
+
+def gather_kv(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+    """Gather one layer's pooled K (or V) into per-slot contiguous views.
+
+    ``pool``: ``[num_blocks, block_size, kvh, hd]``; ``tables``:
+    ``[slots, blocks_per_slot]`` int32 physical block ids. Returns
+    ``[slots, blocks_per_slot * block_size, kvh, hd]`` — position ``p`` of
+    slot ``i`` is ``pool[tables[i, p // bs], p % bs]``.
+    """
+    slots, bpr = tables.shape
+    _, bs, kvh, hd = pool.shape
+    g = pool[tables]  # [slots, bpr, bs, kvh, hd]
+    return g.reshape(slots, bpr * bs, kvh, hd)
+
+
+def paged_attention(
+    q: jnp.ndarray,  # [slots, h, hd] — ONE query token per slot
+    k_pool: jnp.ndarray,  # [num_blocks, bs, kvh, hd]
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,  # [slots, blocks_per_slot] int32
+    lengths: jnp.ndarray,  # [slots] int32 — valid tokens (incl. current)
+) -> jnp.ndarray:
+    """Single-token decode attention against the paged cache.
+
+    GQA: query heads ``h`` fold onto ``kvh`` cache heads by repetition
+    (same as the dense path's ``_cached_attention``). Positions at or
+    beyond ``lengths[i]`` — unwritten block tails and every unassigned
+    (trash) block — are masked out of slot ``i``'s softmax. Returns
+    ``[slots, h, hd]``.
+    """
+    slots, h, d = q.shape
+    k = gather_kv(k_pool, tables)  # [slots, S, kvh, hd]
+    v = gather_kv(v_pool, tables)
+    n_rep = h // k.shape[2]
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+    logits = (
+        jnp.einsum("shd,sthd->sht", q, k, preferred_element_type=jnp.float32)
+        * d**-0.5
+    )
+    S = k.shape[1]
+    mask = jnp.arange(S)[None, :] < lengths[:, None]  # [slots, S]
+    logits = jnp.where(mask[:, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("sht,sthd->shd", probs, v)
+
+
+def append_kv(
+    pool: jnp.ndarray,  # [num_blocks, bs, kvh, hd]
+    tables: jnp.ndarray,  # [slots, blocks_per_slot]
+    positions: jnp.ndarray,  # [slots] — logical position being written
+    new: jnp.ndarray,  # [slots, kvh, hd]
+) -> jnp.ndarray:
+    """Scatter one new K (or V) token per slot into its table position.
+
+    Slots whose table entry for ``positions[i] // block_size`` is the
+    trash block (inactive slots) harmlessly overwrite trash; collisions
+    there don't matter because nothing masked-in ever reads it.
+    """
+    slots = tables.shape[0]
+    bs = pool.shape[1]
+    block_ids = tables[jnp.arange(slots), positions // bs]  # [slots]
+    offsets = positions % bs
+    return pool.at[block_ids, offsets].set(new, mode="drop")
+
+
+def write_prefill(
+    pool: jnp.ndarray,  # [num_blocks, bs, kvh, hd]
+    block_ids: jnp.ndarray,  # [n_bucket_blocks] physical ids (trash-padded)
+    kv: jnp.ndarray,  # [t_bucket, kvh, hd] — t_bucket = n_bucket_blocks * bs
+) -> jnp.ndarray:
+    """Bulk-write a prefilled prompt's K (or V) rows into assigned blocks.
+
+    ``kv`` covers the whole prefill bucket; rows past the true prompt
+    length are garbage from padding and land either in the slot's own
+    final block past its valid length (masked) or — for fully-unused
+    bucket blocks — in the trash block.
+    """
+    nb = block_ids.shape[0]
+    bs = pool.shape[1]
+    chunks = kv.reshape(nb, bs, *kv.shape[1:])
+    return pool.at[block_ids].set(chunks, mode="drop")

@@ -1,0 +1,19 @@
+"""Production serving runtime: continuous batching over a paged KV cache.
+
+Three layers, bottom-up:
+
+* :mod:`torchx_tpu.serve.kv_pool` — host-side paged KV-cache planning and
+  block allocation (the device-side gather/scatter lives in
+  :mod:`torchx_tpu.ops.paged_attention`);
+* :mod:`torchx_tpu.serve.engine` — the continuous-batching decode engine:
+  a fixed slot array XLA compiles once, per-step admission and eviction,
+  bucketed prefill interleaved with decode;
+* :mod:`torchx_tpu.serve.pool` — the launcher-driven serve pool:
+  ``tpx serve-pool`` submits N ``generate_server`` replicas through the
+  Runner, routes requests least-loaded, and autoscales via
+  ``Runner.resize`` on queue-depth/p99 targets.
+"""
+
+from torchx_tpu.serve.kv_pool import BlockAllocator, PoolPlan, plan_pool
+
+__all__ = ["BlockAllocator", "PoolPlan", "plan_pool"]

@@ -1,0 +1,586 @@
+"""Continuous-batching decode engine over a paged KV cache.
+
+The batch-to-completion server (`apps/generate_server.py`'s coalescing
+batcher) decodes every admitted batch to its full ``max_new_tokens``
+before the next batch starts: a request arriving mid-decode waits out the
+whole window, and slots whose sequences finish early idle until the
+stragglers do. Decode on TPU is HBM-bandwidth-bound, so throughput is
+(occupied slots) x (step rate) — idle slots are thrown-away bandwidth.
+
+This engine keeps a **fixed slot array** decoding continuously:
+
+* one jitted :func:`torchx_tpu.models.generate.paged_decode_step` per
+  engine — static ``[max_slots]`` shapes, XLA compiles once regardless of
+  which requests occupy the slots;
+* **admission** between steps: waiting requests are prefilled in
+  width-bucketed groups (a handful of compiles total) and dropped into
+  free slots, with KV blocks allocated from the shared paged pool
+  (:mod:`torchx_tpu.serve.kv_pool`);
+* **eviction** per step: a slot that hits EOS or its token budget
+  completes immediately — its caller unblocks, its blocks return to the
+  pool, and the slot is free for the next admission *that same step*;
+* **preemption** under pool pressure: if a mid-decode slot can't get its
+  next block, the youngest slot is evicted back to the wait queue (its
+  finished tokens kept; decode resumes exactly — sampling keys are a pure
+  function of (seed, position)).
+
+Requests carry per-sequence temperature, seed, and EOS, so unrelated
+requests share every device step. The engine emits ``serve.*`` spans /
+heartbeats and ``tpx_serve_*`` metrics through the obs registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import math
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchx_tpu.models import generate as gen
+from torchx_tpu.models import llama
+from torchx_tpu.obs import metrics as obs_metrics
+from torchx_tpu.obs import trace as obs_trace
+from torchx_tpu.ops.paged_attention import TRASH_BLOCK
+from torchx_tpu.serve.kv_pool import BlockAllocator, PoolPlan, SlotTables
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ServeRequest", "ServeEngine", "EngineStopped"]
+
+
+class EngineStopped(RuntimeError):
+    """Raised by :meth:`ServeEngine.submit` once the engine is draining or
+    stopped — the SIGTERM drain path returns 503s off this."""
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One generation request moving through the engine.
+
+    Callers fill the first block and :meth:`wait`; the engine appends to
+    ``generated`` as tokens decode and sets ``done`` at completion.
+    Timing: ``ttft_s`` is enqueue -> first token, ``tpot_s`` the mean gap
+    between subsequent tokens — the two serving-latency axes.
+    """
+
+    prompt: Sequence[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+    eos_id: Optional[int] = None
+
+    generated: list[int] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    t_enqueue: float = 0.0
+    t_first: float = 0.0
+    t_done: float = 0.0
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request completes (True) or ``timeout`` (False)."""
+        return self.done.wait(timeout)
+
+    @property
+    def tokens(self) -> list[int]:
+        """prompt + generated, the full sequence."""
+        return list(self.prompt) + self.generated
+
+    @property
+    def ttft_s(self) -> float:
+        """Seconds from enqueue to first generated token."""
+        return max(0.0, self.t_first - self.t_enqueue)
+
+    @property
+    def tpot_s(self) -> float:
+        """Mean seconds per generated token after the first."""
+        n = len(self.generated)
+        if n <= 1:
+            return 0.0
+        return max(0.0, self.t_done - self.t_first) / (n - 1)
+
+
+@dataclasses.dataclass
+class _SlotState:
+    req: ServeRequest
+    cache_len: int  # tokens currently in the KV cache for this sequence
+    last_tok: int  # most recent sampled token (next step's input)
+    admit_seq: int  # admission order; highest = youngest = preemption victim
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+def _fold_keys(seeds: jnp.ndarray, sample_pos: jnp.ndarray) -> jnp.ndarray:
+    # per-row sampling key = f(seed, position of the last token read):
+    # pure, so decode resumed after preemption draws the same tokens
+    base = jax.vmap(jax.random.PRNGKey)(seeds)
+    return jax.vmap(jax.random.fold_in)(base, sample_pos)
+
+
+class ServeEngine:
+    """The continuous-batching serving engine (see module docstring).
+
+    ``max_slots``/``block_size``/``num_blocks`` fix the compiled geometry;
+    pass a :class:`~torchx_tpu.serve.kv_pool.PoolPlan` (from
+    :func:`~torchx_tpu.serve.kv_pool.plan_pool`) via :meth:`from_plan` to
+    size them against real HBM. The default ``num_blocks`` gives every
+    slot a half-``max_seq`` budget — mild oversubscription; the preemption
+    path covers the tail.
+    """
+
+    def __init__(
+        self,
+        params: llama.Params,
+        cfg: llama.LlamaConfig,
+        *,
+        max_slots: int = 8,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        max_prefill_batch: int = 4,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if block_size & (block_size - 1):
+            raise ValueError(f"block_size must be a power of 2, got {block_size}")
+        self._params = params
+        self._cfg = cfg
+        self.max_slots = max_slots
+        self.block_size = block_size
+        self.blocks_per_slot = math.ceil(cfg.max_seq / block_size)
+        if num_blocks is None:
+            num_blocks = 1 + max_slots * max(1, self.blocks_per_slot // 2)
+        if num_blocks < self.blocks_per_slot + 1:
+            raise ValueError(
+                f"num_blocks={num_blocks} cannot hold one max_seq sequence"
+                f" ({self.blocks_per_slot} blocks + trash)"
+            )
+        self.num_blocks = num_blocks
+        self.max_prefill_batch = max(1, max_prefill_batch)
+        self._clock = clock
+
+        self.pools = gen.init_kv_pools(cfg, num_blocks, block_size)
+        self.alloc = BlockAllocator(num_blocks)
+        self.tables = SlotTables(max_slots, self.blocks_per_slot)
+        self._slots: list[Optional[_SlotState]] = [None] * max_slots
+        self._admit_counter = itertools.count()
+
+        self._lock = threading.Lock()
+        self._waiting: deque[ServeRequest] = deque()
+        self._prefilling = 0  # popped from _waiting, not yet slotted/done
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._draining = False
+        self._thread: Optional[threading.Thread] = None
+        self.requests_done = 0
+        self.tokens_out = 0
+        self.steps = 0
+        self._steps_since_beat = 0
+
+        # one compiled decode step for the engine's lifetime; donation lets
+        # XLA update the pools in place (no-op on CPU, where jax warns —
+        # so only donate off-CPU)
+        donate = (3,) if jax.default_backend() != "cpu" else ()
+        params_c, cfg_c = self._params, self._cfg
+
+        def _decode(tokens, positions, tables, pools, seeds, temps):  # noqa: ANN001
+            keys = _fold_keys(seeds, positions)
+            return gen.paged_decode_step(
+                params_c, tokens, positions, tables, pools, cfg_c, keys, temps
+            )
+
+        self._decode = jax.jit(_decode, donate_argnums=donate)
+        self._prefill_fns: dict[tuple[int, int], Callable] = {}
+
+    @classmethod
+    def from_plan(
+        cls,
+        params: llama.Params,
+        cfg: llama.LlamaConfig,
+        plan: PoolPlan,
+        **kwargs,
+    ) -> "ServeEngine":
+        """Build an engine with the geometry a :func:`plan_pool` sizing
+        chose for the HBM budget."""
+        return cls(
+            params,
+            cfg,
+            max_slots=plan.max_slots,
+            block_size=plan.block_size,
+            num_blocks=plan.num_blocks,
+            **kwargs,
+        )
+
+    # -- public API --------------------------------------------------------
+
+    def start(self) -> "ServeEngine":
+        """Spawn the engine loop thread (idempotent)."""
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="serve-engine", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def submit(self, req: ServeRequest) -> ServeRequest:
+        """Enqueue a request for admission; raises :class:`EngineStopped`
+        when draining/stopped, ValueError when it can never fit."""
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self._cfg.max_seq:
+            raise ValueError(
+                f"prompt + new tokens ({total}) exceeds max_seq"
+                f" {self._cfg.max_seq}"
+            )
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        with self._lock:
+            if self._draining or self._stop.is_set():
+                raise EngineStopped("engine is draining; not admitting requests")
+            req.t_enqueue = self._clock()
+            self._waiting.append(req)
+            obs_metrics.SERVE_QUEUE_DEPTH.set(len(self._waiting))
+        self._work.set()
+        return req
+
+    def generate(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        seed: int = 0,
+        eos_id: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> ServeRequest:
+        """Submit and block until done — the one-call convenience path."""
+        req = ServeRequest(
+            prompt=list(prompt),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            seed=seed,
+            eos_id=eos_id,
+        )
+        self.submit(req)
+        if not req.wait(timeout):
+            raise TimeoutError(f"generation did not finish in {timeout}s")
+        if req.error:
+            raise RuntimeError(req.error)
+        return req
+
+    def stats(self) -> dict:
+        """Engine occupancy/queue snapshot (feeds ``/healthz`` and the
+        serve pool's load probe)."""
+        with self._lock:
+            active = sum(1 for s in self._slots if s is not None)
+            return {
+                "active_slots": active,
+                "max_slots": self.max_slots,
+                "occupancy": active / self.max_slots,
+                "queue_depth": len(self._waiting),
+                "kv_blocks_used": self.alloc.used_blocks,
+                "kv_blocks_free": self.alloc.free_blocks,
+                "requests_done": self.requests_done,
+                "tokens_out": self.tokens_out,
+                "steps": self.steps,
+                "draining": self._draining,
+            }
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot (the autoscaler's primary signal)."""
+        with self._lock:
+            return len(self._waiting)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting, finish everything in flight, return True when
+        empty (False on timeout). The SIGTERM grace path."""
+        with self._lock:
+            self._draining = True
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                empty = (
+                    not self._waiting
+                    and self._prefilling == 0
+                    and all(s is None for s in self._slots)
+                )
+            if empty:
+                return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.005)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Kill the loop thread; in-flight requests get ``error`` set."""
+        self._stop.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        self._fail_all("engine stopped")
+
+    # -- engine loop -------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                worked = self._admit()
+                worked = self._decode_once() or worked
+            except Exception as e:  # noqa: BLE001 — a step bug must not hang callers
+                logger.exception("serve engine step failed")
+                self._fail_all(f"engine step failed: {e}")
+                return
+            if not worked:
+                self._work.wait(0.002)
+                self._work.clear()
+
+    def _fail_all(self, msg: str) -> None:
+        with self._lock:
+            pending = list(self._waiting)
+            self._waiting.clear()
+            self._prefilling = 0
+        for i, st in enumerate(self._slots):
+            if st is not None:
+                self._slots[i] = None
+                pending.append(st.req)
+        for req in pending:
+            if not req.done.is_set():
+                req.error = msg
+                req.t_done = self._clock()
+                req.done.set()
+                obs_metrics.SERVE_REQUESTS.inc(status="error")
+
+    # -- admission / prefill ----------------------------------------------
+
+    def _prefill_fn(self, rows: int, width: int) -> Callable:
+        fn = self._prefill_fns.get((rows, width))
+        if fn is None:
+            donate = (3,) if jax.default_backend() != "cpu" else ()
+            params_c, cfg_c = self._params, self._cfg
+
+            def _prefill(prompts, true_lens, block_ids, pools, seeds, temps):  # noqa: ANN001
+                keys = _fold_keys(seeds, true_lens - 1)
+                return gen.paged_prefill(
+                    params_c, prompts, true_lens, block_ids, pools, cfg_c, keys, temps
+                )
+
+            fn = jax.jit(_prefill, donate_argnums=donate)
+            self._prefill_fns[(rows, width)] = fn
+        return fn
+
+    def _bucket_width(self, plen: int) -> int:
+        return min(
+            max(self.block_size, _next_pow2(plen)),
+            _next_pow2(self._cfg.max_seq),
+        )
+
+    def _admit(self) -> bool:
+        free_slots = [i for i, s in enumerate(self._slots) if s is None]
+        if not free_slots:
+            return False
+        with self._lock:
+            if not self._waiting:
+                return False
+            head = self._waiting[0]
+            width = self._bucket_width(len(head.prompt) + len(head.generated))
+            group: list[ServeRequest] = []
+            limit = min(len(free_slots), self.max_prefill_batch)
+            for req in list(self._waiting):
+                if len(group) >= limit:
+                    break
+                plen = len(req.prompt) + len(req.generated)
+                if self._bucket_width(plen) != width:
+                    continue
+                group.append(req)
+            # blocks to hold each prompt now (+1-token headroom comes
+            # lazily during decode)
+            admitted: list[tuple[ServeRequest, list[int]]] = []
+            for req in group:
+                plen = len(req.prompt) + len(req.generated)
+                blocks = self.alloc.alloc(math.ceil(plen / self.block_size))
+                if blocks is None:
+                    break  # pool pressure: admit what fits, retry later
+                admitted.append((req, blocks))
+            for req, _ in admitted:
+                self._waiting.remove(req)
+            # visible to drain(): popped but not yet in a slot/completed
+            self._prefilling += len(admitted)
+            obs_metrics.SERVE_QUEUE_DEPTH.set(len(self._waiting))
+        if not admitted:
+            return False
+
+        rows = _next_pow2(len(admitted))
+        nb_bucket = width // self.block_size
+        prompts = np.zeros((rows, width), np.int32)
+        true_lens = np.ones((rows,), np.int32)
+        block_ids = np.full((rows, nb_bucket), TRASH_BLOCK, np.int32)
+        seeds = np.zeros((rows,), np.int32)
+        temps = np.zeros((rows,), np.float32)
+        for r, (req, blocks) in enumerate(admitted):
+            toks = list(req.prompt) + req.generated
+            prompts[r, : len(toks)] = toks
+            true_lens[r] = len(toks)
+            block_ids[r, : len(blocks)] = blocks
+            seeds[r] = np.int32(np.uint32(req.seed & 0xFFFFFFFF))
+            temps[r] = req.temperature
+
+        with obs_trace.span("serve.prefill", rows=len(admitted), width=width):
+            fn = self._prefill_fn(rows, width)
+            first, self.pools = fn(
+                jnp.asarray(prompts),
+                jnp.asarray(true_lens),
+                jnp.asarray(block_ids),
+                self.pools,
+                jnp.asarray(seeds),
+                jnp.asarray(temps),
+            )
+            first = np.asarray(first)
+
+        now = self._clock()
+        for r, (req, blocks) in enumerate(admitted):
+            resumed = bool(req.generated)  # preempted earlier; TTFT already set
+            tok = int(first[r])
+            req.generated.append(tok)
+            if not resumed:
+                req.t_first = now
+                obs_metrics.SERVE_TTFT_SECONDS.observe(req.ttft_s)
+            obs_metrics.SERVE_TOKENS.inc(phase="prefill")
+            self.tokens_out += 1
+            if self._finished(req, tok):
+                self.alloc.free(blocks)
+                self._complete(req, now)
+                continue
+            slot = free_slots.pop(0)
+            self.tables.assign(slot, blocks)
+            self.tables.lengths[slot] = true_lens[r]
+            self._slots[slot] = _SlotState(
+                req=req,
+                cache_len=int(true_lens[r]),
+                last_tok=tok,
+                admit_seq=next(self._admit_counter),
+            )
+        with self._lock:
+            self._prefilling -= len(admitted)
+        self._update_gauges()
+        return True
+
+    # -- decode ------------------------------------------------------------
+
+    def _finished(self, req: ServeRequest, tok: int) -> bool:
+        return len(req.generated) >= req.max_new_tokens or (
+            req.eos_id is not None and tok == req.eos_id
+        )
+
+    def _complete(self, req: ServeRequest, now: float) -> None:
+        req.t_done = now
+        req.done.set()
+        self.requests_done += 1
+        obs_metrics.SERVE_REQUESTS.inc(status="ok")
+        if len(req.generated) > 1:
+            obs_metrics.SERVE_TPOT_SECONDS.observe(req.tpot_s)
+
+    def _preempt_youngest(self) -> bool:
+        victims = [
+            (st.admit_seq, i) for i, st in enumerate(self._slots) if st is not None
+        ]
+        if not victims:
+            return False
+        _, slot = max(victims)
+        st = self._slots[slot]
+        self._slots[slot] = None
+        self.alloc.free(self.tables.release(slot))
+        with self._lock:
+            self._waiting.appendleft(st.req)  # resumes via re-prefill
+            obs_metrics.SERVE_QUEUE_DEPTH.set(len(self._waiting))
+        obs_metrics.SERVE_PREEMPTIONS.inc()
+        return True
+
+    def _ensure_capacity(self, slot: int, write_pos: int) -> bool:
+        """Make sure ``slot`` holds a block for ``write_pos``; preempts the
+        youngest slot under pool pressure. False if ``slot`` itself was
+        preempted away."""
+        while True:
+            need = write_pos // self.block_size + 1
+            have = len(self.tables.blocks_of(slot))
+            if have >= need:
+                return True
+            blocks = self.alloc.alloc(need - have)
+            if blocks is not None:
+                self.tables.assign(slot, blocks)
+                return True
+            self._preempt_youngest()
+            if self._slots[slot] is None:
+                return False  # preempted ourselves: nothing else to evict
+
+    def _decode_once(self) -> bool:
+        active = [(i, st) for i, st in enumerate(self._slots) if st is not None]
+        if not active:
+            return False
+        for slot, st in active:
+            if self._slots[slot] is None:
+                continue  # preempted by an earlier slot's capacity grab
+            self._ensure_capacity(slot, st.cache_len)
+
+        tokens = np.zeros((self.max_slots,), np.int32)
+        positions = np.zeros((self.max_slots,), np.int32)
+        seeds = np.zeros((self.max_slots,), np.int32)
+        temps = np.zeros((self.max_slots,), np.float32)
+        stepping: list[tuple[int, _SlotState]] = []
+        for slot, st in enumerate(self._slots):
+            if st is None:
+                continue
+            tokens[slot] = st.last_tok
+            positions[slot] = st.cache_len
+            seeds[slot] = np.int32(np.uint32(st.req.seed & 0xFFFFFFFF))
+            temps[slot] = st.req.temperature
+            stepping.append((slot, st))
+        if not stepping:
+            return False
+
+        nxt, self.pools = self._decode(
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(self.tables.tables),
+            self.pools,
+            jnp.asarray(seeds),
+            jnp.asarray(temps),
+        )
+        nxt = np.asarray(nxt)
+        self.steps += 1
+
+        now = self._clock()
+        for slot, st in stepping:
+            st.cache_len += 1
+            self.tables.lengths[slot] = st.cache_len
+            tok = int(nxt[slot])
+            st.last_tok = tok
+            st.req.generated.append(tok)
+            self.tokens_out += 1
+            obs_metrics.SERVE_TOKENS.inc(phase="decode")
+            if self._finished(st.req, tok):
+                self._slots[slot] = None
+                self.alloc.free(self.tables.release(slot))
+                self._complete(st.req, now)
+        self._update_gauges()
+        self._steps_since_beat += 1
+        if self._steps_since_beat >= 64:
+            self._steps_since_beat = 0
+            obs_trace.heartbeat(
+                "serve.window",
+                steps=self.steps,
+                tokens=self.tokens_out,
+                requests=self.requests_done,
+            )
+        return True
+
+    def _update_gauges(self) -> None:
+        active = sum(1 for s in self._slots if s is not None)
+        obs_metrics.SERVE_SLOTS_ACTIVE.set(active)
+        obs_metrics.SERVE_OCCUPANCY.set(active / self.max_slots)
+        obs_metrics.SERVE_KV_BLOCKS_USED.set(self.alloc.used_blocks)

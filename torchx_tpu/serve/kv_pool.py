@@ -1,0 +1,229 @@
+"""Paged KV-cache planning and block allocation (host side).
+
+The dense decode path costs ``L * 2 * max_seq * kvh * hd`` bytes per
+sequence regardless of how many tokens the request actually produces, so
+concurrency is bounded by worst-case ``max_seq``. Here KV memory is one
+fixed pool of ``num_blocks`` blocks of ``block_size`` tokens shared by
+every active slot; a slot holds only the blocks its tokens occupy, so the
+same HBM budget admits far more concurrent sequences (vLLM's central
+observation, applied to the TPU serving path).
+
+Nothing here runs on device: :func:`plan_pool` does the analytic HBM
+sizing — same style as ``parallel/aot_fit.model_state_bytes_per_device``,
+whose budget constants it reuses — and :class:`BlockAllocator` +
+:class:`SlotTables` manage physical blocks and per-slot block tables as
+plain numpy, feeding the jitted step functions in
+:mod:`torchx_tpu.serve.engine` as ordinary array arguments.
+
+Block 0 is the *trash block* (``ops.paged_attention.TRASH_BLOCK``): never
+allocated, the target of every unassigned table entry, so inactive slots
+in the fixed-shape step harmlessly read/write it under the length mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import deque
+
+import numpy as np
+
+from torchx_tpu.ops.paged_attention import TRASH_BLOCK
+from torchx_tpu.parallel.aot_fit import DEFAULT_HEADROOM, GIB, V5P_HBM_BYTES
+
+__all__ = [
+    "PoolPlan",
+    "plan_pool",
+    "BlockAllocator",
+    "SlotTables",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolPlan:
+    """Resolved geometry of a paged KV pool for one model config.
+
+    ``kv_budget_bytes`` is HBM after headroom and parameters;
+    ``dense_slots`` is how many sequences the *dense* ``[max_seq]`` cache
+    would fit in the same budget — the bench's occupancy comparison.
+    """
+
+    num_blocks: int
+    block_size: int
+    blocks_per_slot: int
+    max_slots: int
+    kv_bytes: int
+    kv_budget_bytes: int
+    dense_slots: int
+
+    @property
+    def pool_tokens(self) -> int:
+        """Total KV token capacity (excluding the trash block)."""
+        return (self.num_blocks - 1) * self.block_size
+
+    def occupancy_report(self) -> dict:
+        """Paged-vs-dense concurrency at the same HBM budget, as a dict
+        (serialised into the serving bench's JSON output)."""
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "blocks_per_slot": self.blocks_per_slot,
+            "paged_slots": self.max_slots,
+            "dense_slots": self.dense_slots,
+            "kv_budget_gib": round(self.kv_budget_bytes / GIB, 3),
+            "kv_bytes_gib": round(self.kv_bytes / GIB, 3),
+            "pool_tokens": self.pool_tokens,
+        }
+
+
+def _kv_itemsize(cfg) -> int:
+    # serving caches are stored in the model compute dtype; np.dtype
+    # resolves jnp dtypes too (ml_dtypes registers bfloat16)
+    return np.dtype(cfg.dtype).itemsize
+
+
+def plan_pool(
+    cfg,
+    *,
+    hbm_bytes: int = V5P_HBM_BYTES,
+    headroom: float = DEFAULT_HEADROOM,
+    block_size: int = 16,
+    max_slots: int | None = None,
+    mean_tokens_per_seq: int | None = None,
+) -> PoolPlan:
+    """Size a paged KV pool against an HBM budget for ``cfg``.
+
+    Budget = ``hbm_bytes * headroom`` minus parameter bytes (serving holds
+    no optimizer state, so params are ``param_count * itemsize`` — compare
+    ``aot_fit.model_state_bytes_per_device`` which charges 3x for Adam).
+    ``num_blocks`` fills the remainder; ``max_slots`` (the engine's fixed
+    slot-array size) defaults to oversubscribing the pool assuming
+    sequences average ``mean_tokens_per_seq`` tokens (default
+    ``max_seq / 4`` — serving traffic rarely decodes to the cap), capped
+    so a single full-length sequence always fits.
+    """
+    itemsize = _kv_itemsize(cfg)
+    param_bytes = cfg.param_count() * itemsize
+    budget = int(hbm_bytes * headroom) - param_bytes
+    if budget <= 0:
+        raise ValueError(
+            f"params ({param_bytes / GIB:.1f} GiB) exceed HBM budget "
+            f"({hbm_bytes * headroom / GIB:.1f} GiB); no room for KV pool"
+        )
+    # one block, all layers, K and V
+    block_bytes = cfg.n_layers * 2 * block_size * cfg.n_kv_heads * cfg.head_dim * itemsize
+    num_blocks = budget // block_bytes
+    blocks_per_slot = math.ceil(cfg.max_seq / block_size)
+    if num_blocks < blocks_per_slot + 1:  # +1: trash block
+        raise ValueError(
+            f"KV budget ({budget / GIB:.2f} GiB) fits only {num_blocks} "
+            f"blocks; one {cfg.max_seq}-token sequence needs "
+            f"{blocks_per_slot}"
+        )
+    dense_seq_bytes = (
+        cfg.n_layers * 2 * cfg.max_seq * cfg.n_kv_heads * cfg.head_dim * itemsize
+    )
+    dense_slots = budget // dense_seq_bytes
+    if max_slots is None:
+        mean_tokens = mean_tokens_per_seq or max(block_size, cfg.max_seq // 4)
+        mean_blocks = math.ceil(mean_tokens / block_size)
+        max_slots = max(1, (num_blocks - 1) // mean_blocks)
+    kv_bytes = num_blocks * block_bytes
+    return PoolPlan(
+        num_blocks=int(num_blocks),
+        block_size=block_size,
+        blocks_per_slot=blocks_per_slot,
+        max_slots=int(max_slots),
+        kv_bytes=int(kv_bytes),
+        kv_budget_bytes=int(budget),
+        dense_slots=int(dense_slots),
+    )
+
+
+class BlockAllocator:
+    """Free-list allocator over the physical blocks of one KV pool.
+
+    Allocation is all-or-nothing: :meth:`alloc` returns ``None`` rather
+    than a partial grant, so the engine can atomically decide to admit,
+    wait, or preempt. Block ``TRASH_BLOCK`` is never handed out.
+    """
+
+    def __init__(self, num_blocks: int) -> None:
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (1 is trash), got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free: deque[int] = deque(
+            b for b in range(num_blocks) if b != TRASH_BLOCK
+        )
+
+    @property
+    def free_blocks(self) -> int:
+        """Blocks currently available to allocate."""
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        """Blocks currently held by slots (excludes the trash block)."""
+        return self.num_blocks - 1 - len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Take ``n`` blocks, or ``None`` (and take nothing) if fewer are
+        free."""
+        if n < 0:
+            raise ValueError(f"negative allocation: {n}")
+        if n > len(self._free):
+            return None
+        return [self._free.popleft() for _ in range(n)]
+
+    def free(self, blocks: list[int]) -> None:
+        """Return previously-allocated blocks to the free list."""
+        for b in blocks:
+            if b == TRASH_BLOCK:
+                raise ValueError("freeing the trash block")
+            self._free.append(b)
+
+
+class SlotTables:
+    """Per-slot block tables + valid lengths, host side (numpy).
+
+    The engine passes :attr:`tables` / :attr:`lengths` into the jitted
+    decode step every iteration; unassigned entries stay ``TRASH_BLOCK``
+    so inactive slots are inert under the mask. One instance is shared by
+    all layers — every layer of a sequence uses the same physical block
+    ids into its own layer-indexed pool.
+    """
+
+    def __init__(self, max_slots: int, blocks_per_slot: int) -> None:
+        self.max_slots = max_slots
+        self.blocks_per_slot = blocks_per_slot
+        self.tables = np.full((max_slots, blocks_per_slot), TRASH_BLOCK, np.int32)
+        self.lengths = np.zeros((max_slots,), np.int32)
+        self._blocks: list[list[int]] = [[] for _ in range(max_slots)]
+
+    def assign(self, slot: int, blocks: list[int]) -> None:
+        """Append physical ``blocks`` to ``slot``'s table."""
+        held = self._blocks[slot]
+        if len(held) + len(blocks) > self.blocks_per_slot:
+            raise ValueError(
+                f"slot {slot}: {len(held)}+{len(blocks)} blocks exceeds "
+                f"blocks_per_slot={self.blocks_per_slot}"
+            )
+        self.tables[slot, len(held) : len(held) + len(blocks)] = blocks
+        held.extend(blocks)
+
+    def blocks_of(self, slot: int) -> list[int]:
+        """Physical blocks currently held by ``slot``."""
+        return list(self._blocks[slot])
+
+    def token_capacity(self, slot: int, block_size: int) -> int:
+        """Token capacity of ``slot``'s currently-assigned blocks."""
+        return len(self._blocks[slot]) * block_size
+
+    def release(self, slot: int) -> list[int]:
+        """Clear ``slot`` back to trash and return its blocks for
+        :meth:`BlockAllocator.free`."""
+        blocks = self._blocks[slot]
+        self._blocks[slot] = []
+        self.tables[slot, :] = TRASH_BLOCK
+        self.lengths[slot] = 0
+        return blocks
