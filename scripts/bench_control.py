@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Control-plane benchmark: daemon+watch vs per-caller direct polling.
+
+The fleet-scale question: with K concurrent jobs and K waiters, how many
+backend control-plane calls does "everyone polls for themselves" cost
+versus "everyone asks the ``tpx control`` daemon, which owns ONE watch
+stream per backend"?
+
+Two phases over the same workload (K local ``sleep`` jobs, one poller
+per job at a fixed interval):
+
+* **direct** — the pre-daemon world: each waiter drives its own
+  ``Runner.status(fresh=True)`` poll loop (what K independent CLIs do),
+  so every poll is a real backend describe.
+* **daemon** — the same client behavior pointed at a ControlDaemon:
+  every poll is an HTTP ``/v1/status``; the daemon's reconciler rides
+  the local scheduler's sidecar watch stream and its shared describe
+  cache answers the polls, so backend describes collapse to roughly one
+  confirm per state transition (plus TTL refreshes of live entries).
+
+Reported per phase: control-plane ops/sec (client-visible status calls),
+status-latency p50/p99, the backend describe-call count over the phase
+(``tpx_control_plane_calls_total{backend=local,op=describe}`` delta),
+and describes-per-job — the *scheduler-call amplification*. The headline
+number is ``amplification_reduction`` = direct describes / daemon
+describes, which must be > 1 at fleet width.
+
+Usage:
+    python scripts/bench_control.py [--jobs 32] [--job-seconds 3]
+        [--poll-interval 0.25] [--out BENCH_CONTROL_r01.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import tempfile
+import threading
+import time
+
+
+def _quantiles(samples: list[float]) -> dict:
+    if not samples:
+        return {"p50_ms": None, "p99_ms": None}
+    qs = statistics.quantiles(samples, n=100, method="inclusive")
+    return {
+        "p50_ms": round(qs[49] * 1000, 3),
+        "p99_ms": round(qs[98] * 1000, 3),
+    }
+
+
+def _describe_calls() -> float:
+    """Backend describes issued so far (all outcome labels)."""
+    from torchx_tpu.obs import metrics as obs_metrics
+
+    return sum(
+        obs_metrics.CONTROL_PLANE_CALLS.value(
+            backend="local", op="describe", status=status
+        )
+        for status in ("ok", "error", "rejected")
+    )
+
+
+def _watch_events() -> float:
+    from torchx_tpu.obs import metrics as obs_metrics
+
+    return sum(
+        obs_metrics.WATCH_EVENTS.value(scheduler="local", source=source)
+        for source in ("sidecar", "poll", "kubectl", "daemon")
+    )
+
+
+def _submit_jobs(submit, jobs: int, job_seconds: float, root: str) -> list[str]:
+    handles = []
+    for i in range(jobs):
+        handles.append(submit(i, os.path.join(root, f"job{i:03d}")))
+    return handles
+
+
+def _poll_until_terminal(
+    poll, handles: list[str], interval: float
+) -> tuple[list[float], int]:
+    """K waiter threads, each polling its job to terminal. Returns
+    (per-call latencies, total status ops)."""
+    latencies: list[float] = []
+    ops = [0]
+    lock = threading.Lock()
+
+    def wait_one(handle: str) -> None:
+        local: list[float] = []
+        n = 0
+        while True:
+            t0 = time.perf_counter()
+            terminal = poll(handle)
+            local.append(time.perf_counter() - t0)
+            n += 1
+            if terminal:
+                break
+            time.sleep(interval)
+        with lock:
+            latencies.extend(local)
+            ops[0] += n
+
+    threads = [
+        threading.Thread(target=wait_one, args=(h,), daemon=True)
+        for h in handles
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    return latencies, ops[0]
+
+
+def bench_direct(jobs: int, job_seconds: float, interval: float, root: str) -> dict:
+    """Phase A: every waiter runs its own fresh-describe poll loop."""
+    from torchx_tpu.runner.api import get_runner
+
+    with get_runner("bench-direct") as runner:
+        def submit(i: int, log_dir: str) -> str:
+            return runner.run_component(
+                "utils.sh",
+                ["sleep", str(job_seconds)],
+                "local",
+                {"log_dir": log_dir},
+            )
+
+        def poll(handle: str) -> bool:
+            status = runner.status(handle, fresh=True)
+            return status is None or status.is_terminal()
+
+        calls0 = _describe_calls()
+        t0 = time.perf_counter()
+        handles = _submit_jobs(submit, jobs, job_seconds, root)
+        latencies, ops = _poll_until_terminal(poll, handles, interval)
+        wall = time.perf_counter() - t0
+        describes = _describe_calls() - calls0
+    return {
+        "mode": "direct",
+        "wall_s": round(wall, 3),
+        "status_ops": ops,
+        "ops_per_sec": round(ops / wall, 2),
+        "status_latency": _quantiles(latencies),
+        "scheduler_describe_calls": int(describes),
+        "describes_per_job": round(describes / jobs, 2),
+    }
+
+
+def bench_daemon(jobs: int, job_seconds: float, interval: float, root: str) -> dict:
+    """Phase B: the same pollers, through the control daemon."""
+    from torchx_tpu.control.client import ControlClient
+    from torchx_tpu.control.daemon import ControlDaemon
+    from torchx_tpu.runner.api import get_runner
+
+    runner = get_runner("bench-daemon")
+    daemon = ControlDaemon(
+        runner=runner, state_dir=os.path.join(root, "control")
+    ).start()
+    try:
+        client = ControlClient(daemon.addr, daemon.root_token)
+
+        def submit(i: int, log_dir: str) -> str:
+            return client.submit(
+                "utils.sh",
+                ["sleep", str(job_seconds)],
+                "local",
+                cfg={"log_dir": log_dir},
+            )
+
+        def poll(handle: str) -> bool:
+            return bool(client.status(handle)["terminal"])
+
+        calls0 = _describe_calls()
+        events0 = _watch_events()
+        t0 = time.perf_counter()
+        handles = _submit_jobs(submit, jobs, job_seconds, root)
+        latencies, ops = _poll_until_terminal(poll, handles, interval)
+        wall = time.perf_counter() - t0
+        describes = _describe_calls() - calls0
+        events = _watch_events() - events0
+    finally:
+        daemon.close()
+        runner.close()
+    return {
+        "mode": "daemon",
+        "wall_s": round(wall, 3),
+        "status_ops": ops,
+        "ops_per_sec": round(ops / wall, 2),
+        "status_latency": _quantiles(latencies),
+        "scheduler_describe_calls": int(describes),
+        "describes_per_job": round(describes / jobs, 2),
+        "watch_events": int(events),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--jobs", type=int, default=32)
+    parser.add_argument("--job-seconds", type=float, default=3.0)
+    parser.add_argument("--poll-interval", type=float, default=0.25)
+    parser.add_argument("--out", default=None, help="write results JSON here")
+    args = parser.parse_args()
+
+    root = tempfile.mkdtemp(prefix="tpx-bench-control-")
+    os.environ.setdefault("TPX_OBS_DIR", os.path.join(root, "obs"))
+    os.environ.setdefault("TPX_EVENT_DESTINATION", "null")
+    os.environ.setdefault("TPX_WATCH_INTERVAL", str(args.poll_interval))
+
+    print(
+        f"bench_control: {args.jobs} jobs x {args.job_seconds}s,"
+        f" poll every {args.poll_interval}s"
+    )
+    direct = bench_direct(args.jobs, args.job_seconds, args.poll_interval, root)
+    print(
+        f"  direct: {direct['scheduler_describe_calls']} backend describes"
+        f" ({direct['describes_per_job']}/job),"
+        f" {direct['ops_per_sec']} status ops/s,"
+        f" p99 {direct['status_latency']['p99_ms']}ms"
+    )
+    daemon = bench_daemon(args.jobs, args.job_seconds, args.poll_interval, root)
+    print(
+        f"  daemon: {daemon['scheduler_describe_calls']} backend describes"
+        f" ({daemon['describes_per_job']}/job),"
+        f" {daemon['ops_per_sec']} status ops/s,"
+        f" p99 {daemon['status_latency']['p99_ms']}ms,"
+        f" {daemon['watch_events']} watch events"
+    )
+    reduction = (
+        direct["scheduler_describe_calls"]
+        / max(1, daemon["scheduler_describe_calls"])
+    )
+    print(f"  scheduler-call amplification reduction: {reduction:.1f}x")
+    result = {
+        "bench": "control_plane",
+        "jobs": args.jobs,
+        "job_seconds": args.job_seconds,
+        "poll_interval_s": args.poll_interval,
+        "direct": direct,
+        "daemon": daemon,
+        "amplification_reduction": round(reduction, 2),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"  wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -165,6 +165,77 @@ EOF
 then echo "GANG_SMOKE=ok"; else echo "GANG_SMOKE=FAILED"; rc=1; fi
 rm -rf "$gang_dir"
 
+# Control smoke: boot the `tpx control` daemon, submit + wait through the
+# proxying CLI (TPX_CONTROL_ADDR), assert the journaled job reached
+# terminal and the daemon's /metricz exports control-plane ops, and keep
+# `tpx --help` jax-free with the control command registered.
+ctl_dir=$(mktemp -d /tmp/tpx_ctl_smoke.XXXXXX)
+if timeout -k 10 180 env JAX_PLATFORMS=cpu TPX_OBS_DIR="$ctl_dir/obs" \
+    TPX_CONTROL_DIR="$ctl_dir/control" TPX_WATCH_INTERVAL=0.1 \
+    python - <<'EOF'
+import json, os, subprocess, sys, time, urllib.request
+
+ctl = os.environ["TPX_CONTROL_DIR"]
+daemon = subprocess.Popen(
+    [sys.executable, "-m", "torchx_tpu.cli.main", "control"],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+)
+try:
+    discovery = os.path.join(ctl, "control.json")
+    deadline = time.monotonic() + 60
+    while not os.path.exists(discovery):
+        assert daemon.poll() is None, daemon.stdout.read()
+        assert time.monotonic() < deadline, "daemon never wrote discovery"
+        time.sleep(0.1)
+    doc = json.load(open(discovery))
+    addr = doc["addr"]
+
+    env = dict(os.environ, TPX_CONTROL_ADDR=addr)
+    tpx = [sys.executable, "-m", "torchx_tpu.cli.main"]
+    r = subprocess.run(
+        tpx + ["run", "-s", "local", "--wait", "utils.echo", "--msg", "ctl-smoke"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    handle = r.stdout.splitlines()[0].strip()
+    assert handle.startswith("local://"), r.stdout
+
+    r = subprocess.run(
+        tpx + ["status", handle], capture_output=True, text=True, env=env,
+        timeout=60,
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    assert "SUCCEEDED" in r.stdout, r.stdout
+
+    with urllib.request.urlopen(f"{addr}/metricz", timeout=10) as resp:
+        metrics = resp.read().decode()
+    assert "tpx_control_requests_total" in metrics, metrics[:2000]
+    assert 'op="submit"' in metrics and 'op="status"' in metrics, metrics[:2000]
+    assert "tpx_watch_events_total" in metrics, metrics[:2000]
+finally:
+    daemon.terminate()
+    daemon.wait(timeout=10)
+
+# the proxying layer must not drag the control (or jax) modules into the
+# help fast path — only the lazy dispatcher's table may know about them
+r = subprocess.run(
+    [sys.executable, "-c", (
+        "import sys\n"
+        "from torchx_tpu.cli.main import main\n"
+        "try: main(['--help'])\n"
+        "except SystemExit: pass\n"
+        "leaked = [m for m in ('jax', 'numpy', 'torchx_tpu.control',"
+        " 'torchx_tpu.cli.cmd_control') if m in sys.modules]\n"
+        "assert not leaked, f'tpx --help imported {leaked}'\n"
+    )],
+    capture_output=True, text=True, timeout=60,
+)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+assert "control" in r.stdout, r.stdout
+EOF
+then echo "CONTROL_SMOKE=ok"; else echo "CONTROL_SMOKE=FAILED"; rc=1; fi
+rm -rf "$ctl_dir"
+
 # Serving smoke: boot generate_server on the tiny config (CPU, continuous
 # engine, ephemeral port), answer /healthz, decode one /v1/generate, and
 # assert the continuous-batching occupancy gauge is exported on /metricz.

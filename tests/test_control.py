@@ -1,0 +1,912 @@
+"""Control-plane tests: state events, the sharded job-state store
+(crash-safety + concurrency), watch-adapter parity across backends, the
+reconciler wake path through ``Runner.wait``, describe-cache coherence,
+the ``tpx control`` daemon (auth, tenancy caps, rehydration), and the
+TPX601 analyze rule."""
+
+import json
+import os
+import threading
+import time
+from typing import Mapping, Optional
+
+import pytest
+
+from torchx_tpu.control.client import ControlClient, ControlClientError
+from torchx_tpu.control.daemon import ControlDaemon
+from torchx_tpu.control.events import StateEvent, event_from_describe
+from torchx_tpu.control.reconciler import Reconciler
+from torchx_tpu.control.store import (
+    EVENTS_FILE,
+    JobStateStore,
+    shard_for,
+)
+from torchx_tpu.control.watch import (
+    KubectlWatcher,
+    LocalSidecarWatcher,
+    PollWatcher,
+    jobset_watch_state,
+)
+from torchx_tpu.runner.api import Runner, get_runner
+from torchx_tpu.runner.describe_cache import DescribeCache
+from torchx_tpu.schedulers.api import DescribeAppResponse, ListAppResponse, Scheduler
+from torchx_tpu.specs.api import (
+    AppDef,
+    AppDryRunInfo,
+    AppState,
+    CfgVal,
+    Role,
+    parse_app_handle,
+    runopts,
+)
+
+
+# ---------------------------------------------------------------------------
+# fixtures and stubs
+# ---------------------------------------------------------------------------
+
+
+class StubScheduler(Scheduler[dict]):
+    """Same shape as the runner tests' stub, plus a describe-call counter
+    so cache-pinning assertions can see exactly when the backend is hit."""
+
+    def __init__(self, session_name: str = "test", backend: str = "stub", **kwargs):
+        super().__init__(backend, session_name)
+        self.apps: dict[str, AppState] = {}
+        self.describe_calls = 0
+        self._counter = 0
+
+    def run_opts(self) -> runopts:
+        return runopts()
+
+    def _submit_dryrun(self, app: AppDef, cfg: Mapping[str, CfgVal]):
+        return AppDryRunInfo({"app": app, "cfg": dict(cfg)})
+
+    def schedule(self, dryrun_info) -> str:
+        self._counter += 1
+        app_id = f"stub_app_{self._counter}"
+        self.apps[app_id] = AppState.RUNNING
+        return app_id
+
+    def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
+        self.describe_calls += 1
+        if app_id not in self.apps:
+            return None
+        return DescribeAppResponse(app_id=app_id, state=self.apps[app_id])
+
+    def _cancel_existing(self, app_id: str) -> None:
+        self.apps[app_id] = AppState.CANCELLED
+
+    def list(self):
+        return [ListAppResponse(app_id=a, state=s) for a, s in self.apps.items()]
+
+
+class NoWatchStubScheduler(StubScheduler):
+    """A backend whose watch cannot start: the reconciler must degrade
+    (tracking is an optimization), and every event in these tests is
+    injected deterministically via ``Reconciler.ingest``."""
+
+    def watch(self, app_ids=(), interval=None):
+        raise RuntimeError("no watch stream here")
+
+
+def simple_app(**role_kwargs) -> AppDef:
+    defaults = dict(name="r", image="i", entrypoint="echo", args=["hi"])
+    defaults.update(role_kwargs)
+    return AppDef(name="app", roles=[Role(**defaults)])
+
+
+def ev(
+    app_id: str,
+    state: AppState,
+    scheduler: str = "stub",
+    with_resp: bool = False,
+) -> StateEvent:
+    resp = (
+        DescribeAppResponse(app_id=app_id, state=state) if with_resp else None
+    )
+    return StateEvent(scheduler=scheduler, app_id=app_id, state=state, resp=resp)
+
+
+# ---------------------------------------------------------------------------
+# StateEvent
+# ---------------------------------------------------------------------------
+
+
+class TestStateEvent:
+    def test_serialize_roundtrip(self):
+        e = ev("a1", AppState.SUCCEEDED, with_resp=True)
+        back = StateEvent.deserialize(json.loads(json.dumps(e.serialize())))
+        assert (back.scheduler, back.app_id, back.state) == (
+            "stub",
+            "a1",
+            AppState.SUCCEEDED,
+        )
+        assert back.terminal and back.resp is None  # resp never journaled
+
+    def test_unknown_state_name_degrades(self):
+        doc = {"scheduler": "s", "app_id": "a", "state": "FROM_THE_FUTURE"}
+        assert StateEvent.deserialize(doc).state == AppState.UNKNOWN
+
+    def test_event_from_none_describe_is_unknown(self):
+        e = event_from_describe("stub", "ghost", None)
+        assert e.state == AppState.UNKNOWN and e.resp is None
+
+
+# ---------------------------------------------------------------------------
+# JobStateStore: sharding, crash safety, concurrency
+# ---------------------------------------------------------------------------
+
+
+class TestJobStateStore:
+    def test_append_latest_snapshot(self, tmp_path):
+        store = JobStateStore(str(tmp_path / "store"), shards=4)
+        store.append(ev("a1", AppState.RUNNING))
+        store.append(ev("a1", AppState.SUCCEEDED))
+        store.append(ev("a2", AppState.PENDING))
+        assert store.latest("stub", "a1").state == AppState.SUCCEEDED
+        assert store.latest("stub", "ghost") is None
+        assert len(store) == 2
+        assert set(store.snapshot()) == {("stub", "a1"), ("stub", "a2")}
+
+    def test_shard_for_is_stable(self):
+        # CRC32, not hash(): the same key must land in the same shard in
+        # every process, or rehydration would read the wrong files
+        assert shard_for("local", "app_1", 8) == shard_for("local", "app_1", 8)
+        assert 0 <= shard_for("local", "app_1", 8) < 8
+        assert shard_for("x", "y", 1) == 0
+
+    def test_meta_pins_shard_count_across_reopen(self, tmp_path):
+        root = str(tmp_path / "store")
+        store = JobStateStore(root, shards=8)
+        store.append(ev("a1", AppState.RUNNING))
+        # a reopen with a DIFFERENT shards argument keeps the on-disk
+        # layout — otherwise lookups would scan the wrong shard set
+        again = JobStateStore(root, shards=3)
+        assert again.shards == 8
+        assert again.latest("stub", "a1").state == AppState.RUNNING
+
+    def test_rehydrate_on_restart(self, tmp_path):
+        root = str(tmp_path / "store")
+        store = JobStateStore(root)
+        for i in range(20):
+            store.append(ev(f"job_{i}", AppState.RUNNING))
+            store.append(ev(f"job_{i}", AppState.SUCCEEDED))
+        # "restart": a brand-new store over the same root
+        restarted = JobStateStore(root)
+        assert len(restarted) == 20
+        for i in range(20):
+            assert restarted.latest("stub", f"job_{i}").state == AppState.SUCCEEDED
+
+    def test_kill9_mid_append_recovers_complete_lines(self, tmp_path):
+        root = str(tmp_path / "store")
+        store = JobStateStore(root, shards=2)
+        store.append(ev("job_x", AppState.RUNNING))
+        store.append(ev("job_x", AppState.SUCCEEDED))
+        # the writer is SIGKILLed mid-append: a torn, non-JSON final line
+        # in exactly the shard that owns the app
+        shard = shard_for("stub", "job_x", store.shards)
+        path = os.path.join(root, f"shard-{shard:02d}", EVENTS_FILE)
+        with open(path, "a") as f:
+            f.write('{"scheduler": "stub", "app_id": "job_x", "sta')
+        restarted = JobStateStore(root)
+        assert len(restarted) == 1
+        assert restarted.latest("stub", "job_x").state == AppState.SUCCEEDED
+
+    def test_concurrent_writers_and_readers(self, tmp_path):
+        store = JobStateStore(str(tmp_path / "store"), shards=4)
+        writers, per_writer = 4, 25
+        barrier = threading.Barrier(writers + 2)
+        errors: list[BaseException] = []
+
+        def write(w: int) -> None:
+            try:
+                barrier.wait()
+                for i in range(per_writer):
+                    store.append(ev(f"w{w}_job{i}", AppState.RUNNING))
+                    store.append(ev(f"w{w}_job{i}", AppState.SUCCEEDED))
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        def read() -> None:
+            try:
+                barrier.wait()
+                for _ in range(50):
+                    snap = store.snapshot()
+                    # a reader must only ever see complete events
+                    assert all(isinstance(e, StateEvent) for e in snap.values())
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=write, args=(w,)) for w in range(writers)
+        ] + [threading.Thread(target=read) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        assert len(store) == writers * per_writer
+        # and what hit disk rehydrates to the same map
+        assert len(JobStateStore(store.root)) == writers * per_writer
+
+
+# ---------------------------------------------------------------------------
+# Watch adapters: parity across backends
+# ---------------------------------------------------------------------------
+
+
+def collect_events(watcher, timeout: float = 20.0) -> list:
+    """Drain ``events(follow=False)`` with a watchdog that closes the
+    stream rather than hanging the suite."""
+    out: list = []
+    killer = threading.Timer(timeout, watcher.close)
+    killer.start()
+    try:
+        out.extend(watcher.events(follow=False))
+    finally:
+        killer.cancel()
+        watcher.close()
+    return out
+
+
+class TestWatchAdapters:
+    def test_poll_watcher_emits_transitions(self):
+        sched = StubScheduler()
+        handle_state = sched.apps
+        app_id = sched.schedule(sched._submit_dryrun(simple_app(), {}))
+        watcher = PollWatcher(sched, [app_id], interval=0.02)
+        threading.Timer(
+            0.15, lambda: handle_state.__setitem__(app_id, AppState.SUCCEEDED)
+        ).start()
+        events = collect_events(watcher)
+        assert [e.state for e in events] == [AppState.RUNNING, AppState.SUCCEEDED]
+        assert all(e.source == "poll" and e.resp is not None for e in events)
+
+    def test_poll_watcher_dedups_unchanged_state(self):
+        sched = StubScheduler()
+        app_id = sched.schedule(sched._submit_dryrun(simple_app(), {}))
+        watcher = PollWatcher(sched, [app_id], interval=0.01)
+        gen = watcher.events(follow=True)
+        assert next(gen).state == AppState.RUNNING
+        # several more scans with no state change yield nothing new
+        calls_before = sched.describe_calls
+        time.sleep(0.1)
+        sched.apps[app_id] = AppState.FAILED
+        assert next(gen).state == AppState.FAILED
+        assert sched.describe_calls > calls_before  # it DID keep scanning
+        watcher.close()
+
+    def test_poll_watcher_describe_error_keeps_watching(self):
+        sched = StubScheduler()
+        app_id = sched.schedule(sched._submit_dryrun(simple_app(), {}))
+        real_describe = sched.describe
+        state = {"boom": True}
+
+        def flaky(app_id):
+            if state["boom"]:
+                raise RuntimeError("control plane wobble")
+            return real_describe(app_id)
+
+        sched.describe = flaky
+        watcher = PollWatcher(sched, [app_id], interval=0.02)
+
+        def heal():
+            state["boom"] = False
+            sched.apps[app_id] = AppState.SUCCEEDED
+
+        threading.Timer(0.15, heal).start()
+        events = collect_events(watcher)
+        # errors were absorbed; the stream delivered the terminal event
+        assert events[-1].state == AppState.SUCCEEDED
+
+    def test_poll_watcher_forgotten_app_ends_as_unknown(self):
+        sched = StubScheduler()
+        watcher = PollWatcher(sched, ["never_submitted"], interval=0.01)
+        events = collect_events(watcher)
+        assert [e.state for e in events] == [AppState.UNKNOWN]
+
+    def test_local_sidecar_watcher_real_job(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPX_WATCH_INTERVAL", "0.05")
+        with get_runner("watch-e2e") as runner:
+            handle = runner.run_component(
+                "utils.echo",
+                ["--msg", "watched"],
+                "local",
+                {"log_dir": str(tmp_path)},
+            )
+            _, _, app_id = parse_app_handle(handle)
+            sched = runner._scheduler("local")
+            assert sched.capabilities.watch
+            watcher = sched.watch([app_id])
+            assert isinstance(watcher, LocalSidecarWatcher)
+            events = collect_events(watcher)
+            assert events, "sidecar watcher emitted nothing"
+            assert events[-1].state == AppState.SUCCEEDED
+            assert events[-1].source == "sidecar"
+            assert events[-1].resp is not None  # confirmed via describe
+
+    def test_kubectl_watcher_fake_stream(self):
+        sched = StubScheduler(backend="gke")
+        sched.apps["ns:j1"] = AppState.RUNNING
+
+        running_doc = json.dumps({"metadata": {"name": "j1"}, "status": {}})
+        done_doc = json.dumps(
+            {
+                "metadata": {"name": "j1"},
+                "status": {
+                    "conditions": [{"type": "Completed", "status": "True"}]
+                },
+            }
+        )
+
+        class FakeProc:
+            stdout = [running_doc, "\n", done_doc]
+
+            def terminate(self):
+                pass
+
+        spawned: list[list[str]] = []
+
+        def spawn(cmd):
+            spawned.append(cmd)
+            # the terminal doc must find describe already terminal
+            sched.apps["ns:j1"] = AppState.SUCCEEDED
+            return FakeProc()
+
+        watcher = KubectlWatcher(sched, ["ns:j1"], interval=0.02, spawn=spawn)
+        events = collect_events(watcher)
+        assert spawned and "-n" in spawned[0] and "ns" in spawned[0]
+        assert events[-1].state == AppState.SUCCEEDED
+        # terminal line was CONFIRMED through describe (authoritative
+        # classification), so it carries the response
+        assert events[-1].resp is not None
+        assert events[-1].source in ("kubectl", "poll")
+
+    def test_kubectl_watcher_spawn_failure_degrades_to_poll(self):
+        sched = StubScheduler(backend="gke")
+        sched.apps["ns:j2"] = AppState.SUCCEEDED
+
+        def no_kubectl(cmd):
+            raise OSError("kubectl: not found")
+
+        watcher = KubectlWatcher(sched, ["ns:j2"], interval=0.02, spawn=no_kubectl)
+        events = collect_events(watcher)
+        assert [e.state for e in events] == [AppState.SUCCEEDED]
+        assert events[0].source == "poll"  # the fallback path, same events
+
+    def test_jobset_watch_state_mapping(self):
+        def doc(ctype, status="True"):
+            return {"status": {"conditions": [{"type": ctype, "status": status}]}}
+
+        assert jobset_watch_state(doc("Completed")) == AppState.SUCCEEDED
+        assert jobset_watch_state(doc("Failed")) == AppState.FAILED
+        assert (
+            jobset_watch_state(doc("FailurePolicyComplete")) == AppState.FAILED
+        )
+        assert jobset_watch_state(doc("Suspended")) == AppState.PENDING
+        # a False condition is not a transition
+        assert jobset_watch_state(doc("Completed", "False")) == AppState.RUNNING
+        assert jobset_watch_state({}) == AppState.RUNNING
+
+    def test_adapter_parity_three_backends(self, tmp_path, monkeypatch):
+        """The ISSUE's parity check: the same lifecycle through the poll
+        adapter, the local sidecar adapter, and the kubectl shim produces
+        the same transition contract — a deduped sequence ending in ONE
+        terminal event that carries a confirming describe."""
+        monkeypatch.setenv("TPX_WATCH_INTERVAL", "0.05")
+        sequences = {}
+
+        # generic poll
+        poll_sched = StubScheduler()
+        a = poll_sched.schedule(poll_sched._submit_dryrun(simple_app(), {}))
+        threading.Timer(
+            0.1, lambda: poll_sched.apps.__setitem__(a, AppState.SUCCEEDED)
+        ).start()
+        sequences["poll"] = collect_events(PollWatcher(poll_sched, [a], 0.02))
+
+        # kubectl shim
+        gke_sched = StubScheduler(backend="gke")
+        gke_sched.apps["ns:p"] = AppState.RUNNING
+        docs = [
+            json.dumps({"metadata": {"name": "p"}, "status": {}}),
+            json.dumps(
+                {
+                    "metadata": {"name": "p"},
+                    "status": {
+                        "conditions": [{"type": "Completed", "status": "True"}]
+                    },
+                }
+            ),
+        ]
+
+        class Proc:
+            stdout = docs
+
+            def terminate(self):
+                pass
+
+        def spawn(cmd):
+            gke_sched.apps["ns:p"] = AppState.SUCCEEDED
+            return Proc()
+
+        sequences["kubectl"] = collect_events(
+            KubectlWatcher(gke_sched, ["ns:p"], interval=0.02, spawn=spawn)
+        )
+
+        # local sidecars, a real process
+        with get_runner("parity") as runner:
+            handle = runner.run_component(
+                "utils.echo", ["--msg", "p"], "local", {"log_dir": str(tmp_path)}
+            )
+            _, _, app_id = parse_app_handle(handle)
+            sequences["sidecar"] = collect_events(
+                runner._scheduler("local").watch([app_id])
+            )
+
+        for name, events in sequences.items():
+            assert events, f"{name}: no events"
+            terminal = [e for e in events if e.terminal]
+            assert len(terminal) == 1, f"{name}: {[e.state for e in events]}"
+            assert events[-1] is terminal[0], f"{name}: terminal not last"
+            assert terminal[0].state == AppState.SUCCEEDED, name
+            assert terminal[0].resp is not None, f"{name}: unconfirmed terminal"
+            states = [e.state for e in events]
+            assert len(states) == len(set(states)), f"{name}: duplicate states"
+
+
+# ---------------------------------------------------------------------------
+# Reconciler: journal -> cache -> wake
+# ---------------------------------------------------------------------------
+
+
+class TestReconciler:
+    def test_ingest_journals_and_records_latest(self, tmp_path):
+        store = JobStateStore(str(tmp_path / "store"))
+        rec = Reconciler(store=store)
+        rec.ingest(ev("a1", AppState.RUNNING))
+        rec.ingest(ev("a1", AppState.SUCCEEDED))
+        assert rec.latest("stub", "a1").state == AppState.SUCCEEDED
+        assert store.latest("stub", "a1").state == AppState.SUCCEEDED
+
+    def test_wait_event_returns_recorded_terminal_immediately(self):
+        rec = Reconciler()
+        rec.ingest(ev("a1", AppState.SUCCEEDED))
+        t0 = time.monotonic()
+        got = rec.wait_event("stub", "a1", timeout=10.0)
+        assert got is not None and got.state == AppState.SUCCEEDED
+        assert time.monotonic() - t0 < 1.0  # no wait at all
+
+    def test_wait_event_wakes_on_new_event(self):
+        rec = Reconciler()
+        rec.ingest(ev("a1", AppState.RUNNING))
+        threading.Timer(0.1, lambda: rec.ingest(ev("a1", AppState.FAILED))).start()
+        t0 = time.monotonic()
+        got = rec.wait_event("stub", "a1", timeout=10.0)
+        assert got is not None and got.state == AppState.FAILED
+        assert time.monotonic() - t0 < 5.0
+
+    def test_wait_event_times_out_to_none(self):
+        rec = Reconciler()
+        assert rec.wait_event("stub", "nothing", timeout=0.05) is None
+
+    def test_ingest_refreshes_bound_cache_via_writer_path(self):
+        rec = Reconciler()
+        cache = DescribeCache(ttl=600.0)
+        rec.bind_cache(cache)
+        # a confirmed event installs the response: the next read is a hit
+        rec.ingest(ev("a1", AppState.SUCCEEDED, with_resp=True))
+        resp = cache.get("stub", "a1", fetch=lambda: pytest.fail("not pinned"))
+        assert resp.state == AppState.SUCCEEDED
+        # a stream-only (unconfirmed) event invalidates instead: the next
+        # reader re-fetches through the resilient seam
+        rec.ingest(ev("a2", AppState.RUNNING, with_resp=True))
+        rec.ingest(ev("a2", AppState.FAILED, with_resp=False))
+        fetched = []
+        cache.get(
+            "stub",
+            "a2",
+            fetch=lambda: fetched.append(1)
+            or DescribeAppResponse(app_id="a2", state=AppState.FAILED),
+        )
+        assert fetched == [1]
+
+    def test_track_survives_watchless_backend(self):
+        rec = Reconciler()
+        sched = NoWatchStubScheduler()
+        rec.track("stub", sched, "a1")  # must not raise
+        assert not rec.has_stream("stub")
+
+    def test_track_opens_one_stream_per_backend(self):
+        rec = Reconciler()
+        sched = StubScheduler()
+        a1 = sched.schedule(sched._submit_dryrun(simple_app(), {}))
+        a2 = sched.schedule(sched._submit_dryrun(simple_app(), {}))
+        try:
+            rec.track("stub", sched, a1)
+            rec.track("stub", sched, a2)
+            assert rec.has_stream("stub")
+            assert len(rec._watchers) == 1
+            sched.apps[a1] = AppState.SUCCEEDED
+            sched.apps[a2] = AppState.SUCCEEDED
+            # wait_event wakes per TRANSITION (a RUNNING event is a wake
+            # too — Runner.wait re-polls on each); loop to terminal
+            deadline = time.monotonic() + 10.0
+            got = None
+            while time.monotonic() < deadline:
+                got = rec.wait_event("stub", a1, timeout=1.0)
+                if got is not None and got.terminal:
+                    break
+            assert got is not None and got.state == AppState.SUCCEEDED
+        finally:
+            rec.close()
+        assert not rec.has_stream("stub")
+
+
+class TestRunnerWaitWakePath:
+    def test_terminal_event_between_polls_wakes_immediately(self):
+        """The ISSUE regression: a terminal event landing while ``wait``
+        is paused must wake the waiter at event latency — NOT after the
+        30s poll interval — and the follow-up poll must be served from
+        the pinned cache entry (zero extra backend describes)."""
+        sched = NoWatchStubScheduler()
+        runner = Runner("wake", {"stub": lambda session_name, **kw: sched})
+        rec = Reconciler()
+        runner.attach_reconciler(rec)
+        slept: list[float] = []
+        try:
+            handle = runner.run(simple_app(), "stub")
+            _, _, app_id = parse_app_handle(handle)
+
+            def finish():
+                sched.apps[app_id] = AppState.SUCCEEDED
+                rec.ingest(
+                    StateEvent(
+                        scheduler="stub",
+                        app_id=app_id,
+                        state=AppState.SUCCEEDED,
+                        resp=DescribeAppResponse(
+                            app_id=app_id, state=AppState.SUCCEEDED
+                        ),
+                    )
+                )
+                # cache-pinning check: no describe may happen after this
+                sched.describe = lambda app_id: pytest.fail(
+                    "terminal poll was not served from the pinned cache"
+                )
+
+            threading.Timer(0.2, finish).start()
+            t0 = time.monotonic()
+            status = runner.wait(
+                handle, wait_interval=30, sleep=lambda s: slept.append(s)
+            )
+            elapsed = time.monotonic() - t0
+        finally:
+            runner.close()
+        assert status is not None and status.state == AppState.SUCCEEDED
+        assert elapsed < 10.0, f"waiter slept out the poll interval ({elapsed}s)"
+        # the pause rode the condition variable, never plain sleep
+        assert slept == []
+
+    def test_watch_driven_terminal_pins_cache_like_fresh_wait(self):
+        """Describe-cache coherence satellite: a watch-confirmed terminal
+        goes through the SAME writer path as ``wait(fresh=True)`` — pinned
+        forever, shared by every later reader, no second cache."""
+        sched = NoWatchStubScheduler()
+        runner = Runner("pin", {"stub": lambda session_name, **kw: sched})
+        rec = Reconciler()
+        runner.attach_reconciler(rec)
+        try:
+            handle = runner.run(simple_app(), "stub")
+            _, _, app_id = parse_app_handle(handle)
+            sched.apps[app_id] = AppState.SUCCEEDED
+            rec.ingest(
+                StateEvent(
+                    scheduler="stub",
+                    app_id=app_id,
+                    state=AppState.SUCCEEDED,
+                    resp=DescribeAppResponse(
+                        app_id=app_id, state=AppState.SUCCEEDED
+                    ),
+                )
+            )
+            before = sched.describe_calls
+            for _ in range(5):
+                assert runner.status(handle).state == AppState.SUCCEEDED
+            assert runner.status(handle, fresh=True).state == AppState.SUCCEEDED
+            assert sched.describe_calls == before
+        finally:
+            runner.close()
+
+    def test_wait_without_reconciler_still_polls(self):
+        sched = StubScheduler()
+        runner = Runner("plain", {"stub": lambda session_name, **kw: sched})
+        try:
+            handle = runner.run(simple_app(), "stub")
+            _, _, app_id = parse_app_handle(handle)
+            threading.Timer(
+                0.1, lambda: sched.apps.__setitem__(app_id, AppState.SUCCEEDED)
+            ).start()
+            status = runner.wait(handle, wait_interval=0.05)
+            assert status.state == AppState.SUCCEEDED
+        finally:
+            runner.close()
+
+
+# ---------------------------------------------------------------------------
+# DescribeCache.put: the watch writer path
+# ---------------------------------------------------------------------------
+
+
+class TestDescribeCachePut:
+    def test_put_terminal_pins_forever(self):
+        cache = DescribeCache(ttl=0.0)  # ttl 0: nothing non-terminal survives
+        cache.put(
+            "s", "a", DescribeAppResponse(app_id="a", state=AppState.FAILED)
+        )
+        resp = cache.get("s", "a", fetch=lambda: pytest.fail("pinned"))
+        assert resp.state == AppState.FAILED
+        resp = cache.get(
+            "s", "a", fetch=lambda: pytest.fail("pinned"), fresh=True
+        )
+        assert resp.state == AppState.FAILED
+
+    def test_put_none_drops_entry(self):
+        cache = DescribeCache(ttl=600.0)
+        cache.put(
+            "s", "a", DescribeAppResponse(app_id="a", state=AppState.RUNNING)
+        )
+        cache.put("s", "a", None)
+        fetched = []
+        cache.get(
+            "s",
+            "a",
+            fetch=lambda: fetched.append(1)
+            or DescribeAppResponse(app_id="a", state=AppState.RUNNING),
+        )
+        assert fetched == [1]
+
+    def test_put_matches_fresh_get_writer_semantics(self):
+        """Parity: installing a terminal via put() leaves the cache in the
+        same state as a wait-loop get(fresh=True) that fetched it."""
+        terminal = DescribeAppResponse(app_id="a", state=AppState.SUCCEEDED)
+        via_get = DescribeCache(ttl=0.0)
+        via_get.get("s", "a", fetch=lambda: terminal, fresh=True)
+        via_put = DescribeCache(ttl=0.0)
+        via_put.put("s", "a", terminal)
+        for cache in (via_get, via_put):
+            got = cache.get(
+                "s", "a", fetch=lambda: pytest.fail("not pinned"), fresh=True
+            )
+            assert got is terminal
+
+
+# ---------------------------------------------------------------------------
+# the daemon
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def daemon(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPX_WATCH_INTERVAL", "0.05")
+    d = ControlDaemon(
+        runner=get_runner("ctl-test"),
+        state_dir=str(tmp_path / "control"),
+        tenant_cap=2,
+    ).start()
+    yield d
+    d.close()
+    d.runner.close()
+
+
+class TestControlDaemon:
+    def test_healthz_and_discovery(self, daemon):
+        client = ControlClient(daemon.addr, daemon.root_token)
+        health = client.healthz()
+        assert health["status"] == "ok" and health["tenant_cap"] == 2
+        with open(daemon.discovery_path()) as f:
+            doc = json.load(f)
+        assert doc["addr"] == daemon.addr and doc["token"] == daemon.root_token
+        mode = os.stat(daemon.discovery_path()).st_mode & 0o777
+        assert mode == 0o600  # the token IS the auth boundary
+
+    def test_submit_watch_wait_roundtrip(self, daemon, tmp_path):
+        client = ControlClient(daemon.addr, daemon.root_token)
+        handle = client.submit(
+            "utils.echo",
+            ["--msg", "from-the-daemon"],
+            "local",
+            cfg={"log_dir": str(tmp_path / "logs")},
+        )
+        assert handle.startswith("local://")
+        final = client.wait(handle, timeout=60)
+        assert final["state"] == "SUCCEEDED" and final["terminal"]
+        # the journal holds the lifecycle (fleet list needs no backend)
+        _, _, app_id = parse_app_handle(handle)
+        journaled = daemon.store.latest("local", app_id)
+        assert journaled is not None
+        apps = client.list()
+        assert any(a["app_id"] == app_id for a in apps)
+        # log attach through the daemon
+        lines = list(client.log_lines(handle, "echo", k=0))
+        assert any("from-the-daemon" in ln for ln in lines)
+
+    def test_status_unknown_handle_404(self, daemon):
+        client = ControlClient(daemon.addr, daemon.root_token)
+        with pytest.raises(ControlClientError) as ei:
+            client.status("local://ctl-test/ghost_app")
+        assert ei.value.code == 404
+
+    def test_bad_token_401(self, daemon):
+        client = ControlClient(daemon.addr, "not-a-token")
+        with pytest.raises(ControlClientError) as ei:
+            client.status("local://ctl-test/anything")
+        assert ei.value.code == 401
+
+    def test_session_minting_is_root_only(self, daemon):
+        root = ControlClient(daemon.addr, daemon.root_token)
+        tenant_token = root.mint_session("team-a")
+        tenant = ControlClient(daemon.addr, tenant_token)
+        with pytest.raises(ControlClientError) as ei:
+            tenant.mint_session("team-b")
+        assert ei.value.code == 403
+
+    def test_tenant_cap_429(self, daemon, tmp_path):
+        root = ControlClient(daemon.addr, daemon.root_token)
+        tenant = ControlClient(daemon.addr, root.mint_session("team-cap"))
+        handles = [
+            tenant.submit(
+                "utils.sh",
+                ["sleep", "30"],
+                "local",
+                cfg={"log_dir": str(tmp_path / f"cap{i}")},
+            )
+            for i in range(2)
+        ]
+        try:
+            with pytest.raises(ControlClientError) as ei:
+                tenant.submit(
+                    "utils.sh",
+                    ["sleep", "30"],
+                    "local",
+                    cfg={"log_dir": str(tmp_path / "cap-over")},
+                )
+            assert ei.value.code == 429
+            assert "cap" in ei.value.message
+            # the cap is PER tenant: root is not throttled by team-cap
+            other = root.submit(
+                "utils.echo",
+                ["--msg", "hi"],
+                "local",
+                cfg={"log_dir": str(tmp_path / "other")},
+            )
+            assert other.startswith("local://")
+        finally:
+            for h in handles:
+                tenant.cancel(h)
+
+    def test_metricz_counts_control_ops(self, daemon):
+        client = ControlClient(daemon.addr, daemon.root_token)
+        client.healthz()
+        client.list()
+        import urllib.request
+
+        with urllib.request.urlopen(daemon.addr + "/metricz", timeout=10) as r:
+            text = r.read().decode()
+        assert "tpx_control_requests_total" in text
+        assert 'op="list"' in text
+
+    def test_restart_rehydrates_journal(self, daemon, tmp_path):
+        client = ControlClient(daemon.addr, daemon.root_token)
+        handle = client.submit(
+            "utils.echo",
+            ["--msg", "durable"],
+            "local",
+            cfg={"log_dir": str(tmp_path / "logs")},
+        )
+        client.wait(handle, timeout=60)
+        _, _, app_id = parse_app_handle(handle)
+        state_dir = daemon.state_dir
+        daemon.close()
+        # a brand-new daemon over the same state dir knows the job before
+        # making a single backend call
+        runner2 = get_runner("ctl-test-2")
+        d2 = ControlDaemon(runner=runner2, state_dir=state_dir)
+        try:
+            assert d2.store.latest("local", app_id) is not None
+        finally:
+            d2.close()
+            runner2.close()
+
+    def test_bad_submit_is_a_clean_400(self, daemon):
+        client = ControlClient(daemon.addr, daemon.root_token)
+        with pytest.raises(ControlClientError) as ei:
+            client.submit("not.a.component", [], "local")
+        assert ei.value.code == 400
+
+
+class TestMaybeClient:
+    def test_addr_without_token_raises_401(self, monkeypatch, tmp_path):
+        from torchx_tpu.control.client import maybe_client
+
+        monkeypatch.setenv("TPX_CONTROL_ADDR", "http://127.0.0.1:1")
+        monkeypatch.delenv("TPX_CONTROL_TOKEN", raising=False)
+        monkeypatch.setenv("TPX_CONTROL_DIR", str(tmp_path / "nowhere"))
+        with pytest.raises(ControlClientError) as ei:
+            maybe_client()
+        assert ei.value.code == 401
+
+    def test_unset_means_direct_mode(self, monkeypatch, tmp_path):
+        from torchx_tpu.control.client import maybe_client
+
+        monkeypatch.delenv("TPX_CONTROL_ADDR", raising=False)
+        monkeypatch.setenv("TPX_CONTROL_DIR", str(tmp_path / "nowhere"))
+        assert maybe_client() is None
+
+    def test_discovery_file_resolves_token(self, monkeypatch, tmp_path):
+        from torchx_tpu.control.client import maybe_client
+
+        control_dir = tmp_path / "control"
+        control_dir.mkdir()
+        (control_dir / "control.json").write_text(
+            json.dumps(
+                {"addr": "http://127.0.0.1:7777", "token": "tok", "pid": 1}
+            )
+        )
+        monkeypatch.setenv("TPX_CONTROL_DIR", str(control_dir))
+        monkeypatch.setenv("TPX_CONTROL_ADDR", "http://127.0.0.1:7777")
+        monkeypatch.delenv("TPX_CONTROL_TOKEN", raising=False)
+        client = maybe_client()
+        assert client is not None and client.token == "tok"
+
+
+# ---------------------------------------------------------------------------
+# TPX601: hang detection + daemon + watchless backend
+# ---------------------------------------------------------------------------
+
+
+class TestControlPlaneRule:
+    def _report(self, watch: bool):
+        from torchx_tpu.analyze import analyze
+        from torchx_tpu.schedulers.api import SchedulerCapabilities
+        from torchx_tpu.supervisor.policy import SupervisorPolicy
+
+        return analyze(
+            simple_app(),
+            scheduler="local",
+            policy=SupervisorPolicy(hang_deadline_seconds=120),
+            capabilities=SchedulerCapabilities(watch=watch),
+        )
+
+    @staticmethod
+    def _codes(report):
+        return {d.code for d in report.diagnostics}
+
+    def test_warns_on_watchless_backend_under_daemon(self, monkeypatch):
+        monkeypatch.setenv("TPX_CONTROL_ADDR", "http://127.0.0.1:1")
+        report = self._report(watch=False)
+        assert "TPX601" in self._codes(report)
+        d = next(d for d in report.diagnostics if d.code == "TPX601")
+        assert d.severity.name == "WARNING"
+
+    def test_quiet_with_watch_capability(self, monkeypatch):
+        monkeypatch.setenv("TPX_CONTROL_ADDR", "http://127.0.0.1:1")
+        assert "TPX601" not in self._codes(self._report(watch=True))
+
+    def test_quiet_without_daemon(self, monkeypatch):
+        monkeypatch.delenv("TPX_CONTROL_ADDR", raising=False)
+        assert "TPX601" not in self._codes(self._report(watch=False))
+
+    def test_quiet_without_hang_detection(self, monkeypatch):
+        from torchx_tpu.analyze import analyze
+        from torchx_tpu.schedulers.api import SchedulerCapabilities
+        from torchx_tpu.supervisor.policy import SupervisorPolicy
+
+        monkeypatch.setenv("TPX_CONTROL_ADDR", "http://127.0.0.1:1")
+        report = analyze(
+            simple_app(),
+            scheduler="local",
+            policy=SupervisorPolicy(),  # hang detection off
+            capabilities=SchedulerCapabilities(watch=False),
+        )
+        assert "TPX601" not in self._codes(report)

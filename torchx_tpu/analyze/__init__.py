@@ -69,6 +69,7 @@ Diagnostic codes
 | TPX501 | warning | supervisor resubmit budgets stack multiplicatively with the backend's native ``max_retries`` restarts | set max_retries=0 under ``tpx supervise`` |
 | TPX502 | error | ``TPX_FAULT_PLAN`` set while submitting to a non-local backend (chaos drill would corrupt real cloud calls) | unset it or drill against local / local_docker |
 | TPX503 | warning | policy budgets checkpoint-resume retries but no role passes a checkpoint-dir flag (every resubmit restarts from step 0) | pass ``--ckpt-dir`` to the app or drop ``checkpoint_dir`` |
+| TPX601 | warning | hang detection under the control daemon (``TPX_CONTROL_ADDR``) on a backend without the ``watch`` capability — state changes surface at the watch poll interval | use a watch-capable backend, tighten ``TPX_WATCH_INTERVAL``, or unset ``TPX_CONTROL_ADDR`` |
 """
 
 from torchx_tpu.analyze.diagnostics import (

@@ -18,6 +18,7 @@ Code families (full table in docs/api/analyze.md):
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from string import Template
 from typing import Callable, Iterable, Iterator, Mapping, Optional
@@ -926,5 +927,52 @@ def check_recovery(ctx: RuleContext) -> Iterator[Diagnostic]:
             "point the app at the same directory (e.g."
             f" --ckpt-dir {policy.checkpoint_dir}) so saved steps feed"
             " TPX_RESUME_STEP, or drop checkpoint_dir from the policy"
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# TPX6xx — control-plane (daemon / watch) coherence
+# ---------------------------------------------------------------------------
+
+
+@rule("control-plane")
+def check_control_plane(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX601: hang detection expects event latency the backend can't give.
+
+    Under the control daemon (``TPX_CONTROL_ADDR`` set), supervision
+    waits ride the reconciler's watch streams — terminal transitions and
+    gang-health signals arrive at event latency on backends that declare
+    the ``watch`` capability (local sidecars, GKE's kubectl stream). On a
+    backend WITHOUT it, the same interface silently degrades to the
+    generic poll adapter, so a policy that budgets hang detection
+    (``hang_deadline_seconds``) will observe hangs only at the watch poll
+    interval — worth knowing before the 3am page arrives late."""
+    policy = ctx.policy
+    cap = ctx.capabilities
+    if policy is None or cap is None:
+        return
+    if getattr(policy, "hang_deadline_seconds", 0) <= 0:
+        return
+    if not os.environ.get(s.ENV_TPX_CONTROL_ADDR, "").strip():
+        return
+    if cap.watch:
+        return
+    yield Diagnostic(
+        code="TPX601",
+        severity=Severity.WARNING,
+        field="hang_deadline_seconds",
+        message=(
+            f"supervisor hang detection"
+            f" (hang_deadline_seconds={policy.hang_deadline_seconds:g}) runs"
+            f" through the control daemon ({s.ENV_TPX_CONTROL_ADDR} is set),"
+            f" but scheduler {ctx.scheduler!r} has no native watch source —"
+            " state changes surface at the watch POLL interval, so"
+            " hang-detection latency degrades by up to that interval"
+        ),
+        hint=(
+            "target a watch-capable backend (local, gke), tighten"
+            f" {s.ENV_TPX_WATCH_INTERVAL}, or run this job outside the"
+            " daemon (unset TPX_CONTROL_ADDR) to poll directly"
         ),
     )

@@ -3,7 +3,9 @@
 from __future__ import annotations
 
 import argparse
+import sys
 from abc import ABC, abstractmethod
+from typing import Any, Optional
 
 
 class SubCommand(ABC):
@@ -14,3 +16,19 @@ class SubCommand(ABC):
     @abstractmethod
     def run(self, args: argparse.Namespace) -> None:
         ...
+
+
+def control_client() -> Optional[Any]:
+    """The CLI's proxy decision: a
+    :class:`~torchx_tpu.control.client.ControlClient` when
+    ``$TPX_CONTROL_ADDR`` points at a ``tpx control`` daemon, None for
+    direct-runner mode. A set address with no reachable token is an
+    operator error and exits 1 (silently falling back would run the job
+    outside the daemon's tenancy caps)."""
+    from torchx_tpu.control.client import ControlClientError, maybe_client
+
+    try:
+        return maybe_client()
+    except ControlClientError as e:
+        print(f"control: {e.message}", file=sys.stderr)
+        sys.exit(1)

@@ -1,0 +1,72 @@
+"""``tpx control`` — run the control-plane daemon (foreground).
+
+Starts the multi-tenant daemon (:mod:`torchx_tpu.control.daemon`): one
+process owning the Runner, every watch stream, and the sharded job-state
+store, serving submit/status/list/cancel/wait/log over localhost JSON.
+Point other shells at it with::
+
+    export TPX_CONTROL_ADDR=<printed addr>
+
+(the bearer token is read from the daemon's 0600 discovery file, or set
+``TPX_CONTROL_TOKEN`` explicitly) and every ``tpx`` verb proxies through
+the daemon instead of driving schedulers directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from torchx_tpu.cli.cmd_base import SubCommand
+
+
+class CmdControl(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument(
+            "--host", default="127.0.0.1", help="bind address (loopback only)"
+        )
+        subparser.add_argument(
+            "--port", type=int, default=0, help="bind port (0 = OS-assigned)"
+        )
+        subparser.add_argument(
+            "--state-dir",
+            default=None,
+            help="discovery file + job-state store root"
+            " (default $TPX_CONTROL_DIR, else ~/.torchx_tpu/control)",
+        )
+        subparser.add_argument(
+            "--tenant-cap",
+            type=int,
+            default=None,
+            help="max concurrently active jobs per tenant (429 past it)",
+        )
+        subparser.add_argument(
+            "--print-token",
+            action="store_true",
+            help="also print the root token (it is always in the 0600"
+            " discovery file; printing it puts it in scrollback)",
+        )
+
+    def run(self, args: argparse.Namespace) -> None:
+        from torchx_tpu.control.daemon import ControlDaemon
+
+        daemon = ControlDaemon(
+            host=args.host,
+            port=args.port,
+            state_dir=args.state_dir,
+            tenant_cap=args.tenant_cap,
+        )
+        recovered = len(daemon.store)
+        print(
+            f"tpx control: serving on {daemon.addr}"
+            f" (state {daemon.state_dir}, {recovered} jobs rehydrated)",
+            flush=True,
+        )
+        print(f"  export TPX_CONTROL_ADDR={daemon.addr}", flush=True)
+        if args.print_token:
+            print(f"  export TPX_CONTROL_TOKEN={daemon.root_token}", flush=True)
+        try:
+            daemon.serve_forever()
+        except KeyboardInterrupt:
+            print("tpx control: shutting down", file=sys.stderr)
+            daemon.close()

@@ -10,7 +10,7 @@ import argparse
 import json
 import sys
 
-from torchx_tpu.cli.cmd_base import SubCommand
+from torchx_tpu.cli.cmd_base import SubCommand, control_client
 from torchx_tpu.runner import config as tpx_config
 from torchx_tpu.runner.api import get_runner
 
@@ -20,6 +20,10 @@ class CmdStatus(SubCommand):
         subparser.add_argument("app_handle", help="scheduler://session/app_id")
 
     def run(self, args: argparse.Namespace) -> None:
+        client = control_client()
+        if client is not None:
+            self._run_proxied(client, args)
+            return
         from torchx_tpu.util.colors import supports_color
 
         with get_runner() as runner:
@@ -28,6 +32,24 @@ class CmdStatus(SubCommand):
                 print(f"app not found: {args.app_handle}", file=sys.stderr)
                 sys.exit(1)
             print(status.format(colored=supports_color()))
+
+    def _run_proxied(self, client, args: argparse.Namespace) -> None:  # noqa: ANN001
+        from torchx_tpu.control.client import ControlClientError
+
+        try:
+            st = client.status(args.app_handle)
+        except ControlClientError as e:
+            if e.code == 404:
+                print(f"app not found: {args.app_handle}", file=sys.stderr)
+            else:
+                print(f"control: {e.message}", file=sys.stderr)
+            sys.exit(1)
+        line = f"{st.get('handle', args.app_handle)}: {st.get('state')}"
+        if st.get("failure_class"):
+            line += f" ({st['failure_class']})"
+        print(line)
+        if st.get("msg"):
+            print(st["msg"])
 
 
 class CmdDescribe(SubCommand):
@@ -54,6 +76,26 @@ class CmdList(SubCommand):
         )
 
     def run(self, args: argparse.Namespace) -> None:
+        client = control_client()
+        if client is not None:
+            from torchx_tpu.control.client import ControlClientError
+
+            try:
+                if args.scheduler:
+                    for app in client.list(args.scheduler):
+                        print(f"{app.get('app_id')}\t{app.get('state')}")
+                else:
+                    # fleet view straight from the daemon's journal — no
+                    # backend round-trips at all
+                    for app in client.list():
+                        print(
+                            f"{app.get('scheduler')}\t{app.get('app_id')}"
+                            f"\t{app.get('state')}"
+                        )
+            except ControlClientError as e:
+                print(f"control: {e.message}", file=sys.stderr)
+                sys.exit(1)
+            return
         with get_runner() as runner:
             if args.scheduler:
                 for app in runner.list(args.scheduler):
@@ -75,6 +117,17 @@ class CmdCancel(SubCommand):
         subparser.add_argument("app_handle")
 
     def run(self, args: argparse.Namespace) -> None:
+        client = control_client()
+        if client is not None:
+            from torchx_tpu.control.client import ControlClientError
+
+            try:
+                client.cancel(args.app_handle)
+            except ControlClientError as e:
+                print(f"control: {e.message}", file=sys.stderr)
+                sys.exit(1)
+            print(f"cancelled {args.app_handle}")
+            return
         with get_runner() as runner:
             runner.cancel(args.app_handle)
             print(f"cancelled {args.app_handle}")

@@ -44,6 +44,7 @@ BUILTIN_CMDS: dict[str, tuple[str, str]] = {
     "configure": ("torchx_tpu.cli.cmd_simple", "CmdConfigure"),
     "tracker": ("torchx_tpu.cli.cmd_tracker", "CmdTracker"),
     "serve-pool": ("torchx_tpu.cli.cmd_serve_pool", "CmdServePool"),
+    "control": ("torchx_tpu.cli.cmd_control", "CmdControl"),
 }
 
 

@@ -1,0 +1,243 @@
+"""HTTP client for the ``tpx control`` daemon — the CLI's proxy seam.
+
+When ``$TPX_CONTROL_ADDR`` is set (or a live daemon's discovery file is
+found under ``$TPX_CONTROL_DIR``), :func:`maybe_client` returns a
+:class:`ControlClient` and the CLI routes submit/status/list/cancel/wait/
+log verbs through the daemon instead of driving schedulers directly —
+thousands of shells then share one reconciler. When neither is present it
+returns None and the CLI falls back to direct-runner mode, byte-for-byte
+the pre-daemon behavior.
+
+stdlib-only (urllib), so the proxy path adds nothing to the CLI's
+import cost — ``tpx --help`` stays jax-free with the daemon registered.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from typing import Iterator, Optional
+
+from torchx_tpu import settings
+
+DEFAULT_TIMEOUT = 30.0
+
+
+class ControlClientError(RuntimeError):
+    """A daemon request failed; carries the HTTP status (0 = transport)."""
+
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class ControlClient:
+    """Thin JSON-over-HTTP wrapper mirroring the daemon's verb set."""
+
+    def __init__(
+        self, addr: str, token: str, timeout: float = DEFAULT_TIMEOUT
+    ) -> None:
+        self.addr = addr.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _request(
+        self,
+        path: str,
+        payload: Optional[dict] = None,
+        timeout: Optional[float] = None,
+    ) -> dict:
+        req = urllib.request.Request(
+            self.addr + path,
+            data=None if payload is None else json.dumps(payload).encode(),
+            headers={
+                "Authorization": f"Bearer {self.token}",
+                "Content-Type": "application/json",
+            },
+            method="GET" if payload is None else "POST",
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout or self.timeout
+            ) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                message = json.loads(e.read() or b"{}").get("error", str(e))
+            except ValueError:
+                message = str(e)
+            raise ControlClientError(e.code, message) from e
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise ControlClientError(0, f"control daemon unreachable: {e}") from e
+
+    # -- verbs -------------------------------------------------------------
+
+    def healthz(self) -> dict:
+        """Liveness probe: the daemon's version, uptime, and stream count."""
+        return self._request("/healthz")
+
+    def mint_session(self, tenant: str) -> str:
+        """Mint a per-tenant session token (root-token callers only)."""
+        return str(self._request("/v1/session", {"tenant": tenant})["token"])
+
+    def submit(
+        self,
+        component: str,
+        args: list[str],
+        scheduler: str,
+        cfg: Optional[dict] = None,
+        cfg_str: str = "",
+        workspace: Optional[str] = None,
+    ) -> str:
+        """Submit through the daemon. ``cfg_str`` ships the CLI's raw
+        ``-cfg k=v,...`` string so the daemon parses it against the
+        backend's typed runopts schema (the client stays schema-blind)."""
+        return str(
+            self._request(
+                "/v1/submit",
+                {
+                    "component": component,
+                    "args": list(args),
+                    "scheduler": scheduler,
+                    "cfg": dict(cfg or {}),
+                    "cfg_str": cfg_str,
+                    "workspace": workspace,
+                },
+            )["handle"]
+        )
+
+    def status(self, handle: str) -> dict:
+        """One job's recorded state: answered from the daemon's
+        reconciler journal + shared describe cache, not a fresh backend
+        describe per call."""
+        from urllib.parse import quote
+
+        return self._request(f"/v1/status?handle={quote(handle, safe='')}")
+
+    def list(self, scheduler: Optional[str] = None) -> list[dict]:
+        """All jobs the daemon tracks, optionally filtered by backend."""
+        path = "/v1/list"
+        if scheduler:
+            from urllib.parse import quote
+
+            path += f"?scheduler={quote(scheduler, safe='')}"
+        return list(self._request(path).get("apps", []))
+
+    def cancel(self, handle: str) -> None:
+        """Cancel the job on its backend (and release the tenant's slot)."""
+        self._request("/v1/cancel", {"handle": handle})
+
+    def wait(self, handle: str, timeout: Optional[float] = None) -> dict:
+        """Block until terminal: chained bounded long-polls against
+        ``/v1/wait`` (each HTTP request stays short; the daemon's
+        reconciler wakes it the moment the terminal event lands)."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        from urllib.parse import quote
+
+        while True:
+            budget = 30.0
+            if deadline is not None:
+                budget = min(budget, max(0.1, deadline - time.monotonic()))
+            payload = self._request(
+                f"/v1/wait?handle={quote(handle, safe='')}&timeout={budget:g}",
+                timeout=budget + 15.0,
+            )
+            if payload.get("terminal"):
+                return payload
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"app {handle} still {payload.get('state')} after {timeout}s"
+                )
+
+    def log_lines(
+        self,
+        handle: str,
+        role_name: str = "app",
+        k: int = 0,
+        tail: bool = False,
+    ) -> Iterator[str]:
+        """Stream one replica's log lines through the daemon (JSONL);
+        ``tail=True`` follows the stream until the app finishes."""
+        from urllib.parse import quote
+
+        req = urllib.request.Request(
+            f"{self.addr}/v1/logs?handle={quote(handle, safe='')}"
+            f"&role={quote(role_name, safe='')}&k={int(k)}"
+            f"&tail={'1' if tail else '0'}",
+            headers={"Authorization": f"Bearer {self.token}"},
+        )
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=None if tail else self.timeout
+            )
+        except urllib.error.HTTPError as e:
+            try:
+                message = json.loads(e.read() or b"{}").get("error", str(e))
+            except ValueError:
+                message = str(e)
+            raise ControlClientError(e.code, message) from e
+        except (urllib.error.URLError, OSError) as e:
+            raise ControlClientError(0, f"control daemon unreachable: {e}") from e
+        with resp:
+            for raw in resp:
+                try:
+                    doc = json.loads(raw)
+                except ValueError:
+                    continue
+                if doc.get("done"):
+                    return
+                if "line" in doc:
+                    yield str(doc["line"])
+
+
+def _discovery() -> Optional[tuple[str, str]]:
+    """(addr, token) from the daemon's discovery file, if one exists."""
+    from torchx_tpu.control.daemon import DISCOVERY_FILE, control_dir
+
+    path = os.path.join(control_dir(), DISCOVERY_FILE)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        addr, token = str(doc.get("addr", "")), str(doc.get("token", ""))
+        if addr and token:
+            return addr, token
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def maybe_client(require_env: bool = True) -> Optional[ControlClient]:
+    """The CLI's proxy decision, in one place.
+
+    ``$TPX_CONTROL_ADDR`` set -> a client for that address (token from
+    ``$TPX_CONTROL_TOKEN``, else the discovery file). Unset -> None
+    (direct-runner mode) unless ``require_env=False``, which also accepts
+    a discovery file alone (how ``tpx control status`` finds its daemon).
+    """
+    addr = os.environ.get(settings.ENV_TPX_CONTROL_ADDR, "").strip()
+    token = os.environ.get(settings.ENV_TPX_CONTROL_TOKEN, "").strip()
+    if addr:
+        if not token:
+            found = _discovery()
+            if found is not None and found[0].rstrip("/") == addr.rstrip("/"):
+                token = found[1]
+        if not token:
+            raise ControlClientError(
+                401,
+                f"{settings.ENV_TPX_CONTROL_ADDR} is set but no token: set"
+                f" {settings.ENV_TPX_CONTROL_TOKEN} or run the daemon with a"
+                " readable discovery file",
+            )
+        return ControlClient(addr, token)
+    if not require_env:
+        found = _discovery()
+        if found is not None:
+            return ControlClient(found[0], found[1])
+    return None

@@ -1,0 +1,550 @@
+"""``tpx control`` — the multi-tenant control-plane daemon.
+
+One long-lived localhost process owns a Runner, a
+:class:`~torchx_tpu.control.reconciler.Reconciler` (all watch streams),
+and the sharded :class:`~torchx_tpu.control.store.JobStateStore`, and
+serves the launcher verbs over plain JSON HTTP (the stdlib
+ThreadingHTTPServer idiom the serving stack already uses). Every CLI on
+the machine then shares ONE describe path and ONE event stream per
+backend instead of each running its own poll loop.
+
+API (JSON; Bearer-token auth on every ``/v1`` route):
+
+    GET  /healthz                 -> {"status": "ok", "jobs": N, ...}
+    GET  /metricz                 -> tpx_* metrics, Prometheus text
+    POST /v1/session  {"tenant"}  -> {"token"}          (root token only)
+    POST /v1/submit   {"component", "args", "scheduler", "cfg", ...}
+                                  -> {"handle"} | 429 past the tenant cap
+    GET  /v1/status?handle=       -> {"state", "terminal", ...} | 404
+    GET  /v1/list[?scheduler=]    -> {"apps": [...]}
+    POST /v1/cancel   {"handle"}  -> {"ok": true}
+    GET  /v1/wait?handle=&timeout= -> bounded long-poll; returns the
+                                  status when terminal or when the budget
+                                  expires ({"terminal": false})
+    GET  /v1/logs?handle=&role=&k= -> JSONL line stream (log attach)
+
+Security model: the daemon binds loopback only. At start it mints a root
+token and records ``{"addr", "token", "pid"}`` in a 0600 discovery file
+(``$TPX_CONTROL_DIR/control.json``) — same-user CLIs find the daemon
+through it (:func:`torchx_tpu.control.client.maybe_client`). The root
+token can mint per-tenant session tokens (``/v1/session``); each tenant
+is capped at ``tenant_cap`` concurrently *active* (non-terminal) jobs,
+submits past the cap get 429 and the caller's retry policy decides.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import secrets
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import parse_qs, urlparse
+
+from torchx_tpu import settings
+from torchx_tpu.control.events import StateEvent
+from torchx_tpu.control.reconciler import Reconciler
+from torchx_tpu.control.store import JobStateStore
+from torchx_tpu.obs import metrics as obs_metrics
+from torchx_tpu.specs.api import AppState
+
+logger = logging.getLogger(__name__)
+
+DISCOVERY_FILE = "control.json"
+
+
+def control_dir() -> str:
+    """State root for the control plane: ``$TPX_CONTROL_DIR``, default
+    ``~/.torchx_tpu/control``."""
+    raw = os.environ.get(settings.ENV_TPX_CONTROL_DIR)
+    if raw and raw.strip():
+        return raw
+    return os.path.join(os.path.expanduser("~"), ".torchx_tpu", "control")
+
+
+class _DaemonError(Exception):
+    """Maps straight to an HTTP error reply."""
+
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class ControlDaemon:
+    """The daemon's state + HTTP server; see the module docstring.
+
+    Args:
+        runner: the :class:`~torchx_tpu.runner.api.Runner` driving the
+            backends (default: a fresh ``get_runner("tpx-control")``).
+        host/port: bind address — loopback by default; ``port=0`` lets
+            the OS pick (read it back from :attr:`addr`).
+        state_dir: discovery file + job-state store root (default
+            :func:`control_dir`).
+        tenant_cap: max concurrently active jobs per tenant (default
+            :data:`~torchx_tpu.settings.DEFAULT_CONTROL_TENANT_CAP`).
+    """
+
+    def __init__(
+        self,
+        runner: Optional[Any] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        state_dir: Optional[str] = None,
+        tenant_cap: Optional[int] = None,
+    ) -> None:
+        if runner is None:
+            from torchx_tpu.runner.api import get_runner
+
+            runner = get_runner("tpx-control")
+        self.runner = runner
+        self.state_dir = state_dir or control_dir()
+        self.tenant_cap = (
+            tenant_cap
+            if tenant_cap is not None
+            else settings.DEFAULT_CONTROL_TENANT_CAP
+        )
+        self.store = JobStateStore(os.path.join(self.state_dir, "store"))
+        self.reconciler = Reconciler(store=self.store)
+        runner.attach_reconciler(self.reconciler)
+        self.root_token = secrets.token_hex(16)
+        self._tokens: dict[str, str] = {self.root_token: "root"}
+        # handle -> tenant, for the per-tenant active-job cap. Rehydrated
+        # handles (daemon restart) land under their journaled tenant.
+        self._jobs: dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._server = ThreadingHTTPServer((host, port), self._make_handler())
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+        self._serving = False
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def addr(self) -> str:
+        """The daemon's base URL, e.g. ``http://127.0.0.1:PORT``."""
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def discovery_path(self) -> str:
+        """Where the 0600 addr+token discovery file lives under state_dir."""
+        return os.path.join(self.state_dir, DISCOVERY_FILE)
+
+    def _write_discovery(self) -> None:
+        """Record addr + root token for same-user CLIs, 0600 (the token
+        IS the auth boundary between users on a shared host)."""
+        os.makedirs(self.state_dir, exist_ok=True)
+        path = self.discovery_path()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"addr": self.addr, "token": self.root_token, "pid": os.getpid()},
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.chmod(tmp, 0o600)
+        os.replace(tmp, path)
+
+    def start(self) -> "ControlDaemon":
+        """Write the discovery file and serve on a background thread."""
+        self._write_discovery()
+        self._serving = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="tpx-control", daemon=True
+        )
+        self._thread.start()
+        logger.info("tpx control serving on %s", self.addr)
+        return self
+
+    def serve_forever(self) -> None:
+        """Foreground mode (what ``tpx control`` runs)."""
+        self._write_discovery()
+        logger.info("tpx control serving on %s", self.addr)
+        self._serving = True
+        try:
+            self._server.serve_forever()
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Stop serving, join the serve thread, close the reconciler, and
+        remove the discovery file. Idempotent; safe on a never-started
+        daemon."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._serving:
+            # shutdown() blocks on the serve loop acknowledging — never
+            # call it on a server whose serve_forever was never entered
+            self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.reconciler.close()
+        try:
+            os.remove(self.discovery_path())
+        except OSError:
+            pass
+
+    # -- tenancy -----------------------------------------------------------
+
+    def _authenticate(self, header: Optional[str]) -> str:
+        """Bearer token -> tenant name, or 401."""
+        if header and header.startswith("Bearer "):
+            tenant = self._tokens.get(header[len("Bearer ") :].strip())
+            if tenant is not None:
+                return tenant
+        raise _DaemonError(401, "missing or invalid bearer token")
+
+    def mint_session(self, tenant: str) -> str:
+        """Issue a fresh bearer token bound to ``tenant`` (in-memory only;
+        tokens die with the daemon)."""
+        token = secrets.token_hex(16)
+        with self._lock:
+            self._tokens[token] = tenant
+        return token
+
+    def _active_jobs(self, tenant: str) -> int:
+        """Jobs of the tenant whose last journaled state is still live.
+        A job with no event yet counts as active (its SUBMITTED seed is
+        written on the submit path, so this is a closing race, not a
+        steady state)."""
+        with self._lock:
+            handles = [h for h, t in self._jobs.items() if t == tenant]
+        active = 0
+        for handle in handles:
+            scheduler, app_id = self._split_handle(handle)
+            event = self.reconciler.latest(scheduler, app_id) or self.store.latest(
+                scheduler, app_id
+            )
+            if event is None or not (
+                event.terminal or event.state == AppState.UNKNOWN
+            ):
+                active += 1
+        obs_metrics.CONTROL_ACTIVE_JOBS.set(float(active), tenant=tenant)
+        return active
+
+    @staticmethod
+    def _split_handle(handle: str) -> tuple[str, str]:
+        from torchx_tpu.specs.api import parse_app_handle
+
+        scheduler, _, app_id = parse_app_handle(handle)
+        return scheduler, app_id
+
+    # -- verbs -------------------------------------------------------------
+
+    def _op_session(self, tenant: str, req: dict) -> dict:
+        if tenant != "root":
+            raise _DaemonError(403, "only the root token mints sessions")
+        name = str(req.get("tenant", "")).strip()
+        if not name:
+            raise _DaemonError(400, "missing tenant name")
+        return {"token": self.mint_session(name)}
+
+    def _op_submit(self, tenant: str, req: dict) -> dict:
+        component = req.get("component")
+        scheduler = req.get("scheduler")
+        if not component or not scheduler:
+            raise _DaemonError(400, "submit needs component and scheduler")
+        active = self._active_jobs(tenant)
+        if active >= self.tenant_cap:
+            raise _DaemonError(
+                429,
+                f"tenant {tenant!r} has {active} active jobs"
+                f" (cap {self.tenant_cap}); retry after one finishes",
+            )
+        try:
+            # cfg_str (the CLI's raw -cfg string) parses against the
+            # backend's typed runopts schema HERE — clients stay
+            # schema-blind; an explicit cfg dict overlays the result
+            cfg = {}
+            cfg_str = str(req.get("cfg_str") or "")
+            if cfg_str:
+                cfg.update(
+                    self.runner.scheduler_run_opts(str(scheduler)).cfg_from_str(
+                        cfg_str
+                    )
+                )
+            cfg.update(dict(req.get("cfg") or {}))
+            handle = self.runner.run_component(
+                str(component),
+                [str(a) for a in req.get("args", [])],
+                str(scheduler),
+                cfg=cfg,
+                workspace=req.get("workspace"),
+            )
+        except _DaemonError:
+            raise
+        except Exception as e:  # noqa: BLE001 - surfaced to the client
+            raise _DaemonError(400, f"{type(e).__name__}: {e}") from e
+        sched_name, app_id = self._split_handle(handle)
+        with self._lock:
+            self._jobs[handle] = tenant
+        # seed the journal (the cap's ground truth) and join the watch
+        # stream so the terminal event lands without anyone polling
+        self.reconciler.ingest(
+            StateEvent(
+                scheduler=sched_name,
+                app_id=app_id,
+                state=AppState.SUBMITTED,
+                source="daemon",
+            )
+        )
+        self.reconciler.track(
+            sched_name, self.runner._scheduler(sched_name), app_id
+        )
+        self._active_jobs(tenant)
+        return {"handle": handle}
+
+    def _status_payload(self, handle: str, status: Optional[Any]) -> dict:
+        if status is None:
+            return {"handle": handle, "state": "UNKNOWN", "terminal": True}
+        failure_class = getattr(status, "failure_class", None)
+        roles = []
+        for role in getattr(status, "roles", []) or []:
+            roles.append(
+                {
+                    "role": getattr(role, "role", ""),
+                    "replicas": [
+                        getattr(r, "id", 0)
+                        for r in getattr(role, "replicas", []) or []
+                    ],
+                }
+            )
+        return {
+            "handle": handle,
+            "state": str(getattr(status.state, "name", status.state)),
+            "terminal": bool(status.is_terminal()),
+            "num_restarts": getattr(status, "num_restarts", 0),
+            "msg": getattr(status, "msg", ""),
+            "failure_class": (
+                str(getattr(failure_class, "name", failure_class))
+                if failure_class is not None
+                else None
+            ),
+            "ui_url": getattr(status, "ui_url", None),
+            "roles": roles,
+        }
+
+    def _op_status(self, tenant: str, query: dict) -> dict:
+        handle = self._one(query, "handle")
+        status = self.runner.status(handle)
+        if status is None:
+            raise _DaemonError(404, f"unknown app {handle}")
+        return self._status_payload(handle, status)
+
+    def _op_list(self, tenant: str, query: dict) -> dict:
+        scheduler = query.get("scheduler", [None])[0]
+        if scheduler:
+            apps = self.runner.list(scheduler)
+            return {
+                "apps": [
+                    {"app_id": a.app_id, "state": str(a.state.name)} for a in apps
+                ]
+            }
+        # fleet view: everything the journal knows, no backend calls
+        out = []
+        for (sched, app_id), event in sorted(self.store.snapshot().items()):
+            out.append(
+                {
+                    "scheduler": sched,
+                    "app_id": app_id,
+                    "state": event.state.name,
+                    "time_usec": event.time_usec,
+                }
+            )
+        return {"apps": out}
+
+    def _op_cancel(self, tenant: str, req: dict) -> dict:
+        handle = str(req.get("handle", ""))
+        if not handle:
+            raise _DaemonError(400, "missing handle")
+        try:
+            self.runner.cancel(handle)
+        except Exception as e:  # noqa: BLE001
+            raise _DaemonError(400, f"{type(e).__name__}: {e}") from e
+        return {"ok": True}
+
+    def _op_wait(self, tenant: str, query: dict) -> dict:
+        """Bounded long-poll: rides the reconciler's wake path, so a
+        terminal event answers immediately; budget capped at 60s per
+        request (clients re-issue — HTTP stays short-lived)."""
+        handle = self._one(query, "handle")
+        budget = min(60.0, float(query.get("timeout", ["30"])[0] or 30.0))
+        scheduler, app_id = self._split_handle(handle)
+        self.reconciler.track(
+            scheduler, self.runner._scheduler(scheduler), app_id
+        )
+        deadline = time.monotonic() + budget
+        while True:
+            status = self.runner.status(handle)
+            if status is None:
+                return {"handle": handle, "state": "UNKNOWN", "terminal": True}
+            if status.is_terminal():
+                return self._status_payload(handle, status)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                payload = self._status_payload(handle, status)
+                payload["terminal"] = False
+                return payload
+            self.reconciler.wait_event(
+                scheduler, app_id, timeout=min(remaining, 2.0)
+            )
+
+    def _one(self, query: dict, key: str) -> str:
+        vals = query.get(key) or []
+        if not vals or not vals[0]:
+            raise _DaemonError(400, f"missing query parameter {key!r}")
+        return str(vals[0])
+
+    # -- HTTP plumbing -----------------------------------------------------
+
+    def _make_handler(self) -> Any:
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: Any) -> None:  # quiet
+                pass
+
+            def _reply(self, code: int, payload: dict, op: str = "") -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                if op:
+                    obs_metrics.CONTROL_REQUESTS.inc(op=op, code=str(code))
+
+            def _run(self, op: str, fn: Any) -> None:
+                start = time.perf_counter()
+                try:
+                    payload = fn()
+                    code = 200
+                except _DaemonError as e:
+                    payload, code = {"error": e.message}, e.code
+                except Exception as e:  # noqa: BLE001 - keep the daemon up
+                    logger.warning("control %s failed: %s", op, e)
+                    payload, code = {"error": f"{type(e).__name__}: {e}"}, 500
+                obs_metrics.CONTROL_REQUEST_SECONDS.observe(
+                    time.perf_counter() - start, op=op
+                )
+                self._reply(code, payload, op=op)
+
+            def _tenant(self) -> str:
+                return daemon._authenticate(self.headers.get("Authorization"))
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                raw = self.rfile.read(n) if n else b"{}"
+                try:
+                    doc = json.loads(raw or b"{}")
+                except ValueError as e:
+                    raise _DaemonError(400, f"bad JSON body: {e}") from e
+                if not isinstance(doc, dict):
+                    raise _DaemonError(400, "body must be a JSON object")
+                return doc
+
+            def do_GET(self) -> None:  # noqa: N802
+                url = urlparse(self.path)
+                query = parse_qs(url.query)
+                if url.path == "/healthz":
+                    self._reply(
+                        200,
+                        {
+                            "status": "ok",
+                            "jobs": len(daemon.store),
+                            "addr": daemon.addr,
+                            "tenant_cap": daemon.tenant_cap,
+                        },
+                    )
+                elif url.path == "/metricz":
+                    text = obs_metrics.REGISTRY.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(text)))
+                    self.end_headers()
+                    self.wfile.write(text)
+                elif url.path == "/v1/status":
+                    self._run(
+                        "status",
+                        lambda: daemon._op_status(self._tenant(), query),
+                    )
+                elif url.path == "/v1/list":
+                    self._run(
+                        "list", lambda: daemon._op_list(self._tenant(), query)
+                    )
+                elif url.path == "/v1/wait":
+                    self._run(
+                        "wait", lambda: daemon._op_wait(self._tenant(), query)
+                    )
+                elif url.path == "/v1/logs":
+                    self._logs(query)
+                else:
+                    self._reply(404, {"error": f"unknown path {url.path}"})
+
+            def do_POST(self) -> None:  # noqa: N802
+                url = urlparse(self.path)
+                if url.path == "/v1/session":
+                    self._run(
+                        "session",
+                        lambda: daemon._op_session(self._tenant(), self._body()),
+                    )
+                elif url.path == "/v1/submit":
+                    self._run(
+                        "submit",
+                        lambda: daemon._op_submit(self._tenant(), self._body()),
+                    )
+                elif url.path == "/v1/cancel":
+                    self._run(
+                        "cancel",
+                        lambda: daemon._op_cancel(self._tenant(), self._body()),
+                    )
+                else:
+                    self._reply(404, {"error": f"unknown path {url.path}"})
+
+            def _logs(self, query: dict) -> None:
+                """Log attach: JSONL stream, one {"line": ...} per log
+                line, closed by {"done": true}. Auth + argument errors
+                surface as clean JSON replies BEFORE streaming starts."""
+                try:
+                    self._tenant()
+                    handle = daemon._one(query, "handle")
+                    role = query.get("role", ["app"])[0]
+                    k = int(query.get("k", ["0"])[0] or 0)
+                    tail = query.get("tail", ["0"])[0] in ("1", "true")
+                    lines = daemon.runner.log_lines(
+                        handle, role, k=k, should_tail=tail
+                    )
+                except _DaemonError as e:
+                    self._reply(e.code, {"error": e.message}, op="logs")
+                    return
+                except Exception as e:  # noqa: BLE001
+                    self._reply(
+                        400, {"error": f"{type(e).__name__}: {e}"}, op="logs"
+                    )
+                    return
+                obs_metrics.CONTROL_REQUESTS.inc(op="logs", code="200")
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    for line in lines:
+                        self.wfile.write(
+                            json.dumps({"line": line.rstrip("\n")}).encode()
+                            + b"\n"
+                        )
+                        self.wfile.flush()
+                    self.wfile.write(b'{"done": true}\n')
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client detached mid-stream
+
+        return Handler

@@ -480,3 +480,51 @@ SERVE_SCALE_EVENTS = REGISTRY.counter(
     "serve-pool autoscale resizes applied",
     ("direction",),
 )
+
+# -- fleet control plane (torchx_tpu/control/) ------------------------------
+
+#: state-transition events emitted by scheduler watch streams, by source
+#: ("sidecar"/"kubectl"/"poll") — the control plane's unit of work.
+WATCH_EVENTS = REGISTRY.counter(
+    "tpx_watch_events_total",
+    "scheduler watch-stream state events observed",
+    ("scheduler", "source"),
+)
+
+#: live watch streams, one per (scheduler, reconciler) pair.
+WATCH_STREAMS = REGISTRY.gauge(
+    "tpx_watch_streams",
+    "watch streams currently owned by a reconciler",
+    ("scheduler",),
+)
+
+#: Runner.wait waiters woken early by a reconciler event (instead of
+#: sleeping out their full poll interval).
+WAITER_WAKEUPS = REGISTRY.counter(
+    "tpx_waiter_wakeups_total",
+    "wait() waiters woken by a watch event before their poll interval",
+    ("scheduler",),
+)
+
+#: control-daemon HTTP requests, by logical op and response code.
+CONTROL_REQUESTS = REGISTRY.counter(
+    "tpx_control_requests_total",
+    "control daemon API requests served",
+    ("op", "code"),
+)
+
+#: control-daemon request latency by logical op.
+CONTROL_REQUEST_SECONDS = REGISTRY.histogram(
+    "tpx_control_request_seconds",
+    "control daemon API request latency in seconds",
+    ("op",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0),
+)
+
+#: active (non-terminal) jobs the daemon tracks per tenant — the value the
+#: per-tenant 429 cap is enforced against.
+CONTROL_ACTIVE_JOBS = REGISTRY.gauge(
+    "tpx_control_active_jobs",
+    "active jobs per control-daemon tenant",
+    ("tenant",),
+)

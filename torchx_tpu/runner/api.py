@@ -75,6 +75,9 @@ class Runner:
         self._component_defaults = dict(component_defaults or {})
         self._scheduler_params = dict(scheduler_params or {})
         self._describe_cache = DescribeCache()
+        # set via attach_reconciler: wait() then wakes on watch events
+        # instead of sleeping out its poll interval
+        self._reconciler: Optional[Any] = None
         # fan-out paths create scheduler instances from worker threads
         self._sched_locks_guard = threading.Lock()
         self._sched_locks: dict[str, threading.Lock] = {}
@@ -299,6 +302,39 @@ class Runner:
 
     # -- monitor path ------------------------------------------------------
 
+    def attach_reconciler(self, reconciler: Any) -> None:
+        """Join this runner to a control-plane reconciler
+        (:class:`~torchx_tpu.control.reconciler.Reconciler`): watch events
+        refresh this runner's describe cache through its writer path, and
+        :meth:`wait` wakes on events instead of sleeping out its poll
+        interval (the poll loop stays as the fallback — a dead watch
+        stream degrades latency, never correctness)."""
+        self._reconciler = reconciler
+        reconciler.bind_cache(self._describe_cache)
+
+    def _wait_tick(
+        self,
+        scheduler: str,
+        app_id: str,
+        interval: float,
+        sleep: Callable[[float], None],
+    ) -> None:
+        """One wait-loop pause: block on the reconciler's condition
+        variable when a reconciler is attached (a watch event — or an
+        already-recorded terminal — returns early and the next poll is
+        served from the pinned cache entry), else plain sleep."""
+        rec = self._reconciler
+        if rec is not None:
+            try:
+                if rec.wait_event(scheduler, app_id, timeout=interval) is not None:
+                    obs_metrics.WAITER_WAKEUPS.inc(scheduler=scheduler)
+                # a timeout also consumed the full interval blocking on
+                # the condition variable — never sleep on top of it
+                return
+            except Exception:  # noqa: BLE001 - wake path is an optimization
+                logger.debug("reconciler wait_event failed", exc_info=True)
+        sleep(interval)
+
     def status(
         self, app_handle: AppHandle, fresh: bool = False
     ) -> Optional[AppStatus]:
@@ -362,6 +398,10 @@ class Runner:
         under it), with the poll count in attrs and the per-scheduler poll
         counter metric incremented as it goes."""
         scheduler, _, app_id = parse_app_handle(app_handle)
+        if self._reconciler is not None:
+            # join the backend's watch stream: terminal transitions then
+            # wake this wait immediately via _wait_tick
+            self._reconciler.track(scheduler, self._scheduler(scheduler), app_id)
         deadline = (
             time.monotonic() + timeout if timeout is not None else None
         )
@@ -396,7 +436,7 @@ class Runner:
                             f"app {app_handle} status unknown after {timeout}s"
                             " (polls failing)"
                         ) from e
-                    sleep(interval)
+                    self._wait_tick(scheduler, app_id, interval, sleep)
                     continue
                 polls += 1
                 obs_metrics.WAIT_POLLS.inc(scheduler=scheduler)
@@ -414,7 +454,7 @@ class Runner:
                             f" {timeout}s"
                         )
                     interval = min(interval, remaining)
-                sleep(interval)
+                self._wait_tick(scheduler, app_id, interval, sleep)
         raise AssertionError("unreachable: poll_intervals is infinite")
 
     def _emit_poll_degraded(

@@ -142,6 +142,22 @@ class DescribeCache:
         flight.event.set()
         return resp
 
+    def put(
+        self, scheduler: str, app_id: str, resp: Optional[DescribeAppResponse]
+    ) -> None:
+        """Install a response a WATCH stream (reconciler) observed — the
+        same writer path a completing ``get(fresh=True)`` takes: the entry
+        is stamped now, terminal states are pinned forever, and ``None``
+        (backend forgot the app) drops any stale entry. This is how watch
+        events refresh the cache without a second cache layer."""
+        with self._lock:
+            if resp is None:
+                self._entries.pop((scheduler, app_id), None)
+                return
+            self._entries[(scheduler, app_id)] = _Entry(
+                resp, time.monotonic(), is_terminal(resp.state)
+            )
+
     def invalidate(self, scheduler: str, app_id: Optional[str] = None) -> None:
         """Drop cached entries after a mutation (``cancel``/``delete``/
         ``resize``); ``app_id=None`` drops every entry for the scheduler."""

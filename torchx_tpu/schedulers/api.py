@@ -98,6 +98,12 @@ class SchedulerCapabilities:
         classifies_preemption: backend can distinguish PREEMPTED from FAILED
             in :meth:`Scheduler.classify_failure` — without it, preemptions
             burn the supervisor's (default zero) APP_ERROR budget.
+        watch: backend has a *native* event source behind
+            :meth:`Scheduler.watch` (local sidecar mtime, GKE kubectl
+            stream) — transitions surface at event latency. Without it the
+            same ``watch()`` interface still works but rides the generic
+            poll adapter, so hang/terminal detection latency degrades to
+            the watch poll interval (what analyze rule TPX601 warns about).
     """
 
     mounts: bool = False
@@ -110,6 +116,7 @@ class SchedulerCapabilities:
     native_retries: bool = False
     concrete_resources: bool = False
     classifies_preemption: bool = False
+    watch: bool = False
 
 
 def dquote(s: str) -> str:
@@ -314,6 +321,24 @@ class Scheduler(ABC, Generic[T]):
         raise NotImplementedError(
             f"{self.backend} scheduler does not support listing apps"
         )
+
+    def watch(
+        self, app_ids: "Iterable[str]" = (), interval: Optional[float] = None
+    ) -> Any:
+        """An event stream over the given apps: a
+        :class:`~torchx_tpu.control.watch.Watcher` whose ``events()``
+        iterator yields one :class:`~torchx_tpu.control.events.StateEvent`
+        per observed state transition.
+
+        Every backend supports this interface; only backends that declare
+        the ``watch`` capability back it with a native event source
+        (sidecar mtime, kubectl stream). The default is the generic poll
+        adapter — still one coalesced describe scan per tick regardless of
+        how many waiters consume the stream, and still routed through the
+        backend's resilient describe seam."""
+        from torchx_tpu.control.watch import PollWatcher
+
+        return PollWatcher(self, app_ids, interval=interval)
 
     def exists(self, app_id: str) -> bool:
         """True when the backend still knows ``app_id``."""

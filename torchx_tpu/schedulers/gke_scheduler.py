@@ -780,6 +780,9 @@ CAPABILITIES = SchedulerCapabilities(
     native_retries=True,
     concrete_resources=True,
     classifies_preemption=True,
+    # native event source: kubectl's streaming watch (see
+    # GKEScheduler.watch); degrades to the poll adapter without kubectl
+    watch=True,
 )
 
 
@@ -930,6 +933,16 @@ class GKEScheduler(DockerWorkspaceMixin, Scheduler[GKEJob]):
         if not name:
             raise ValueError(f"invalid gke app id {app_id!r}; expected namespace:name")
         return namespace, name
+
+    def watch(self, app_ids=(), interval=None):
+        """Native event stream: one ``kubectl get jobsets -w`` subprocess
+        per watched namespace (shared by every JobSet in it), with
+        terminal lines confirmed through :meth:`describe` so preemption
+        classification stays authoritative. Falls back to the generic
+        poll scan for namespaces where kubectl cannot be spawned."""
+        from torchx_tpu.control.watch import KubectlWatcher
+
+        return KubectlWatcher(self, app_ids, interval=interval)
 
     def describe(self, app_id: str) -> Optional[DescribeAppResponse]:
         namespace, name = self._parse_app_id(app_id)

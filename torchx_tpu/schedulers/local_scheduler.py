@@ -452,6 +452,9 @@ CAPABILITIES = SchedulerCapabilities(
     native_retries=True,
     concrete_resources=False,
     classifies_preemption=True,
+    # native event source: the state file + exitcode sidecars every job
+    # leaves next to its logs (see LocalScheduler.watch)
+    watch=True,
 )
 
 
@@ -1054,6 +1057,16 @@ class LocalScheduler(Scheduler[PopenRequest]):
         for replica_id, rp in enumerate(params):
             _rotate_attempt_logs(rp, attempt)
             app.add_replica(role.name, self._popen(role.name, replica_id, rp))
+
+    def watch(self, app_ids=(), interval=None):
+        """Native event stream: mtime-polls the state file and counts the
+        per-replica ``exitcode`` sidecars the launch wrapper writes, so a
+        tick over N jobs costs N ``stat`` calls and a describe only fires
+        to *confirm* an observed change (state writes, external cancels,
+        replica exits all bump one of those signals)."""
+        from torchx_tpu.control.watch import LocalSidecarWatcher
+
+        return LocalSidecarWatcher(self, app_ids, interval=interval)
 
     def list(self) -> list[ListAppResponse]:
         return resilient_call(

@@ -291,6 +291,7 @@ class ServePool:
         router: Optional[LeastLoadedRouter] = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        reconciler: Optional[Any] = None,
     ) -> None:
         self._runner = runner
         self._app = app
@@ -304,6 +305,10 @@ class ServePool:
         self.router = router or LeastLoadedRouter()
         self._clock = clock
         self._sleep = sleep
+        # optional control-plane reconciler: the run() loop then consumes
+        # watch events (terminal detection at event latency, zero describe
+        # calls) instead of polling Runner.status every interval
+        self._reconciler = reconciler
         self.autoscaler = Autoscaler(self.policy, clock=clock)
         self.handle: Optional[str] = None
         self._replicas = next(
@@ -321,6 +326,13 @@ class ServePool:
         ):
             self.handle = self._runner.run(
                 self._app, self._scheduler, self._cfg
+            )
+        if self._reconciler is not None:
+            from torchx_tpu.specs.api import parse_app_handle
+
+            sched_name, _, app_id = parse_app_handle(self.handle)
+            self._reconciler.track(
+                sched_name, self._runner._scheduler(sched_name), app_id
             )
         obs_metrics.SERVE_REPLICAS.set(self._replicas)
         logger.info(
@@ -412,23 +424,56 @@ class ServePool:
         while iterations is None or done < iterations:
             if stop_event is not None and stop_event.is_set():
                 return
-            status = (
-                self._runner.status(self.handle)
-                if self.handle is not None
-                else None
-            )
-            if status is not None and status.state is not None:
-                from torchx_tpu.specs.api import is_terminal
-
-                if is_terminal(status.state):
-                    logger.warning(
-                        "serve pool app reached %s; controller exiting",
-                        status.state.name,
-                    )
-                    return
+            if self._app_terminal():
+                return
             self.step()
             done += 1
-            self._sleep(interval_s)
+            self._pause(interval_s)
+
+    def _app_terminal(self) -> bool:
+        """True when the pool's app reached a terminal state. With a
+        reconciler the answer comes from the watch stream's last event
+        (no describe call); otherwise from a status poll."""
+        if self.handle is None:
+            return False
+        if self._reconciler is not None:
+            from torchx_tpu.specs.api import parse_app_handle
+
+            sched_name, _, app_id = parse_app_handle(self.handle)
+            event = self._reconciler.latest(sched_name, app_id)
+            if event is not None and event.terminal:
+                logger.warning(
+                    "serve pool app reached %s (watch); controller exiting",
+                    event.state.name,
+                )
+                return True
+            if event is not None:
+                return False  # watch confirms it live: skip the poll
+        status = self._runner.status(self.handle)
+        if status is not None and status.state is not None:
+            from torchx_tpu.specs.api import is_terminal
+
+            if is_terminal(status.state):
+                logger.warning(
+                    "serve pool app reached %s; controller exiting",
+                    status.state.name,
+                )
+                return True
+        return False
+
+    def _pause(self, interval_s: float) -> None:
+        """Between steps: ride the reconciler's wake path when attached
+        (a terminal event cuts the sleep short; the next loop iteration
+        then exits immediately), else plain sleep."""
+        if self._reconciler is not None and self.handle is not None:
+            from torchx_tpu.specs.api import parse_app_handle
+
+            sched_name, _, app_id = parse_app_handle(self.handle)
+            # blocks up to interval_s either way; an event ends the pause
+            # early and the next iteration acts on it
+            self._reconciler.wait_event(sched_name, app_id, timeout=interval_s)
+            return
+        self._sleep(interval_s)
 
 
 # =========================================================================

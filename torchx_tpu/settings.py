@@ -88,6 +88,33 @@ ENV_TPX_DESCRIBE_CACHE_TTL = "TPX_DESCRIBE_CACHE_TTL"
 # successive wait ticks always observe fresh state.
 DEFAULT_DESCRIBE_CACHE_TTL = 1.0
 
+# Address ("host:port") of a running `tpx control` daemon. When set, the
+# CLI transparently proxies submit/status/list/cancel/log through the
+# daemon's HTTP API instead of driving schedulers directly — thousands of
+# callers then share ONE reconciler and ONE describe path per backend.
+# Unset = direct-runner mode (the pre-daemon behavior, unchanged).
+ENV_TPX_CONTROL_ADDR = "TPX_CONTROL_ADDR"
+
+# Bearer token presented to the control daemon. Falls back to the token
+# recorded in the daemon's discovery file ($TPX_CONTROL_DIR/control.json).
+ENV_TPX_CONTROL_TOKEN = "TPX_CONTROL_TOKEN"
+
+# State root for the control plane: the daemon's discovery file and the
+# sharded job-state store live here. Default ~/.torchx_tpu/control.
+ENV_TPX_CONTROL_DIR = "TPX_CONTROL_DIR"
+
+# Poll interval (seconds) for watch adapters that fall back to polling
+# (generic backends) and for the local scheduler's sidecar mtime watcher.
+# Watch streams coalesce N callers into one scan, so this can be much
+# tighter than Runner.wait's per-caller interval without amplifying
+# control-plane calls.
+ENV_TPX_WATCH_INTERVAL = "TPX_WATCH_INTERVAL"
+DEFAULT_WATCH_INTERVAL = 1.0
+
+# Default per-tenant cap on concurrently active (non-terminal) jobs
+# submitted through the control daemon; submits past the cap get HTTP 429.
+DEFAULT_CONTROL_TENANT_CAP = 64
+
 # ---------------------------------------------------------------------------
 # In-job (injected by schedulers into every replica)
 # ---------------------------------------------------------------------------
