@@ -314,6 +314,37 @@ def main() -> None:
         result["int8_launch_to_first_step_s"] = round(
             int8_metrics["launch_to_first_step_s"], 1
         )
+    # deep-preflight predictions next to the measured numbers, so the
+    # static cost model's error is tracked across bench rounds (the
+    # analyzer side of `tpx explain` — jax-free, pure arithmetic)
+    try:
+        from torchx_tpu.analyze import costmodel as _cm
+        from torchx_tpu.analyze.plan import MODEL_SHAPES, ParallelPlan
+
+        _name = "llama3_1b" if on_tpu else "tiny"
+        _plan = ParallelPlan(
+            role="bench",
+            model=MODEL_SHAPES[_name],
+            mesh_spec="fsdp=-1",
+            sizes=mesh_cfg.resolve(jax.device_count()),
+            batch=int(batch_used),
+            seq=int(seq),
+            remat_policy=str(result.get("remat_policy", policy_used)),
+            devices=jax.device_count(),
+            slices=1,
+            chips_per_slice=jax.device_count(),
+        )
+        _fit = _cm.hbm_fit(_plan)
+        result["explain_predictions"] = {
+            "hbm_total_bytes": _fit.total_bytes,
+            "hbm_components": dict(sorted(_fit.components.items())),
+            "collective_bytes_per_step": {
+                t.axis: t.bytes_per_step
+                for t in _cm.collective_traffic(_plan)
+            },
+        }
+    except Exception as e:  # noqa: BLE001 - predictions must not sink a bench
+        print(f"explain predictions failed: {e}", file=sys.stderr)
     print(json.dumps(result))
 
 

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Regenerate the ``tpx explain --json`` golden file.
+
+``tests/test_explain.py::test_explain_report_schema_golden`` pins the
+schema (version 1) and every byte of the deterministic cost-model output
+for one fixed plan. When the schema or the cost model changes *on
+purpose*, rerun this and commit the diff — the test failing otherwise is
+the point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+GOLDEN = os.path.join(REPO, "tests", "fixtures", "explain_golden.json")
+
+
+def main() -> int:
+    os.environ.setdefault("TPX_EVENT_DESTINATION", "null")
+    from torchx_tpu.analyze.explain import explain
+    from torchx_tpu.components import dist
+
+    app = dist.spmd(
+        "--config", "moe_tiny", "--mesh", "ep=2,fsdp=-1",
+        "--batch", "8", "--seq", "128",
+        m="my.custom_trainer", j="1x8",
+    )
+    report = explain(app, gate="test")
+    with open(GOLDEN, "w") as f:
+        json.dump(report.to_dict(), f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {GOLDEN}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""AST-based self-lint enforcing the repo's own layering invariants.
+
+Two rules, both load-bearing for the launcher's design:
+
+1. **jax-free layers stay jax-free.** ``cli/``, ``supervisor/``,
+   ``control/``, ``analyze/`` and ``parallel/mesh_config.py`` must never
+   import ``jax`` (or ``jax.*``) at module level: the client-side
+   supervisor, the preflight analyzer and ``tpx --help`` all run on
+   machines without an accelerator runtime, and a single eager import
+   regresses CLI latency by seconds. Function-local (lazy) imports are
+   allowed — that is the sanctioned escape hatch (``tpx explain --aot``).
+
+2. **scheduler subprocess calls go through the resilient seam.** Raw
+   ``subprocess.run/Popen/check_*/call`` in ``schedulers/`` bypasses the
+   retry/circuit-breaker wrapper; the only sanctioned call sites are the
+   ``_run_cmd`` methods (the seam each backend funnels through) and the
+   local scheduler's ``_popen`` (data-plane replica spawn, not a
+   control-plane call).
+
+Run directly (``python scripts/lint_internal.py``) or via the tier1.sh
+SELF_LINT step. Exit 0 clean, 1 violations (one line each).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "torchx_tpu")
+
+#: packages/modules (relative to torchx_tpu/) that must not import jax at
+#: module level
+JAX_FREE = (
+    "cli",
+    "supervisor",
+    "control",
+    "analyze",
+    os.path.join("parallel", "mesh_config.py"),
+)
+
+#: functions inside schedulers/ allowed to call subprocess directly
+SUBPROCESS_SEAM_FUNCS = ("_run_cmd", "_popen")
+
+SUBPROCESS_CALLS = ("run", "Popen", "check_call", "check_output", "call")
+
+
+def _py_files(path: str) -> list[str]:
+    if os.path.isfile(path):
+        return [path]
+    out = []
+    for root, _dirs, files in os.walk(path):
+        out.extend(
+            os.path.join(root, f) for f in files if f.endswith(".py")
+        )
+    return sorted(out)
+
+
+def _is_jax(name: str) -> bool:
+    return name == "jax" or name.startswith("jax.")
+
+
+def check_jax_free(path: str) -> list[str]:
+    """Module-level ``import jax`` / ``from jax ...`` statements in one
+    file (imports nested in functions are lazy and fine; class bodies and
+    ``if TYPE_CHECKING`` don't occur for jax here and stay flagged to keep
+    the rule simple)."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    bad = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.depth = 0
+
+        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+            self.depth += 1
+            self.generic_visit(node)
+            self.depth -= 1
+
+        visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+        def visit_Import(self, node: ast.Import) -> None:
+            if self.depth == 0:
+                for alias in node.names:
+                    if _is_jax(alias.name):
+                        bad.append((node.lineno, f"import {alias.name}"))
+
+        def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+            if self.depth == 0 and node.module and _is_jax(node.module):
+                bad.append((node.lineno, f"from {node.module} import ..."))
+
+    V().visit(tree)
+    rel = os.path.relpath(path, REPO)
+    return [
+        f"{rel}:{line}: module-level jax import in a jax-free layer"
+        f" ({stmt}); import inside the function that needs it"
+        for line, stmt in bad
+    ]
+
+
+def check_scheduler_subprocess(path: str) -> list[str]:
+    """Raw ``subprocess.<call>`` sites in one schedulers/ file outside the
+    sanctioned seam functions."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    bad = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.func_stack: list[str] = []
+
+        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+            self.func_stack.append(node.name)
+            self.generic_visit(node)
+            self.func_stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+        def visit_Call(self, node: ast.Call) -> None:
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "subprocess"
+                and fn.attr in SUBPROCESS_CALLS
+                and not any(
+                    f in SUBPROCESS_SEAM_FUNCS for f in self.func_stack
+                )
+            ):
+                bad.append((node.lineno, f"subprocess.{fn.attr}"))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    rel = os.path.relpath(path, REPO)
+    return [
+        f"{rel}:{line}: raw {call} in schedulers/ outside the"
+        f" {'/'.join(SUBPROCESS_SEAM_FUNCS)} seam; route it through the"
+        " backend's resilient _run_cmd"
+        for line, call in bad
+    ]
+
+
+def main() -> int:
+    violations: list[str] = []
+    for target in JAX_FREE:
+        for path in _py_files(os.path.join(PKG, target)):
+            violations.extend(check_jax_free(path))
+    for path in _py_files(os.path.join(PKG, "schedulers")):
+        violations.extend(check_scheduler_subprocess(path))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"SELF_LINT: {len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    print("SELF_LINT: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

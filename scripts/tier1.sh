@@ -61,6 +61,57 @@ assert len({d["code"] for d in doc["diagnostics"]}) >= 3, doc
 EOF
 then echo "LINT_SMOKE=ok"; else echo "LINT_SMOKE=FAILED"; rc=1; fi
 
+# Self-lint: AST-enforced repo invariants — no module-level jax import in
+# the jax-free layers (cli/, supervisor/, control/, analyze/,
+# parallel/mesh_config.py), no raw subprocess in schedulers/ outside the
+# resilient _run_cmd/_popen seam.
+if timeout -k 10 60 python scripts/lint_internal.py
+then echo "SELF_LINT=ok"; else echo "SELF_LINT=FAILED"; rc=1; fi
+
+# Explain smoke: `tpx explain` on a builtin component must statically
+# report the MoE-mesh resharding boundary (the involuntary-full-remat
+# shape behind the MULTICHIP r03/r04 warning -> TPX700 ERROR, exit 1) and
+# an HBM fit verdict — without the analyzer importing jax.
+if timeout -k 10 120 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json, subprocess, sys
+
+tpx = [sys.executable, "-m", "torchx_tpu.cli.main", "explain"]
+argv = ["dist.spmd", "-j", "1x8", "-m", "my.custom_trainer", "--",
+        "--config", "moe_tiny", "--mesh", "ep=2,fsdp=-1",
+        "--batch", "8", "--seq", "128"]
+r = subprocess.run(tpx + ["--json"] + argv, capture_output=True, text=True)
+assert r.returncode == 1, (r.returncode, r.stdout, r.stderr)
+doc = json.loads(r.stdout)
+assert doc["version"] == 1, doc
+role = doc["roles"][0]
+kinds = {b["kind"] for b in role["sharding"]["boundaries"]}
+assert "full_remat" in kinds, role["sharding"]
+assert role["hbm"]["verdict"] in ("fits", "exceeds"), role["hbm"]
+codes = {d["code"] for d in role["diagnostics"]}
+assert "TPX700" in codes, codes
+
+# same mesh, stock trainer: propagation proves it safe (exit 0)
+r = subprocess.run(
+    tpx + ["dist.spmd", "-j", "1x8", "-m", "torchx_tpu.examples.train_llama",
+           "--", "--config", "moe_tiny", "--mesh", "ep=2,fsdp=-1"],
+    capture_output=True, text=True)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+assert "FITS" in r.stdout or "EXCEEDS" in r.stdout, r.stdout
+
+# the analyzer itself must never touch jax
+probe = (
+    "import sys\n"
+    "from torchx_tpu.cli.main import main\n"
+    "try: main(['explain', 'dist.spmd', '-j', '1x8', '-m', 'x.y', '--',\n"
+    "           '--config', 'moe_tiny', '--mesh', 'ep=2,fsdp=-1'])\n"
+    "except SystemExit: pass\n"
+    "assert 'jax' not in sys.modules, 'tpx explain imported jax'\n"
+)
+r = subprocess.run([sys.executable, "-c", probe], capture_output=True, text=True)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+EOF
+then echo "EXPLAIN_SMOKE=ok"; else echo "EXPLAIN_SMOKE=FAILED"; rc=1; fi
+
 # Resilience smoke: a fault-injected local run must succeed anyway —
 # the injected transient describe failures are absorbed by in-seam
 # retries (retry metric non-zero), never surfacing to the user.

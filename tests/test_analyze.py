@@ -233,6 +233,72 @@ class TestTopologyRules:
         assert "TPX103" in codes(report)
 
 
+class TestMeshRules:
+    """TPX110/TPX111 regression: the heuristic mesh rule keeps firing for
+    roles deep preflight cannot plan, and stands down when TPX700
+    propagation owns the role (tests/test_explain.py covers the TPX7xx
+    side)."""
+
+    def heuristic_role(self, *extra, entrypoint="python"):
+        return app_with(
+            entrypoint=entrypoint,
+            args=["-m", "my.custom_trainer", "--mesh", "ep=2,fsdp=-1", *extra],
+            env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        )
+
+    def test_tpx110_fires_without_a_plan(self):
+        # no --config: plan_from_role returns None, the heuristic owns it
+        report = analyze(self.heuristic_role())
+        assert "TPX110" in codes(report)
+        assert "TPX700" not in codes(report)
+
+    def test_tpx110_stock_trainer_stays_clean(self):
+        report = analyze(
+            app_with(
+                entrypoint="python",
+                args=[
+                    "-m", "torchx_tpu.examples.train_llama",
+                    "--mesh", "ep=2,fsdp=-1",
+                ],
+            )
+        )
+        assert "TPX110" not in codes(report)
+
+    def test_tpx110_superseded_by_propagation(self):
+        # a recognizable --config resolves into a ParallelPlan: TPX700
+        # carries the exact boundary and the pattern-match stands down
+        report = analyze(self.heuristic_role("--config", "moe_tiny"))
+        assert "TPX110" not in codes(report)
+        assert "TPX700" in codes(report)
+
+    def test_tpx110_stands_down_on_broken_plans(self):
+        # plan-shaped but inconsistent: TPX703 owns the role
+        report = analyze(
+            app_with(
+                entrypoint="python",
+                args=[
+                    "-m", "my.custom_trainer",
+                    "--config", "moe_tiny", "--mesh", "ep=3,fsdp=7",
+                ],
+                env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+            )
+        )
+        assert "TPX703" in codes(report)
+        assert "TPX110" not in codes(report)
+
+    def test_tpx111_unknown_axis_always_errors(self):
+        report = analyze(self.heuristic_role("--mesh", "fsd=2"))
+        assert "TPX111" in codes(report)
+        # ...including on plan-shaped roles (spec hygiene never stands down)
+        report = analyze(
+            app_with(
+                entrypoint="python",
+                args=["-m", "t", "--config", "tiny", "--mesh=fsd=2"],
+            )
+        )
+        assert "TPX111" in codes(report)
+
+
 class TestTpuSliceEdgeCases:
     """Satellite: TpuSlice naming/shape edge cases backing the TPX1xx rules."""
 

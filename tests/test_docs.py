@@ -93,3 +93,34 @@ def test_docs_build_check_passes():
         timeout=120,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_every_diagnostic_code_documented():
+    """Every TPX diagnostic code the analyzers can emit has a row in the
+    torchx_tpu/analyze docstring table (the one gen_api_docs renders into
+    docs/api/analyze.md), and the table carries no dead rows."""
+    import torchx_tpu.analyze as analyze_pkg
+
+    code_re = re.compile(r"TPX\d{3}")
+    emitted: set[str] = set()
+    for src in (
+        REPO / "torchx_tpu" / "analyze" / "rules.py",
+        REPO / "torchx_tpu" / "analyze" / "explain.py",
+        REPO / "torchx_tpu" / "specs" / "file_linter.py",
+        REPO / "torchx_tpu" / "cli" / "cmd_lint.py",
+    ):
+        emitted |= set(code_re.findall(src.read_text()))
+    documented = {
+        m.group(0)
+        for line in (analyze_pkg.__doc__ or "").splitlines()
+        if line.startswith("| TPX")
+        for m in [code_re.search(line)]
+        if m
+    }
+    assert emitted - documented == set(), (
+        f"codes emitted but missing from the analyze docstring table:"
+        f" {sorted(emitted - documented)}"
+    )
+    assert documented - emitted == set(), (
+        f"documented codes nothing emits: {sorted(documented - emitted)}"
+    )

@@ -43,7 +43,7 @@ Diagnostic codes
 | TPX101 | error | no such TPU slice: chip count impossible for the generation (multi-host slices are built from fixed-size host VMs; v5e/v6e pods cap at 256 chips) | use a valid chip count for the generation |
 | TPX102 | error | topology dimensionality does not match the generation (v5e/v6e are 2D meshes, v4/v5p are 3D tori) | use a shape like ``4x8`` (v5e) or ``2x2x4`` (v4) |
 | TPX103 | error | TPU-looking key in ``resource.devices`` | TPU chips are allocated via ``resource.tpu``, never devices |
-| TPX110 | warning | ``--mesh`` pairs expert parallelism (``ep``) with ``fsdp``/``sp`` sharding: embedding/expert gathers reshard dim-sharded → batch/seq-sharded, which GSPMD partitions by involuntary full rematerialization unless gather outputs carry explicit sharding constraints | pin gather outputs with ``with_sharding_constraint``, or use ``torchx_tpu.examples.train_llama`` which already does |
+| TPX110 | warning | ``--mesh`` pairs expert parallelism (``ep``) with ``fsdp``/``sp`` sharding: embedding/expert gathers reshard dim-sharded → batch/seq-sharded, which GSPMD partitions by involuntary full rematerialization unless gather outputs carry explicit sharding constraints (heuristic fallback — when the role resolves into a full parallelism plan, TPX700 propagation supersedes this) | pin gather outputs with ``with_sharding_constraint``, or use ``torchx_tpu.examples.train_llama`` which already does |
 | TPX111 | error | unknown mesh axis name in a ``--mesh`` role arg | use the trainer mesh axes ``pp/dp/fsdp/ep/tp/sp`` |
 | TPX201 | error | role env overrides a launcher-injected identity/rendezvous var (``TPX_REPLICA_ID``, ``MEGASCALE_*``, ...) | remove it — every scheduler injects it |
 | TPX202 | warning | env var uses a reserved prefix (``TPX_``/``TPU_``/``MEGASCALE_``) but is not a documented knob | rename it |
@@ -70,6 +70,12 @@ Diagnostic codes
 | TPX502 | error | ``TPX_FAULT_PLAN`` set while submitting to a non-local backend (chaos drill would corrupt real cloud calls) | unset it or drill against local / local_docker |
 | TPX503 | warning | policy budgets checkpoint-resume retries but no role passes a checkpoint-dir flag (every resubmit restarts from step 0) | pass ``--ckpt-dir`` to the app or drop ``checkpoint_dir`` |
 | TPX601 | warning | hang detection under the control daemon (``TPX_CONTROL_ADDR``) on a backend without the ``watch`` capability — state changes surface at the watch poll interval | use a watch-capable backend, tighten ``TPX_WATCH_INTERVAL``, or unset ``TPX_CONTROL_ADDR`` |
+| TPX700 | error | deep preflight: sharding propagation found a resharding boundary GSPMD resolves by involuntary full rematerialization (dim-sharded gather/dispatch into a batch/seq-sharded consumer with no output constraint) | pin the gather/combine output with ``with_sharding_constraint`` (see ``models/llama.py forward_features``), or train with ``torchx_tpu.examples.train_llama`` |
+| TPX701 | error | deep preflight: static HBM fit exceeded — params + optimizer + gradients + activations + logits outgrow the per-chip budget under the headroom | raise ``fsdp``/``tp``, lower ``--batch``/``--seq``, or use ``--remat-policy full`` |
+| TPX702 | warning | deep preflight: a DCN-classified mesh axis (``fsdp``/``ep``/``tp``/``sp``) carries ICI-scale collective traffic — cross-slice bandwidth will pace every step | keep fsdp/ep/tp/sp inside a slice; put only dp/pp on the cross-slice dimension |
+| TPX703 | error | deep preflight: the role is plan-shaped but the ``--mesh`` spec cannot resolve onto its device count | make the axis sizes multiply out to slices × chips (or replicas × nproc) |
+| TPX704 | warning | deep preflight: a serve-shaped role's params + KV pool do not fit the per-chip HBM | lower ``--max-batch``, shorten ``max_seq``, or use a larger-HBM generation |
+| TPX705 | info | deep preflight skipped: no parallelism plan resolvable from the role args (``tpx explain`` only — the submit gate falls back to the TPX110 heuristic) | use a builtin ``--config`` name to enable static sharding/HBM analysis |
 """
 
 from torchx_tpu.analyze.diagnostics import (
@@ -79,6 +85,15 @@ from torchx_tpu.analyze.diagnostics import (
     Severity,
 )
 from torchx_tpu.analyze.engine import analyze, analyze_component, capabilities_for
+from torchx_tpu.analyze.explain import ExplainReport, deep_preflight, explain
+from torchx_tpu.analyze.plan import (
+    MODEL_SHAPES,
+    ModelShape,
+    ParallelPlan,
+    PlanError,
+    plan_from_role,
+)
+from torchx_tpu.analyze.propagation import Boundary, ShardingFlow, propagate
 from torchx_tpu.analyze.rules import (
     RuleContext,
     all_rules,
@@ -98,4 +113,15 @@ __all__ = [
     "analyze",
     "analyze_component",
     "capabilities_for",
+    "ExplainReport",
+    "explain",
+    "deep_preflight",
+    "ModelShape",
+    "MODEL_SHAPES",
+    "ParallelPlan",
+    "PlanError",
+    "plan_from_role",
+    "Boundary",
+    "ShardingFlow",
+    "propagate",
 ]

@@ -1,0 +1,286 @@
+"""Jax-free static HBM-fit and collective-traffic cost model.
+
+Given a resolved :class:`~torchx_tpu.analyze.plan.ParallelPlan` this
+computes, with plain arithmetic:
+
+* :func:`hbm_fit` — per-chip HBM bytes by component (params, optimizer
+  state, gradients, activation footprint per remat policy, CE logits,
+  KV pool for serve-shaped roles) against the per-chip budget. The
+  sharding math follows ``models/llama.py param_specs`` (params over
+  ``fsdp`` x ``tp``, layers over ``pp``; activations over
+  ``dp``/``fsdp`` x ``sp``) and the optimizer follows
+  ``parallel/aot_fit.model_state_bytes_per_device`` (AdamW: two moments
+  in the param dtype, so model state = 3x params).
+* :func:`collective_traffic` — per-step bytes each mesh axis moves per
+  device (ring-algorithm ``(k-1)/k`` scaling), classified ICI vs DCN via
+  :func:`~torchx_tpu.parallel.mesh_config.axis_networks`.
+
+These are first-order estimates — no XLA fusion, padding or scheduling —
+meant to be cross-checked against ``parallel/aot_fit.compile_fit`` (the
+``tpx explain --aot`` mode) and the measured BENCH step-time breakdown
+(``bench.py`` embeds both so prediction error is tracked per round).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from torchx_tpu.analyze.plan import ParallelPlan
+from torchx_tpu.parallel.mesh_config import axis_networks
+
+GIB = 1024**3
+
+#: fraction of per-chip HBM the fit may use (mirrors
+#: ``parallel/aot_fit.DEFAULT_HEADROOM`` without importing it — aot_fit
+#: imports jax at module level).
+DEFAULT_HEADROOM = 0.9
+
+#: mesh axes whose collectives are latency/bandwidth-critical enough that
+#: routing them over DCN is (almost) always a mistake — the TPX702 set.
+ICI_BOUND_AXES = ("fsdp", "ep", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class HbmFit:
+    """Per-chip static memory fit."""
+
+    components: dict[str, int]  # name -> bytes (per chip)
+    total_bytes: int
+    budget_bytes: int  # per-chip HBM capacity
+    headroom: float
+    fits: bool
+    source: str  # where the budget came from (plan.hbm_source)
+
+    @property
+    def verdict(self) -> str:
+        return "fits" if self.fits else "exceeds"
+
+    def to_dict(self) -> dict:
+        return {
+            "components": dict(sorted(self.components.items())),
+            "total_bytes": self.total_bytes,
+            "budget_bytes": self.budget_bytes,
+            "headroom": self.headroom,
+            "usable_bytes": int(self.budget_bytes * self.headroom),
+            "fits": self.fits,
+            "verdict": self.verdict,
+            "source": self.source,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisTraffic:
+    """Per-step collective bytes one mesh axis moves, per device."""
+
+    axis: str
+    size: int
+    network: str  # ici | dcn | mixed
+    bytes_per_step: int
+    ops: tuple[str, ...]
+
+    def to_dict(self) -> dict:
+        return {
+            "axis": self.axis,
+            "size": self.size,
+            "network": self.network,
+            "bytes_per_step": self.bytes_per_step,
+            "ops": list(self.ops),
+        }
+
+
+def _ring(k: int) -> float:
+    """Ring-algorithm per-device traffic factor for a k-way collective."""
+    return (k - 1) / k if k > 1 else 0.0
+
+
+def hbm_fit(plan: ParallelPlan, headroom: float = DEFAULT_HEADROOM) -> HbmFit:
+    """Static per-chip HBM usage vs the plan's per-chip budget."""
+    m = plan.model
+    dtype = m.dtype_bytes
+    pp = plan.axis("pp")
+    tp = plan.axis("tp")
+    sp = plan.axis("sp")
+    ep = plan.axis("ep")
+    data = plan.data_shards
+    # params shard over (fsdp, tp); MoE expert weights over (ep, tp) — in
+    # both cases the product of model-axis shards; layers split over pp.
+    param_shards = pp * plan.axis("fsdp") * tp * (ep if m.is_moe else 1)
+    # ceil-divide: a shard can't be smaller than one replica of the
+    # unsharded remainder (norms, embeddings replicate over tp)
+    param_bytes = math.ceil(m.param_count() * dtype / param_shards)
+
+    comps: dict[str, int] = {}
+    b_local = max(1, math.ceil(plan.batch / data))
+    s_local = max(1, math.ceil(plan.seq / sp))
+
+    if plan.serve:
+        comps["params"] = (
+            math.ceil(m.param_count() / param_shards)
+            if plan.int8
+            else param_bytes
+        )
+        # paged KV pool sized for max_batch full-length sequences
+        # (serve/kv_pool.plan_pool block math, dense upper bound)
+        comps["kv_pool"] = math.ceil(
+            plan.max_batch
+            * m.n_layers
+            * 2  # K and V
+            * m.max_seq
+            * m.n_kv_heads
+            * m.head_dim
+            * dtype
+            / tp
+        )
+        comps["decode_state"] = plan.max_batch * m.dim * dtype
+    else:
+        comps["params"] = param_bytes
+        comps["optimizer"] = 2 * param_bytes  # AdamW mu+nu in param dtype
+        comps["gradients"] = param_bytes  # transient backward peak
+        comps["activations"] = _activation_bytes(plan, b_local, s_local)
+        comps["logits"] = _logits_bytes(plan, b_local, s_local)
+        comps["batch"] = b_local * plan.seq * 4 * 2  # tokens + targets i32
+
+    total = sum(comps.values())
+    budget = plan.hbm_bytes_per_chip
+    return HbmFit(
+        components=comps,
+        total_bytes=total,
+        budget_bytes=budget,
+        headroom=headroom,
+        fits=total <= int(budget * headroom),
+        source=plan.hbm_source,
+    )
+
+
+def _activation_bytes(plan: ParallelPlan, b: int, s: int) -> int:
+    """Per-chip activation footprint for the plan's remat policy.
+
+    ``full`` keeps only the per-layer residual checkpoints (the
+    ``lax.scan`` carry) plus one layer's working set; ``dots`` also saves
+    every projection output per layer; ``dots_attn`` adds the attention
+    output. Mirrors the ``jax.checkpoint`` policies models/llama.py
+    installs.
+    """
+    m = plan.model
+    dtype = m.dtype_bytes
+    tp = plan.axis("tp")
+    layers = max(1, math.ceil(m.n_layers / plan.axis("pp")))
+    d = m.dim
+    token_bytes = b * s * dtype  # one [b_local, s_local] slice, 1 unit wide
+
+    residuals = layers * token_bytes * d
+    saved = 0
+    if plan.remat_policy in ("dots", "dots_attn"):
+        per_layer_units = (
+            m.n_heads * m.head_dim / tp  # q
+            + 2 * m.n_kv_heads * m.head_dim / tp  # k, v
+            + d  # attn residual add
+            + 2 * m.ffn_dim / tp  # gate, up
+            + d  # mlp residual add
+        )
+        if plan.remat_policy == "dots_attn":
+            per_layer_units += m.n_heads * m.head_dim / tp
+        saved = int(layers * token_bytes * per_layer_units)
+    # one layer's live working set during (re)compute
+    working_units = 4 * d + 2 * m.ffn_dim / tp
+    working = int(token_bytes * working_units)
+    if m.is_moe:
+        # GShard dispatch/combine one-hots [b, s, E, capacity] in f32 and
+        # the dispatched expert inputs [E/ep, capacity, d]
+        e_local = max(1, math.ceil(m.n_experts / plan.axis("ep")))
+        cap = max(1, int(m.capacity_factor * s * m.top_k / m.n_experts))
+        working += 2 * b * s * m.n_experts * cap * 4
+        working += e_local * cap * b * d * dtype
+    return int(residuals + saved + working)
+
+
+def _logits_bytes(plan: ParallelPlan, b: int, s: int) -> int:
+    """CE logits footprint: f32 [b, chunk, vocab/tp] (+ its grad) when
+    loss chunking is on, the full [b, s, vocab/tp] otherwise."""
+    m = plan.model
+    chunk = min(s, m.loss_chunk) if m.loss_chunk else s
+    return int(2 * b * chunk * math.ceil(m.vocab_size / plan.axis("tp")) * 4)
+
+
+def collective_traffic(plan: ParallelPlan) -> list[AxisTraffic]:
+    """Per-step, per-device collective bytes for every live mesh axis,
+    classified ICI vs DCN from the slice topology."""
+    m = plan.model
+    dtype = m.dtype_bytes
+    pp = plan.axis("pp")
+    tp = plan.axis("tp")
+    sp = plan.axis("sp")
+    ep = plan.axis("ep")
+    dp = plan.axis("dp")
+    fsdp = plan.axis("fsdp")
+    data = plan.data_shards
+    b = max(1, math.ceil(plan.batch / data))
+    s = max(1, math.ceil(plan.seq / sp))
+    layers = max(1, math.ceil(m.n_layers / pp))
+    act_tok = b * s * dtype
+    # param bytes one device must see un-fsdp-sharded (tp/ep/pp shards
+    # stay local; fsdp is what gets gathered)
+    param_slice = m.param_count() * dtype / (pp * tp * (ep if m.is_moe else 1))
+
+    networks = axis_networks(plan.sizes, plan.chips_per_slice)
+    out: list[AxisTraffic] = []
+
+    def add(axis: str, size: int, nbytes: float, ops: tuple[str, ...]):
+        out.append(
+            AxisTraffic(
+                axis=axis,
+                size=size,
+                network=networks.get(axis, "none"),
+                bytes_per_step=int(nbytes),
+                ops=ops,
+            )
+        )
+
+    if fsdp > 1 and not plan.serve:
+        # ZeRO-3: all-gather params fwd + bwd, reduce-scatter grads
+        add(
+            "fsdp",
+            fsdp,
+            3 * _ring(fsdp) * param_slice,
+            ("allgather_params_fwd", "allgather_params_bwd", "reducescatter_grads"),
+        )
+    if dp > 1 and not plan.serve:
+        add(
+            "dp",
+            dp,
+            2 * _ring(dp) * param_slice / fsdp,
+            ("allreduce_grads",),
+        )
+    if tp > 1:
+        # 2 all-reduces per layer (attn out, mlp/moe out), fwd + bwd
+        # mirrors; all-reduce ring moves 2(k-1)/k x N
+        ops_per_step = 4 * layers
+        add(
+            "tp",
+            tp,
+            ops_per_step * 2 * _ring(tp) * act_tok * m.dim,
+            ("allreduce_partials",),
+        )
+    if sp > 1:
+        kv_bytes = act_tok * 2 * m.n_kv_heads * m.head_dim
+        if plan.ring_attention:
+            add("sp", sp, layers * (sp - 1) * kv_bytes, ("ring_kv_permute",))
+        else:
+            add("sp", sp, layers * 2 * _ring(sp) * kv_bytes, ("allgather_kv",))
+    if ep > 1 and m.is_moe:
+        # dispatch + combine all-to-alls, fwd + bwd
+        add(
+            "ep",
+            ep,
+            4 * _ring(ep) * act_tok * m.dim * m.top_k,
+            ("alltoall_dispatch", "alltoall_combine"),
+        )
+    if pp > 1:
+        add(
+            "pp",
+            pp,
+            2 * act_tok * m.dim * (pp - 1) / pp,
+            ("stage_activations",),
+        )
+    return out

@@ -1,0 +1,466 @@
+"""Jax-free parallelism-plan IR for the deep preflight analyzer.
+
+A :class:`ParallelPlan` is everything the static analyzer needs to reason
+about one role's training (or serving) step *without importing jax*: the
+model shape, the resolved mesh axis sizes, the batch geometry, and the
+physical topology (device count, chips per slice, HBM per chip). It is
+assembled purely from launcher-side facts — the role's arg list (the
+trainer CLI flags after the ``spmd_main`` ``--`` separator), the
+``TPX_MESH`` env override, ``parse_mesh_spec``, and the role's
+:class:`~torchx_tpu.specs.api.TpuSlice` resource (or the CPU-sim
+``--xla_force_host_platform_device_count`` flag).
+
+The model shapes are a deliberately duplicated, arithmetic-only mirror of
+``models/llama.py`` / ``models/moe.py`` (which import jax and therefore
+cannot be used at lint time). Honesty of the mirror is enforced by
+``tests/test_explain.py::test_model_shapes_match_jax_configs``, which
+cross-checks ``param_count`` against the real configs where jax is
+available.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Optional
+
+from torchx_tpu import settings
+from torchx_tpu.parallel.mesh_config import (
+    AXES,
+    MeshConfig,
+    parse_mesh_spec,
+)
+from torchx_tpu.specs.api import Role
+
+GIB = 1024**3
+
+#: HBM budget assumed for roles whose topology carries no generation info
+#: (CPU-sim roles, bare-process entrypoints) — v5e-class, the smallest
+#: current-generation part, so the fit verdict errs conservative.
+DEFAULT_HBM_BYTES = 16 * GIB
+
+#: Entrypoint modules known to pin gather/combine outputs with explicit
+#: ``with_sharding_constraint`` (models/llama.py forward_features), which
+#: keeps expert-parallel meshes free of involuntary full remat. Mirrors
+#: ``rules.REMAT_SAFE_MODULES`` (kept there for the heuristic fallback).
+REMAT_SAFE_MODULES = ("torchx_tpu.examples.train_llama",)
+
+#: Serve-shaped entrypoint modules: no optimizer state, KV pool instead
+#: of activations.
+SERVE_MODULES = ("torchx_tpu.apps.generate_server",)
+
+
+class PlanError(ValueError):
+    """A role *is* plan-shaped but the plan is inconsistent (e.g. the mesh
+    spec cannot resolve onto the role's device count) — surfaced as a
+    TPX703 error rather than silently skipping deep preflight."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelShape:
+    """Arithmetic-only model shape (jax-free mirror of LlamaConfig /
+    MoEConfig — see the module docstring for the honesty contract)."""
+
+    name: str
+    vocab_size: int
+    dim: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    ffn_dim: int
+    max_seq: int
+    dtype_bytes: int
+    tie_embeddings: bool = False
+    loss_chunk: int = 512
+    n_experts: int = 0  # 0 = dense
+    top_k: int = 0
+    capacity_factor: float = 2.0
+
+    @property
+    def head_dim(self) -> int:
+        """Per-attention-head width (``dim / n_heads``)."""
+        return self.dim // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        """True when the FFN is a mixture-of-experts block."""
+        return self.n_experts > 0
+
+    def param_count(self) -> int:
+        """Exact parameter count (mirror of LlamaConfig.param_count +
+        the MoEConfig expert/router delta)."""
+        d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        hd = self.head_dim
+        per_layer = (
+            d * self.n_heads * hd  # wq
+            + 2 * d * self.n_kv_heads * hd  # wk, wv
+            + self.n_heads * hd * d  # wo
+            + 3 * d * f  # gate, up, down
+            + 2 * d  # norms
+        )
+        total = self.n_layers * per_layer + v * d + d  # embed + final norm
+        if not self.tie_embeddings:
+            total += d * v
+        if self.is_moe:
+            ffn = 3 * d * f
+            total += self.n_layers * (
+                (self.n_experts - 1) * ffn + d * self.n_experts
+            )
+        return total
+
+    def active_param_count(self) -> int:
+        """Params touched per token (MoE: top_k experts; dense: all)."""
+        if not self.is_moe:
+            return self.param_count()
+        d, f = self.dim, self.ffn_dim
+        ffn = 3 * d * f
+        dense = dataclasses.replace(self, n_experts=0, top_k=0).param_count()
+        return dense + self.n_layers * (
+            (self.top_k - 1) * ffn + d * self.n_experts
+        )
+
+    def to_dict(self) -> dict:
+        """Stable JSON form for the explain report."""
+        return {
+            "name": self.name,
+            "params": self.param_count(),
+            "active_params": self.active_param_count(),
+            "dim": self.dim,
+            "n_layers": self.n_layers,
+            "n_heads": self.n_heads,
+            "n_kv_heads": self.n_kv_heads,
+            "ffn_dim": self.ffn_dim,
+            "vocab_size": self.vocab_size,
+            "dtype_bytes": self.dtype_bytes,
+            "n_experts": self.n_experts,
+            "top_k": self.top_k,
+        }
+
+
+#: Name -> shape for every builtin trainer/server ``--config`` choice.
+#: The dtype_bytes mirror the preset dtypes (tiny shapes train in f32).
+MODEL_SHAPES: dict[str, ModelShape] = {
+    "tiny": ModelShape(
+        name="tiny",
+        vocab_size=512,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_dim=128,
+        max_seq=128,
+        dtype_bytes=4,
+    ),
+    "llama3_1b": ModelShape(
+        name="llama3_1b",
+        vocab_size=128256,
+        dim=2048,
+        n_layers=16,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_dim=8192,
+        max_seq=8192,
+        dtype_bytes=2,
+        tie_embeddings=True,
+    ),
+    "llama3_8b": ModelShape(
+        name="llama3_8b",
+        vocab_size=128256,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_dim=14336,
+        max_seq=8192,
+        dtype_bytes=2,
+    ),
+    "moe_tiny": ModelShape(
+        name="moe_tiny",
+        vocab_size=512,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_dim=128,
+        max_seq=128,
+        dtype_bytes=4,
+        n_experts=4,
+        top_k=2,
+    ),
+    "mixtral_8x7b": ModelShape(
+        name="mixtral_8x7b",
+        vocab_size=32000,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_dim=14336,
+        max_seq=8192,
+        dtype_bytes=2,
+        n_experts=8,
+        top_k=2,
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelPlan:
+    """One role's statically-resolved parallelism plan.
+
+    ``sizes`` are the concrete per-axis mesh sizes (every wildcard
+    resolved); ``devices`` is the total device count the plan is laid out
+    on. ``hbm_source`` records where the per-chip budget came from:
+    ``"tpu_slice"`` (the role's TpuSlice generation), ``"override"``
+    (caller-provided) or ``"assumed"`` (:data:`DEFAULT_HBM_BYTES`).
+    """
+
+    role: str
+    model: ModelShape
+    mesh_spec: str
+    sizes: dict[str, int]
+    batch: int
+    seq: int
+    remat_policy: str = "full"
+    int8: bool = False
+    ring_attention: bool = False
+    serve: bool = False
+    max_batch: int = 16  # serve decode slots
+    devices: int = 1
+    slices: int = 1
+    chips_per_slice: int = 1
+    hbm_bytes_per_chip: int = DEFAULT_HBM_BYTES
+    hbm_source: str = "assumed"
+    module: str = ""
+    accelerator: str = ""
+    remat_safe: bool = False
+    notes: tuple[str, ...] = ()
+
+    def axis(self, name: str) -> int:
+        """Resolved size of one mesh axis (1 when absent)."""
+        return int(self.sizes.get(name, 1))
+
+    @property
+    def data_shards(self) -> int:
+        """Batch-dimension sharding factor (dp * fsdp)."""
+        return self.axis("dp") * self.axis("fsdp")
+
+    def to_dict(self) -> dict:
+        """Stable JSON form for the explain report."""
+        return {
+            "role": self.role,
+            "config": self.model.name,
+            "mesh": {a: self.axis(a) for a in AXES},
+            "batch": self.batch,
+            "seq": self.seq,
+            "remat_policy": self.remat_policy,
+            "int8": self.int8,
+            "ring_attention": self.ring_attention,
+            "serve": self.serve,
+            "devices": self.devices,
+            "slices": self.slices,
+            "chips_per_slice": self.chips_per_slice,
+            "hbm_bytes_per_chip": self.hbm_bytes_per_chip,
+            "hbm_source": self.hbm_source,
+            "module": self.module,
+            "accelerator": self.accelerator,
+            "remat_safe": self.remat_safe,
+            "model": self.model.to_dict(),
+            "notes": list(self.notes),
+        }
+
+
+_HOST_DEVICE_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
+
+
+def _script_argv(role: Role) -> tuple[str, list[str]]:
+    """(entry module, trainer argv) recovered from a role's arg list.
+
+    Handles the ``dist.spmd`` shape (``-m torchx_tpu.apps.spmd_main ...
+    -m <user module> -- <script args>``, where the user module is the
+    *last* ``-m``/``--script`` value before the ``--`` separator) and the
+    direct ``python -m <module> <args>`` shape.
+    """
+    args = [str(a) for a in role.args]
+    module = ""
+    if "--" in args:
+        sep = args.index("--")
+        head, tail = args[:sep], args[sep + 1 :]
+    else:
+        head, tail = args, []
+    i = 0
+    last_module_at = -1
+    while i < len(head):
+        if head[i] in ("-m", "--script") and i + 1 < len(head):
+            module = head[i + 1]
+            last_module_at = i + 1
+            i += 2
+            continue
+        i += 1
+    if tail:
+        return module, tail
+    # direct `python -m module flags...`: the flags follow the module
+    if last_module_at >= 0:
+        return module, head[last_module_at + 1 :]
+    return module, []
+
+
+def _flag_values(argv: list[str]) -> tuple[dict[str, str], set[str]]:
+    """Last-wins ``--flag value`` / ``--flag=value`` map + the set of
+    bare flags seen (for store_true options)."""
+    values: dict[str, str] = {}
+    bare: set[str] = set()
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        if tok.startswith("--"):
+            if "=" in tok:
+                k, _, v = tok.partition("=")
+                values[k] = v
+            elif i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+                values[tok] = argv[i + 1]
+                bare.add(tok)
+                i += 2
+                continue
+            else:
+                bare.add(tok)
+        i += 1
+    return values, bare
+
+
+def _role_topology(
+    role: Role, devices_override: Optional[int]
+) -> tuple[Optional[int], int, int, int, str, str, list[str]]:
+    """(devices, slices, chips_per_slice, hbm_bytes, hbm_source,
+    accelerator, notes) from the role's resource / CPU-sim env."""
+    notes: list[str] = []
+    tpu = getattr(role.resource, "tpu", None)
+    replicas = max(1, int(getattr(role, "num_replicas", 1) or 1))
+    if tpu is not None:
+        # dist.spmd semantics: num_replicas = slices when a TPU resource
+        # is set (components/dist.py), chips stay within one slice on ICI
+        chips = int(tpu.chips)
+        hbm = tpu.hbm_bytes_per_chip
+        devices = chips * replicas
+        return (
+            devices_override or devices,
+            replicas,
+            chips,
+            hbm,
+            "tpu_slice",
+            tpu.accelerator_type,
+            notes,
+        )
+    m = _HOST_DEVICE_RE.search(str(role.env.get(settings.ENV_XLA_FLAGS, "")))
+    if m:
+        nproc = int(m.group(1))
+        devices = nproc * replicas
+        notes.append(
+            f"CPU-sim topology: {replicas} process(es) x {nproc} host"
+            f" devices; HBM budget assumed {DEFAULT_HBM_BYTES // GIB} GiB"
+        )
+        return (
+            devices_override or devices,
+            replicas,
+            nproc,
+            DEFAULT_HBM_BYTES,
+            "assumed",
+            "cpu-sim",
+            notes,
+        )
+    notes.append(
+        "no TPU resource or CPU-sim device count on the role; HBM budget"
+        f" assumed {DEFAULT_HBM_BYTES // GIB} GiB"
+    )
+    return devices_override, 1, 1, DEFAULT_HBM_BYTES, "assumed", "", notes
+
+
+def plan_from_role(
+    role: Role,
+    *,
+    devices: Optional[int] = None,
+    hbm_bytes: Optional[int] = None,
+) -> Optional[ParallelPlan]:
+    """Resolve a role into a :class:`ParallelPlan`, or None when the role
+    is not plan-shaped (no recognizable ``--config``): the caller then
+    falls back to the TPX110 heuristic or skips deep preflight.
+
+    Raises :class:`PlanError` when the role *is* plan-shaped but
+    inconsistent (mesh spec that cannot resolve onto the device count,
+    unknown wildcard with no device information).
+    """
+    module, argv = _script_argv(role)
+    if not argv and not module:
+        return None
+    values, bare = _flag_values(argv)
+    config = values.get("--config")
+    if config is None or config not in MODEL_SHAPES:
+        return None
+    model = MODEL_SHAPES[config]
+    serve = any(m in module for m in SERVE_MODULES)
+
+    # the trainer honors $TPX_MESH over --mesh (examples/train_llama.py)
+    mesh_spec = str(
+        role.env.get(settings.ENV_TPX_MESH) or values.get("--mesh") or ""
+    )
+    try:
+        mesh_cfg = parse_mesh_spec(mesh_spec) if mesh_spec else MeshConfig()
+    except ValueError as e:
+        raise PlanError(f"--mesh {mesh_spec!r}: {e}") from e
+
+    n_devices, slices, chips_per_slice, hbm, hbm_source, accel, notes = (
+        _role_topology(role, devices)
+    )
+    if hbm_bytes is not None:
+        hbm, hbm_source = int(hbm_bytes), "override"
+    if n_devices is not None:
+        try:
+            sizes = mesh_cfg.resolve(n_devices)
+        except ValueError as e:
+            raise PlanError(
+                f"mesh {mesh_spec or 'default'} does not fit the role's"
+                f" {n_devices} device(s): {e}"
+            ) from e
+    else:
+        # device count unknown (bare entrypoint): wildcards collapse to 1
+        # and the plan covers exactly the fixed axes
+        sizes = {a: getattr(mesh_cfg, a) for a in AXES}
+        wild = [a for a, s in sizes.items() if s == -1]
+        for a in wild:
+            sizes[a] = 1
+        if wild:
+            notes.append(
+                f"device count unknown; wildcard axes {wild} assumed 1"
+            )
+        n_devices = math.prod(sizes.values())
+        chips_per_slice = n_devices
+
+    remat_policy = values.get("--remat-policy", "full")
+    if remat_policy == "auto":
+        remat_policy = "dots"  # the trainer's auto-push floor
+
+    safe = any(
+        m in module or m in (role.entrypoint or "") for m in REMAT_SAFE_MODULES
+    )
+    return ParallelPlan(
+        role=role.name,
+        model=model,
+        mesh_spec=mesh_spec,
+        sizes={a: int(s) for a, s in sizes.items()},
+        batch=int(values.get("--batch", values.get("--max-batch", 8) if serve else 8)),
+        seq=int(values.get("--seq", model.max_seq if serve else 128)),
+        remat_policy=remat_policy,
+        int8=("--int8" in bare or "--int8" in values),
+        ring_attention=("--ring-attention" in bare or "--ring-attention" in values),
+        serve=serve,
+        max_batch=int(values.get("--max-batch", 16)),
+        devices=int(n_devices),
+        slices=slices,
+        chips_per_slice=int(chips_per_slice),
+        hbm_bytes_per_chip=int(hbm),
+        hbm_source=hbm_source,
+        module=module,
+        accelerator=accel,
+        remat_safe=safe,
+        notes=tuple(notes),
+    )

@@ -1,0 +1,312 @@
+"""Jax-free named-sharding propagation over the train-step layer graph.
+
+This walks the same dataflow ``models/llama.py`` / ``models/moe.py``
+compile — embed gather -> (per layer) qkv projections -> attention
+(flash or ring) -> output projection -> FFN or MoE dispatch/combine ->
+cross-entropy -> gradient sync — carrying the canonical named shardings
+(``parallel/mesh.py``: batch over ``("dp","fsdp")``, sequence over
+``sp``, model dims over ``tp``, experts over ``ep``, params over
+``("fsdp","tp")``), and records every point where GSPMD must insert a
+collective to move between the producer's layout and the consumer's:
+a :class:`Boundary`.
+
+Boundary kinds:
+
+* ``allgather`` — a dim-sharded operand is gathered (ZeRO-3 params over
+  ``fsdp``, K/V over ``sp`` without ring attention).
+* ``allreduce`` — partial sums over a contracted sharded dim (``tp``
+  output projections, gradient sync over ``dp``/``fsdp``).
+* ``alltoall`` — token redistribution onto the expert layout (``ep``).
+* ``permute`` — neighbor collective-permute (ring attention K/V rotation
+  over ``sp``, pipeline stage hand-off over ``pp``).
+* ``full_remat`` — the involuntary-full-rematerialization resolution:
+  a gather/dispatch whose operand is dim-sharded while its output is
+  batch/seq-sharded, *and* nothing pins the output layout. GSPMD then
+  partitions by replicate+reslice — the compile-time warning the
+  MULTICHIP r03/r04 dryrun legs chase. The stock trainer
+  (``plan.REMAT_SAFE_MODULES``) pins these outputs with
+  ``with_sharding_constraint``; custom entrypoints get the ERROR.
+
+Everything here is pure arithmetic on axis names and the resolved
+:class:`~torchx_tpu.analyze.plan.ParallelPlan` — no jax import, ever
+(enforced by ``scripts/lint_internal.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+from torchx_tpu.analyze.plan import ParallelPlan
+
+Dim = tuple[str, ...]
+
+
+def _spec(*dims: "str | Dim | None") -> tuple[Dim, ...]:
+    """Normalize a PartitionSpec-like description to per-dim axis tuples."""
+    out: list[Dim] = []
+    for d in dims:
+        if d is None:
+            out.append(())
+        elif isinstance(d, str):
+            out.append((d,))
+        else:
+            out.append(tuple(d))
+    return tuple(out)
+
+
+def render_spec(dims: tuple[Dim, ...]) -> str:
+    """Human/JSON-stable ``P(...)`` rendering of a per-dim axis layout."""
+    parts = []
+    for d in dims:
+        if not d:
+            parts.append("None")
+        elif len(d) == 1:
+            parts.append(f"'{d[0]}'")
+        else:
+            parts.append("(" + ", ".join(f"'{a}'" for a in d) + ")")
+    return "P(" + ", ".join(parts) + ")"
+
+
+@dataclasses.dataclass(frozen=True)
+class Boundary:
+    """One resharding point GSPMD must bridge with a collective."""
+
+    op: str  # graph site, e.g. "embed.gather", "layer.mlp_out"
+    kind: str  # allgather | allreduce | alltoall | permute | full_remat
+    axes: tuple[str, ...]  # mesh axes the collective runs over
+    producer: str  # rendered spec of the produced layout
+    consumer: str  # rendered spec the consumer needs
+    note: str = ""
+
+    def to_dict(self) -> dict:
+        """Stable JSON form for the explain report."""
+        return {
+            "op": self.op,
+            "kind": self.kind,
+            "axes": list(self.axes),
+            "producer": self.producer,
+            "consumer": self.consumer,
+            "note": self.note,
+        }
+
+
+@dataclasses.dataclass
+class ShardingFlow:
+    """The propagation result for one plan."""
+
+    boundaries: list[Boundary]
+    batch_spec: str
+    activation_spec: str
+
+    @property
+    def full_remat(self) -> bool:
+        """True when any boundary resolves by involuntary full remat."""
+        return any(b.kind == "full_remat" for b in self.boundaries)
+
+    def to_dict(self) -> dict:
+        """Stable JSON form for the explain report."""
+        return {
+            "batch_spec": self.batch_spec,
+            "activation_spec": self.activation_spec,
+            "full_remat": self.full_remat,
+            "boundaries": [b.to_dict() for b in self.boundaries],
+        }
+
+
+def _live(plan: ParallelPlan, *axes: str) -> tuple[str, ...]:
+    """The subset of ``axes`` actually sharded (size > 1) in the plan,
+    in canonical mesh-axis order."""
+    from torchx_tpu.parallel.mesh_config import AXES
+
+    live = {a for a in axes if plan.axis(a) > 1}
+    return tuple(a for a in AXES if a in live)
+
+
+def propagate(plan: ParallelPlan) -> ShardingFlow:
+    """Propagate named shardings through the plan's train/serve step and
+    return every resharding boundary in graph order."""
+    boundaries: list[Boundary] = []
+    data = _live(plan, "dp", "fsdp")  # batch-dim axes
+    sp = plan.axis("sp") > 1
+    tp = plan.axis("tp") > 1
+    ep = plan.axis("ep") > 1
+    pp = plan.axis("pp") > 1
+
+    seq_dim: Dim = ("sp",) if sp else ()
+    act = _spec(data, seq_dim, None)  # residual stream [b, s, d]
+    act_s = render_spec(act)
+    batch_s = render_spec(_spec(data, seq_dim))
+
+    def add(op: str, kind: str, axes: Iterable[str], producer, consumer, note=""):
+        axes = tuple(axes)
+        if not axes:
+            return
+        boundaries.append(
+            Boundary(
+                op=op,
+                kind=kind,
+                axes=axes,
+                producer=producer if isinstance(producer, str) else render_spec(producer),
+                consumer=consumer if isinstance(consumer, str) else render_spec(consumer),
+                note=note,
+            )
+        )
+
+    # -- embedding gather: table P(None, 'fsdp') indexed by batch/seq-
+    # sharded token ids; the output must land on the residual layout.
+    table = _spec(None, "fsdp")
+    if "fsdp" in _live(plan, "fsdp"):
+        gather_unsafe = ep and not plan.remat_safe
+        add(
+            "embed.gather",
+            "full_remat" if gather_unsafe else "allgather",
+            ("fsdp",) + (_live(plan, "ep") if gather_unsafe else ()),
+            table,
+            act,
+            note=(
+                "dim-sharded table gathered to a batch/seq-sharded output;"
+                " unpinned under an expert-parallel mesh GSPMD resolves"
+                " this by replicate+reslice (involuntary full remat)"
+                if gather_unsafe
+                else "embedding table all-gathered over fsdp for the lookup"
+            ),
+        )
+
+    # -- per-layer attention block
+    if "fsdp" in _live(plan, "fsdp"):
+        add(
+            "layer.qkv",
+            "allgather",
+            ("fsdp",),
+            _spec(None, "fsdp", "tp"),
+            _spec(None, None, "tp"),
+            note="ZeRO-3: layer projection weights all-gathered over fsdp",
+        )
+    if sp:
+        if plan.ring_attention:
+            add(
+                "attn.ring",
+                "permute",
+                ("sp",),
+                _spec(data, "sp", None, None),
+                _spec(data, "sp", None, None),
+                note="ring attention: K/V blocks rotate around sp via"
+                " collective-permute, one hop per step",
+            )
+        else:
+            add(
+                "attn.kv_allgather",
+                "allgather",
+                ("sp",),
+                _spec(data, "sp", None, None),
+                _spec(data, None, None, None),
+                note="full attention over a sp-sharded sequence gathers"
+                " K/V along sp (use --ring-attention to stream instead)",
+            )
+    if tp:
+        add(
+            "layer.attn_out",
+            "allreduce",
+            ("tp",),
+            _spec(data, seq_dim, "tp"),
+            act,
+            note="wo contracts the tp-sharded head dim: partial sums"
+            " all-reduced over tp",
+        )
+
+    # -- FFN: dense MLP or MoE dispatch/combine
+    if plan.model.is_moe:
+        expert_layout = _spec(("ep", "tp"), None, None)  # [E, cap, d]
+        if ep:
+            dispatch_unsafe = not plan.remat_safe and bool(
+                _live(plan, "fsdp", "sp")
+            )
+            add(
+                "moe.dispatch",
+                "full_remat" if dispatch_unsafe else "alltoall",
+                _live(plan, "ep", "fsdp", "sp")
+                if dispatch_unsafe
+                else ("ep",),
+                act,
+                expert_layout,
+                note=(
+                    "token dispatch resharding batch/seq-sharded"
+                    " activations onto the ep expert layout with no"
+                    " output constraint: GSPMD replicates + reslices"
+                    " (involuntary full remat) — pin the combine output"
+                    " with with_sharding_constraint"
+                    if dispatch_unsafe
+                    else "tokens all-to-all'd onto the expert layout"
+                ),
+            )
+            add(
+                "moe.combine",
+                "alltoall",
+                ("ep",),
+                expert_layout,
+                act,
+                note="expert outputs all-to-all'd back to the token layout",
+            )
+        elif tp:
+            add(
+                "moe.experts",
+                "allreduce",
+                ("tp",),
+                _spec(("ep", "tp"), None, None),
+                act,
+                note="ep=1: experts shard over tp only; combine partial"
+                " sums all-reduce over tp",
+            )
+    else:
+        if tp:
+            add(
+                "layer.mlp_out",
+                "allreduce",
+                ("tp",),
+                _spec(data, seq_dim, "tp"),
+                act,
+                note="w_down contracts the tp-sharded ffn dim: partial"
+                " sums all-reduced over tp",
+            )
+
+    # -- pipeline stage boundary
+    if pp:
+        add(
+            "pp.stage",
+            "permute",
+            ("pp",),
+            act,
+            act,
+            note="microbatch activations hand off stage->stage over pp",
+        )
+
+    # -- cross-entropy over the (fsdp, tp)-sharded lm_head
+    if not plan.serve:
+        head_axes = _live(plan, "fsdp", "tp")
+        if head_axes:
+            add(
+                "loss.ce",
+                "allreduce",
+                _live(plan, "tp") or head_axes,
+                _spec(data, seq_dim, "tp"),
+                _spec(data, seq_dim),
+                note="vocab-sharded logits: softmax normalizer all-reduced"
+                " over tp (lm_head all-gathered over fsdp)",
+            )
+        # -- backward gradient sync
+        grad_axes = _live(plan, "dp")
+        if grad_axes or "fsdp" in _live(plan, "fsdp"):
+            add(
+                "grad.sync",
+                "allreduce",
+                _live(plan, "dp", "fsdp"),
+                _spec(None, "fsdp", "tp"),
+                _spec(None, "fsdp", "tp"),
+                note="backward: gradients reduce-scattered over fsdp and"
+                " all-reduced over dp",
+            )
+
+    return ShardingFlow(
+        boundaries=boundaries, batch_spec=batch_s, activation_spec=act_s
+    )

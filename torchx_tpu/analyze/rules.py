@@ -14,6 +14,8 @@ Code families (full table in docs/api/analyze.md):
 * ``TPX3xx`` scheduler capability fit
 * ``TPX4xx`` supervisor / retry coherence
 * ``TPX5xx`` control-plane resilience coherence
+* ``TPX6xx`` control-daemon coherence
+* ``TPX7xx`` deep preflight (static sharding / HBM / collective analysis)
 """
 
 from __future__ import annotations
@@ -362,12 +364,25 @@ def check_mesh(ctx: RuleContext) -> Iterator[Diagnostic]:
     explicit ``with_sharding_constraint``. The stock trainer does; a
     custom entrypoint module probably does not, so warn before the job
     ever reaches a pod.
+
+    The heuristic is the FALLBACK: when the role resolves into a full
+    :class:`~torchx_tpu.analyze.plan.ParallelPlan` (a recognizable
+    ``--config``), real sharding propagation owns the question and emits
+    TPX700 with the exact boundary instead (``check_deep_preflight``) —
+    the pattern-match would double-report, so it stands down. TPX111
+    (unknown axis names) always runs; it is pure spec hygiene.
     """
+    from torchx_tpu.analyze.plan import PlanError, plan_from_role
+
     for role in ctx.app.roles:
         args = [str(a) for a in role.args]
         safe = any(
             m in (role.entrypoint or "") or m in args for m in REMAT_SAFE_MODULES
         )
+        try:
+            superseded = plan_from_role(role) is not None
+        except PlanError:
+            superseded = True  # broken plan: TPX703 owns the role
         for spec in _mesh_specs(role):
             sizes: dict[str, int] = {}
             for pair in spec.split(","):
@@ -396,7 +411,7 @@ def check_mesh(ctx: RuleContext) -> Iterator[Diagnostic]:
             paired = [
                 a for a in ("fsdp", "sp") if sizes.get(a, 1) > 1 or sizes.get(a) == -1
             ]
-            if (ep > 1 or ep == -1) and paired and not safe:
+            if (ep > 1 or ep == -1) and paired and not safe and not superseded:
                 yield Diagnostic(
                     code="TPX110",
                     severity=Severity.WARNING,
@@ -976,3 +991,32 @@ def check_control_plane(ctx: RuleContext) -> Iterator[Diagnostic]:
             " daemon (unset TPX_CONTROL_ADDR) to poll directly"
         ),
     )
+
+
+# ---------------------------------------------------------------------------
+# TPX7xx — deep preflight: static sharding / HBM / collective analysis
+# ---------------------------------------------------------------------------
+
+
+@rule("deep-preflight")
+def check_deep_preflight(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX700-TPX704: the jax-free static analysis pass.
+
+    For every role whose args resolve into a
+    :class:`~torchx_tpu.analyze.plan.ParallelPlan` (a recognizable
+    ``--config`` plus mesh/topology facts), propagate named shardings
+    through the train/serve step, compute the static HBM fit and classify
+    per-axis collective traffic ICI vs DCN — the full report is
+    ``tpx explain``; this rule feeds the same diagnostics into the submit
+    gate. Roles with no resolvable plan are silently skipped here (the
+    TPX110 heuristic covers them); ``tpx explain`` additionally reports
+    the skip as TPX705 info.
+    """
+    from torchx_tpu.analyze.explain import deep_preflight
+
+    for role in ctx.app.roles:
+        _plan, diags = deep_preflight(role)
+        for d in diags:
+            if d.code == "TPX705":
+                continue  # explain-only: the gate stays quiet on skips
+            yield d

@@ -1,0 +1,160 @@
+"""``tpx explain`` — deep preflight: static sharding / HBM / collective
+analysis of a job's parallelism plan, without submitting anything.
+
+Targets (same grammar as ``tpx lint``):
+
+* a builtin component name (``dist.spmd``) or custom ``file.py:fn``,
+  followed by the component's arguments — the component is materialized
+  and every plan-shaped role analyzed;
+* an AppDef JSON file (``job.json``) or ``-`` for the same on stdin.
+
+The analysis itself is jax-free (enforced by ``scripts/lint_internal.py``
+and the tier1 EXPLAIN_SMOKE step): sharding propagation, the static HBM
+fit and ICI-vs-DCN collective classification all run on launcher-side
+arithmetic. ``--aot`` additionally AOT-compiles the train step through
+``parallel/aot_fit.compile_fit`` (imports jax) and prints the XLA memory
+analysis next to the static prediction.
+
+Exit codes: 0 clean (warnings allowed), 1 error-severity diagnostics
+(TPX700/701/703), 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from torchx_tpu.cli.cmd_base import SubCommand
+
+logger = logging.getLogger(__name__)
+
+
+class CmdExplain(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument(
+            "-s",
+            "--scheduler",
+            type=str,
+            default=None,
+            help="scheduler name stamped on the report/span (informational)",
+        )
+        subparser.add_argument(
+            "--json",
+            action="store_true",
+            help="emit the report as stable JSON (schema version 1)",
+        )
+        subparser.add_argument(
+            "--aot",
+            action="store_true",
+            help="cross-check the static HBM fit against the XLA AOT"
+            " memory analysis (imports jax)",
+        )
+        subparser.add_argument(
+            "--devices",
+            type=int,
+            default=None,
+            help="override the device count the plan resolves onto",
+        )
+        subparser.add_argument(
+            "--hbm-gb",
+            type=float,
+            default=None,
+            help="override the per-chip HBM budget in GiB",
+        )
+        subparser.add_argument(
+            "--headroom",
+            type=float,
+            default=None,
+            help="fraction of HBM the fit may use (default 0.9)",
+        )
+        subparser.add_argument(
+            "conf_args",
+            nargs=argparse.REMAINDER,
+            help="component name / file.py:fn / appdef.json / '-' (stdin),"
+            " optionally followed by component arguments",
+        )
+
+    def run(self, args: argparse.Namespace) -> None:
+        from torchx_tpu.analyze.costmodel import DEFAULT_HEADROOM
+
+        conf_args = args.conf_args
+        if conf_args and conf_args[0] == "--":
+            conf_args = conf_args[1:]
+        if not conf_args:
+            print(
+                "error: explain needs a target: a component name, file.py:fn,"
+                " an AppDef JSON file, or '-' for stdin",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        target, rest = conf_args[0], conf_args[1:]
+
+        scheduler = args.scheduler
+        if scheduler is not None:
+            from torchx_tpu.schedulers import get_scheduler_factories
+
+            available = sorted(get_scheduler_factories())
+            if scheduler not in available:
+                print(
+                    f"error: unknown scheduler {scheduler!r};"
+                    f" available: {available}",
+                    file=sys.stderr,
+                )
+                sys.exit(2)
+
+        app = self._load_app(target, rest)
+        from torchx_tpu.analyze.explain import explain
+
+        report = explain(
+            app,
+            scheduler=scheduler,
+            devices=args.devices,
+            hbm_bytes=(
+                int(args.hbm_gb * 1024**3) if args.hbm_gb is not None else None
+            ),
+            headroom=(
+                args.headroom if args.headroom is not None else DEFAULT_HEADROOM
+            ),
+            aot=args.aot,
+            gate="cli",
+        )
+        if target not in ("-",):
+            report.target = target
+        if args.json:
+            print(json.dumps(report.to_dict(), indent=2))
+        else:
+            print(report.render())
+        sys.exit(1 if report.has_errors else 0)
+
+    def _load_app(self, target: str, rest):  # noqa: ANN001 - AppDef
+        from torchx_tpu.specs.serialize import appdef_from_dict
+
+        if target == "-" or target.endswith(".json"):
+            try:
+                if target == "-":
+                    raw = json.load(sys.stdin)
+                else:
+                    with open(target) as f:
+                        raw = json.load(f)
+                return appdef_from_dict(raw)
+            except (
+                OSError,
+                json.JSONDecodeError,
+                ValueError,
+                KeyError,
+                TypeError,
+                AttributeError,
+            ) as e:
+                print(f"error: invalid job spec {target!r}: {e}", file=sys.stderr)
+                sys.exit(2)
+        from torchx_tpu.specs.builders import materialize_appdef
+        from torchx_tpu.specs.finder import get_component
+
+        try:
+            component_def = get_component(target)
+            return materialize_appdef(component_def.fn, rest)
+        except Exception as e:  # noqa: BLE001 - unknown component, bad args
+            print(f"error: cannot materialize {target!r}: {e}", file=sys.stderr)
+            sys.exit(2)

@@ -29,6 +29,7 @@ CMDS_ENTRYPOINT_GROUP = "tpx.cli.cmds"
 BUILTIN_CMDS: dict[str, tuple[str, str]] = {
     "run": ("torchx_tpu.cli.cmd_run", "CmdRun"),
     "lint": ("torchx_tpu.cli.cmd_lint", "CmdLint"),
+    "explain": ("torchx_tpu.cli.cmd_explain", "CmdExplain"),
     "supervise": ("torchx_tpu.cli.cmd_supervise", "CmdSupervise"),
     "status": ("torchx_tpu.cli.cmd_simple", "CmdStatus"),
     "describe": ("torchx_tpu.cli.cmd_simple", "CmdDescribe"),

@@ -384,6 +384,28 @@ LINT_DIAGNOSTICS = REGISTRY.counter(
     ("code", "severity"),
 )
 
+#: deep-preflight (``tpx explain``) runs, by entry point and outcome.
+EXPLAIN_RUNS = REGISTRY.counter(
+    "tpx_explain_runs_total",
+    "deep-preflight analyzer runs",
+    ("gate", "status"),
+)
+
+#: TPX7xx diagnostics emitted by the deep preflight, by code + severity.
+EXPLAIN_DIAGNOSTICS = REGISTRY.counter(
+    "tpx_explain_diagnostics_total",
+    "deep-preflight diagnostics emitted",
+    ("code", "severity"),
+)
+
+#: statically-predicted per-chip HBM usage of the last explained plan,
+#: by role — compared against the measured/compiled numbers in BENCH.
+EXPLAIN_HBM_TOTAL_BYTES = REGISTRY.gauge(
+    "tpx_explain_hbm_total_bytes",
+    "per-chip HBM bytes the deep preflight predicts for a role's plan",
+    ("role",),
+)
+
 #: control-plane calls issued through the resilient seam, by backend +
 #: logical op + outcome ("ok"/"error"/"rejected" — rejected means the
 #: backend's circuit breaker refused the call).

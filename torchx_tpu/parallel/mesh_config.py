@@ -64,6 +64,46 @@ class MeshConfig:
         return sizes
 
 
+def axis_networks(
+    sizes: dict[str, int], chips_per_slice: int
+) -> dict[str, str]:
+    """Classify each mesh axis as ``"ici"``, ``"dcn"`` or ``"mixed"``
+    (``"none"`` for size-1 axes) from the slice topology.
+
+    ``make_mesh`` (parallel/mesh.py) reshapes the device list in
+    :data:`AXES` order, outermost (``pp``) to innermost (``sp``), and the
+    device list enumerates slices contiguously — so an axis's position in
+    the flat device order decides which interconnect its collectives
+    traverse. With ``stride(axis)`` = product of the sizes of the axes
+    *after* it:
+
+    * ``stride * size <= chips_per_slice`` — every hop along the axis
+      stays inside one slice: **ici**.
+    * ``stride >= chips_per_slice`` — every hop crosses a slice boundary:
+      **dcn**.
+    * otherwise the axis straddles the boundary: **mixed** (part of each
+      ring is ICI, part DCN — the DCN segment paces the collective).
+
+    ``sizes`` must be fully resolved (no -1); extra keys are ignored.
+    """
+    out: dict[str, str] = {}
+    stride = 1
+    for axis in reversed(AXES):
+        size = int(sizes.get(axis, 1))
+        if size <= 1:
+            out[axis] = "none"
+            continue
+        extent = stride * size
+        if extent <= chips_per_slice:
+            out[axis] = "ici"
+        elif stride >= chips_per_slice:
+            out[axis] = "dcn"
+        else:
+            out[axis] = "mixed"
+        stride = extent
+    return out
+
+
 def parse_mesh_spec(spec: str) -> MeshConfig:
     """``"dp=2,fsdp=-1,tp=4"`` -> :class:`MeshConfig` (unnamed axes keep
     their defaults; unknown axis names raise)."""

@@ -37,14 +37,18 @@ from typing import Any, Callable, Generic, Iterator, Mapping, Optional, TypeVar,
 # but multi-host slices are built EXCLUSIVELY from 4-chip VMs
 # (ct5lp-hightpu-4t / ct6e-standard-4t) — e.g. v5litepod-16 is 4 hosts x 4
 # chips on a 4x4 topology, never 2 hosts x 8.
+_GIB = 1024**3
+
+# hbm_bytes: per-chip HBM capacity (the deep-preflight fit budget; v5p is
+# the 95 GiB figure parallel/aot_fit.py uses for the north-star gate).
 _TPU_GENERATIONS: dict[str, dict[str, Any]] = {
-    "v2": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": True},
-    "v3": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": True},
-    "v4": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": True},
-    "v5p": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": True},
-    "v5e": {"cores_per_chip": 1, "single_host_chips": 8, "multi_host_vm_chips": 4, "name_counts_cores": False},
-    "v6e": {"cores_per_chip": 1, "single_host_chips": 8, "multi_host_vm_chips": 4, "name_counts_cores": False},
-    "v7x": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": False},
+    "v2": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": True, "hbm_bytes": 8 * _GIB},
+    "v3": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": True, "hbm_bytes": 16 * _GIB},
+    "v4": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": True, "hbm_bytes": 32 * _GIB},
+    "v5p": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": True, "hbm_bytes": 95 * _GIB},
+    "v5e": {"cores_per_chip": 1, "single_host_chips": 8, "multi_host_vm_chips": 4, "name_counts_cores": False, "hbm_bytes": 16 * _GIB},
+    "v6e": {"cores_per_chip": 1, "single_host_chips": 8, "multi_host_vm_chips": 4, "name_counts_cores": False, "hbm_bytes": 32 * _GIB},
+    "v7x": {"cores_per_chip": 2, "single_host_chips": 4, "multi_host_vm_chips": 4, "name_counts_cores": False, "hbm_bytes": 192 * _GIB},
 }
 
 # Aliases seen in Cloud TPU accelerator-type strings.
@@ -137,6 +141,11 @@ class TpuSlice:
     @property
     def cores(self) -> int:
         return self.chips * self.cores_per_chip
+
+    @property
+    def hbm_bytes_per_chip(self) -> int:
+        """Per-chip HBM capacity — the deep-preflight memory-fit budget."""
+        return _TPU_GENERATIONS[self.accelerator]["hbm_bytes"]
 
     @property
     def chips_per_host(self) -> int:
