@@ -38,6 +38,7 @@ JAX_FREE = (
     "supervisor",
     "control",
     "analyze",
+    "fleet",
     os.path.join("parallel", "mesh_config.py"),
 )
 

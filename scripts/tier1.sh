@@ -325,4 +325,87 @@ finally:
 EOF
 then echo "SERVE_SMOKE=ok"; else echo "SERVE_SMOKE=FAILED"; rc=1; fi
 rm -rf "$serve_dir"
+
+# Fleet smoke: boot `tpx control --fleet`, fill the modeled fleet with a
+# serve gang, queue a batch then an interactive gang, and assert `tpx
+# queue` orders interactive first, /metricz exports the tpx_fleet_*
+# gauges, and `tpx --help` stays jax- AND fleet-free.
+fleet_dir=$(mktemp -d /tmp/tpx_fleet_smoke.XXXXXX)
+if timeout -k 10 180 env JAX_PLATFORMS=cpu TPX_OBS_DIR="$fleet_dir/obs" \
+    TPX_CONTROL_DIR="$fleet_dir/control" TPX_WATCH_INTERVAL=0.1 \
+    python - <<'EOF'
+import json, os, subprocess, sys, time, urllib.request
+
+ctl = os.environ["TPX_CONTROL_DIR"]
+daemon = subprocess.Popen(
+    [sys.executable, "-m", "torchx_tpu.cli.main", "control",
+     "--fleet", "sim:v5e-1x4"],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+)
+try:
+    discovery = os.path.join(ctl, "control.json")
+    deadline = time.monotonic() + 60
+    while not os.path.exists(discovery):
+        assert daemon.poll() is None, daemon.stdout.read()
+        assert time.monotonic() < deadline, "daemon never wrote discovery"
+        time.sleep(0.1)
+    doc = json.load(open(discovery))
+    addr, token = doc["addr"], doc["token"]
+
+    from torchx_tpu.control.client import ControlClient
+    client = ControlClient(addr, token)
+    log = os.path.join(os.environ["TPX_OBS_DIR"], "logs")
+    filler = client.submit_job(
+        "utils.sh", ["sleep", "30"], "local", cfg={"log_dir": log},
+        priority="serve", replicas=4,
+    )
+    assert filler.get("handle", "").startswith("local://"), filler
+    batch = client.submit_job(
+        "utils.sh", ["sleep", "1"], "local", cfg={"log_dir": log},
+        priority="batch",
+    )
+    inter = client.submit_job(
+        "utils.sh", ["sleep", "1"], "local", cfg={"log_dir": log},
+        priority="interactive",
+    )
+    assert batch.get("queued") and inter.get("queued"), (batch, inter)
+
+    env = dict(os.environ, TPX_CONTROL_ADDR=addr)
+    r = subprocess.run(
+        [sys.executable, "-m", "torchx_tpu.cli.main", "queue"],
+        capture_output=True, text=True, env=env, timeout=60,
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    assert "queued (2):" in r.stdout, r.stdout
+    lines = [l for l in r.stdout.splitlines() if l.strip().startswith("#")]
+    assert "interactive" in lines[0] and "batch" in lines[1], r.stdout
+
+    with urllib.request.urlopen(f"{addr}/metricz", timeout=10) as resp:
+        metrics = resp.read().decode()
+    assert 'tpx_fleet_queue_depth{klass="interactive"} 1' in metrics, metrics[:2000]
+    assert 'tpx_fleet_chips{state="free"} 0' in metrics, metrics[:2000]
+    assert 'tpx_fleet_placements_total{klass="serve"} 1' in metrics, metrics[:2000]
+finally:
+    daemon.terminate()
+    daemon.wait(timeout=10)
+
+# the queue verb must ride the same lazy dispatcher: no fleet (or jax)
+# modules on the help fast path
+r = subprocess.run(
+    [sys.executable, "-c", (
+        "import sys\n"
+        "from torchx_tpu.cli.main import main\n"
+        "try: main(['--help'])\n"
+        "except SystemExit: pass\n"
+        "leaked = [m for m in ('jax', 'numpy', 'torchx_tpu.fleet',"
+        " 'torchx_tpu.control', 'torchx_tpu.cli.cmd_queue') if m in sys.modules]\n"
+        "assert not leaked, f'tpx --help imported {leaked}'\n"
+    )],
+    capture_output=True, text=True, timeout=60,
+)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+assert "queue" in r.stdout, r.stdout
+EOF
+then echo "FLEET_SMOKE=ok"; else echo "FLEET_SMOKE=FAILED"; rc=1; fi
+rm -rf "$fleet_dir"
 exit $rc

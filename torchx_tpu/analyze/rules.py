@@ -993,6 +993,52 @@ def check_control_plane(ctx: RuleContext) -> Iterator[Diagnostic]:
     )
 
 
+@rule("fleet-class")
+def check_fleet_class(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX602: a preemptible-class gang with no way to survive preemption.
+
+    Under the fleet scheduler, ``batch`` and ``preemptible`` classes are
+    the preemption market's victims: a higher class that cannot place
+    will shrink them (elastic reshape) or checkpoint-preempt them. A role
+    in one of those classes that is neither elastic
+    (``SupervisorPolicy.elastic_reshape``) nor checkpointing (no
+    checkpoint-dir flag, same detection as TPX503) loses ALL progress on
+    every market action — it runs, but every preemption restarts it from
+    step 0. The class is read from ``role.metadata["fleet/class"]`` or
+    the injected ``$TPX_FLEET_CLASS`` role env."""
+    if ctx.policy is not None and getattr(ctx.policy, "elastic_reshape", False):
+        return
+    for role in ctx.app.roles:
+        klass = str(
+            role.metadata.get("fleet/class")
+            or role.env.get(s.ENV_TPX_FLEET_CLASS)
+            or ""
+        ).strip()
+        if klass not in ("batch", "preemptible"):
+            continue
+        args = list(role.args) + [role.entrypoint]
+        if any(flag in str(a) for a in args for flag in _CKPT_DIR_FLAGS):
+            continue
+        yield Diagnostic(
+            code="TPX602",
+            severity=Severity.WARNING,
+            field="fleet/class",
+            message=(
+                f"role {role.name!r} runs in fleet class {klass!r} — a"
+                " preemption-market victim class — but is neither elastic"
+                " (no SupervisorPolicy.elastic_reshape) nor checkpointing"
+                f" (no {'/'.join(_CKPT_DIR_FLAGS)} flag): every market"
+                " shrink or preemption will cost its full progress"
+            ),
+            hint=(
+                "make the gang elastic (policy elastic_reshape + a mesh"
+                " spec, submit with elastic=true) so the market shrinks it"
+                " instead of killing it, or pass a checkpoint-dir flag so"
+                " a preempted attempt resumes from its last step"
+            ),
+        )
+
+
 # ---------------------------------------------------------------------------
 # TPX7xx — deep preflight: static sharding / HBM / collective analysis
 # ---------------------------------------------------------------------------

@@ -46,15 +46,42 @@ class CmdControl(SubCommand):
             help="also print the root token (it is always in the 0600"
             " discovery file; printing it puts it in scrollback)",
         )
+        subparser.add_argument(
+            "--fleet",
+            default=None,
+            metavar="SPEC",
+            help="enable the fleet scheduler on this modeled fleet, e.g."
+            " 'default:v5e-4x8,big:v5p-8x2' (name:gen-CHIPSxCOUNT,...);"
+            " submits then queue/place/preempt instead of 429ing",
+        )
+        subparser.add_argument(
+            "--fleet-quota",
+            action="append",
+            default=None,
+            metavar="TENANT=CHIPS",
+            help="per-tenant chip quota for the fleet scheduler"
+            " (repeatable; tenants without one are unlimited)",
+        )
 
     def run(self, args: argparse.Namespace) -> None:
-        from torchx_tpu.control.daemon import ControlDaemon
+        from torchx_tpu.control.daemon import ControlDaemon, control_dir
 
+        fleet = None
+        if args.fleet:
+            from torchx_tpu.fleet.api import FleetScheduler, parse_quotas
+            from torchx_tpu.fleet.model import FleetModel
+
+            fleet = FleetScheduler(
+                FleetModel.from_spec(args.fleet),
+                state_dir=args.state_dir or control_dir(),
+                quotas=parse_quotas(args.fleet_quota),
+            )
         daemon = ControlDaemon(
             host=args.host,
             port=args.port,
             state_dir=args.state_dir,
             tenant_cap=args.tenant_cap,
+            fleet=fleet,
         )
         recovered = len(daemon.store)
         print(
@@ -62,6 +89,15 @@ class CmdControl(SubCommand):
             f" (state {daemon.state_dir}, {recovered} jobs rehydrated)",
             flush=True,
         )
+        if fleet is not None:
+            snap = fleet.queue_snapshot()
+            print(
+                f"  fleet: {snap['fleet']['chips_total']} chips in"
+                f" {len(snap['fleet']['pools'])} pool(s),"
+                f" {len(snap['queue'])} queued /"
+                f" {len(snap['running'])} running rehydrated",
+                flush=True,
+            )
         print(f"  export TPX_CONTROL_ADDR={daemon.addr}", flush=True)
         if args.print_token:
             print(f"  export TPX_CONTROL_TOKEN={daemon.root_token}", flush=True)

@@ -46,6 +46,7 @@ BUILTIN_CMDS: dict[str, tuple[str, str]] = {
     "tracker": ("torchx_tpu.cli.cmd_tracker", "CmdTracker"),
     "serve-pool": ("torchx_tpu.cli.cmd_serve_pool", "CmdServePool"),
     "control": ("torchx_tpu.cli.cmd_control", "CmdControl"),
+    "queue": ("torchx_tpu.cli.cmd_queue", "CmdQueue"),
 }
 
 

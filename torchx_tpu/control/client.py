@@ -85,6 +85,48 @@ class ControlClient:
         """Mint a per-tenant session token (root-token callers only)."""
         return str(self._request("/v1/session", {"tenant": tenant})["token"])
 
+    def submit_job(
+        self,
+        component: str,
+        args: list[str],
+        scheduler: str,
+        cfg: Optional[dict] = None,
+        cfg_str: str = "",
+        workspace: Optional[str] = None,
+        priority: Optional[str] = None,
+        elastic: bool = False,
+        mesh: str = "",
+        replicas: Optional[int] = None,
+        chips: Optional[int] = None,
+        min_replicas: Optional[int] = None,
+    ) -> dict:
+        """Submit through the daemon, returning the full reply.
+
+        In daemon-only mode the reply is ``{"handle"}``; with the fleet
+        scheduler enabled it may instead be ``{"queued": true,
+        "fleet_job", "position"}``. The fleet fields (``priority``,
+        ``elastic``, ``mesh``, ``replicas``/``chips`` overrides,
+        ``min_replicas``) are ignored by a daemon without a fleet."""
+        payload: dict = {
+            "component": component,
+            "args": list(args),
+            "scheduler": scheduler,
+            "cfg": dict(cfg or {}),
+            "cfg_str": cfg_str,
+            "workspace": workspace,
+            "elastic": bool(elastic),
+            "mesh": mesh,
+        }
+        if priority is not None:
+            payload["priority"] = priority
+        if replicas is not None:
+            payload["replicas"] = int(replicas)
+        if chips is not None:
+            payload["chips"] = int(chips)
+        if min_replicas is not None:
+            payload["min_replicas"] = int(min_replicas)
+        return self._request("/v1/submit", payload)
+
     def submit(
         self,
         component: str,
@@ -96,20 +138,32 @@ class ControlClient:
     ) -> str:
         """Submit through the daemon. ``cfg_str`` ships the CLI's raw
         ``-cfg k=v,...`` string so the daemon parses it against the
-        backend's typed runopts schema (the client stays schema-blind)."""
-        return str(
-            self._request(
-                "/v1/submit",
-                {
-                    "component": component,
-                    "args": list(args),
-                    "scheduler": scheduler,
-                    "cfg": dict(cfg or {}),
-                    "cfg_str": cfg_str,
-                    "workspace": workspace,
-                },
-            )["handle"]
+        backend's typed runopts schema (the client stays schema-blind).
+
+        Callers of this verb need a handle NOW; a fleet-queued reply
+        (no handle yet) surfaces as a 202-coded
+        :class:`ControlClientError` naming the fleet job id."""
+        reply = self.submit_job(
+            component,
+            args,
+            scheduler,
+            cfg=cfg,
+            cfg_str=cfg_str,
+            workspace=workspace,
         )
+        handle = reply.get("handle")
+        if not handle:
+            raise ControlClientError(
+                202,
+                f"queued as {reply.get('fleet_job')} at position"
+                f" {reply.get('position')}; watch with `tpx queue`",
+            )
+        return str(handle)
+
+    def queue(self) -> dict:
+        """The fleet scheduler's queue + placement snapshot
+        (``{"enabled": false}`` when the daemon has no fleet)."""
+        return self._request("/v1/queue")
 
     def status(self, handle: str) -> dict:
         """One job's recorded state: answered from the daemon's

@@ -22,6 +22,8 @@ API (JSON; Bearer-token auth on every ``/v1`` route):
                                   status when terminal or when the budget
                                   expires ({"terminal": false})
     GET  /v1/logs?handle=&role=&k= -> JSONL line stream (log attach)
+    GET  /v1/queue                -> fleet queue + placements snapshot
+                                  ({"enabled": false} without --fleet)
 
 Security model: the daemon binds loopback only. At start it mints a root
 token and records ``{"addr", "token", "pid"}`` in a 0600 discovery file
@@ -29,7 +31,17 @@ token and records ``{"addr", "token", "pid"}`` in a 0600 discovery file
 through it (:func:`torchx_tpu.control.client.maybe_client`). The root
 token can mint per-tenant session tokens (``/v1/session``); each tenant
 is capped at ``tenant_cap`` concurrently *active* (non-terminal) jobs,
-submits past the cap get 429 and the caller's retry policy decides.
+submits past the cap get 429 (with a ``Retry-After`` hint and a stable
+JSON error body) and the caller's retry policy decides.
+
+With a :class:`~torchx_tpu.fleet.api.FleetScheduler` attached (``tpx
+control --fleet``), ``/v1/submit`` stops bouncing: the submit is
+dryrun-validated, serialized into a resubmission recipe, and handed to
+the fleet queue — the reply is either ``{"handle"}`` (placed now) or
+``{"queued": true, "position": N}``. The daemon implements the
+scheduler's executor seam (materialize + run + reconciler tracking) and
+feeds it every watch event, so a terminal job immediately re-runs the
+placement loop.
 """
 
 from __future__ import annotations
@@ -66,12 +78,83 @@ def control_dir() -> str:
 
 
 class _DaemonError(Exception):
-    """Maps straight to an HTTP error reply."""
+    """Maps straight to an HTTP error reply.
 
-    def __init__(self, code: int, message: str) -> None:
+    ``payload`` keys are merged into the JSON error body (stable,
+    machine-readable fields next to the human ``error`` string);
+    ``headers`` become response headers (e.g. ``Retry-After``)."""
+
+    def __init__(
+        self,
+        code: int,
+        message: str,
+        payload: Optional[dict] = None,
+        headers: Optional[dict] = None,
+    ) -> None:
         super().__init__(message)
         self.code = code
         self.message = message
+        self.payload = dict(payload or {})
+        self.headers = dict(headers or {})
+
+
+class _FleetExecutor:
+    """The daemon-side half of the fleet scheduler's executor seam.
+
+    Re-materializes a gang's journaled recipe at its CURRENT replica
+    count (shrink/grow resubmits change it), injects the fleet env
+    (``$TPX_FLEET_JOB``/``CLASS`` always, ``$TPX_MESH`` on reshapes), and
+    submits with ``no_lint=True`` — validation happened at submit-time
+    dryrun; a reshape must not bounce off a lint gate. Called with the
+    scheduler's lock held, so it never calls back into the scheduler."""
+
+    def __init__(self, daemon: "ControlDaemon") -> None:
+        self._daemon = daemon
+
+    def schedule(self, job: Any, mesh_spec: Optional[str]) -> str:
+        from torchx_tpu.specs.serialize import appdef_from_dict
+
+        daemon = self._daemon
+        recipe = job.recipe
+        app = appdef_from_dict(recipe["appdef"])
+        scheduler = str(recipe.get("scheduler") or "local")
+        if app.roles:
+            app.roles[0].num_replicas = int(job.cur_replicas)
+        for role in app.roles:
+            role.env[settings.ENV_TPX_FLEET_JOB] = job.req.job
+            role.env[settings.ENV_TPX_FLEET_CLASS] = job.req.klass
+            if mesh_spec:
+                role.env[settings.ENV_TPX_MESH] = mesh_spec
+            else:
+                role.env.pop(settings.ENV_TPX_MESH, None)
+        handle = daemon.runner.run(
+            app,
+            scheduler,
+            cfg=dict(recipe.get("cfg") or {}),
+            workspace=recipe.get("workspace"),
+            no_lint=True,
+        )
+        sched_name, app_id = daemon._split_handle(handle)
+        with daemon._lock:
+            daemon._jobs[handle] = job.req.tenant
+        daemon.reconciler.ingest(
+            StateEvent(
+                scheduler=sched_name,
+                app_id=app_id,
+                state=AppState.SUBMITTED,
+                source="fleet",
+            )
+        )
+        daemon.reconciler.track(
+            sched_name, daemon.runner._scheduler(sched_name), app_id
+        )
+        return handle
+
+    def cancel(self, handle: str) -> None:
+        try:
+            self._daemon.runner.cancel(handle)
+        except Exception as e:  # noqa: BLE001 - reshape cancel is best-effort
+            logger.debug("fleet cancel of %s failed: %s", handle, e)
 
 
 class ControlDaemon:
@@ -86,6 +169,11 @@ class ControlDaemon:
             :func:`control_dir`).
         tenant_cap: max concurrently active jobs per tenant (default
             :data:`~torchx_tpu.settings.DEFAULT_CONTROL_TENANT_CAP`).
+            Only enforced in daemon-only mode — with ``fleet`` attached,
+            submits queue instead of bouncing.
+        fleet: an optional :class:`~torchx_tpu.fleet.api.FleetScheduler`;
+            the daemon binds itself as its executor, subscribes it to the
+            watch stream, and rehydrates its journal.
     """
 
     def __init__(
@@ -95,6 +183,7 @@ class ControlDaemon:
         port: int = 0,
         state_dir: Optional[str] = None,
         tenant_cap: Optional[int] = None,
+        fleet: Optional[Any] = None,
     ) -> None:
         if runner is None:
             from torchx_tpu.runner.api import get_runner
@@ -121,6 +210,28 @@ class ControlDaemon:
         self._thread: Optional[threading.Thread] = None
         self._serving = False
         self._closed = False
+        self.fleet = fleet
+        if fleet is not None:
+            fleet.bind(_FleetExecutor(self))
+            self.reconciler.subscribe(fleet.on_event)
+            fleet.rehydrate()
+            # re-own rehydrated running jobs: tenant accounting + watch
+            # tracking, so their terminal events free fleet capacity
+            for entry in fleet.queue_snapshot().get("running", []):
+                handle = str(entry.get("handle") or "")
+                if not handle:
+                    continue
+                with self._lock:
+                    self._jobs[handle] = str(entry.get("tenant", ""))
+                try:
+                    sched_name, app_id = self._split_handle(handle)
+                    self.reconciler.track(
+                        sched_name, runner._scheduler(sched_name), app_id
+                    )
+                except Exception as e:  # noqa: BLE001 - degrade to poll
+                    logger.warning(
+                        "fleet rehydrate: cannot track %s: %s", handle, e
+                    )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -246,31 +357,44 @@ class ControlDaemon:
             raise _DaemonError(400, "missing tenant name")
         return {"token": self.mint_session(name)}
 
+    def _parse_cfg(self, scheduler: str, req: dict) -> dict:
+        # cfg_str (the CLI's raw -cfg string) parses against the
+        # backend's typed runopts schema HERE — clients stay
+        # schema-blind; an explicit cfg dict overlays the result
+        cfg: dict = {}
+        cfg_str = str(req.get("cfg_str") or "")
+        if cfg_str:
+            cfg.update(
+                self.runner.scheduler_run_opts(scheduler).cfg_from_str(cfg_str)
+            )
+        cfg.update(dict(req.get("cfg") or {}))
+        return cfg
+
     def _op_submit(self, tenant: str, req: dict) -> dict:
         component = req.get("component")
         scheduler = req.get("scheduler")
         if not component or not scheduler:
             raise _DaemonError(400, "submit needs component and scheduler")
+        if self.fleet is not None:
+            return self._op_fleet_submit(tenant, req)
         active = self._active_jobs(tenant)
         if active >= self.tenant_cap:
+            retry_after = settings.CONTROL_RETRY_AFTER_SECONDS
             raise _DaemonError(
                 429,
                 f"tenant {tenant!r} has {active} active jobs"
                 f" (cap {self.tenant_cap}); retry after one finishes",
+                payload={
+                    "code": "tenant_cap_exceeded",
+                    "tenant": tenant,
+                    "active": active,
+                    "cap": self.tenant_cap,
+                    "retry_after_seconds": retry_after,
+                },
+                headers={"Retry-After": str(retry_after)},
             )
         try:
-            # cfg_str (the CLI's raw -cfg string) parses against the
-            # backend's typed runopts schema HERE — clients stay
-            # schema-blind; an explicit cfg dict overlays the result
-            cfg = {}
-            cfg_str = str(req.get("cfg_str") or "")
-            if cfg_str:
-                cfg.update(
-                    self.runner.scheduler_run_opts(str(scheduler)).cfg_from_str(
-                        cfg_str
-                    )
-                )
-            cfg.update(dict(req.get("cfg") or {}))
+            cfg = self._parse_cfg(str(scheduler), req)
             handle = self.runner.run_component(
                 str(component),
                 [str(a) for a in req.get("args", [])],
@@ -300,6 +424,78 @@ class ControlDaemon:
         )
         self._active_jobs(tenant)
         return {"handle": handle}
+
+    def _op_fleet_submit(self, tenant: str, req: dict) -> dict:
+        """Submit through the fleet scheduler: dryrun-validate, derive the
+        gang demand from the materialized AppDef (overridable by explicit
+        ``replicas``/``chips`` request fields), journal the resubmission
+        recipe, and enqueue. 409 = the fleet can NEVER host the gang."""
+        from torchx_tpu.fleet.model import GangRequest
+        from torchx_tpu.specs.serialize import appdef_to_dict
+
+        component = str(req.get("component"))
+        scheduler = str(req.get("scheduler"))
+        try:
+            cfg = self._parse_cfg(scheduler, req)
+            info = self.runner.dryrun_component(
+                component,
+                [str(a) for a in req.get("args", [])],
+                scheduler,
+                cfg=cfg,
+                workspace=req.get("workspace"),
+            )
+        except Exception as e:  # noqa: BLE001 - surfaced to the client
+            raise _DaemonError(400, f"{type(e).__name__}: {e}") from e
+        app = info._app
+        role = app.roles[0] if app.roles else None
+        replicas = int(
+            req.get("replicas")
+            or (role.num_replicas if role is not None else 1)
+        )
+        chips = req.get("chips")
+        if chips is None:
+            tpu = role.resource.tpu if role is not None else None
+            chips = tpu.chips if tpu is not None else 1
+        try:
+            gang = GangRequest(
+                job="",
+                tenant=tenant,
+                klass=str(req.get("priority") or "batch"),
+                replicas=replicas,
+                chips_per_replica=int(chips),
+                elastic=bool(req.get("elastic")),
+                mesh=str(req.get("mesh") or ""),
+                min_replicas=int(req.get("min_replicas") or 1),
+            )
+        except ValueError as e:
+            raise _DaemonError(400, str(e)) from e
+        recipe = {
+            "appdef": appdef_to_dict(app),
+            "scheduler": scheduler,
+            "cfg": cfg,
+            "workspace": req.get("workspace"),
+        }
+        result = self.fleet.submit(gang, recipe)
+        status = result.get("status")
+        if status == "infeasible":
+            raise _DaemonError(
+                409,
+                f"gang cannot fit this fleet: {result.get('reason')}",
+                payload={"code": "fleet_infeasible", "fleet_job": result["job"]},
+            )
+        if status == "placed":
+            return {"handle": result.get("handle", ""), "fleet_job": result["job"]}
+        return {
+            "queued": True,
+            "fleet_job": result["job"],
+            "position": result.get("position"),
+            "class": result.get("class"),
+        }
+
+    def _op_queue(self, tenant: str, query: dict) -> dict:
+        if self.fleet is None:
+            return {"enabled": False}
+        return self.fleet.queue_snapshot()
 
     def _status_payload(self, handle: str, status: Optional[Any]) -> dict:
         if status is None:
@@ -363,6 +559,13 @@ class ControlDaemon:
     def _op_cancel(self, tenant: str, req: dict) -> dict:
         handle = str(req.get("handle", ""))
         if not handle:
+            # fleet job id: cancels a queued gang before it ever gets a
+            # handle (or the current attempt of a running one)
+            job = str(req.get("job", ""))
+            if job and self.fleet is not None:
+                if not self.fleet.cancel_job(job):
+                    raise _DaemonError(404, f"unknown fleet job {job!r}")
+                return {"ok": True}
             raise _DaemonError(400, "missing handle")
         try:
             self.runner.cancel(handle)
@@ -411,11 +614,19 @@ class ControlDaemon:
             def log_message(self, fmt: str, *args: Any) -> None:  # quiet
                 pass
 
-            def _reply(self, code: int, payload: dict, op: str = "") -> None:
+            def _reply(
+                self,
+                code: int,
+                payload: dict,
+                op: str = "",
+                headers: Optional[dict] = None,
+            ) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, str(value))
                 self.end_headers()
                 self.wfile.write(body)
                 if op:
@@ -423,18 +634,20 @@ class ControlDaemon:
 
             def _run(self, op: str, fn: Any) -> None:
                 start = time.perf_counter()
+                headers: Optional[dict] = None
                 try:
                     payload = fn()
                     code = 200
                 except _DaemonError as e:
-                    payload, code = {"error": e.message}, e.code
+                    payload = {"error": e.message, **e.payload}
+                    code, headers = e.code, e.headers
                 except Exception as e:  # noqa: BLE001 - keep the daemon up
                     logger.warning("control %s failed: %s", op, e)
                     payload, code = {"error": f"{type(e).__name__}: {e}"}, 500
                 obs_metrics.CONTROL_REQUEST_SECONDS.observe(
                     time.perf_counter() - start, op=op
                 )
-                self._reply(code, payload, op=op)
+                self._reply(code, payload, op=op, headers=headers)
 
             def _tenant(self) -> str:
                 return daemon._authenticate(self.headers.get("Authorization"))
@@ -461,6 +674,7 @@ class ControlDaemon:
                             "jobs": len(daemon.store),
                             "addr": daemon.addr,
                             "tenant_cap": daemon.tenant_cap,
+                            "fleet": daemon.fleet is not None,
                         },
                     )
                 elif url.path == "/metricz":
@@ -484,6 +698,11 @@ class ControlDaemon:
                 elif url.path == "/v1/wait":
                     self._run(
                         "wait", lambda: daemon._op_wait(self._tenant(), query)
+                    )
+                elif url.path == "/v1/queue":
+                    self._run(
+                        "queue",
+                        lambda: daemon._op_queue(self._tenant(), query),
                     )
                 elif url.path == "/v1/logs":
                     self._logs(query)

@@ -55,6 +55,7 @@ class Reconciler:
         self._watchers: dict[str, Any] = {}  # backend -> Watcher
         self._threads: dict[str, threading.Thread] = {}
         self._caches: list[Any] = []  # DescribeCache instances to refresh
+        self._subscribers: list[Any] = []  # callables fed every ingest
         self._closed = False
 
     # -- wiring ------------------------------------------------------------
@@ -65,6 +66,16 @@ class Reconciler:
         with self._lock:
             if cache not in self._caches:
                 self._caches.append(cache)
+
+    def subscribe(self, fn: Any) -> None:
+        """Register ``fn(event)`` to run after every ingested transition
+        (journal -> cache -> broadcast -> subscribers). The fleet
+        scheduler hangs its placement loop off this hook. Subscriber
+        exceptions are logged, never propagated into the watch pump; a
+        subscriber may call back into the reconciler (ingest/track)."""
+        with self._lock:
+            if fn not in self._subscribers:
+                self._subscribers.append(fn)
 
     def track(self, backend: str, scheduler: Any, app_id: str) -> None:
         """Start watching one app: joins the backend's existing stream or
@@ -137,6 +148,18 @@ class Reconciler:
             self._seq += 1
             self._events[(event.scheduler, event.app_id)] = (self._seq, event)
             self._cond.notify_all()
+        with self._lock:
+            subscribers = list(self._subscribers)
+        for fn in subscribers:
+            try:
+                fn(event)
+            except Exception:  # noqa: BLE001 - never kill the watch pump
+                logger.warning(
+                    "reconciler subscriber failed for %s/%s",
+                    event.scheduler,
+                    event.app_id,
+                    exc_info=True,
+                )
 
     # -- waiter side -------------------------------------------------------
 

@@ -1,0 +1,82 @@
+"""Fleet scheduler: priority classes, gang placement, preemption market.
+
+This package turns the control daemon from a pass-through submitter into
+a fleet scheduler. Demand arrives as gangs (N replicas of
+:class:`~torchx_tpu.specs.api.TpuSlice`-shaped slices); the scheduler
+orders them by priority class and per-tenant fair share, places them
+all-or-nothing onto a modeled fleet with ICI/DCN locality preference,
+uses the PR 10 deep-preflight cost model as an HBM placement oracle, and
+— when a high class cannot place — runs an **elastic preemption
+market**: shrink the cheapest elastic victim via the PR 7 mesh-reshape
+path instead of killing it, record the debt, grow it back when capacity
+frees. Non-elastic victims are checkpoint-preempted and requeued at
+their original class position.
+
+Layering: jax-free (enforced by ``scripts/lint_internal.py``). The
+decision layers (:mod:`~torchx_tpu.fleet.model`,
+:mod:`~torchx_tpu.fleet.queue`, :mod:`~torchx_tpu.fleet.placer`,
+:mod:`~torchx_tpu.fleet.market`) are pure; only
+:class:`~torchx_tpu.fleet.api.FleetScheduler` touches the world, and
+only through the :class:`~torchx_tpu.fleet.api.FleetExecutor` seam the
+daemon implements.
+"""
+
+from torchx_tpu.fleet.api import (
+    FleetExecutor,
+    FleetJob,
+    FleetScheduler,
+    parse_quotas,
+)
+from torchx_tpu.fleet.market import (
+    MarketAction,
+    Preempt,
+    Shrink,
+    Victim,
+    plan_market,
+)
+from torchx_tpu.fleet.model import (
+    DEFAULT_CLASS,
+    PRIORITY_CLASSES,
+    FleetModel,
+    GangRequest,
+    SlicePool,
+    SliceUnit,
+    priority_index,
+)
+from torchx_tpu.fleet.placer import (
+    PlacementDecision,
+    hbm_refusal,
+    plan_placement,
+)
+from torchx_tpu.fleet.queue import (
+    FleetJournal,
+    FleetQueue,
+    QueuedGang,
+    over_quota,
+)
+
+__all__ = [
+    "PRIORITY_CLASSES",
+    "DEFAULT_CLASS",
+    "priority_index",
+    "SliceUnit",
+    "SlicePool",
+    "GangRequest",
+    "FleetModel",
+    "FleetQueue",
+    "QueuedGang",
+    "FleetJournal",
+    "over_quota",
+    "PlacementDecision",
+    "plan_placement",
+    "hbm_refusal",
+    "Victim",
+    "Shrink",
+    "Preempt",
+    "MarketAction",
+    "plan_market",
+    "FleetScheduler",
+    "FleetExecutor",
+    "FleetJob",
+    "parse_quotas",
+]

@@ -1,0 +1,148 @@
+"""Topology-aware gang placement over the modeled fleet.
+
+Placement is all-or-nothing (gang admission): a decision either names one
+free slice per replica or nothing. Preference order:
+
+1. **ICI/DCN locality** — all replicas from ONE pool when any single pool
+   can host the whole gang (replicas in a pool are DCN-adjacent; a pool
+   models one locality domain), lowest slice indices first (contiguity).
+2. **Exact chip fit** — a 4-chip replica lands on a 4-chip slice before a
+   16-chip slice; fragmenting big slices is a last resort.
+
+Before any pool is considered, the PR 10 cost model acts as the
+**placement oracle**: for plan-shaped roles,
+:func:`~torchx_tpu.analyze.explain.deep_preflight` re-runs the static
+HBM fit against *that pool's generation* (``hbm_bytes_per_chip`` from
+``specs/api.py``). A pool whose HBM verdict is an ERROR (TPX701 et al.)
+is refused; a gang every pool refuses is **infeasible** — it is reported
+and dropped instead of waiting forever for capacity that can never fit
+it.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from torchx_tpu.fleet.model import FleetModel, GangRequest, SliceUnit
+from torchx_tpu.specs.api import Role
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PlacementDecision:
+    """The placer's answer for one gang.
+
+    Attributes:
+        units: one free slice per replica when the gang fits NOW
+            (empty = not placeable at current free capacity).
+        infeasible: non-empty when no pool in the fleet can EVER host the
+            gang (oracle refusal or shape mismatch) — the gang should be
+            rejected, not queued.
+        refusals: per-pool oracle refusal messages (diagnostic detail).
+    """
+
+    units: list[SliceUnit] = field(default_factory=list)
+    infeasible: str = ""
+    refusals: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def placed(self) -> bool:
+        """True when :attr:`units` covers the whole gang."""
+        return bool(self.units)
+
+
+def hbm_refusal(
+    role: Role, gang: GangRequest, hbm_bytes: int
+) -> Optional[str]:
+    """The placement oracle for one (role, pool-generation) pair.
+
+    Re-runs deep preflight with the pool's per-chip HBM as the budget and
+    the gang's total chips as the device count. Any ERROR-severity
+    verdict (TPX701 static HBM overflow, TPX703 unresolvable plan) is a
+    refusal; roles that are not plan-shaped pass (nothing to verify —
+    the TPX705 skip is info, not an error)."""
+    from torchx_tpu.analyze.diagnostics import Severity
+    from torchx_tpu.analyze.explain import deep_preflight
+
+    _plan, diags = deep_preflight(
+        role,
+        devices=gang.replicas * gang.chips_per_replica,
+        hbm_bytes=hbm_bytes,
+    )
+    errors = [d for d in diags if d.severity == Severity.ERROR]
+    if not errors:
+        return None
+    worst = errors[0]
+    return f"{worst.code}: {worst.message}"
+
+
+def plan_placement(
+    gang: GangRequest,
+    model: FleetModel,
+    role: Optional[Role] = None,
+) -> PlacementDecision:
+    """Fit one gang onto the fleet's free slices (see module docstring).
+
+    ``role`` enables the HBM oracle; None (synthetic/bench demand, or
+    jobs with no resolvable plan) skips it."""
+    decision = PlacementDecision()
+    # pools whose slice shape can host one replica at all
+    capable = [
+        p for p in model.pools if p.shape.chips >= gang.chips_per_replica
+    ]
+    if not capable:
+        decision.infeasible = (
+            f"no pool has {gang.chips_per_replica}-chip slices"
+            f" (largest: {max(p.shape.chips for p in model.pools)})"
+        )
+        return decision
+    # the oracle prunes pools whose generation cannot hold the plan
+    allowed = []
+    for pool in capable:
+        if role is not None:
+            refusal = hbm_refusal(role, gang, pool.shape.hbm_bytes_per_chip)
+            if refusal is not None:
+                decision.refusals[pool.name] = refusal
+                continue
+        allowed.append(pool)
+    if not allowed:
+        worst = next(iter(decision.refusals.values()))
+        decision.infeasible = (
+            f"every capable pool refused by the placement oracle ({worst})"
+        )
+        return decision
+
+    allowed_names = {p.name for p in allowed}
+    free = [
+        u
+        for u in model.free_units()
+        if u.pool in allowed_names and u.chips >= gang.chips_per_replica
+    ]
+    by_pool: dict[str, list[SliceUnit]] = {}
+    for u in free:
+        by_pool.setdefault(u.pool, []).append(u)
+
+    # 1) a single pool that can host the whole gang: ICI/DCN-contiguous.
+    #    Tightest fit first (least chip waste), then name for stability.
+    whole = [
+        (units[0].chips - gang.chips_per_replica, pool, units)
+        for pool, units in by_pool.items()
+        if len(units) >= gang.replicas
+    ]
+    if whole:
+        _waste, _pool, units = min(whole, key=lambda t: (t[0], t[1]))
+        decision.units = sorted(units, key=lambda u: u.index)[: gang.replicas]
+        return decision
+
+    # 2) spill across pools: exact fits first, then smallest waste, then
+    #    stable pool/index order — still no partial placement.
+    if len(free) >= gang.replicas:
+        ranked = sorted(
+            free,
+            key=lambda u: (u.chips - gang.chips_per_replica, u.pool, u.index),
+        )
+        decision.units = ranked[: gang.replicas]
+    return decision

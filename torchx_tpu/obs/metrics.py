@@ -550,3 +550,54 @@ CONTROL_ACTIVE_JOBS = REGISTRY.gauge(
     "active jobs per control-daemon tenant",
     ("tenant",),
 )
+
+# -- fleet scheduler (torchx_tpu/fleet/) -------------------------------------
+
+#: gangs waiting in the fleet queue, per priority class.
+FLEET_QUEUE_DEPTH = REGISTRY.gauge(
+    "tpx_fleet_queue_depth",
+    "gangs queued in the fleet scheduler per priority class",
+    ("klass",),
+)
+
+#: modeled fleet capacity in chips (series: state="total" / state="free").
+FLEET_CHIPS = REGISTRY.gauge(
+    "tpx_fleet_chips",
+    "modeled fleet capacity in chips, total and currently free",
+    ("state",),
+)
+
+#: chips currently placed per tenant (the quota accounting value).
+FLEET_TENANT_CHIPS = REGISTRY.gauge(
+    "tpx_fleet_tenant_chips",
+    "chips currently placed per fleet tenant",
+    ("tenant",),
+)
+
+#: gang placements executed, per priority class.
+FLEET_PLACEMENTS = REGISTRY.counter(
+    "tpx_fleet_placements_total",
+    "gangs placed by the fleet scheduler",
+    ("klass",),
+)
+
+#: market actions taken: kind="shrink" (elastic mesh-reshape, no kill) or
+#: kind="requeue" (checkpoint-preempt of a non-elastic victim).
+FLEET_PREEMPTIONS = REGISTRY.counter(
+    "tpx_fleet_preemptions_total",
+    "preemption-market actions executed, by kind",
+    ("kind",),
+)
+
+#: shrink debts repaid — gangs grown back to their launch mesh.
+FLEET_GROWBACKS = REGISTRY.counter(
+    "tpx_fleet_growbacks_total",
+    "shrunk gangs grown back to launch size",
+)
+
+#: queue wait from submit (or requeue) to placement, per priority class.
+FLEET_GANG_WAIT_SECONDS = REGISTRY.histogram(
+    "tpx_fleet_gang_wait_seconds",
+    "gang wait time from enqueue to placement in seconds",
+    ("klass",),
+)

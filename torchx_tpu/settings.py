@@ -112,8 +112,13 @@ ENV_TPX_WATCH_INTERVAL = "TPX_WATCH_INTERVAL"
 DEFAULT_WATCH_INTERVAL = 1.0
 
 # Default per-tenant cap on concurrently active (non-terminal) jobs
-# submitted through the control daemon; submits past the cap get HTTP 429.
+# submitted through the control daemon; submits past the cap get HTTP 429
+# (daemon-only mode; with the fleet scheduler enabled submits queue instead).
 DEFAULT_CONTROL_TENANT_CAP = 64
+
+# Seconds a 429'd client should wait before resubmitting (the daemon's
+# Retry-After header and the retry_after_seconds field of the error body).
+CONTROL_RETRY_AFTER_SECONDS = 5
 
 # ---------------------------------------------------------------------------
 # In-job (injected by schedulers into every replica)
@@ -172,6 +177,13 @@ ENV_TPX_RESUME_STEP = "TPX_RESUME_STEP"
 # preemption/hang; trainers honor it over their --mesh flag so a resubmitted
 # attempt comes up on the surviving capacity.
 ENV_TPX_MESH = "TPX_MESH"
+
+# Injected by the fleet scheduler into every replica it places: the fleet
+# job id (stable across shrink/grow reshapes) and the gang's priority
+# class, so in-job tooling and log lines can be joined back to the
+# scheduling decision that produced them.
+ENV_TPX_FLEET_JOB = "TPX_FLEET_JOB"
+ENV_TPX_FLEET_CLASS = "TPX_FLEET_CLASS"
 
 # Preemption drill knob for the LOCAL scheduler only: when a role env sets
 # this to an integer exit code, a replica exiting with that code marks the
