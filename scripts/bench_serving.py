@@ -29,10 +29,21 @@ Two halves:
   :meth:`~torchx_tpu.serve.kv_pool.PoolPlan.occupancy_report`) as one
   JSON document (see BENCH_SERVE_r01.json).
 
+* shared-prefix serving (``--shared-prefix``): every prompt opens with
+  the same system prompt (+ an exponential long-prompt tail) under the
+  same open-loop Poisson arrivals, replayed against two topologies at
+  equal per-engine HBM: TWO unified continuous engines with the prefix
+  cache off (round-robin) vs ONE prefill engine (radix prefix cache on)
+  streaming KV to ONE decode engine. Reports prefix-hit rate, TTFT
+  p50/p99, decode tokens/sec, and cached-block occupancy (see
+  BENCH_SERVE_r02.json).
+
 Usage:
     python scripts/bench_serving.py [--steps 128] [--batches 1,4,8]
     python scripts/bench_serving.py --poisson [--rate 8] [--requests 48] \
         [--max-batch 4] [--out BENCH_SERVE_r01.json]
+    python scripts/bench_serving.py --shared-prefix [--shared-len 48] \
+        [--out BENCH_SERVE_r02.json]
 """
 
 from __future__ import annotations
@@ -299,6 +310,277 @@ def make_workload(
     return trace
 
 
+def make_shared_prefix_workload(
+    *,
+    num_requests: int,
+    rate_rps: float,
+    max_new: int,
+    shared_len: int,
+    mean_tail: int,
+    max_tail: int,
+    seed: int,
+    vocab: int,
+) -> list[dict]:
+    """Deterministic shared-prefix trace: every prompt opens with the SAME
+    ``shared_len``-token system prompt, followed by a per-request tail
+    whose length is exponentially distributed (a long-prompt tail) —
+    the workload shape that motivates prefix caching. Arrivals are the
+    same seeded Poisson process :func:`make_workload` uses."""
+    rng = random.Random(seed)
+    shared = [rng.randrange(1, vocab) for _ in range(shared_len)]
+    trace = []
+    t = 0.0
+    for i in range(num_requests):
+        t += rng.expovariate(rate_rps)
+        tail_len = min(max_tail, 1 + int(rng.expovariate(1.0 / mean_tail)))
+        trace.append(
+            {
+                "arrival_s": t,
+                "prompt": shared
+                + [rng.randrange(1, vocab) for _ in range(tail_len)],
+                "max_new": max_new,
+                "seed": seed * 1000 + i,
+            }
+        )
+    return trace
+
+
+def bench_shared_prefix(
+    cfg_name: str,
+    mode: str,
+    trace: list[dict],
+    *,
+    max_batch: int,
+    slo_ttft_ms: float,
+    block_size: int = 16,
+    num_blocks: int | None = None,
+    temperature: float = 0.7,
+) -> dict:
+    """Replay one shared-prefix trace against one serving topology at a
+    fixed per-engine HBM budget (same ``max_batch`` / ``num_blocks``):
+
+    * ``unified``: TWO unified continuous engines, prefix cache OFF,
+      round-robin — the pre-disaggregation baseline at equal chip count;
+    * ``disagg``: ONE prefill engine (radix prefix cache ON) streaming
+      KV to ONE decode engine over an in-process transfer — same two
+      chips, split by phase.
+
+    -> scorecard: decode tokens/sec, TTFT p50/p99, prefix-hit rate, and
+    cached-block occupancy."""
+    from torchx_tpu.apps.generate_server import GenerateService
+    from torchx_tpu.serve.kv_transfer import LocalTransfer
+
+    services: list[GenerateService] = []
+    try:
+        if mode == "unified":
+            services = [
+                GenerateService(
+                    cfg_name,
+                    engine="continuous",
+                    max_batch=max_batch,
+                    block_size=block_size,
+                    num_blocks=num_blocks,
+                    enable_prefix_cache=False,
+                )
+                for _ in range(2)
+            ]
+
+            def submit(i: int, req: dict):
+                return services[i % 2].generate_timed(
+                    [req["prompt"]],
+                    req["max_new"],
+                    temperature=temperature,
+                    seed=req["seed"],
+                )
+
+            cache_engine = None
+        elif mode == "disagg":
+            dec = GenerateService(
+                cfg_name,
+                engine="continuous",
+                serve_role="decode",
+                max_batch=max_batch,
+                block_size=block_size,
+                num_blocks=num_blocks,
+            )
+            pre = GenerateService(
+                cfg_name,
+                engine="continuous",
+                serve_role="prefill",
+                kv_transfer="local",
+                max_batch=max_batch,
+                block_size=block_size,
+                num_blocks=num_blocks,
+            )
+            pre._transfer = LocalTransfer({"decode": dec.handle_kv_payload})
+            services = [pre, dec]
+
+            def submit(i: int, req: dict):
+                return pre.generate_timed(
+                    [req["prompt"]],
+                    req["max_new"],
+                    temperature=temperature,
+                    seed=req["seed"],
+                )
+
+            cache_engine = pre._engine
+        else:
+            raise ValueError(f"unknown mode {mode!r}")
+
+        # warm every observed prompt length twice outside the timed
+        # window: the first pass compiles the cold bucket (and seeds the
+        # shared prefix into the cache where enabled), the second
+        # compiles the cached-suffix bucket the steady state runs in
+        for plen in sorted({len(r["prompt"]) for r in trace}):
+            warm = trace[0]["prompt"][:plen]
+            for _ in range(2):
+                for i in range(len(services) if mode == "unified" else 1):
+                    submit(i, {
+                        "prompt": warm,
+                        "max_new": trace[0]["max_new"],
+                        "seed": 0,
+                    })
+        hits0 = misses0 = 0
+        if cache_engine is not None:
+            st0 = cache_engine.stats()["prefix_cache"]
+            hits0, misses0 = st0["hits"], st0["misses"]
+
+        results: list[dict] = [None] * len(trace)  # type: ignore[list-item]
+
+        def one(i: int, req: dict) -> None:
+            try:
+                seqs, timing = submit(i, req)
+                results[i] = {
+                    "ok": True,
+                    "generated": len(seqs[0]) - len(req["prompt"]),
+                    "done_at": time.monotonic(),
+                    **timing,
+                }
+            except Exception as e:  # noqa: BLE001 - scored as a miss
+                results[i] = {"ok": False, "error": str(e)[:200]}
+
+        t0 = time.monotonic()
+        workers = []
+        for i, req in enumerate(trace):
+            delay = t0 + req["arrival_s"] - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=one, args=(i, req), daemon=True)
+            th.start()
+            workers.append(th)
+        for th in workers:
+            th.join(timeout=600)
+        done = [r for r in results if r and r.get("ok")]
+        failed = len(trace) - len(done)
+        if not done:
+            raise RuntimeError(f"all {len(trace)} requests failed")
+        duration = max(r["done_at"] for r in done) - t0
+        total_tokens = sum(r["generated"] for r in done)
+        ttfts = sorted(r["ttft_ms"] for r in done)
+        good = sum(1 for r in done if r["ttft_ms"] <= slo_ttft_ms)
+        out = {
+            "mode": mode,
+            "requests": len(trace),
+            "failed": failed,
+            "duration_s": round(duration, 2),
+            "decode_tokens_per_sec": round(total_tokens / duration, 1),
+            "ttft_ms": {
+                "p50": round(_percentile(ttfts, 0.50), 1),
+                "p99": round(_percentile(ttfts, 0.99), 1),
+            },
+            "goodput": round(good / len(trace), 3),
+            "slo_ttft_ms": slo_ttft_ms,
+        }
+        if cache_engine is not None:
+            st = cache_engine.stats()
+            pc = st["prefix_cache"]
+            hits, misses = pc["hits"] - hits0, pc["misses"] - misses0
+            out["prefix_cache"] = {
+                "hit_rate": round(hits / max(1, hits + misses), 3),
+                "hits": hits,
+                "misses": misses,
+                "token_hit_rate": pc["token_hit_rate"],
+                "cached_blocks": pc["cached_blocks"],
+                "cached_block_occupancy": round(
+                    pc["cached_blocks"]
+                    / max(1, st["kv_blocks_used"] + st["kv_blocks_free"]),
+                    3,
+                ),
+                "evictions": pc["evictions"],
+            }
+        return out
+    finally:
+        for s in services:
+            s.close()
+
+
+def run_shared_prefix_comparison(args) -> dict:
+    """Unified (2 engines, no cache) vs disaggregated+cache (prefill +
+    decode) on one shared-prefix trace at equal per-engine HBM — the
+    --shared-prefix mode, one JSON document (BENCH_SERVE_r02.json)."""
+    from torchx_tpu.models import llama
+    from torchx_tpu.serve.kv_pool import plan_pool
+
+    platform = jax.devices()[0].platform
+    cfg_name = args.config if platform == "tpu" else "tiny"
+    cfg = llama.CONFIGS[cfg_name]()
+    max_new = min(args.steps, cfg.max_seq // 8)
+    shared_len = min(args.shared_len, cfg.max_seq // 2)
+    max_tail = max(4, cfg.max_seq - shared_len - max_new - 1)
+    trace = make_shared_prefix_workload(
+        num_requests=args.requests,
+        rate_rps=args.rate,
+        max_new=max_new,
+        shared_len=shared_len,
+        mean_tail=min(12, max_tail),
+        max_tail=max_tail,
+        seed=args.seed,
+        vocab=cfg.vocab_size,
+    )
+    doc = {
+        "bench": "shared-prefix serving: unified vs disaggregated+cache"
+        " at equal per-engine HBM (2 engines each)",
+        "config": cfg_name,
+        "platform": platform,
+        "workload": {
+            "requests": args.requests,
+            "rate_rps": args.rate,
+            "max_new_tokens": max_new,
+            "shared_prefix_len": shared_len,
+            "prompt_lens": sorted({len(r["prompt"]) for r in trace}),
+            "seed": args.seed,
+            "max_batch": args.max_batch,
+        },
+        "modes": {},
+    }
+    for mode in ("unified", "disagg"):
+        doc["modes"][mode] = bench_shared_prefix(
+            cfg_name,
+            mode,
+            trace,
+            max_batch=args.max_batch,
+            slo_ttft_ms=args.slo_ttft_ms,
+        )
+        print(json.dumps(doc["modes"][mode]))
+    uni, dis = doc["modes"]["unified"], doc["modes"]["disagg"]
+    doc["comparison"] = {
+        "p99_ttft_reduction": round(
+            1 - dis["ttft_ms"]["p99"] / uni["ttft_ms"]["p99"], 3
+        ),
+        "decode_tokens_per_sec_ratio": round(
+            dis["decode_tokens_per_sec"] / uni["decode_tokens_per_sec"], 2
+        ),
+        "prefix_hit_rate": dis["prefix_cache"]["hit_rate"],
+        "goodput_delta": round(dis["goodput"] - uni["goodput"], 3),
+    }
+    # paged-vs-dense at the target config (the HBM half of the story),
+    # same as the r01 report, plus what the cache held at steady state
+    plan_cfg = llama.CONFIGS[args.config]()
+    doc["kv_pool_occupancy"] = plan_pool(plan_cfg).occupancy_report()
+    print(json.dumps(doc["comparison"]))
+    return doc
+
+
 def bench_poisson(
     cfg_name: str,
     engine: str,
@@ -479,6 +761,20 @@ def main() -> None:
         help="open-loop Poisson comparison: continuous engine vs"
         " coalescing baseline at equal --max-batch",
     )
+    ap.add_argument(
+        "--shared-prefix",
+        action="store_true",
+        help="shared-prefix comparison: unified continuous engines vs"
+        " disaggregated prefill/decode with the radix prefix cache, at"
+        " equal per-engine HBM",
+    )
+    ap.add_argument(
+        "--shared-len",
+        type=int,
+        default=48,
+        help="length of the common system prompt in the shared-prefix"
+        " workload (tokens)",
+    )
     ap.add_argument("--rate", type=float, default=8.0, help="arrivals/sec")
     ap.add_argument("--requests", type=int, default=48)
     ap.add_argument("--max-batch", type=int, default=4)
@@ -487,8 +783,12 @@ def main() -> None:
     ap.add_argument("--out", default=None, help="write the comparison JSON here")
     args = ap.parse_args()
 
-    if args.poisson:
-        doc = run_poisson_comparison(args)
+    if args.poisson or args.shared_prefix:
+        doc = (
+            run_shared_prefix_comparison(args)
+            if args.shared_prefix
+            else run_poisson_comparison(args)
+        )
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(doc, f, indent=2)

@@ -288,12 +288,14 @@ then echo "CONTROL_SMOKE=ok"; else echo "CONTROL_SMOKE=FAILED"; rc=1; fi
 rm -rf "$ctl_dir"
 
 # Serving smoke: boot generate_server on the tiny config (CPU, continuous
-# engine, ephemeral port), answer /healthz, decode one /v1/generate, and
-# assert the continuous-batching occupancy gauge is exported on /metricz.
+# engine, ephemeral port), answer /healthz, decode one /v1/generate, assert
+# the continuous-batching occupancy gauge is exported on /metricz, repeat
+# the same prompt and assert it hit the radix prefix cache, and check the
+# serve-pool CLI's disaggregation flags stay jax-free.
 serve_dir=$(mktemp -d /tmp/tpx_serve_smoke.XXXXXX)
 if timeout -k 10 300 env JAX_PLATFORMS=cpu TPX_OBS_DIR="$serve_dir" \
     python - <<'EOF'
-import json, threading, urllib.request
+import json, subprocess, sys, threading, urllib.request
 from torchx_tpu.apps.generate_server import serve
 
 ready = threading.Event()
@@ -306,6 +308,7 @@ try:
         health = json.loads(r.read())
     assert health["status"] == "ok" and health["engine"] == "continuous", health
     assert "occupancy" in health and "queue_depth" in health, health
+    assert health["serve_role"] == "unified", health
     req = urllib.request.Request(
         f"{base}/v1/generate",
         data=json.dumps({"tokens": [[1, 2, 3]], "max_new_tokens": 4}).encode(),
@@ -315,13 +318,51 @@ try:
         body = json.loads(r.read())
     (seq,) = body["tokens"]
     assert seq[:3] == [1, 2, 3] and len(seq) == 7, body
+    # repeated prompt long enough to span a full cache block (> block_size
+    # tokens at the default block_size=16): the second pass must hit the
+    # radix prefix cache and both must decode identical tokens
+    prompt = list(range(1, 21))
+    req = urllib.request.Request(
+        f"{base}/v1/generate",
+        data=json.dumps({"tokens": [prompt], "max_new_tokens": 4}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    outs = []
+    for _ in range(2):
+        with urllib.request.urlopen(req, timeout=120) as r:
+            outs.append(json.loads(r.read())["tokens"][0])
+    assert outs[0] == outs[1], outs
+    with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+        health = json.loads(r.read())
+    assert health["prefix_summary"], health
     with urllib.request.urlopen(f"{base}/metricz", timeout=10) as r:
         metrics = r.read().decode()
     assert "tpx_serve_slot_occupancy" in metrics, metrics[:2000]
     assert "tpx_serve_tokens_total" in metrics, metrics[:2000]
+    hits = [
+        line for line in metrics.splitlines()
+        if line.startswith("tpx_serve_prefix_hits_total")
+    ]
+    assert hits and float(hits[0].split()[-1]) > 0, metrics[:2000]
 finally:
     server.shutdown()
     server.service.close()
+
+# the disaggregation flags ride the help fast path: `tpx serve-pool
+# --help` must show them without importing jax
+r = subprocess.run(
+    [sys.executable, "-c", (
+        "import sys\n"
+        "from torchx_tpu.cli.main import main\n"
+        "try: main(['serve-pool', '--help'])\n"
+        "except SystemExit: pass\n"
+        "assert 'jax' not in sys.modules, 'tpx serve-pool --help imported jax'\n"
+    )],
+    capture_output=True, text=True, timeout=60,
+)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+for flag in ("--disaggregate", "--kv-transfer", "--prefix-cache-reserve"):
+    assert flag in r.stdout, (flag, r.stdout)
 EOF
 then echo "SERVE_SMOKE=ok"; else echo "SERVE_SMOKE=FAILED"; rc=1; fi
 rm -rf "$serve_dir"

@@ -404,6 +404,49 @@ class TestPortAndMountRules:
         )
         assert report.diagnostics == []
 
+    def test_disagg_role_without_transfer_path_errors(self):
+        report = analyze(app_with(args=["--serve-role", "prefill"]))
+        assert codes(report) == ["TPX213"]
+        (d,) = report.diagnostics
+        assert d.severity == Severity.ERROR
+        assert "--kv-transfer" in d.hint
+
+    def test_disagg_decode_equals_form_detected(self):
+        report = analyze(app_with(args=["--serve-role=decode"]))
+        assert codes(report) == ["TPX213"]
+
+    def test_disagg_role_with_transfer_arg_is_silent(self):
+        report = analyze(
+            app_with(
+                args=[
+                    "--serve-role",
+                    "prefill",
+                    "--kv-transfer",
+                    "http:http://127.0.0.1:8100",
+                ]
+            )
+        )
+        assert report.diagnostics == []
+
+    def test_disagg_role_with_metadata_is_silent(self):
+        report = analyze(
+            app_with(
+                args=["--serve-role", "decode"],
+                metadata={"tpx/kv_transfer": "file:/var/spool/tpx-kv"},
+            )
+        )
+        assert report.diagnostics == []
+
+    def test_unified_serve_role_is_silent(self):
+        report = analyze(app_with(args=["--serve-role", "unified"]))
+        assert report.diagnostics == []
+
+    def test_disagg_component_wires_both_roles_clean(self):
+        from torchx_tpu.components.serve import generate_server_disagg
+
+        report = analyze(generate_server_disagg("llama3_1b"))
+        assert "TPX213" not in codes(report)
+
     def test_duplicate_mount_dst(self):
         report = analyze(
             app_with(

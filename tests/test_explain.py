@@ -274,6 +274,40 @@ def test_hbm_fit_serve_kv_pool():
     assert fit.components["kv_pool"] == dense * m.dtype_bytes // 8  # /tp
 
 
+def test_plan_parses_serve_role_and_prefix_reserve():
+    app = spmd_app(
+        "--config",
+        "tiny",
+        "--serve-role",
+        "prefill",
+        "--prefix-cache-reserve",
+        "0.25",
+    )
+    plan = plan_of(app)
+    assert plan.serve_role == "prefill" and plan.prefix_reserve == 0.25
+    d = plan.to_dict()
+    assert d["serve_role"] == "prefill" and d["prefix_reserve"] == 0.25
+    # defaults: unified, no reserve
+    default = plan_of(spmd_app("--config", "tiny"))
+    assert default.serve_role == "unified" and default.prefix_reserve == 0.0
+
+
+def test_hbm_fit_charges_prefix_cache_reserve():
+    base = dataclasses.replace(
+        plan_of(spmd_app("--config", "tiny", "--mesh", "fsdp=1,tp=-1")),
+        serve=True,
+        max_batch=4,
+    )
+    reserved = dataclasses.replace(base, prefix_reserve=0.25)
+    fit0, fit1 = hbm_fit(base), hbm_fit(reserved)
+    assert "prefix_cache" not in fit0.components
+    # the reserve holds cached prefixes ON TOP of the live-sequence pool
+    assert fit1.components["prefix_cache"] == -(
+        -fit1.components["kv_pool"] // 4
+    )
+    assert fit1.total_bytes == fit0.total_bytes + fit1.components["prefix_cache"]
+
+
 def test_collective_traffic_axes_and_network():
     plan = plan_of(
         spmd_app("--config", "moe_tiny", "--mesh", "ep=2,fsdp=4", j="1x8")

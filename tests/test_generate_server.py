@@ -94,6 +94,31 @@ class TestGenerateServer:
         assert role.port_map == {"http": 9000}
         assert role.resource.tpu is not None
 
+    def test_disagg_component_materializes(self):
+        from torchx_tpu.components.serve import generate_server_disagg
+        from torchx_tpu.serve.kv_transfer import ROLE_METADATA_KEY
+
+        app = generate_server_disagg(
+            "llama3_1b", prefill_replicas=2, decode_replicas=2
+        )
+        pre, dec = app.roles
+        assert pre.name == "prefill" and dec.name == "decode"
+        assert pre.num_replicas == 2 and dec.num_replicas == 2
+        i = list(pre.args).index("--serve-role")
+        assert pre.args[i + 1] == "prefill"
+        # default transfer spec spans the decode gang's port range and is
+        # mirrored into both roles' metadata for the TPX213 submit rule
+        spec = pre.metadata[ROLE_METADATA_KEY]
+        assert spec == "http:http://127.0.0.1:8100,http://127.0.0.1:8101"
+        assert dec.metadata[ROLE_METADATA_KEY] == spec
+        assert spec in pre.args and spec in dec.args
+
+    def test_disagg_component_rejects_bad_transfer_spec(self):
+        from torchx_tpu.components.serve import generate_server_disagg
+
+        with pytest.raises(ValueError, match="kv-transfer"):
+            generate_server_disagg("llama3_1b", kv_transfer="smoke-signal:x")
+
 
 class TestBatcher:
     """Cross-request coalescing: concurrent compatible requests merge into
@@ -422,3 +447,93 @@ class TestDrain:
                 svc.generate([[1]], max_new_tokens=1)
         finally:
             svc.close()
+
+
+class TestDisaggHttp:
+    """Prefill/decode split over real HTTP: the prefill service streams
+    KV payloads to the decode replica's /v1/kv and returns the full
+    sequence to the client, matching the unified engine exactly."""
+
+    @pytest.fixture(scope="class")
+    def decode_url(self):
+        srv = serve("tiny", port=0, engine="continuous", serve_role="decode")
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        yield f"http://127.0.0.1:{srv.server_address[1]}"
+        srv.shutdown()
+        srv.service.close()
+
+    def test_round_trip_matches_unified(self, decode_url):
+        pre = GenerateService(
+            "tiny",
+            engine="continuous",
+            serve_role="prefill",
+            kv_transfer=f"http:{decode_url}",
+        )
+        uni = GenerateService("tiny", engine="continuous")
+        try:
+            prompts = [[1, 2, 3], list(range(4, 21))]
+            for prompt in prompts:
+                split = pre.generate([prompt], max_new_tokens=5)[0]
+                whole = uni.generate([prompt], max_new_tokens=5)[0]
+                assert split == whole, (prompt, split, whole)
+        finally:
+            pre.close()
+            uni.close()
+
+    def test_decode_healthz_publishes_role_and_block_size(self, decode_url):
+        with urllib.request.urlopen(f"{decode_url}/healthz", timeout=30) as r:
+            body = json.loads(r.read())
+        assert body["serve_role"] == "decode"
+        assert body["block_size"] > 0
+        assert "prefix_summary" in body
+
+    def test_kv_endpoint_rejects_garbage(self, decode_url):
+        req = urllib.request.Request(
+            f"{decode_url}/v1/kv",
+            data=b"not an npz payload",
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 400
+
+    def test_unified_role_rejects_kv_handoffs(self, server_url):
+        # a valid payload at a non-decode replica is rejected (503) so
+        # the sender requeues it to a real decode target
+        import numpy as np
+
+        from torchx_tpu.serve.kv_transfer import KvPayload, new_request_id
+
+        payload = KvPayload(
+            request_id=new_request_id(),
+            tokens=[1, 2, 3, 4],
+            generated=[5],
+            cache_len=4,
+            max_new_tokens=4,
+            temperature=0.0,
+            seed=0,
+            eos_id=None,
+            block_size=16,
+            k=np.zeros((2, 1, 16, 2, 32), np.float32),
+            v=np.zeros((2, 1, 16, 2, 32), np.float32),
+        )
+        req = urllib.request.Request(
+            f"{server_url}/v1/kv",
+            data=payload.to_bytes(),
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 503
+
+    def test_role_validation(self):
+        with pytest.raises(ValueError, match="serve role"):
+            GenerateService("tiny", serve_role="sideways")
+        with pytest.raises(ValueError, match="continuous"):
+            GenerateService("tiny", engine="coalesce", serve_role="decode")
+        with pytest.raises(ValueError, match="kv.transfer|transfer"):
+            GenerateService(
+                "tiny", engine="continuous", serve_role="prefill"
+            )

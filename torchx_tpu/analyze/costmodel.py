@@ -133,6 +133,13 @@ def hbm_fit(plan: ParallelPlan, headroom: float = DEFAULT_HEADROOM) -> HbmFit:
             / tp
         )
         comps["decode_state"] = plan.max_batch * m.dim * dtype
+        # a declared prefix-cache reserve holds that fraction of the pool
+        # for cached prefixes ON TOP of the live-sequence budget above —
+        # the fit verdict must see the worst case where both are full
+        if plan.prefix_reserve > 0:
+            comps["prefix_cache"] = math.ceil(
+                plan.prefix_reserve * comps["kv_pool"]
+            )
     else:
         comps["params"] = param_bytes
         comps["optimizer"] = 2 * param_bytes  # AdamW mu+nu in param dtype

@@ -226,6 +226,8 @@ class ParallelPlan:
     ring_attention: bool = False
     serve: bool = False
     max_batch: int = 16  # serve decode slots
+    serve_role: str = "unified"  # disaggregated serving: prefill | decode
+    prefix_reserve: float = 0.0  # prefix-cache block reserve fraction
     devices: int = 1
     slices: int = 1
     chips_per_slice: int = 1
@@ -257,6 +259,8 @@ class ParallelPlan:
             "int8": self.int8,
             "ring_attention": self.ring_attention,
             "serve": self.serve,
+            "serve_role": self.serve_role,
+            "prefix_reserve": self.prefix_reserve,
             "devices": self.devices,
             "slices": self.slices,
             "chips_per_slice": self.chips_per_slice,
@@ -454,6 +458,8 @@ def plan_from_role(
         ring_attention=("--ring-attention" in bare or "--ring-attention" in values),
         serve=serve,
         max_batch=int(values.get("--max-batch", 16)),
+        serve_role=str(values.get("--serve-role", "unified")),
+        prefix_reserve=float(values.get("--prefix-cache-reserve", 0.0)),
         devices=int(n_devices),
         slices=slices,
         chips_per_slice=int(chips_per_slice),

@@ -598,6 +598,53 @@ def check_serve_ports(ctx: RuleContext) -> Iterator[Diagnostic]:
                 )
 
 
+@rule("serve_disagg")
+def check_serve_disagg(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX213: a disaggregated serving role (``--serve-role prefill`` or
+    ``decode``) with no KV transfer path declared — neither a
+    ``--kv-transfer`` arg nor ``tpx/kv_transfer`` role metadata. A
+    prefill gang with nowhere to stream its computed KV blocks (or a
+    decode gang no prefill can reach) is an assembly error: every
+    request would prefill and then fail, so it is an ERROR at submit,
+    before any chip is provisioned."""
+    from torchx_tpu.serve.kv_transfer import ROLE_METADATA_KEY
+
+    def _flag_value(args: list[str], flag: str) -> Optional[str]:
+        for i, a in enumerate(args):
+            if a == flag and i + 1 < len(args):
+                return args[i + 1]
+            if a.startswith(flag + "="):
+                return a.split("=", 1)[1]
+        return None
+
+    for role in ctx.app.roles:
+        args = [str(a) for a in role.args]
+        serve_role = _flag_value(args, "--serve-role")
+        if serve_role not in ("prefill", "decode"):
+            continue
+        if _flag_value(args, "--kv-transfer"):
+            continue
+        if role.metadata.get(ROLE_METADATA_KEY):
+            continue
+        yield Diagnostic(
+            code="TPX213",
+            severity=Severity.ERROR,
+            role=role.name,
+            field="args",
+            message=(
+                f"role declares --serve-role {serve_role} but no KV"
+                f" transfer path (no --kv-transfer arg and no"
+                f" {ROLE_METADATA_KEY!r} metadata)"
+            ),
+            hint=(
+                "declare the prefill->decode path: --kv-transfer"
+                " http:<decode-url>[,...] | file:<dir> | local, or use"
+                " components.serve.generate_server_disagg which wires"
+                " both roles"
+            ),
+        )
+
+
 @rule("mounts")
 def check_mounts(ctx: RuleContext) -> Iterator[Diagnostic]:
     """TPX220-TPX221: duplicate destinations and relative paths in mounts."""

@@ -20,6 +20,17 @@ API (JSON):
                           or {"text": "...", ...} (byte-level codec, the
                               same tokenization datapreproc defaults to)
                           -> {"tokens": [[...]]} / {"text": [...]}
+    POST /v1/kv              (decode role) serialized KvPayload handoff
+                              from a prefill replica -> the decode
+                              completion; 503 while draining so the
+                              sender requeues elsewhere
+
+Disaggregated serving (``--serve-role prefill|decode``): prefill
+replicas take /v1/generate traffic, run the cache-aware chunked prefill
+(shared prompt prefixes hit the radix prefix cache and skip
+recomputation), then stream the computed KV blocks to a decode replica
+over ``--kv-transfer`` and relay its completion. Decode replicas accept
+handoffs on /v1/kv (or a file: spool) and batch pure decode steps.
 
 Two serving engines, selected by ``--engine``:
 
@@ -132,10 +143,28 @@ class GenerateService:
         engine: str = "continuous",
         block_size: int = 16,
         num_blocks: Optional[int] = None,
+        serve_role: str = "unified",
+        kv_transfer: Optional[str] = None,
+        enable_prefix_cache: bool = True,
+        prefix_cache_reserve: float = 0.0,
     ) -> None:
         if engine not in ("continuous", "coalesce"):
             raise ValueError(
                 f"unknown engine {engine!r}; have 'continuous', 'coalesce'"
+            )
+        if serve_role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"unknown serve role {serve_role!r}; have 'unified',"
+                f" 'prefill', 'decode'"
+            )
+        if serve_role != "unified" and engine != "continuous":
+            raise ValueError(
+                f"serve role {serve_role!r} requires the continuous engine"
+                f" (got {engine!r})"
+            )
+        if serve_role == "prefill" and not kv_transfer:
+            raise ValueError(
+                "prefill role needs a --kv-transfer spec (decode targets)"
             )
         from torchx_tpu.examples.train_llama import all_configs
 
@@ -173,10 +202,19 @@ class GenerateService:
         self.batch_window_s = batch_window_ms / 1000.0
         self.max_batch = max_batch
         self.engine_mode = engine
+        self.serve_role = serve_role
         self.draining = False
         self._closed = False
         self._count_lock = threading.Lock()
         self._engine = None
+        # prefill role: KV handoffs in flight to decode replicas — the
+        # disaggregated twin of the engine's _prefilling counter; drain()
+        # must wait these out or a mid-transfer SIGTERM drops the request
+        self._transferring = 0
+        self._transfer_done = threading.Condition()
+        self._transfer = None
+        self._spool_stop: Optional[threading.Event] = None
+        self._spool_thread: Optional[threading.Thread] = None
         if engine == "continuous":
             from torchx_tpu.serve.engine import ServeEngine
 
@@ -186,7 +224,37 @@ class GenerateService:
                 max_slots=max_batch,
                 block_size=block_size,
                 num_blocks=num_blocks,
+                enable_prefix_cache=enable_prefix_cache,
+                prefix_cache_reserve=prefix_cache_reserve,
             ).start()
+            if serve_role == "prefill":
+                from torchx_tpu.serve.kv_transfer import (
+                    TransferConfig,
+                    make_transfer,
+                )
+
+                self._transfer = make_transfer(
+                    TransferConfig.from_spec(kv_transfer)
+                )
+            elif serve_role == "decode" and kv_transfer:
+                # a decode role given a file: spec pumps the spool dir
+                # itself (HTTP decode targets are served by /v1/kv)
+                from torchx_tpu.serve import kv_transfer as kvt
+
+                tcfg = kvt.TransferConfig.from_spec(kv_transfer)
+                if tcfg.mode == "file":
+                    self._spool_stop = threading.Event()
+                    self._spool_thread = threading.Thread(
+                        target=kvt.serve_spool,
+                        args=(
+                            tcfg.endpoints[0],
+                            self.handle_kv_payload,
+                            self._spool_stop,
+                        ),
+                        name="tpx-kv-spool",
+                        daemon=True,
+                    )
+                    self._spool_thread.start()
             return
         self._submit_lock = threading.Lock()  # orders enqueue vs close
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
@@ -200,8 +268,13 @@ class GenerateService:
         drains to completion; work racing close fails fast — never hangs."""
         if self._engine is not None:
             self._closed = True
+            if self._spool_stop is not None:
+                self._spool_stop.set()
             self._engine.drain(timeout=60)
+            self._wait_transfers(timeout=60)
             self._engine.stop()
+            if self._spool_thread is not None:
+                self._spool_thread.join(timeout=5)
             return
         with self._submit_lock:
             # under the same lock generate() enqueues with, so every put
@@ -222,13 +295,56 @@ class GenerateService:
         True when fully drained within ``grace_s``."""
         self.draining = True
         if self._engine is not None:
-            return self._engine.drain(timeout=grace_s)
+            if self._spool_stop is not None:
+                self._spool_stop.set()
+            t0 = time.monotonic()
+            ok = self._engine.drain(timeout=grace_s)
+            # prefill role: engine-drained handoffs may still be streaming
+            # to decode replicas; they count as in-flight until the reply
+            ok = (
+                self._wait_transfers(
+                    timeout=max(0.0, grace_s - (time.monotonic() - t0))
+                )
+                and ok
+            )
+            return ok
         deadline = time.monotonic() + grace_s
         with self._submit_lock:
             self._closed = True
             self._queue.put(None)
         self._batcher.join(timeout=max(0.0, deadline - time.monotonic()))
         return not self._batcher.is_alive()
+
+    def _wait_transfers(self, timeout: float) -> bool:
+        """Block until every in-flight KV handoff has its decode reply
+        (prefill role; trivially True elsewhere)."""
+        deadline = time.monotonic() + timeout
+        with self._transfer_done:
+            while self._transferring > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._transfer_done.wait(remaining)
+        return True
+
+    def handle_kv_payload(self, payload: Any) -> dict:
+        """Decode role: admit one prefilled handoff and decode it out.
+
+        Raises :class:`~torchx_tpu.serve.kv_transfer.TransferRejected`
+        while draining so the prefill side requeues to another decode
+        replica — the drain-race contract."""
+        from torchx_tpu.serve.engine import serve_kv_payload
+        from torchx_tpu.serve.kv_transfer import TransferRejected
+
+        if self.serve_role != "decode":
+            raise TransferRejected(
+                f"replica role is {self.serve_role!r}, not decode"
+            )
+        if self.draining or self._closed:
+            raise TransferRejected("decode replica draining; requeue")
+        with self._count_lock:
+            self.requests += 1
+        return serve_kv_payload(self._engine, payload)
 
     # -- batcher thread ----------------------------------------------------
 
@@ -448,6 +564,10 @@ class GenerateService:
     ) -> tuple[list[list[int]], dict]:
         from torchx_tpu.serve.engine import EngineStopped, ServeRequest
 
+        if self.serve_role == "prefill":
+            return self._generate_disagg(
+                tokens, max_new_tokens, temperature, seed, eos_id
+            )
         reqs = [
             ServeRequest(
                 prompt=list(t),
@@ -479,6 +599,72 @@ class GenerateService:
             "ttft_ms": round(max(r.ttft_s for r in reqs) * 1e3, 2),
         }
         return [r.tokens for r in reqs], timing
+
+    def _generate_disagg(
+        self,
+        tokens: list[list[int]],
+        max_new_tokens: int,
+        temperature: float,
+        seed: int,
+        eos_id: Optional[int],
+    ) -> tuple[list[list[int]], dict]:
+        """Prefill role: run the cache-aware prefill locally, then stream
+        each computed KV payload to a decode replica and relay its
+        completion. TTFT is the locally-sampled first token; the decode
+        gang owns the rest of the latency."""
+        from torchx_tpu.serve.engine import EngineStopped, ServeRequest
+
+        reqs = [
+            ServeRequest(
+                prompt=list(t),
+                max_new_tokens=max_new_tokens,
+                temperature=round(temperature, 3),
+                seed=seed,
+                eos_id=eos_id,
+                prefill_only=True,
+            )
+            for t in tokens
+        ]
+        t0 = time.monotonic()
+        # the handoff window counts as in-flight for drain(): a SIGTERM
+        # between prefill completion and the decode reply must not drop
+        # the request (the disaggregated twin of _prefilling)
+        with self._transfer_done:
+            self._transferring += len(reqs)
+        try:
+            try:
+                for r in reqs:
+                    self._engine.submit(r)
+            except EngineStopped as e:
+                raise ServiceDraining(str(e)) from e
+            outs: list[list[int]] = []
+            ttft = 0.0
+            for r in reqs:
+                r.wait()
+                if r.error is not None:
+                    raise RuntimeError(r.error)
+                ttft = max(ttft, r.ttft_s)
+                if r.handoff is None:  # finished at the first token
+                    outs.append(r.tokens)
+                    continue
+                result = self._transfer.send(r.handoff)
+                # transfer replies carry generated tokens only; restore
+                # the prompt+generated shape the unified path returns
+                outs.append(list(r.prompt) + [int(x) for x in result["tokens"]])
+        finally:
+            with self._transfer_done:
+                self._transferring -= len(reqs)
+                self._transfer_done.notify_all()
+        with self._count_lock:
+            self.batches = self._engine.steps
+            self.batched_sequences += len(reqs)
+        total_ms = round((time.monotonic() - t0) * 1e3, 2)
+        timing = {
+            "queue_ms": round(ttft * 1e3, 2),
+            "total_ms": total_ms,
+            "ttft_ms": round(ttft * 1e3, 2),
+        }
+        return outs, timing
 
     def generate_stream(
         self,
@@ -548,6 +734,7 @@ def _make_handler(service: GenerateService):
                     "status": "draining" if service.draining else "ok",
                     "model": service.name,
                     "engine": service.engine_mode,
+                    "serve_role": service.serve_role,
                     "int8": service.int8,
                     "ckpt_step": service.ckpt_step,
                     "requests": service.requests,
@@ -556,6 +743,9 @@ def _make_handler(service: GenerateService):
                 }
                 if service._engine is not None:
                     body.update(service._engine.stats())
+                    # cache-aware routing inputs: what this replica holds
+                    body["block_size"] = service._engine.block_size
+                    body["prefix_summary"] = service._engine.prefix_summary()
                 # a draining replica must fail its health check so routers
                 # and the serve pool stop sending it traffic
                 self._reply(503 if service.draining else 200, body)
@@ -570,6 +760,23 @@ def _make_handler(service: GenerateService):
                 self.wfile.write(text)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def _handle_kv(self) -> None:
+            """Decode-role KV handoff intake (``HttpTransfer`` sender):
+            octet-stream payload in, decode completion out; 503 while
+            draining so the prefill side requeues elsewhere."""
+            from torchx_tpu.serve.kv_transfer import KvPayload, TransferRejected
+
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = KvPayload.from_bytes(self.rfile.read(n))
+                self._reply(200, service.handle_kv_payload(payload))
+            except TransferRejected as e:
+                self._reply(503, {"error": str(e)})
+            except (KeyError, ValueError, TypeError) as e:
+                self._reply(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 - surface, don't kill the server
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
         def _stream(self, tokens: list[int], req: dict, text_mode: bool) -> None:
             """JSONL streaming response (one line per decoded chunk,
@@ -618,6 +825,9 @@ def _make_handler(service: GenerateService):
                 pass  # client went away mid-stream; nothing to reply to
 
         def do_POST(self) -> None:  # noqa: N802
+            if self.path == "/v1/kv":
+                self._handle_kv()
+                return
             if self.path != "/v1/generate":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
@@ -693,6 +903,10 @@ def serve(
     engine: str = "continuous",
     block_size: int = 16,
     num_blocks: Optional[int] = None,
+    serve_role: str = "unified",
+    kv_transfer: Optional[str] = None,
+    enable_prefix_cache: bool = True,
+    prefix_cache_reserve: float = 0.0,
 ) -> ThreadingHTTPServer:
     service = GenerateService(
         config,
@@ -703,6 +917,10 @@ def serve(
         engine=engine,
         block_size=block_size,
         num_blocks=num_blocks,
+        serve_role=serve_role,
+        kv_transfer=kv_transfer,
+        enable_prefix_cache=enable_prefix_cache,
+        prefix_cache_reserve=prefix_cache_reserve,
     )
     server = ThreadingHTTPServer(("", port), _make_handler(service))
     server.service = service  # for tests / shutdown hooks
@@ -791,6 +1009,32 @@ def main(argv: Optional[list[str]] = None) -> None:
         help="paged KV pool size in blocks (default: sized from max-batch)",
     )
     parser.add_argument(
+        "--serve-role",
+        choices=("unified", "prefill", "decode"),
+        default="unified",
+        help="disaggregated serving role: 'prefill' computes prompt KV and"
+        " streams it out over --kv-transfer, 'decode' accepts handoffs on"
+        " /v1/kv; 'unified' (default) does both in one replica",
+    )
+    parser.add_argument(
+        "--kv-transfer",
+        default=None,
+        help="KV transfer spec: local | file:<dir> |"
+        " http:<url>[,<url>...] (decode replica base URLs)",
+    )
+    parser.add_argument(
+        "--no-prefix-cache",
+        action="store_true",
+        help="disable the radix prefix cache (every prompt prefills cold)",
+    )
+    parser.add_argument(
+        "--prefix-cache-reserve",
+        type=float,
+        default=0.0,
+        help="cap cached prefix blocks at this fraction of the KV pool"
+        " (0 = share the whole pool, evicting under pressure)",
+    )
+    parser.add_argument(
         "--drain-grace-s",
         type=float,
         default=30.0,
@@ -819,6 +1063,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         engine=args.engine,
         block_size=args.block_size,
         num_blocks=args.num_blocks,
+        serve_role=args.serve_role,
+        kv_transfer=args.kv_transfer,
+        enable_prefix_cache=not args.no_prefix_cache,
+        prefix_cache_reserve=args.prefix_cache_reserve,
     )
     _install_drain_handler(server, server.service, args.drain_grace_s)
     # report the BOUND port: with --port 0 the OS picks one, and whatever

@@ -13,6 +13,15 @@ Every scale event is an ordinary ledgered resize — ``tpx trace`` shows
 ``tpx_serve_replicas`` / ``tpx_serve_scale_events_total`` land in the
 metrics sink. Ctrl-C cancels the app; replicas drain via their SIGTERM
 handlers.
+
+``--disaggregate`` splits serving into a prefill gang (cache-aware
+chunked prefill over the radix prefix cache, client-facing) and a
+decode gang (pure decode over KV blocks streamed from prefill via
+``--kv-transfer``), each autoscaled independently::
+
+    tpx serve-pool --config llama3_1b --disaggregate \\
+        --prefill-replicas 1 --decode-replicas 2 \\
+        --decode-base-port 8100 --prefix-cache-reserve 0.25
 """
 
 from __future__ import annotations
@@ -91,25 +100,63 @@ class CmdServePool(SubCommand):
         )
         subparser.add_argument("--max-batch", type=int, default=16)
         subparser.add_argument("--ckpt-dir", default=None)
+        subparser.add_argument(
+            "--disaggregate",
+            action="store_true",
+            help="split serving into a prefill gang (cache-aware chunked"
+            " prefill) and a decode gang (pure decode over transferred KV)"
+            " with independent autoscale policies",
+        )
+        subparser.add_argument(
+            "--prefill-replicas",
+            type=int,
+            default=1,
+            help="initial prefill gang size (disaggregated mode)",
+        )
+        subparser.add_argument(
+            "--decode-replicas",
+            type=int,
+            default=1,
+            help="initial decode gang size (disaggregated mode)",
+        )
+        subparser.add_argument(
+            "--decode-base-port",
+            type=int,
+            default=8100,
+            help="decode replica i serves on decode-base-port + stride * i",
+        )
+        subparser.add_argument(
+            "--kv-transfer",
+            default=None,
+            help="prefill->decode KV transfer spec (local | file:<dir> |"
+            " http:<url>[,...]); default: http over the decode port range",
+        )
+        subparser.add_argument(
+            "--prefix-cache-reserve",
+            type=float,
+            default=0.0,
+            help="cap cached prefix blocks at this fraction of each"
+            " replica's KV pool (0 = share the whole pool)",
+        )
+        subparser.add_argument(
+            "--no-prefix-cache",
+            action="store_true",
+            help="disable the radix prefix cache on replicas",
+        )
 
     def run(self, args: argparse.Namespace) -> None:
         # heavy imports deferred: `tpx --help` must stay jax-free
-        from torchx_tpu.components.serve import generate_server
+        from torchx_tpu.components.serve import (
+            generate_server,
+            generate_server_disagg,
+        )
         from torchx_tpu.serve.pool import (
             AutoscalePolicy,
+            DisaggServePool,
             ServePool,
             serve_router,
         )
 
-        app = generate_server(
-            args.config,
-            port=args.base_port,
-            ckpt_dir=args.ckpt_dir,
-            engine=args.engine,
-            max_batch=args.max_batch,
-            num_replicas=args.replicas,
-            port_stride=args.port_stride,
-        )
         policy = AutoscalePolicy(
             min_replicas=args.min_replicas,
             max_replicas=args.max_replicas,
@@ -121,15 +168,52 @@ class CmdServePool(SubCommand):
             ),
             cooldown_s=args.cooldown_s,
         )
-        with get_runner() as runner:
-            pool = ServePool(
-                runner,
-                app,
-                scheduler=args.scheduler,
-                base_port=args.base_port,
+        if args.disaggregate:
+            app = generate_server_disagg(
+                args.config,
+                prefill_port=args.base_port,
+                decode_port=args.decode_base_port,
+                ckpt_dir=args.ckpt_dir,
+                max_batch=args.max_batch,
+                prefill_replicas=args.prefill_replicas,
+                decode_replicas=args.decode_replicas,
                 port_stride=args.port_stride,
-                policy=policy,
+                kv_transfer=args.kv_transfer,
+                prefix_cache_reserve=args.prefix_cache_reserve,
             )
+        else:
+            app = generate_server(
+                args.config,
+                port=args.base_port,
+                ckpt_dir=args.ckpt_dir,
+                engine=args.engine,
+                max_batch=args.max_batch,
+                num_replicas=args.replicas,
+                port_stride=args.port_stride,
+                prefix_cache=not args.no_prefix_cache,
+                prefix_cache_reserve=args.prefix_cache_reserve,
+            )
+        with get_runner() as runner:
+            if args.disaggregate:
+                pool = DisaggServePool(
+                    runner,
+                    app,
+                    scheduler=args.scheduler,
+                    prefill_base_port=args.base_port,
+                    decode_base_port=args.decode_base_port,
+                    port_stride=args.port_stride,
+                    prefill_policy=policy,
+                    decode_policy=policy,
+                )
+            else:
+                pool = ServePool(
+                    runner,
+                    app,
+                    scheduler=args.scheduler,
+                    base_port=args.base_port,
+                    port_stride=args.port_stride,
+                    policy=policy,
+                )
             handle = pool.start()
             router = serve_router(pool, args.router_port)
             rport = router.server_address[1]

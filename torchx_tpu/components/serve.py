@@ -68,6 +68,8 @@ def generate_server(
     num_blocks: Optional[int] = None,
     num_replicas: int = 1,
     port_stride: int = 0,
+    prefix_cache: bool = True,
+    prefix_cache_reserve: float = 0.0,
 ) -> specs.AppDef:
     """Serve KV-cache generation for a model family over HTTP
     (POST /v1/generate, GET /healthz, GET /metricz) — the TPU-native
@@ -93,6 +95,9 @@ def generate_server(
         num_replicas: server replicas (a serve pool resizes this)
         port_stride: replica i listens on ``port + stride * i`` so a pool's
             co-located replicas get distinct ports
+        prefix_cache: radix prefix cache over the paged pool (continuous)
+        prefix_cache_reserve: cap cached prefix blocks at this fraction of
+            the KV pool (0 = share the whole pool)
     """
     args = [
         "-m",
@@ -118,6 +123,10 @@ def generate_server(
         args += ["--ckpt-dir", ckpt_dir]
     if int8:
         args += ["--int8"]
+    if not prefix_cache:
+        args += ["--no-prefix-cache"]
+    if prefix_cache_reserve > 0:
+        args += ["--prefix-cache-reserve", str(prefix_cache_reserve)]
     resource = specs.resource(cpu=cpu, memMB=memMB, tpu=tpu)
     return specs.AppDef(
         name=f"generate-{config}",
@@ -131,5 +140,126 @@ def generate_server(
                 port_map={"http": port},
                 resource=resource,
             )
+        ],
+    )
+
+
+def generate_server_disagg(
+    config: str,
+    prefill_port: int = 8000,
+    decode_port: int = 8100,
+    ckpt_dir: Optional[str] = None,
+    int8: bool = False,
+    image: str = TORCHX_TPU_IMAGE,
+    tpu: Optional[str] = None,
+    cpu: int = 4,
+    memMB: int = 16384,
+    max_batch: int = 16,
+    block_size: int = 16,
+    num_blocks: Optional[int] = None,
+    prefill_replicas: int = 1,
+    decode_replicas: int = 1,
+    port_stride: int = 1,
+    kv_transfer: Optional[str] = None,
+    prefix_cache_reserve: float = 0.0,
+) -> specs.AppDef:
+    """Disaggregated generation serving: ONE app, two gangs.
+
+    The ``prefill`` role takes client traffic, runs the cache-aware
+    chunked prefill (radix prefix cache over the paged pool), and
+    streams each prompt's computed KV blocks to the ``decode`` role over
+    the declared transfer path; decode replicas accept handoffs on
+    ``/v1/kv`` and batch pure decode steps. Both roles carry the
+    transfer spec in role metadata (``tpx/kv_transfer``) so submit-time
+    analysis (TPX213) can verify the pair is actually wired — a
+    prefill/decode split without a transfer path is an assembly error,
+    caught before any chip is provisioned.
+
+    Args:
+        config: model config name (e.g. ``llama3_1b``)
+        prefill_port: prefill gang's base HTTP port
+        decode_port: decode gang's base HTTP port
+        ckpt_dir: orbax checkpoint directory to restore weights from
+        int8: serve int8 weight-only quantized
+        image: container image
+        tpu: TPU accelerator type; CPU when unset
+        cpu: cpu count for CPU serving
+        memMB: memory for CPU serving
+        max_batch: decode slots per replica
+        block_size: paged KV-cache block size
+        num_blocks: paged KV pool size in blocks (default: from max_batch)
+        prefill_replicas: prefill gang size (its pool resizes this)
+        decode_replicas: decode gang size (its pool resizes this)
+        port_stride: replica i listens on ``port + stride * i``
+        kv_transfer: transfer spec; defaults to ``http:`` over the decode
+            gang's port range at the current ``decode_replicas``
+        prefix_cache_reserve: cap cached prefix blocks at this fraction
+            of the prefill pool (0 = share the whole pool)
+    """
+    if kv_transfer is None:
+        kv_transfer = "http:" + ",".join(
+            f"http://127.0.0.1:{decode_port + port_stride * i}"
+            for i in range(decode_replicas)
+        )
+    # import via the jax-free module so component loading stays light
+    from torchx_tpu.serve.kv_transfer import ROLE_METADATA_KEY, TransferConfig
+
+    spec = TransferConfig.from_spec(kv_transfer).to_spec()  # validate early
+
+    def _role_args(role: str, port: int) -> list[str]:
+        args = [
+            "-m",
+            "torchx_tpu.apps.generate_server",
+            "--config",
+            config,
+            "--port",
+            str(port),
+            "--max-batch",
+            str(max_batch),
+            "--engine",
+            "continuous",
+            "--block-size",
+            str(block_size),
+            "--serve-role",
+            role,
+            "--kv-transfer",
+            spec,
+        ]
+        if role == "prefill" and prefix_cache_reserve > 0:
+            args += ["--prefix-cache-reserve", str(prefix_cache_reserve)]
+        if num_blocks is not None:
+            args += ["--num-blocks", str(num_blocks)]
+        if port_stride:
+            args += ["--port-stride", str(port_stride)]
+        if ckpt_dir:
+            args += ["--ckpt-dir", ckpt_dir]
+        if int8:
+            args += ["--int8"]
+        return args
+
+    resource = specs.resource(cpu=cpu, memMB=memMB, tpu=tpu)
+    return specs.AppDef(
+        name=f"generate-{config}-disagg",
+        roles=[
+            specs.Role(
+                name="prefill",
+                image=image,
+                entrypoint="python",
+                args=_role_args("prefill", prefill_port),
+                num_replicas=prefill_replicas,
+                port_map={"http": prefill_port},
+                resource=resource,
+                metadata={ROLE_METADATA_KEY: spec},
+            ),
+            specs.Role(
+                name="decode",
+                image=image,
+                entrypoint="python",
+                args=_role_args("decode", decode_port),
+                num_replicas=decode_replicas,
+                port_map={"http": decode_port},
+                resource=resource,
+                metadata={ROLE_METADATA_KEY: spec},
+            ),
         ],
     )

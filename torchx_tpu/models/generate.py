@@ -20,7 +20,12 @@ import jax.numpy as jnp
 
 from torchx_tpu.models import llama
 from torchx_tpu.ops.norms import rms_norm
-from torchx_tpu.ops.paged_attention import append_kv, paged_attention
+from torchx_tpu.ops.paged_attention import (
+    append_kv,
+    paged_attention,
+    paged_attention_chunk,
+    scatter_kv_chunk,
+)
 from torchx_tpu.ops.quant import maybe_matmul as mm
 from torchx_tpu.ops.rope import apply_rope, rope_frequencies
 
@@ -463,3 +468,93 @@ def paged_prefill(
     }
     last = logits[jnp.arange(b), true_lens - 1]  # [b, vocab]
     return _sample_rows(last, keys, temps), pools
+
+
+def _rope_chunk(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """:func:`apply_rope` for a chunk of tokens at per-(row, token)
+    positions: ``x`` [b, t, heads, hd], ``cos``/``sin`` [b, t, hd/2] —
+    the same float32 rotation as :func:`_rope_rows`."""
+    dtype = x.dtype
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    return jnp.concatenate((x1 * c - x2 * s, x2 * c + x1 * s), axis=-1).astype(dtype)
+
+
+def _paged_chunk_layer_step(
+    cfg: llama.LlamaConfig,
+    cos: jnp.ndarray,  # [b, t, hd/2] rope rows at each token's position
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,  # [b, t] absolute cache positions
+    valid: jnp.ndarray,  # [b, t] bool — real suffix tokens
+    tables: jnp.ndarray,  # [b, blocks_per_slot] int32
+    x: jnp.ndarray,  # [b, t, d]
+    layer: llama.Params,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    b, t, _ = x.shape
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    attn_in = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = _rope_chunk(mm(attn_in, layer["wq"]).reshape(b, t, h, hd), cos, sin)
+    k = _rope_chunk(mm(attn_in, layer["wk"]).reshape(b, t, kvh, hd), cos, sin)
+    v = mm(attn_in, layer["wv"]).reshape(b, t, kvh, hd)
+    k_pool = scatter_kv_chunk(k_pool, tables, positions, k, valid)
+    v_pool = scatter_kv_chunk(v_pool, tables, positions, v, valid)
+    attn = paged_attention_chunk(q, k_pool, v_pool, tables, positions)
+    x = x + mm(attn.reshape(b, t, h * hd), layer["wo"])
+    mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    down, _aux = llama.ffn(cfg, layer, mlp_in)
+    x = x + down
+    return x, k_pool, v_pool
+
+
+def paged_prefill_chunk(
+    params: llama.Params,
+    tokens: jnp.ndarray,  # [b, t] int32 suffix tokens, right-padded
+    prefix_lens: jnp.ndarray,  # [b] int32 — cached tokens already in the pool
+    suffix_lens: jnp.ndarray,  # [b] int32 — real suffix lengths (>= 1)
+    tables: jnp.ndarray,  # [b, blocks_per_slot] full per-row block tables
+    pools: KVPools,
+    cfg: llama.LlamaConfig,
+    keys: jnp.ndarray,  # [b, 2] per-row PRNG keys for the first token
+    temps: jnp.ndarray,  # [b] f32
+) -> tuple[jnp.ndarray, KVPools]:
+    """Prefill only the *uncached suffix* of each prompt against the pool.
+
+    The prefix-cache fast path: row ``i``'s first ``prefix_lens[i]``
+    tokens already sit in cached blocks referenced by ``tables[i]``; this
+    computes K/V for the suffix chunk, scatters it into the row's freshly
+    allocated blocks, and attends each suffix token causally over cached
+    prefix + chunk through the same block tables. With ``prefix_lens = 0``
+    it is a cold paged prefill, so cached and cold requests run the exact
+    same program — reused prefix blocks hold bit-identical K/V to what
+    the cold path would recompute, keeping decode parity exact.
+
+    ``t`` is the suffix bucket width; samples the first output token from
+    the logits at each row's last real suffix position.
+    -> (first token [b], updated pools).
+    """
+    b, t = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)  # [b, t, d]
+    cos_full, sin_full = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    positions = prefix_lens[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    pos_safe = jnp.clip(positions, 0, cfg.max_seq - 1)
+    cos, sin = cos_full[pos_safe], sin_full[pos_safe]  # [b, t, hd/2]
+    valid = jnp.arange(t)[None, :] < suffix_lens[:, None]
+
+    def scan_step(carry, layer_and_pools):  # noqa: ANN001
+        x = carry
+        layer, k_p, v_p = layer_and_pools
+        x, k_p, v_p = _paged_chunk_layer_step(
+            cfg, cos, sin, positions, valid, tables, x, layer, k_p, v_p
+        )
+        return x, (k_p, v_p)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        scan_step, x, (params["layers"], pools["k"], pools["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)  # [b, t, d]
+    last = x[jnp.arange(b), suffix_lens - 1]  # [b, d]
+    logits = _lm_head_rows(params, last, cfg)
+    return _sample_rows(logits, keys, temps), {"k": k_new, "v": v_new}

@@ -503,6 +503,56 @@ SERVE_SCALE_EVENTS = REGISTRY.counter(
     ("direction",),
 )
 
+#: prefix-cache lookups that matched at least one cached block.
+SERVE_PREFIX_HITS = REGISTRY.counter(
+    "tpx_serve_prefix_hits_total",
+    "prefix-cache lookups that reused cached KV blocks",
+)
+
+#: prefix-cache lookups that matched nothing (cold prefix).
+SERVE_PREFIX_MISSES = REGISTRY.counter(
+    "tpx_serve_prefix_misses_total",
+    "prefix-cache lookups with no cached prefix",
+)
+
+#: prompt tokens served from cached KV blocks instead of re-prefilling.
+SERVE_PREFIX_HIT_TOKENS = REGISTRY.counter(
+    "tpx_serve_prefix_hit_tokens_total",
+    "prompt tokens whose KV came from the prefix cache",
+)
+
+#: KV blocks currently pinned by the prefix cache (refcount held).
+SERVE_PREFIX_CACHED_BLOCKS = REGISTRY.gauge(
+    "tpx_serve_prefix_cached_blocks",
+    "paged KV blocks pinned by the prefix cache",
+)
+
+#: cache-only blocks evicted (LRU) under pool pressure or reserve cap.
+SERVE_PREFIX_EVICTIONS = REGISTRY.counter(
+    "tpx_serve_prefix_evictions_total",
+    "prefix-cache blocks evicted back to the free list",
+)
+
+#: copy-on-write block copies (shared tail block about to be written).
+SERVE_COW_COPIES = REGISTRY.counter(
+    "tpx_serve_cow_copies_total",
+    "shared KV blocks copied before an in-place append",
+)
+
+#: prefill->decode KV handoffs, by outcome ("ok"/"rejected"/"error") —
+#: "rejected" is a draining decode target (the transfer is requeued).
+SERVE_KV_TRANSFERS = REGISTRY.counter(
+    "tpx_serve_kv_transfers_total",
+    "KV block transfers between prefill and decode replicas",
+    ("status",),
+)
+
+#: payload bytes moved prefill->decode (K+V blocks, serialized).
+SERVE_KV_TRANSFER_BYTES = REGISTRY.counter(
+    "tpx_serve_kv_transfer_bytes_total",
+    "bytes of KV blocks streamed from prefill to decode replicas",
+)
+
 # -- fleet control plane (torchx_tpu/control/) ------------------------------
 
 #: state-transition events emitted by scheduler watch streams, by source

@@ -85,6 +85,70 @@ def paged_attention(
     return jnp.einsum("sht,sthd->shd", probs, v)
 
 
+def paged_attention_chunk(
+    q: jnp.ndarray,  # [slots, t, h, hd] — a chunk of query tokens per slot
+    k_pool: jnp.ndarray,  # [num_blocks, bs, kvh, hd]
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,  # [slots, blocks_per_slot] int32
+    positions: jnp.ndarray,  # [slots, t] int32 — absolute position of each query
+) -> jnp.ndarray:
+    """Multi-query-token attention against the paged cache.
+
+    The chunked-prefill generalisation of :func:`paged_attention`: query
+    ``j`` of slot ``i`` sits at absolute position ``positions[i, j]`` and
+    attends causally to every cached position ``s <= positions[i, j]`` —
+    which covers both a previously-cached shared prefix *and* the chunk's
+    own K/V, provided the caller scattered the chunk into the pool first.
+    Padded query rows produce garbage that the caller never samples.
+    Returns ``[slots, t, h, hd]``.
+    """
+    slots, t, h, d = q.shape
+    k = gather_kv(k_pool, tables)  # [slots, S, kvh, hd]
+    v = gather_kv(v_pool, tables)
+    n_rep = h // k.shape[2]
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+    logits = (
+        jnp.einsum("sqhd,skhd->shqk", q, k, preferred_element_type=jnp.float32)
+        * d**-0.5
+    )
+    S = k.shape[1]
+    mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]  # [slots, t, S]
+    logits = jnp.where(mask[:, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("shqk,skhd->sqhd", probs, v)
+
+
+def scatter_kv_chunk(
+    pool: jnp.ndarray,  # [num_blocks, bs, kvh, hd]
+    tables: jnp.ndarray,  # [slots, blocks_per_slot]
+    positions: jnp.ndarray,  # [slots, t] — logical position of each new token
+    new: jnp.ndarray,  # [slots, t, kvh, hd]
+    valid: jnp.ndarray | None = None,  # [slots, t] bool — False: write trash
+) -> jnp.ndarray:
+    """Scatter a chunk of new K (or V) tokens per slot into table positions.
+
+    The multi-token form of :func:`append_kv`, used by suffix prefill:
+    token ``j`` of slot ``i`` lands at ``tables[i, positions[i,j] // bs]``
+    offset ``positions[i,j] % bs``. ``valid`` marks real (non-padding)
+    tokens; invalid ones are redirected to the trash block — their
+    positions can lie past the table (bucket padding), where a clamped
+    gather would otherwise alias a live block.
+    """
+    slots, t = positions.shape
+    bs = pool.shape[1]
+    block_idx = jnp.clip(positions // bs, 0, tables.shape[1] - 1)
+    block_ids = jnp.take_along_axis(tables, block_idx, axis=1)  # [slots, t]
+    if valid is not None:
+        block_ids = jnp.where(valid, block_ids, TRASH_BLOCK)
+    offsets = positions % bs
+    flat_new = new.reshape(slots * t, *new.shape[2:])
+    return pool.at[block_ids.reshape(-1), offsets.reshape(-1)].set(
+        flat_new, mode="drop"
+    )
+
+
 def append_kv(
     pool: jnp.ndarray,  # [num_blocks, bs, kvh, hd]
     tables: jnp.ndarray,  # [slots, blocks_per_slot]

@@ -8,12 +8,29 @@ Three layers, bottom-up:
 * :mod:`torchx_tpu.serve.engine` — the continuous-batching decode engine:
   a fixed slot array XLA compiles once, per-step admission and eviction,
   bucketed prefill interleaved with decode;
+* :mod:`torchx_tpu.serve.prefix_cache` — refcounted radix prefix cache
+  over the pool: shared prompt prefixes resolve to shared physical
+  blocks instead of recomputing (LRU-evicted under pool pressure);
+* :mod:`torchx_tpu.serve.kv_transfer` — the prefill->decode KV-block
+  transfer seam for disaggregated serving (local/HTTP/file transports;
+  the ``TransferConfig`` shape AppDef roles carry);
 * :mod:`torchx_tpu.serve.pool` — the launcher-driven serve pool:
   ``tpx serve-pool`` submits N ``generate_server`` replicas through the
-  Runner, routes requests least-loaded, and autoscales via
-  ``Runner.resize`` on queue-depth/p99 targets.
+  Runner, routes requests least-loaded (with a longest-cached-prefix
+  bonus), and autoscales via ``Runner.resize`` on queue-depth/p99
+  targets — one gang, or disaggregated prefill + decode gangs with
+  independent policies.
 """
 
 from torchx_tpu.serve.kv_pool import BlockAllocator, PoolPlan, plan_pool
+from torchx_tpu.serve.kv_transfer import TransferConfig
+from torchx_tpu.serve.prefix_cache import PrefixCache, prefix_chain
 
-__all__ = ["BlockAllocator", "PoolPlan", "plan_pool"]
+__all__ = [
+    "BlockAllocator",
+    "PoolPlan",
+    "plan_pool",
+    "PrefixCache",
+    "prefix_chain",
+    "TransferConfig",
+]

@@ -22,7 +22,23 @@ This engine keeps a **fixed slot array** decoding continuously:
 * **preemption** under pool pressure: if a mid-decode slot can't get its
   next block, the youngest slot is evicted back to the wait queue (its
   finished tokens kept; decode resumes exactly — sampling keys are a pure
-  function of (seed, position)).
+  function of (seed, position));
+* **prefix reuse**: admission consults the refcounted radix
+  :class:`~torchx_tpu.serve.prefix_cache.PrefixCache` and prefills only
+  the *uncached suffix* of each prompt (width-bucketed on suffix length,
+  via :func:`~torchx_tpu.models.generate.paged_prefill_chunk`); newly
+  computed full blocks are inserted back on prefill and on completion.
+  Cached blocks are shared by refcount — a shared tail block about to be
+  written is copy-on-write copied first, and under pool pressure the
+  engine evicts cache-only blocks before preempting live slots;
+* **disaggregation seams**: a request marked ``prefill_only`` completes
+  at prefill with its KV blocks exported as a
+  :class:`~torchx_tpu.serve.kv_transfer.KvPayload` (the prefill-replica
+  role), and :meth:`ServeEngine.submit_prefilled` admits a transferred
+  payload straight into a decode slot — scattering the received blocks
+  into the pool with no prefill pass (the decode-replica role). A
+  draining engine rejects handoffs with :class:`EngineStopped` so the
+  sender requeues to another decode target.
 
 Requests carry per-sequence temperature, seed, and EOS, so unrelated
 requests share every device step. The engine emits ``serve.*`` spans /
@@ -50,10 +66,17 @@ from torchx_tpu.obs import metrics as obs_metrics
 from torchx_tpu.obs import trace as obs_trace
 from torchx_tpu.ops.paged_attention import TRASH_BLOCK
 from torchx_tpu.serve.kv_pool import BlockAllocator, PoolPlan, SlotTables
+from torchx_tpu.serve.kv_transfer import KvPayload, new_request_id
+from torchx_tpu.serve.prefix_cache import PrefixCache
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["ServeRequest", "ServeEngine", "EngineStopped"]
+__all__ = [
+    "ServeRequest",
+    "ServeEngine",
+    "EngineStopped",
+    "serve_kv_payload",
+]
 
 
 class EngineStopped(RuntimeError):
@@ -76,6 +99,10 @@ class ServeRequest:
     temperature: float = 0.0
     seed: int = 0
     eos_id: Optional[int] = None
+    #: disaggregated mode: complete at prefill and export the computed
+    #: KV blocks as ``handoff`` instead of occupying a decode slot.
+    prefill_only: bool = False
+    handoff: Optional[KvPayload] = None
 
     generated: list[int] = dataclasses.field(default_factory=list)
     error: Optional[str] = None
@@ -115,6 +142,29 @@ class _SlotState:
     admit_seq: int  # admission order; highest = youngest = preemption victim
 
 
+@dataclasses.dataclass
+class _Admit:
+    """One request through admission: its cached prefix + fresh blocks."""
+
+    req: ServeRequest
+    toks: list[int]  # prompt + already-generated (resume) tokens
+    cached_blocks: list[int]  # retained from the prefix cache
+    cached_tokens: int  # block-aligned prefix length served from cache
+    new_blocks: list[int]  # freshly allocated for the suffix
+
+
+@dataclasses.dataclass
+class _Handoff:
+    """A transferred prefill (KV blocks + continuation state) waiting for
+    a decode slot."""
+
+    req: ServeRequest
+    k: np.ndarray  # [L, n_blocks, bs, kvh, hd]
+    v: np.ndarray
+    cache_len: int
+    last_tok: int
+
+
 def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
 
@@ -146,6 +196,8 @@ class ServeEngine:
         block_size: int = 16,
         num_blocks: Optional[int] = None,
         max_prefill_batch: int = 4,
+        enable_prefix_cache: bool = True,
+        prefix_cache_reserve: float = 0.0,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if block_size & (block_size - 1):
@@ -171,9 +223,20 @@ class ServeEngine:
         self.tables = SlotTables(max_slots, self.blocks_per_slot)
         self._slots: list[Optional[_SlotState]] = [None] * max_slots
         self._admit_counter = itertools.count()
+        self.prefix_cache: Optional[PrefixCache] = None
+        if enable_prefix_cache:
+            cap = (
+                max(1, int(prefix_cache_reserve * num_blocks))
+                if prefix_cache_reserve > 0
+                else None
+            )
+            self.prefix_cache = PrefixCache(
+                self.alloc, block_size, max_blocks=cap
+            )
 
         self._lock = threading.Lock()
         self._waiting: deque[ServeRequest] = deque()
+        self._handoffs: deque[_Handoff] = deque()
         self._prefilling = 0  # popped from _waiting, not yet slotted/done
         self._work = threading.Event()
         self._stop = threading.Event()
@@ -250,6 +313,88 @@ class ServeEngine:
         self._work.set()
         return req
 
+    def submit_prefilled(
+        self,
+        req: ServeRequest,
+        k: np.ndarray,
+        v: np.ndarray,
+        cache_len: int,
+        last_tok: int,
+    ) -> ServeRequest:
+        """Admit a sequence whose KV was prefilled on another replica.
+
+        ``k``/``v`` are block-granular ``[L, n, bs, kvh, hd]`` arrays
+        covering ``cache_len`` tokens; decode continues from ``last_tok``
+        with no prefill pass. Raises :class:`EngineStopped` while
+        draining — the transfer sender requeues to another decode
+        target (the disaggregated drain-race contract)."""
+        n_need = math.ceil(cache_len / self.block_size)
+        if k.shape[1] != n_need or v.shape[1] != n_need:
+            raise ValueError(
+                f"payload has {k.shape[1]} blocks; cache_len={cache_len} "
+                f"needs {n_need} at block_size={self.block_size}"
+            )
+        remaining = req.max_new_tokens - len(req.generated)
+        if cache_len + remaining > self._cfg.max_seq:
+            raise ValueError(
+                f"cached tokens + remaining new tokens "
+                f"({cache_len}+{remaining}) exceeds max_seq {self._cfg.max_seq}"
+            )
+        with self._lock:
+            if self._draining or self._stop.is_set():
+                raise EngineStopped("engine is draining; not accepting handoffs")
+            if req.t_enqueue == 0.0:
+                req.t_enqueue = self._clock()
+            self._handoffs.append(_Handoff(req, k, v, cache_len, last_tok))
+        self._work.set()
+        return req
+
+    def _admit_handoffs(self) -> bool:
+        """Place transferred prefills into free slots: scatter the
+        received blocks into the pool, no device prefill needed."""
+        worked = False
+        while True:
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            with self._lock:
+                if not self._handoffs or not free:
+                    return worked
+                h = self._handoffs[0]
+                blocks = self._alloc_pressure(
+                    math.ceil(h.cache_len / self.block_size)
+                )
+                if blocks is None:
+                    return worked  # pool pressure; retry next loop pass
+                self._handoffs.popleft()
+                self._prefilling += 1  # visible to drain() until slotted
+            with obs_trace.span(
+                "serve.kv_import", blocks=len(blocks), cache_len=h.cache_len
+            ):
+                idx = jnp.asarray(np.asarray(blocks, np.int32))
+                self.pools = {
+                    "k": self.pools["k"].at[:, idx].set(
+                        jnp.asarray(h.k, dtype=self.pools["k"].dtype)
+                    ),
+                    "v": self.pools["v"].at[:, idx].set(
+                        jnp.asarray(h.v, dtype=self.pools["v"].dtype)
+                    ),
+                }
+            seq = list(h.req.prompt) + h.req.generated
+            if self.prefix_cache is not None:
+                self.prefix_cache.insert(seq[: h.cache_len], blocks)
+            slot = free[0]
+            self.tables.assign(slot, blocks)
+            self.tables.lengths[slot] = h.cache_len
+            self._slots[slot] = _SlotState(
+                req=h.req,
+                cache_len=h.cache_len,
+                last_tok=h.last_tok,
+                admit_seq=next(self._admit_counter),
+            )
+            with self._lock:
+                self._prefilling -= 1
+            self._update_gauges()
+            worked = True
+
     def generate(
         self,
         prompt: Sequence[int],
@@ -279,11 +424,12 @@ class ServeEngine:
         serve pool's load probe)."""
         with self._lock:
             active = sum(1 for s in self._slots if s is not None)
-            return {
+            out = {
                 "active_slots": active,
                 "max_slots": self.max_slots,
                 "occupancy": active / self.max_slots,
                 "queue_depth": len(self._waiting),
+                "handoffs_pending": len(self._handoffs),
                 "kv_blocks_used": self.alloc.used_blocks,
                 "kv_blocks_free": self.alloc.free_blocks,
                 "requests_done": self.requests_done,
@@ -291,6 +437,16 @@ class ServeEngine:
                 "steps": self.steps,
                 "draining": self._draining,
             }
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
+        return out
+
+    def prefix_summary(self, max_entries: int = 128) -> list[str]:
+        """Digests of this engine's hottest cached prefixes — published
+        on ``/healthz`` for the cache-aware router."""
+        if self.prefix_cache is None:
+            return []
+        return self.prefix_cache.summary(max_entries)
 
     @property
     def queue_depth(self) -> int:
@@ -308,6 +464,7 @@ class ServeEngine:
             with self._lock:
                 empty = (
                     not self._waiting
+                    and not self._handoffs
                     and self._prefilling == 0
                     and all(s is None for s in self._slots)
                 )
@@ -330,7 +487,8 @@ class ServeEngine:
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
-                worked = self._admit()
+                worked = self._admit_handoffs()
+                worked = self._admit() or worked
                 worked = self._decode_once() or worked
             except Exception as e:  # noqa: BLE001 — a step bug must not hang callers
                 logger.exception("serve engine step failed")
@@ -343,7 +501,9 @@ class ServeEngine:
     def _fail_all(self, msg: str) -> None:
         with self._lock:
             pending = list(self._waiting)
+            pending.extend(h.req for h in self._handoffs)
             self._waiting.clear()
+            self._handoffs.clear()
             self._prefilling = 0
         for i, st in enumerate(self._slots):
             if st is not None:
@@ -361,13 +521,24 @@ class ServeEngine:
     def _prefill_fn(self, rows: int, width: int) -> Callable:
         fn = self._prefill_fns.get((rows, width))
         if fn is None:
-            donate = (3,) if jax.default_backend() != "cpu" else ()
+            donate = (4,) if jax.default_backend() != "cpu" else ()
             params_c, cfg_c = self._params, self._cfg
 
-            def _prefill(prompts, true_lens, block_ids, pools, seeds, temps):  # noqa: ANN001
-                keys = _fold_keys(seeds, true_lens - 1)
-                return gen.paged_prefill(
-                    params_c, prompts, true_lens, block_ids, pools, cfg_c, keys, temps
+            def _prefill(tokens, prefix_lens, suffix_lens, tables, pools, seeds, temps):  # noqa: ANN001
+                # sampling key is a function of the *absolute* position of
+                # the last prompt token, so a cache-hit suffix prefill
+                # draws the same first token a cold prefill would
+                keys = _fold_keys(seeds, prefix_lens + suffix_lens - 1)
+                return gen.paged_prefill_chunk(
+                    params_c,
+                    tokens,
+                    prefix_lens,
+                    suffix_lens,
+                    tables,
+                    pools,
+                    cfg_c,
+                    keys,
+                    temps,
                 )
 
             fn = jax.jit(_prefill, donate_argnums=donate)
@@ -380,35 +551,55 @@ class ServeEngine:
             _next_pow2(self._cfg.max_seq),
         )
 
+    def _alloc_pressure(self, n: int) -> Optional[list[int]]:
+        """:meth:`BlockAllocator.alloc` that spills cache-only blocks
+        first: under pool pressure, LRU prefix-cache entries are cheaper
+        to reclaim than preempting a live slot."""
+        blocks = self.alloc.alloc(n)
+        if blocks is None and self.prefix_cache is not None:
+            self.prefix_cache.evict(n - self.alloc.free_blocks)
+            blocks = self.alloc.alloc(n)
+        return blocks
+
     def _admit(self) -> bool:
         free_slots = [i for i, s in enumerate(self._slots) if s is None]
         if not free_slots:
             return False
+        admitted: list[_Admit] = []
         with self._lock:
             if not self._waiting:
                 return False
-            head = self._waiting[0]
-            width = self._bucket_width(len(head.prompt) + len(head.generated))
-            group: list[ServeRequest] = []
+            width: Optional[int] = None
             limit = min(len(free_slots), self.max_prefill_batch)
             for req in list(self._waiting):
-                if len(group) >= limit:
+                if len(admitted) >= limit:
                     break
-                plen = len(req.prompt) + len(req.generated)
-                if self._bucket_width(plen) != width:
+                toks = list(req.prompt) + req.generated
+                cached_blocks: list[int] = []
+                cached_tokens = 0
+                if self.prefix_cache is not None:
+                    # retains the matched blocks on our behalf; never
+                    # covers the last token, so suffix_len >= 1
+                    cached_blocks, cached_tokens = self.prefix_cache.match(toks)
+                suffix_len = len(toks) - cached_tokens
+                w = self._bucket_width(suffix_len)
+                if width is None:
+                    width = w  # head of queue picks this round's bucket
+                if w != width:
+                    if cached_blocks:
+                        self.alloc.release(cached_blocks)
                     continue
-                group.append(req)
-            # blocks to hold each prompt now (+1-token headroom comes
-            # lazily during decode)
-            admitted: list[tuple[ServeRequest, list[int]]] = []
-            for req in group:
-                plen = len(req.prompt) + len(req.generated)
-                blocks = self.alloc.alloc(math.ceil(plen / self.block_size))
-                if blocks is None:
+                need = math.ceil(len(toks) / self.block_size) - len(cached_blocks)
+                new_blocks = self._alloc_pressure(need)
+                if new_blocks is None:
+                    if cached_blocks:
+                        self.alloc.release(cached_blocks)
                     break  # pool pressure: admit what fits, retry later
-                admitted.append((req, blocks))
-            for req, _ in admitted:
-                self._waiting.remove(req)
+                admitted.append(
+                    _Admit(req, toks, cached_blocks, cached_tokens, new_blocks)
+                )
+            for a in admitted:
+                self._waiting.remove(a.req)
             # visible to drain(): popped but not yet in a slot/completed
             self._prefilling += len(admitted)
             obs_metrics.SERVE_QUEUE_DEPTH.set(len(self._waiting))
@@ -416,26 +607,36 @@ class ServeEngine:
             return False
 
         rows = _next_pow2(len(admitted))
-        nb_bucket = width // self.block_size
-        prompts = np.zeros((rows, width), np.int32)
-        true_lens = np.ones((rows,), np.int32)
-        block_ids = np.full((rows, nb_bucket), TRASH_BLOCK, np.int32)
+        tokens = np.zeros((rows, width), np.int32)
+        prefix_lens = np.zeros((rows,), np.int32)
+        suffix_lens = np.ones((rows,), np.int32)
+        tables_rows = np.full((rows, self.blocks_per_slot), TRASH_BLOCK, np.int32)
         seeds = np.zeros((rows,), np.int32)
         temps = np.zeros((rows,), np.float32)
-        for r, (req, blocks) in enumerate(admitted):
-            toks = list(req.prompt) + req.generated
-            prompts[r, : len(toks)] = toks
-            true_lens[r] = len(toks)
-            block_ids[r, : len(blocks)] = blocks
-            seeds[r] = np.int32(np.uint32(req.seed & 0xFFFFFFFF))
-            temps[r] = req.temperature
+        cached_total = 0
+        for r, a in enumerate(admitted):
+            blocks = a.cached_blocks + a.new_blocks
+            sfx = a.toks[a.cached_tokens :]
+            tokens[r, : len(sfx)] = sfx
+            prefix_lens[r] = a.cached_tokens
+            suffix_lens[r] = len(sfx)
+            tables_rows[r, : len(blocks)] = blocks
+            seeds[r] = np.int32(np.uint32(a.req.seed & 0xFFFFFFFF))
+            temps[r] = a.req.temperature
+            cached_total += a.cached_tokens
 
-        with obs_trace.span("serve.prefill", rows=len(admitted), width=width):
+        with obs_trace.span(
+            "serve.prefill",
+            rows=len(admitted),
+            width=width,
+            cached_tokens=cached_total,
+        ):
             fn = self._prefill_fn(rows, width)
             first, self.pools = fn(
-                jnp.asarray(prompts),
-                jnp.asarray(true_lens),
-                jnp.asarray(block_ids),
+                jnp.asarray(tokens),
+                jnp.asarray(prefix_lens),
+                jnp.asarray(suffix_lens),
+                jnp.asarray(tables_rows),
                 self.pools,
                 jnp.asarray(seeds),
                 jnp.asarray(temps),
@@ -443,7 +644,9 @@ class ServeEngine:
             first = np.asarray(first)
 
         now = self._clock()
-        for r, (req, blocks) in enumerate(admitted):
+        for r, a in enumerate(admitted):
+            req = a.req
+            blocks = a.cached_blocks + a.new_blocks
             resumed = bool(req.generated)  # preempted earlier; TTFT already set
             tok = int(first[r])
             req.generated.append(tok)
@@ -452,16 +655,28 @@ class ServeEngine:
                 obs_metrics.SERVE_TTFT_SECONDS.observe(req.ttft_s)
             obs_metrics.SERVE_TOKENS.inc(phase="prefill")
             self.tokens_out += 1
+            # index the freshly computed full blocks while they're valid —
+            # the next same-prefix request prefills only its tail
+            if self.prefix_cache is not None:
+                self.prefix_cache.insert(a.toks, blocks)
+            if req.prefill_only:
+                # a request its first token already finishes never needs
+                # the decode side: no handoff, the caller reads .tokens
+                if not self._finished(req, tok):
+                    req.handoff = self._export_handoff(req, a.toks, blocks)
+                self.alloc.release(blocks)
+                self._complete(req, now)
+                continue
             if self._finished(req, tok):
-                self.alloc.free(blocks)
+                self.alloc.release(blocks)
                 self._complete(req, now)
                 continue
             slot = free_slots.pop(0)
             self.tables.assign(slot, blocks)
-            self.tables.lengths[slot] = true_lens[r]
+            self.tables.lengths[slot] = len(a.toks)
             self._slots[slot] = _SlotState(
                 req=req,
-                cache_len=int(true_lens[r]),
+                cache_len=len(a.toks),
                 last_tok=tok,
                 admit_seq=next(self._admit_counter),
             )
@@ -469,6 +684,26 @@ class ServeEngine:
             self._prefilling -= len(admitted)
         self._update_gauges()
         return True
+
+    def _export_handoff(
+        self, req: ServeRequest, toks: list[int], blocks: list[int]
+    ) -> KvPayload:
+        """Snapshot the prefilled K/V blocks for transfer to a decode
+        replica (the ``prefill_only`` completion path)."""
+        idx = np.asarray(blocks, np.int32)
+        return KvPayload(
+            request_id=new_request_id(),
+            tokens=list(toks),
+            generated=list(req.generated),
+            cache_len=len(toks),
+            max_new_tokens=req.max_new_tokens,
+            temperature=req.temperature,
+            seed=req.seed,
+            eos_id=req.eos_id,
+            block_size=self.block_size,
+            k=np.asarray(self.pools["k"][:, idx]),
+            v=np.asarray(self.pools["v"][:, idx]),
+        )
 
     # -- decode ------------------------------------------------------------
 
@@ -501,19 +736,38 @@ class ServeEngine:
         obs_metrics.SERVE_PREEMPTIONS.inc()
         return True
 
+    def _copy_block(self, src: int, dst: int) -> None:
+        """Device-side copy of one physical block across all layers."""
+        self.pools = {
+            "k": self.pools["k"].at[:, dst].set(self.pools["k"][:, src]),
+            "v": self.pools["v"].at[:, dst].set(self.pools["v"][:, src]),
+        }
+
     def _ensure_capacity(self, slot: int, write_pos: int) -> bool:
-        """Make sure ``slot`` holds a block for ``write_pos``; preempts the
-        youngest slot under pool pressure. False if ``slot`` itself was
-        preempted away."""
+        """Make sure ``slot`` holds a *writable* block for ``write_pos``:
+        grows the table lazily, copy-on-writes a shared tail block
+        (another holder — cache or sibling slot — still reads it), and
+        preempts the youngest slot under pool pressure. False if ``slot``
+        itself was preempted away."""
+        idx = write_pos // self.block_size
         while True:
-            need = write_pos // self.block_size + 1
             have = len(self.tables.blocks_of(slot))
-            if have >= need:
-                return True
-            blocks = self.alloc.alloc(need - have)
-            if blocks is not None:
-                self.tables.assign(slot, blocks)
-                return True
+            if have >= idx + 1:
+                tail = self.tables.blocks_of(slot)[idx]
+                if not self.alloc.is_shared(tail):
+                    return True
+                fresh = self._alloc_pressure(1)
+                if fresh is not None:
+                    self._copy_block(tail, fresh[0])
+                    self.tables.replace_block(slot, idx, fresh[0])
+                    self.alloc.release([tail])
+                    obs_metrics.SERVE_COW_COPIES.inc()
+                    return True
+            else:
+                blocks = self._alloc_pressure(idx + 1 - have)
+                if blocks is not None:
+                    self.tables.assign(slot, blocks)
+                    continue  # re-check the (fresh, unshared) tail
             self._preempt_youngest()
             if self._slots[slot] is None:
                 return False  # preempted ourselves: nothing else to evict
@@ -565,7 +819,14 @@ class ServeEngine:
             obs_metrics.SERVE_TOKENS.inc(phase="decode")
             if self._finished(st.req, tok):
                 self._slots[slot] = None
-                self.alloc.free(self.tables.release(slot))
+                blocks = self.tables.release(slot)
+                if self.prefix_cache is not None:
+                    # index the completed sequence's full blocks (cache
+                    # holds cache_len tokens: everything but the final
+                    # sampled token) before dropping the slot's refs
+                    seq = list(st.req.prompt) + st.req.generated
+                    self.prefix_cache.insert(seq[: st.cache_len], blocks)
+                self.alloc.release(blocks)
                 self._complete(st.req, now)
         self._update_gauges()
         self._steps_since_beat += 1
@@ -584,3 +845,49 @@ class ServeEngine:
         obs_metrics.SERVE_SLOTS_ACTIVE.set(active)
         obs_metrics.SERVE_OCCUPANCY.set(active / self.max_slots)
         obs_metrics.SERVE_KV_BLOCKS_USED.set(self.alloc.used_blocks)
+
+
+def serve_kv_payload(
+    engine: ServeEngine,
+    payload: KvPayload,
+    timeout: Optional[float] = None,
+) -> dict:
+    """Decode-replica handler for one transferred prefill: admit the
+    payload via :meth:`ServeEngine.submit_prefilled`, wait for
+    completion, and return the transport reply. The ``/v1/kv`` endpoint
+    and the file-spool pump both route here; :class:`EngineStopped`
+    (draining) propagates as
+    :class:`~torchx_tpu.serve.kv_transfer.TransferRejected` so the
+    prefill side requeues."""
+    from torchx_tpu.serve.kv_transfer import TransferRejected
+
+    if payload.block_size != engine.block_size:
+        raise ValueError(
+            f"payload block_size {payload.block_size} != engine "
+            f"block_size {engine.block_size}"
+        )
+    req = ServeRequest(
+        prompt=list(payload.tokens),
+        max_new_tokens=payload.max_new_tokens,
+        temperature=payload.temperature,
+        seed=payload.seed,
+        eos_id=payload.eos_id,
+        generated=list(payload.generated),
+    )
+    try:
+        engine.submit_prefilled(
+            req,
+            payload.k,
+            payload.v,
+            payload.cache_len,
+            last_tok=payload.generated[-1],
+        )
+    except EngineStopped as e:
+        raise TransferRejected(str(e)) from e
+    if not req.wait(timeout):
+        raise TimeoutError(
+            f"transferred request {payload.request_id} did not finish"
+        )
+    if req.error:
+        raise RuntimeError(req.error)
+    return {"request_id": payload.request_id, "tokens": req.generated}

@@ -63,7 +63,15 @@ class PoolPlan:
 
     def occupancy_report(self) -> dict:
         """Paged-vs-dense concurrency at the same HBM budget, as a dict
-        (serialised into the serving bench's JSON output)."""
+        (serialised into the serving bench's JSON output).
+
+        ``kv_bytes_gib`` is the actual pool footprint
+        (``num_blocks * block_bytes``); the block grid rarely tiles the
+        budget exactly, so the unusable remainder is reported separately
+        as ``kv_slack_gib`` rather than rounded into equality with
+        ``kv_budget_gib``.
+        """
+        slack = self.kv_budget_bytes - self.kv_bytes
         return {
             "num_blocks": self.num_blocks,
             "block_size": self.block_size,
@@ -71,7 +79,8 @@ class PoolPlan:
             "paged_slots": self.max_slots,
             "dense_slots": self.dense_slots,
             "kv_budget_gib": round(self.kv_budget_bytes / GIB, 3),
-            "kv_bytes_gib": round(self.kv_bytes / GIB, 3),
+            "kv_bytes_gib": round(self.kv_bytes / GIB, 6),
+            "kv_slack_gib": round(slack / GIB, 6),
             "pool_tokens": self.pool_tokens,
         }
 
@@ -141,11 +150,24 @@ def plan_pool(
 
 
 class BlockAllocator:
-    """Free-list allocator over the physical blocks of one KV pool.
+    """Refcounting free-list allocator over the physical blocks of one
+    KV pool.
 
     Allocation is all-or-nothing: :meth:`alloc` returns ``None`` rather
     than a partial grant, so the engine can atomically decide to admit,
     wait, or preempt. Block ``TRASH_BLOCK`` is never handed out.
+
+    Every allocated block carries a reference count (1 on :meth:`alloc`):
+    the prefix cache and any slot sharing a cached prefix each hold one
+    reference via :meth:`retain`, and :meth:`release` (or its legacy
+    alias :meth:`free`) returns the block to the free list only when the
+    count reaches zero. A shared block (refcount > 1) must never be
+    written in place — the engine copy-on-writes the partial tail block
+    through :meth:`is_shared` before appending to it.
+
+    Freeing a block that is already free (double-free) or freeing the
+    trash block raises ``ValueError`` instead of silently corrupting the
+    free list.
     """
 
     def __init__(self, num_blocks: int) -> None:
@@ -155,6 +177,9 @@ class BlockAllocator:
         self._free: deque[int] = deque(
             b for b in range(num_blocks) if b != TRASH_BLOCK
         )
+        # refcount per physical block; 0 == free (trash stays pinned at 0
+        # and is rejected everywhere by the explicit guards)
+        self._refs = np.zeros((num_blocks,), np.int32)
 
     @property
     def free_blocks(self) -> int:
@@ -166,21 +191,72 @@ class BlockAllocator:
         """Blocks currently held by slots (excludes the trash block)."""
         return self.num_blocks - 1 - len(self._free)
 
+    def _check(self, b: int) -> None:
+        if b == TRASH_BLOCK:
+            raise ValueError("trash block is never allocated/retained/freed")
+        if not 0 < b < self.num_blocks:
+            raise ValueError(f"block {b} outside pool of {self.num_blocks}")
+
     def alloc(self, n: int) -> list[int] | None:
-        """Take ``n`` blocks, or ``None`` (and take nothing) if fewer are
-        free."""
+        """Take ``n`` blocks (each with refcount 1), or ``None`` (and take
+        nothing) if fewer are free."""
         if n < 0:
             raise ValueError(f"negative allocation: {n}")
         if n > len(self._free):
             return None
-        return [self._free.popleft() for _ in range(n)]
+        out = [self._free.popleft() for _ in range(n)]
+        self._refs[out] += 1
+        return out
+
+    def refcount(self, block: int) -> int:
+        """Current reference count of ``block`` (0 == free)."""
+        self._check(block)
+        return int(self._refs[block])
+
+    def is_shared(self, block: int) -> bool:
+        """True when more than one holder references ``block`` — writing
+        it in place would corrupt another holder's prefix (COW trigger)."""
+        return self.refcount(block) > 1
+
+    def retain(self, blocks: list[int]) -> None:
+        """Add one reference to each allocated block (prefix sharing)."""
+        for b in blocks:
+            self._check(b)
+            if self._refs[b] == 0:
+                raise ValueError(f"retaining free block {b}")
+        for b in blocks:
+            self._refs[b] += 1
+
+    def release(self, blocks: list[int]) -> list[int]:
+        """Drop one reference per block; blocks reaching refcount 0 go
+        back to the free list. Returns the blocks actually freed.
+
+        Raises ``ValueError`` on the trash block or a block that is
+        already free (double-free) — validated for the whole batch before
+        any count moves, so a raise leaves the allocator unchanged.
+        """
+        for b in blocks:
+            self._check(b)
+        counts: dict[int, int] = {}
+        for b in blocks:
+            counts[b] = counts.get(b, 0) + 1
+            if counts[b] > self._refs[b]:
+                raise ValueError(
+                    f"double-free of block {b} "
+                    f"(refcount {int(self._refs[b])})"
+                )
+        freed: list[int] = []
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                self._free.append(b)
+                freed.append(b)
+        return freed
 
     def free(self, blocks: list[int]) -> None:
-        """Return previously-allocated blocks to the free list."""
-        for b in blocks:
-            if b == TRASH_BLOCK:
-                raise ValueError("freeing the trash block")
-            self._free.append(b)
+        """Drop one reference per block (see :meth:`release`); the
+        historical name for the owner's release path."""
+        self.release(blocks)
 
 
 class SlotTables:
@@ -214,6 +290,17 @@ class SlotTables:
     def blocks_of(self, slot: int) -> list[int]:
         """Physical blocks currently held by ``slot``."""
         return list(self._blocks[slot])
+
+    def replace_block(self, slot: int, index: int, block: int) -> None:
+        """Swap the physical block at table ``index`` — the engine's
+        copy-on-write path after duplicating a shared tail block."""
+        if index >= len(self._blocks[slot]):
+            raise ValueError(
+                f"slot {slot} holds {len(self._blocks[slot])} blocks; "
+                f"cannot replace index {index}"
+            )
+        self._blocks[slot][index] = block
+        self.tables[slot, index] = block
 
     def token_capacity(self, slot: int, block_size: int) -> int:
         """Token capacity of ``slot``'s currently-assigned blocks."""

@@ -15,12 +15,21 @@ Three pieces, smallest surface first:
   streaks) and a post-scale cooldown on an injectable clock, so tests
   drive it deterministically with a fake clock and synthetic load.
 * :class:`LeastLoadedRouter` — client-side routing state: pick the
-  replica with the lowest (in-flight + last probed queue depth), record
-  request latencies for the p99 the autoscaler consumes. The HTTP proxy
-  front-end (:func:`serve_router`) is a thin wrapper over it.
+  replica with the lowest cache-aware score (in-flight + last probed
+  queue depth, minus a longest-cached-prefix bonus computed from the
+  replica's probed prefix summary), record request latencies for the
+  p99 the autoscaler consumes. The HTTP proxy front-end
+  (:func:`serve_router`) is a thin wrapper over it.
 * :class:`ServePool` — mechanism. Owns the app handle, runs the
   probe -> autoscale -> resize loop, exports ``tpx_serve_replicas`` /
   ``tpx_serve_scale_events_total`` and ``serve.pool.*`` spans.
+* :class:`DisaggServePool` — disaggregated mechanism: ONE app whose
+  AppDef carries a prefill role and a decode role, each driven by its
+  own :class:`ServePool` controller (independent
+  :class:`AutoscalePolicy`s, one ``Runner.resize`` per role) over a
+  shared handle; :meth:`DisaggServePool.transfer_config` derives the
+  prefill->decode :class:`~torchx_tpu.serve.kv_transfer.TransferConfig`
+  from the decode gang's current replica URLs.
 """
 
 from __future__ import annotations
@@ -33,10 +42,12 @@ import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Sequence
 
 from torchx_tpu.obs import metrics as obs_metrics
 from torchx_tpu.obs import trace as obs_trace
+from torchx_tpu.serve.kv_transfer import TransferConfig
+from torchx_tpu.serve.prefix_cache import prefix_chain
 
 logger = logging.getLogger(__name__)
 
@@ -46,6 +57,7 @@ __all__ = [
     "ReplicaStatus",
     "LeastLoadedRouter",
     "ServePool",
+    "DisaggServePool",
     "serve_router",
     "http_probe",
 ]
@@ -163,19 +175,28 @@ class Autoscaler:
 
 @dataclasses.dataclass
 class ReplicaStatus:
-    """What one probe observed about one replica."""
+    """What one probe observed about one replica.
+
+    ``prefix_summary`` is the replica engine's published set of cached
+    prefix-chain digests (hex, recency-ordered; see
+    :func:`torchx_tpu.serve.prefix_cache.prefix_chain`) and
+    ``block_size`` the paged-cache granularity those digests were chained
+    at — together they let the router score a prompt's
+    longest-cached-prefix without shipping token ids in probes."""
 
     replica_id: int
     url: str
     healthy: bool
     queue_depth: float = 0.0
+    prefix_summary: tuple[str, ...] = ()
+    block_size: int = 16
 
 
 def http_probe(url: str, timeout: float = 2.0) -> ReplicaStatus:
     """Default probe: GET ``<url>/healthz`` and read the engine's queue
     depth (the continuous engine merges ``queue_depth`` into healthz; a
     draining or unreachable replica probes unhealthy and takes no new
-    traffic)."""
+    traffic) plus its prefix-cache summary for cache-aware routing."""
     rid = -1
     try:
         with urllib.request.urlopen(f"{url}/healthz", timeout=timeout) as r:
@@ -185,28 +206,36 @@ def http_probe(url: str, timeout: float = 2.0) -> ReplicaStatus:
             url=url,
             healthy=body.get("status") == "ok",
             queue_depth=float(body.get("queue_depth", 0.0)),
+            prefix_summary=tuple(body.get("prefix_summary", ())),
+            block_size=int(body.get("block_size", 16) or 16),
         )
     except (urllib.error.URLError, OSError, ValueError, json.JSONDecodeError):
         return ReplicaStatus(replica_id=rid, url=url, healthy=False)
 
 
 class LeastLoadedRouter:
-    """Routing state over the pool's current replica set.
+    """Cache-aware routing state over the pool's current replica set.
 
-    :meth:`pick` returns the healthy replica with the lowest load score
-    (in-flight requests this router has outstanding + the last probed
-    queue depth — the probe sees load from *other* clients, the in-flight
-    count sees our own before the probe catches up). :meth:`record`
-    feeds a bounded latency window from which :meth:`p99_s` serves the
-    autoscaler's SLO signal.
+    :meth:`pick` returns the healthy replica with the lowest score:
+    load (in-flight requests this router has outstanding + the last
+    probed queue depth — the probe sees load from *other* clients, the
+    in-flight count sees our own before the probe catches up) minus
+    ``cache_bonus`` per prompt block the replica already holds in its
+    prefix cache. The match is computed entirely from probe data: the
+    prompt's positional chain digests (:func:`prefix_chain`) intersected
+    against each replica's published summary — the deepest digest both
+    sides share IS the longest cached prefix, because chain digests
+    commit to the whole path. :meth:`record` feeds a bounded latency
+    window from which :meth:`p99_s` serves the autoscaler's SLO signal.
     """
 
-    def __init__(self, window: int = 512) -> None:
+    def __init__(self, window: int = 512, cache_bonus: float = 1.0) -> None:
         self._lock = threading.Lock()
         self._replicas: dict[int, ReplicaStatus] = {}
         self._inflight: dict[int, int] = {}
         self._latencies: list[float] = []
         self._window = window
+        self.cache_bonus = cache_bonus
 
     def update(self, statuses: list[ReplicaStatus]) -> None:
         """Replace the routing table with the latest probe sweep."""
@@ -216,9 +245,28 @@ class LeastLoadedRouter:
                 rid: self._inflight.get(rid, 0) for rid in self._replicas
             }
 
-    def pick(self) -> Optional[ReplicaStatus]:
-        """Least-loaded healthy replica (None when none are healthy);
-        bumps its in-flight count — pair with :meth:`record`."""
+    def prefix_blocks(
+        self, status: ReplicaStatus, tokens: Sequence[int]
+    ) -> int:
+        """How many leading blocks of ``tokens`` replica ``status`` has
+        cached: the deepest chain digest present in its summary."""
+        if not tokens or not status.prefix_summary:
+            return 0
+        chain = prefix_chain(tokens, status.block_size)
+        have = set(status.prefix_summary)
+        matched = 0
+        for depth, digest in enumerate(chain, start=1):
+            if digest in have:
+                matched = depth
+        return matched
+
+    def pick(
+        self, tokens: Optional[Sequence[int]] = None
+    ) -> Optional[ReplicaStatus]:
+        """Best healthy replica for this prompt (None when none are
+        healthy); bumps its in-flight count — pair with :meth:`record`.
+        With ``tokens`` the score subtracts the longest-cached-prefix
+        bonus; without, it degrades to plain least-loaded."""
         with self._lock:
             healthy = [s for s in self._replicas.values() if s.healthy]
             if not healthy:
@@ -226,7 +274,13 @@ class LeastLoadedRouter:
             best = min(
                 healthy,
                 key=lambda s: (
-                    self._inflight.get(s.replica_id, 0) + s.queue_depth,
+                    self._inflight.get(s.replica_id, 0)
+                    + s.queue_depth
+                    - (
+                        self.cache_bonus * self.prefix_blocks(s, tokens)
+                        if tokens is not None
+                        else 0.0
+                    ),
                     s.replica_id,
                 ),
             )
@@ -477,6 +531,146 @@ class ServePool:
 
 
 # =========================================================================
+# Disaggregated pool: prefill gang + decode gang, one app
+# =========================================================================
+
+
+class DisaggServePool:
+    """Two-gang controller for disaggregated serving.
+
+    ONE app (the :func:`torchx_tpu.components.serve.generate_server_disagg`
+    AppDef) carries a prefill role and a decode role; each gets its own
+    :class:`ServePool` controller — independent
+    :class:`AutoscalePolicy`s, separate probe sweeps and routers, one
+    ``Runner.resize`` per role — sharing a single submitted handle, so
+    every scale event on either gang still rides the launcher's ledger.
+
+    The prefill gang is compute-bound (chunked prefill, prefix-cache
+    warm) and scales on queue depth / TTFT p99; the decode gang is
+    HBM-bandwidth-bound and scales on its own occupancy signal. Client
+    traffic routes to the *prefill* gang (cache-aware);
+    :meth:`transfer_config` hands prefill replicas the decode gang's
+    current URLs as an ``http:`` transfer spec for the KV handoff.
+    """
+
+    def __init__(
+        self,
+        runner: Any,
+        app: Any,
+        *,
+        scheduler: str = "local",
+        cfg: Optional[dict] = None,
+        prefill_role: str = "prefill",
+        decode_role: str = "decode",
+        prefill_policy: Optional[AutoscalePolicy] = None,
+        decode_policy: Optional[AutoscalePolicy] = None,
+        prefill_base_port: int = 8000,
+        decode_base_port: int = 8100,
+        port_stride: int = 1,
+        probe: Optional[Callable[[int, str], ReplicaStatus]] = None,
+        router: Optional[LeastLoadedRouter] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        reconciler: Optional[Any] = None,
+    ) -> None:
+        self._runner = runner
+        self._app = app
+        self._scheduler = scheduler
+        self._cfg = cfg or {}
+        self.prefill = ServePool(
+            runner,
+            app,
+            scheduler=scheduler,
+            cfg=cfg,
+            role_name=prefill_role,
+            base_port=prefill_base_port,
+            port_stride=port_stride,
+            policy=prefill_policy or AutoscalePolicy(),
+            probe=probe,
+            router=router or LeastLoadedRouter(),
+            clock=clock,
+            sleep=sleep,
+            reconciler=reconciler,
+        )
+        self.decode = ServePool(
+            runner,
+            app,
+            scheduler=scheduler,
+            cfg=cfg,
+            role_name=decode_role,
+            base_port=decode_base_port,
+            port_stride=port_stride,
+            policy=decode_policy or AutoscalePolicy(),
+            probe=probe,
+            router=LeastLoadedRouter(),
+            clock=clock,
+            sleep=sleep,
+        )
+        self.handle: Optional[str] = None
+
+    # client traffic enters through the prefill gang: serve_router() and
+    # callers treat a DisaggServePool like a ServePool via these two
+    @property
+    def router(self) -> LeastLoadedRouter:
+        """The prefill gang's router — where client traffic enters."""
+        return self.prefill.router
+
+    @property
+    def replicas(self) -> int:
+        """Total replicas across both gangs (the SERVE_REPLICAS gauge)."""
+        return self.prefill.replicas + self.decode.replicas
+
+    def start(self) -> str:
+        """Submit the two-role app ONCE; both controllers share the
+        handle (their resizes address their own role by name)."""
+        self.handle = self.prefill.start()
+        self.decode.handle = self.handle
+        obs_metrics.SERVE_REPLICAS.set(self.replicas)
+        return self.handle
+
+    def stop(self) -> None:
+        """Cancel the shared two-role app (both gangs go down together)."""
+        if self.handle is not None:
+            self._runner.cancel(self.handle)
+
+    def transfer_config(self) -> TransferConfig:
+        """The prefill->decode transfer path as of the current decode
+        gang size — refresh after decode-side scale events."""
+        return TransferConfig(
+            mode="http",
+            endpoints=tuple(
+                self.decode.replica_url(rid)
+                for rid in range(self.decode.replicas)
+            ),
+        )
+
+    def step(self) -> tuple[Optional[int], Optional[int]]:
+        """One control iteration per gang; returns (prefill, decode) new
+        replica counts (None where that gang held)."""
+        out = (self.prefill.step(), self.decode.step())
+        obs_metrics.SERVE_REPLICAS.set(self.replicas)
+        return out
+
+    def run(
+        self,
+        interval_s: float = 10.0,
+        iterations: Optional[int] = None,
+        stop_event: Optional[threading.Event] = None,
+    ) -> None:
+        """Interleaved controller loop over both gangs (same exit
+        conditions as :meth:`ServePool.run`)."""
+        done = 0
+        while iterations is None or done < iterations:
+            if stop_event is not None and stop_event.is_set():
+                return
+            if self.prefill._app_terminal():
+                return
+            self.step()
+            done += 1
+            self.prefill._pause(interval_s)
+
+
+# =========================================================================
 # HTTP router front-end
 # =========================================================================
 
@@ -519,12 +713,26 @@ def _make_router_handler(pool: ServePool) -> type:
             if self.path != "/v1/generate":
                 self._reply(404, {"error": f"no route {self.path}"})
                 return
-            target = router.pick()
+            length = int(self.headers.get("Content-Length", 0))
+            payload = self.rfile.read(length)
+            # best-effort prompt extraction for the cache-aware score: an
+            # unparseable body still routes (least-loaded) and the replica
+            # produces the authoritative 400
+            tokens = None
+            try:
+                req = json.loads(payload or b"{}")
+                if "tokens" in req and req["tokens"]:
+                    tokens = list(req["tokens"][0])
+                elif isinstance(req.get("text"), str):
+                    tokens = list(req["text"].encode("utf-8"))
+                elif isinstance(req.get("text"), list) and req["text"]:
+                    tokens = list(req["text"][0].encode("utf-8"))
+            except (ValueError, TypeError, KeyError, IndexError):
+                tokens = None
+            target = router.pick(tokens)
             if target is None:
                 self._reply(503, {"error": "no healthy replicas"})
                 return
-            length = int(self.headers.get("Content-Length", 0))
-            payload = self.rfile.read(length)
             t0 = time.perf_counter()
             try:
                 req = urllib.request.Request(
