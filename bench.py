@@ -317,6 +317,7 @@ def main() -> None:
     # deep-preflight predictions next to the measured numbers, so the
     # static cost model's error is tracked across bench rounds (the
     # analyzer side of `tpx explain` — jax-free, pure arithmetic)
+    _plan = None
     try:
         from torchx_tpu.analyze import costmodel as _cm
         from torchx_tpu.analyze.plan import MODEL_SHAPES, ParallelPlan
@@ -345,6 +346,72 @@ def main() -> None:
         }
     except Exception as e:  # noqa: BLE001 - predictions must not sink a bench
         print(f"explain predictions failed: {e}", file=sys.stderr)
+    # the closed loop (`tpx tune`): fold THIS bench's prediction-vs-actual
+    # step-time error into the persisted per-generation calibration table
+    # (error strictly shrinks: EMA gain 0.5 halves the residual), then run
+    # the static tune funnel so the JSON carries the prune report + the
+    # winner artifact. Kill switch: TPX_BENCH_TUNE=0.
+    if os.environ.get("TPX_BENCH_TUNE", "1").lower() not in ("0", "false"):
+        _gen = ""
+        try:
+            from torchx_tpu.tune import rank as _rank
+            from torchx_tpu.tune.calibrate import (
+                CalibrationTable,
+                generation_key,
+            )
+
+            _gen = generation_key(
+                getattr(jax.devices()[0], "device_kind", "") if on_tpu else ""
+            )
+            if _plan is not None and "step_time_s" in metrics:
+                _table = CalibrationTable.load_default()
+                # predict with the PRE-update scales: the before/after
+                # errors below then show this run's calibration gain
+                _cost = _rank.predicted_step_cost(
+                    _plan,
+                    generation=_gen,
+                    calibration=_table.scales_for(_gen),
+                )
+                _obs = _table.observe(
+                    _gen,
+                    predicted_step_s=_cost.step_s,
+                    measured_step_s=float(metrics["step_time_s"]),
+                    predicted_collective_s=_cost.collective_s,
+                )
+                _table.save()
+                result["tune_calibration"] = {
+                    "generation": _gen,
+                    "predicted_step_s": round(_cost.step_s, 6),
+                    "measured_step_s": round(
+                        float(metrics["step_time_s"]), 6
+                    ),
+                    "err_before": round(_obs["step_time"]["err_before"], 4),
+                    "err_after": round(_obs["step_time"]["err_after"], 4),
+                    "scales": _obs["scales"],
+                }
+        except Exception as e:  # noqa: BLE001 - best-effort closed loop
+            print(f"tune calibration failed: {e}", file=sys.stderr)
+        try:
+            from torchx_tpu.tune.driver import run_tune
+            from torchx_tpu.tune.space import (
+                bench_1b_space,
+                tiny_smoke_space,
+            )
+
+            _space = bench_1b_space() if on_tpu else tiny_smoke_space()
+            _tuned = run_tune(
+                _space,
+                devices=jax.device_count(),
+                generation=_gen,
+                aot=False,  # bench time budget: static funnel only
+                measure=False,  # the bench run above IS the measurement
+            )
+            result["tune_report"] = _tuned.report
+            result["tune_artifact"] = _tuned.artifact_path
+            if _tuned.winner is not None:
+                result["tune_winner"] = _tuned.winner.candidate.to_dict()
+        except Exception as e:  # noqa: BLE001 - best-effort closed loop
+            print(f"tune report failed: {e}", file=sys.stderr)
     print(json.dumps(result))
 
 

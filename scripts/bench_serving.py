@@ -98,6 +98,17 @@ def _percentile(sorted_vals: list, q: float) -> float:
     return sorted_vals[idx]
 
 
+def _p99_label(sorted_vals: list) -> str:
+    """Honesty label for the nearest-rank p99: below ~100 samples the
+    nearest-rank 99th percentile IS the sample maximum, so say so
+    (``p99~max(n=40)``) instead of implying tail resolution the sample
+    cannot provide. Emitted next to every p99 in the BENCH JSON."""
+    n = len(sorted_vals)
+    if n and round(0.99 * (n - 1)) >= n - 1:
+        return f"p99~max(n={n})"
+    return f"p99(n={n})"
+
+
 def bench_server(
     cfg_name: str, int8: bool, steps: int, clients: int, rounds: int = 5
 ):
@@ -205,6 +216,7 @@ def bench_server(
                 "p99_total": round(_percentile(totals, 0.99), 1),
                 "p50_queue": round(_percentile(queues, 0.50), 1),
                 "p99_queue": round(_percentile(queues, 0.99), 1),
+                "p99_label": _p99_label(totals),
                 "p50_per_token": round(
                     _percentile(totals, 0.50) / steps, 2
                 ),
@@ -269,6 +281,7 @@ def bench_stream_ttft(cfg_name: str, int8: bool, steps: int, samples: int = 8):
             f" {'int8' if int8 else 'bf16'}, batch 1)",
             "p50_ttft_ms": round(_percentile(ttfts, 0.50), 1),
             "p99_ttft_ms": round(_percentile(ttfts, 0.99), 1),
+            "p99_label": _p99_label(ttfts),
             "p50_per_token_ms": round(
                 _percentile(totals, 0.50) / steps, 2
             ),
@@ -487,6 +500,7 @@ def bench_shared_prefix(
             "ttft_ms": {
                 "p50": round(_percentile(ttfts, 0.50), 1),
                 "p99": round(_percentile(ttfts, 0.99), 1),
+                "p99_label": _p99_label(ttfts),
             },
             "goodput": round(good / len(trace), 3),
             "slo_ttft_ms": slo_ttft_ms,
@@ -671,10 +685,12 @@ def bench_poisson(
             "ttft_ms": {
                 "p50": round(_percentile(ttfts, 0.50), 1),
                 "p99": round(_percentile(ttfts, 0.99), 1),
+                "p99_label": _p99_label(ttfts),
             },
             "tpot_ms": {
                 "p50": round(_percentile(tpots, 0.50), 2),
                 "p99": round(_percentile(tpots, 0.99), 2),
+                "p99_label": _p99_label(tpots),
             },
             "goodput": round(good / len(trace), 3),
             "slo_ttft_ms": slo_ttft_ms,

@@ -39,6 +39,7 @@ JAX_FREE = (
     "control",
     "analyze",
     "fleet",
+    "tune",
     os.path.join("parallel", "mesh_config.py"),
 )
 

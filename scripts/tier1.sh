@@ -449,4 +449,65 @@ assert "queue" in r.stdout, r.stdout
 EOF
 then echo "FLEET_SMOKE=ok"; else echo "FLEET_SMOKE=FAILED"; rc=1; fi
 rm -rf "$fleet_dir"
+
+# Tune smoke: `tpx tune` over the tiny builtin space on CPU — static
+# pruning must kill candidates with a journaled TPX7xx verdict at zero
+# device seconds, the winner's plan artifact must be emitted and then
+# ACCEPTED by the submit gate (and a drifted config refused, TPX706),
+# and `tpx tune --help` must stay jax-free.
+tune_dir=$(mktemp -d /tmp/tpx_tune_smoke.XXXXXX)
+if timeout -k 10 300 env JAX_PLATFORMS=cpu TPX_TUNE_DIR="$tune_dir" \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - <<'EOF'
+import json, os, subprocess, sys
+
+tpx = [sys.executable, "-m", "torchx_tpu.cli.main", "tune"]
+r = subprocess.run(
+    tpx + ["--space", "tiny-smoke", "--devices", "8", "--top-k", "1",
+           "--no-aot", "--json"],
+    capture_output=True, text=True, timeout=240,
+)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+doc = json.loads(r.stdout)
+report = doc["report"]
+assert report["pruned_static"] >= 1, report
+assert any(c.startswith("TPX7") for c in report["pruned_by_code"]), report
+assert report["device_seconds_pruning"] == 0.0, report
+assert report["measured"] >= 1, report
+art = doc["artifact"]
+assert art and os.path.exists(art), art
+assert json.load(open(art))["digest"], art
+
+# the emitted artifact pins the submit gate: the tuned config passes...
+from torchx_tpu.analyze import analyze
+from torchx_tpu.components import dist
+
+win = doc["winner"]["candidate"]
+def app_for(batch, policy):
+    return dist.spmd(
+        "--config", win["config"], "--mesh", win["mesh_spec"],
+        "--batch", str(batch), "--seq", str(win["seq"]),
+        "--remat-policy", policy,
+        m="torchx_tpu.examples.train_llama", j="1x8",
+    )
+os.environ["TPX_PLAN_ARTIFACT"] = art
+codes = {d.code for d in analyze(app_for(win["batch"], win["remat_policy"])).diagnostics}
+assert "TPX706" not in codes and "TPX707" not in codes, codes
+# ... and a config that drifted from the tuned plan is refused
+codes = {d.code for d in analyze(app_for(win["batch"] * 2, win["remat_policy"])).diagnostics}
+assert "TPX706" in codes, codes
+
+# the tune verb rides the lazy dispatcher: help never imports jax
+probe = (
+    "import sys\n"
+    "from torchx_tpu.cli.main import main\n"
+    "try: main(['tune', '--help'])\n"
+    "except SystemExit: pass\n"
+    "assert 'jax' not in sys.modules, 'tpx tune --help imported jax'\n"
+)
+r = subprocess.run([sys.executable, "-c", probe], capture_output=True, text=True)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+EOF
+then echo "TUNE_SMOKE=ok"; else echo "TUNE_SMOKE=FAILED"; rc=1; fi
+rm -rf "$tune_dir"
 exit $rc

@@ -105,7 +105,15 @@ class TestOps:
 
     @pytest.mark.parametrize(
         "axes",
-        [dict(dp=2, fsdp=2, tp=1, sp=2), dict(dp=1, fsdp=2, tp=2, sp=2)],
+        [
+            dict(dp=2, fsdp=2, tp=1, sp=2),
+            dict(dp=1, fsdp=2, tp=2, sp=2),
+            # pp > 1: the norm runs inside one stage; the wrap must not
+            # touch the pp axis
+            dict(pp=2, fsdp=2, tp=1, sp=2),
+            # ep > 1: expert axis present but dense layers ignore it
+            dict(fsdp=2, ep=2, tp=2, sp=1),
+        ],
     )
     def test_rms_norm_fused_sharded_mesh(self, axes):
         """The full-manual shard_map wrap: grads (incl. the weight grad,

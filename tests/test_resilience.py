@@ -354,6 +354,27 @@ class TestFailureLedger:
         led.note("k", ok=True)
         assert not path.exists()
 
+    def test_clear_is_an_append_only_tombstone(self, tmp_path):
+        path = tmp_path / "fails"
+        led = FailureLedger(str(path), threshold=1)
+        led.note("k", ok=False)
+        led.note("k", ok=True)
+        # the success appended a tombstone; nothing was rewritten away
+        assert path.read_text() == "k\nk|clear\n"
+        assert led.failures() == {}
+        # a failure landing AFTER the tombstone survives it (the rewrite
+        # implementation could drop such a line racing the replace)
+        led.note("k", ok=False)
+        assert led.failures() == {"k": 1}
+        assert led.tripped() == {"k"}
+
+    def test_tombstone_only_clears_earlier_lines(self, tmp_path):
+        path = tmp_path / "fails"
+        with open(path, "w") as f:
+            f.write("a|b\na|b|clear\na|b\nc|d\n")
+        led = FailureLedger(str(path), threshold=1)
+        assert led.failures() == {"a|b": 1, "c|d": 1}
+
     def test_validation(self):
         with pytest.raises(ValueError):
             FailureLedger("x", threshold=0)

@@ -1,0 +1,566 @@
+"""The ``tpx tune`` autotuner: space enumeration, the prune funnel, the
+resumable journal, calibration persistence, the plan artifact, and the
+submit-gate pin (TPX706/707).
+
+Measured trials use a stub ``measure_cmd`` (a tiny script speaking the
+stdin-spec / ``TUNE_METRICS``-line protocol), so the funnel tests spend
+zero device seconds; the real subprocess entrypoints get their own
+focused tests (``probe_fits``, ``tpx tune --help`` jax-freeness).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from torchx_tpu import settings
+from torchx_tpu.analyze import analyze
+from torchx_tpu.analyze.explain import deep_preflight
+from torchx_tpu.components import dist
+from torchx_tpu.tune.artifact import (
+    ArtifactError,
+    PlanArtifact,
+    load_artifact,
+)
+from torchx_tpu.tune.calibrate import CalibrationTable, generation_key
+from torchx_tpu.tune.driver import (
+    TuneError,
+    _last_json,
+    role_for_candidate,
+    run_tune,
+)
+from torchx_tpu.tune.journal import TuneJournal
+from torchx_tpu.tune.space import (
+    BUILTIN_SPACES,
+    Candidate,
+    SearchSpace,
+    tiny_smoke_space,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_tune_state(tmp_path, monkeypatch):
+    """Every test gets its own tune dir (journal + calibration table)
+    and no inherited artifact pin."""
+    monkeypatch.setenv(settings.ENV_TPX_TUNE_DIR, str(tmp_path / "tunestate"))
+    monkeypatch.delenv(settings.ENV_TPX_PLAN_ARTIFACT, raising=False)
+
+
+def stub_measure(tmp_path) -> tuple[list[str], str]:
+    """A measure_cmd stub: logs each call, optionally fails one policy
+    (``$STUB_FAIL_POLICY``), reports dots as 2x faster than full."""
+    log = str(tmp_path / "stub_calls.log")
+    script = tmp_path / "stub_measure.py"
+    script.write_text(
+        textwrap.dedent(
+            """
+            import json, os, sys
+
+            spec = json.load(sys.stdin)
+            policy = spec["candidate"]["remat_policy"]
+            with open(os.environ["STUB_LOG"], "a") as f:
+                f.write(policy + "\\n")
+            if os.environ.get("STUB_FAIL_POLICY") == policy:
+                sys.exit(1)
+            tok = 200.0 if policy == "dots" else 100.0
+            out = {"step_time_s": 0.5, "tokens_per_sec_per_chip": tok}
+            print("TUNE_METRICS " + json.dumps(out))
+            """
+        )
+    )
+    return [sys.executable, str(script)], log
+
+
+def stub_calls(log: str) -> list[str]:
+    try:
+        with open(log) as f:
+            return f.read().split()
+    except OSError:
+        return []
+
+
+# ---------------------------------------------------------------------------
+# search space
+# ---------------------------------------------------------------------------
+
+
+class TestSearchSpace:
+    def test_enumeration_is_deterministic(self):
+        a, b = tiny_smoke_space(), tiny_smoke_space()
+        assert [c.cid for c in a.candidates()] == [
+            c.cid for c in b.candidates()
+        ]
+        assert a.digest() == b.digest()
+        assert len(a.candidates()) == 4
+
+    def test_digest_tracks_content(self):
+        base = tiny_smoke_space()
+        widened = SearchSpace.from_dict(
+            {**base.to_dict(), "batches": [8, 16]}
+        )
+        assert widened.digest() != base.digest()
+        # a faithful round-trip keeps the digest
+        assert SearchSpace.from_dict(base.to_dict()).digest() == base.digest()
+
+    def test_candidate_roundtrip(self):
+        c = tiny_smoke_space().candidates()[0]
+        assert Candidate.from_dict(c.to_dict()) == c
+        assert c.cid == "tiny|fsdp=-1|full|b8|s128|pf2|i8=none"
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="int8_scope"):
+            SearchSpace(
+                config="tiny",
+                mesh_specs=("fsdp=-1",),
+                remat_policies=("full",),
+                batches=(8,),
+                seq=128,
+                int8_scopes=("int4",),
+            )
+        with pytest.raises(ValueError, match="empty axis"):
+            SearchSpace(
+                config="tiny",
+                mesh_specs=(),
+                remat_policies=("full",),
+                batches=(8,),
+                seq=128,
+            )
+
+    def test_builtin_spaces_enumerate(self):
+        for name, factory in BUILTIN_SPACES.items():
+            assert factory().candidates(), name
+
+
+# ---------------------------------------------------------------------------
+# the funnel (stubbed measure; aot off = zero subprocesses)
+# ---------------------------------------------------------------------------
+
+
+class TestFunnel:
+    def test_static_prune_kills_unresolvable_meshes(self, tmp_path):
+        res = run_tune(
+            tiny_smoke_space(),
+            devices=8,
+            out_dir=str(tmp_path / "run"),
+            aot=False,
+            measure=False,
+        )
+        pruned = [t for t in res.trials if t.status == "pruned_static"]
+        # tp=3 cannot resolve on 8 devices: both its policies die static
+        assert len(pruned) == 2
+        assert {t.code for t in pruned} == {"TPX703"}
+        assert res.report["prune_rate"] == 0.5
+        assert res.report["pruned_by_code"] == {"TPX703": 2}
+        assert res.report["device_seconds_pruning"] == 0.0
+        # measure=False still selects the top-ranked survivor + artifact
+        assert res.winner is not None and res.winner.status == "selected"
+        art = load_artifact(res.artifact_path)
+        assert art.candidate["config"] == "tiny"
+
+    def test_indivisible_batch_pruned_before_any_device_work(self, tmp_path):
+        space = SearchSpace(
+            config="tiny",
+            mesh_specs=("fsdp=-1",),
+            remat_policies=("full",),
+            batches=(6, 8),  # 6 does not shard over 8 data shards
+            seq=128,
+        )
+        res = run_tune(
+            space,
+            devices=8,
+            out_dir=str(tmp_path / "run"),
+            aot=False,
+            measure=False,
+        )
+        by_status = {t.candidate.batch: t for t in res.trials}
+        assert by_status[6].status == "pruned_static"
+        assert by_status[6].code == "SHARD_INDIVISIBLE"
+        assert res.winner.candidate.batch == 8
+
+    def test_everything_pruned_raises(self, tmp_path):
+        space = SearchSpace(
+            config="tiny",
+            mesh_specs=("tp=3",),
+            remat_policies=("full",),
+            batches=(8,),
+            seq=128,
+        )
+        with pytest.raises(TuneError, match="killed every candidate"):
+            run_tune(
+                space,
+                devices=8,
+                out_dir=str(tmp_path / "run"),
+                aot=False,
+                measure=False,
+            )
+
+    def test_measured_winner_and_journal(self, tmp_path):
+        cmd, log = stub_measure(tmp_path)
+        out_dir = str(tmp_path / "run")
+        res = run_tune(
+            tiny_smoke_space(),
+            devices=8,
+            out_dir=out_dir,
+            aot=False,
+            top_k=2,
+            measure_cmd=cmd,
+            subprocess_env={"STUB_LOG": log},
+        )
+        assert res.report["measured"] == 2
+        # the stub reports dots 2x faster; the winner must follow
+        assert res.winner.candidate.remat_policy == "dots"
+        assert res.winner.metrics["tokens_per_sec_per_chip"] == 200.0
+        events = TuneJournal(os.path.join(out_dir, "journal.jsonl")).replay()
+        kinds = [e["event"] for e in events]
+        assert kinds.count("pruned") == 2
+        assert kinds.count("measured") == 2
+        assert "winner" in kinds
+        # every pruned event names the rule that killed the candidate
+        assert all(
+            e["code"] == "TPX703"
+            for e in events
+            if e["event"] == "pruned"
+        )
+
+
+# ---------------------------------------------------------------------------
+# resume + calibration persistence
+# ---------------------------------------------------------------------------
+
+
+class TestResume:
+    def test_killed_run_resumes_replaying_measured_trials(self, tmp_path):
+        cmd, log = stub_measure(tmp_path)
+        out_dir = str(tmp_path / "run")
+        # run 1: "dots" dies mid-trial (simulated kill: no measured event)
+        res1 = run_tune(
+            tiny_smoke_space(),
+            devices=8,
+            out_dir=out_dir,
+            aot=False,
+            top_k=2,
+            measure_cmd=cmd,
+            subprocess_env={"STUB_LOG": log, "STUB_FAIL_POLICY": "dots"},
+        )
+        assert {t.status for t in res1.trials if t.candidate.remat_policy == "dots"} & {
+            "measure_failed"
+        }
+        assert res1.winner.candidate.remat_policy == "full"
+        assert stub_calls(log) == ["full", "dots"]
+        # a kill mid-append leaves at most one torn line: tolerated
+        with open(os.path.join(out_dir, "journal.jsonl"), "a") as f:
+            f.write('{"event": "measu')
+        # run 2: the completed trial replays; only the remainder re-runs
+        res2 = run_tune(
+            tiny_smoke_space(),
+            devices=8,
+            out_dir=out_dir,
+            aot=False,
+            top_k=2,
+            measure_cmd=cmd,
+            subprocess_env={"STUB_LOG": log},
+        )
+        assert stub_calls(log) == ["full", "dots", "dots"]  # full NOT re-run
+        by_policy = {
+            t.candidate.remat_policy: t
+            for t in res2.trials
+            if t.status == "measured"
+        }
+        assert by_policy["full"].replayed is True
+        assert by_policy["dots"].replayed is False
+        assert res2.winner.candidate.remat_policy == "dots"
+
+    def test_journal_of_a_different_space_is_reset(self, tmp_path):
+        cmd, log = stub_measure(tmp_path)
+        out_dir = str(tmp_path / "run")
+        run_tune(
+            tiny_smoke_space(),
+            devices=8,
+            out_dir=out_dir,
+            aot=False,
+            top_k=1,
+            measure_cmd=cmd,
+            subprocess_env={"STUB_LOG": log},
+        )
+        other = SearchSpace.from_dict(
+            {**tiny_smoke_space().to_dict(), "batches": [16]}
+        )
+        run_tune(
+            other,
+            devices=8,
+            out_dir=out_dir,
+            aot=False,
+            top_k=1,
+            measure_cmd=cmd,
+            subprocess_env={"STUB_LOG": log},
+        )
+        journal = TuneJournal(os.path.join(out_dir, "journal.jsonl"))
+        assert journal.space_digest() == other.digest()
+        # a resumed journal never mixes spaces: 16 re-measured fresh
+        assert all(
+            e["cid"].startswith("tiny|") and "|b16|" in e["cid"]
+            for e in journal.events("measured")
+        )
+
+    def test_calibration_survives_restart_and_error_shrinks(self, tmp_path):
+        cmd, log = stub_measure(tmp_path)
+        res1 = run_tune(
+            tiny_smoke_space(),
+            devices=8,
+            out_dir=str(tmp_path / "r1"),
+            aot=False,
+            top_k=1,
+            measure_cmd=cmd,
+            subprocess_env={"STUB_LOG": log},
+        )
+        obs = res1.calibration["step_time"]
+        assert obs["err_after"] < obs["err_before"]
+        # the table is persisted under $TPX_TUNE_DIR: a FRESH load (new
+        # process restart equivalent) sees the folded observation
+        table = CalibrationTable.load_default()
+        assert table.scales_for("").samples == 1
+        assert table.scales_for("").step_time_scale != 1.0
+        # a second run folds on top of the persisted scales
+        res2 = run_tune(
+            tiny_smoke_space(),
+            devices=8,
+            out_dir=str(tmp_path / "r2"),
+            aot=False,
+            top_k=1,
+            measure_cmd=cmd,
+            subprocess_env={"STUB_LOG": log},
+        )
+        assert res2.calibration["step_time"]["err_before"] < obs["err_before"]
+        assert CalibrationTable.load_default().scales_for("").samples == 2
+
+
+class TestCalibrationTable:
+    def test_observe_halves_the_error(self, tmp_path):
+        table = CalibrationTable(str(tmp_path / "cal.json"))
+        out = table.observe(
+            "v5e", predicted_step_s=1.0, measured_step_s=2.0
+        )
+        st = out["step_time"]
+        assert st["err_before"] == pytest.approx(0.5)
+        assert st["err_after"] == pytest.approx(0.25)
+        assert table.scales_for("v5e").step_time_scale == pytest.approx(1.5)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        path = str(tmp_path / "cal.json")
+        table = CalibrationTable(path)
+        table.observe("v5e", predicted_step_s=1.0, measured_step_s=2.0)
+        table.save()
+        again = CalibrationTable.load(path)
+        assert again.scales_for("v5e").to_dict() == table.scales_for(
+            "v5e"
+        ).to_dict()
+
+    def test_generation_key_normalization(self):
+        assert generation_key("TPU v5e") == "v5e"
+        assert generation_key("V4") == "v4"
+        assert generation_key("") == "cpu-sim"
+        assert generation_key("some CPU host") == "cpu-sim"
+
+    def test_bad_alpha_rejected(self, tmp_path):
+        table = CalibrationTable(str(tmp_path / "cal.json"))
+        with pytest.raises(ValueError, match="alpha"):
+            table.observe(
+                "v5e", predicted_step_s=1.0, measured_step_s=2.0, alpha=1.0
+            )
+
+
+# ---------------------------------------------------------------------------
+# artifact: digest, tamper, diff, and the submit-gate pin
+# ---------------------------------------------------------------------------
+
+
+def _plan_for(app):
+    plan, _diags = deep_preflight(app.roles[0])
+    assert plan is not None
+    return plan
+
+
+def tuned_app(batch: str = "8", policy: str = "full"):
+    return dist.spmd(
+        "--config",
+        "tiny",
+        "--mesh",
+        "fsdp=-1",
+        "--batch",
+        batch,
+        "--seq",
+        "128",
+        "--remat-policy",
+        policy,
+        m="torchx_tpu.examples.train_llama",
+        j="1x8",
+    )
+
+
+class TestArtifact:
+    def test_digest_roundtrip_and_tamper_detection(self, tmp_path):
+        art = PlanArtifact(
+            space={}, candidate={"config": "tiny"},
+            plan=_plan_for(tuned_app()).to_dict(),
+        )
+        path = art.save(str(tmp_path / "art.json"))
+        assert load_artifact(path).digest == art.digest
+        raw = json.load(open(path))
+        raw["plan"]["batch"] = 4  # hand-edit: digest no longer matches
+        json.dump(raw, open(path, "w"))
+        with pytest.raises(ArtifactError, match="digest mismatch"):
+            load_artifact(path)
+
+    def test_unreadable_artifact(self, tmp_path):
+        with pytest.raises(ArtifactError, match="cannot read"):
+            load_artifact(str(tmp_path / "missing.json"))
+        bad = tmp_path / "bad.json"
+        bad.write_text("not json")
+        with pytest.raises(ArtifactError):
+            load_artifact(str(bad))
+
+    def test_diff_plan(self):
+        plan = _plan_for(tuned_app()).to_dict()
+        art = PlanArtifact(space={}, candidate={}, plan=plan)
+        assert art.diff_plan(plan) == []
+        moved = dict(plan, batch=4, remat_policy="dots")
+        diffs = art.diff_plan(moved)
+        assert sorted(d.split(":")[0] for d in diffs) == [
+            "batch",
+            "remat_policy",
+        ]
+        # trivial (size-1) mesh axes never diff: wildcard resolution noise
+        relaxed = dict(plan, mesh={
+            k: v for k, v in plan["mesh"].items() if int(v) != 1
+        })
+        assert art.diff_plan(relaxed) == []
+
+
+class TestSubmitGatePin:
+    def _pin(self, tmp_path, monkeypatch, plan_app=None):
+        art = PlanArtifact(
+            space={}, candidate={"cid": "test"},
+            plan=_plan_for(plan_app or tuned_app()).to_dict(),
+        )
+        path = art.save(str(tmp_path / "pin.json"))
+        monkeypatch.setenv(settings.ENV_TPX_PLAN_ARTIFACT, path)
+        return path
+
+    def test_matching_plan_passes(self, tmp_path, monkeypatch):
+        self._pin(tmp_path, monkeypatch)
+        codes = [d.code for d in analyze(tuned_app()).diagnostics]
+        assert "TPX706" not in codes and "TPX707" not in codes
+
+    def test_diverging_plan_is_tpx706_error(self, tmp_path, monkeypatch):
+        self._pin(tmp_path, monkeypatch)
+        report = analyze(tuned_app(batch="4", policy="dots"))
+        tpx706 = [d for d in report.diagnostics if d.code == "TPX706"]
+        assert len(tpx706) == 1
+        assert tpx706[0].severity.value == "error"
+        assert "batch: artifact=8 plan=4" in tpx706[0].message
+        assert "remat_policy" in tpx706[0].message
+
+    def test_corrupt_pin_is_tpx707_error(self, tmp_path, monkeypatch):
+        path = self._pin(tmp_path, monkeypatch)
+        with open(path, "a") as f:
+            f.write("garbage")
+        report = analyze(tuned_app())
+        tpx707 = [d for d in report.diagnostics if d.code == "TPX707"]
+        assert len(tpx707) == 1
+        assert tpx707[0].severity.value == "error"
+
+    def test_no_pin_no_gate(self):
+        codes = [d.code for d in analyze(tuned_app()).diagnostics]
+        assert "TPX706" not in codes and "TPX707" not in codes
+
+    def test_tune_emitted_artifact_is_accepted_by_the_gate(
+        self, tmp_path, monkeypatch
+    ):
+        res = run_tune(
+            tiny_smoke_space(),
+            devices=8,
+            out_dir=str(tmp_path / "run"),
+            aot=False,
+            measure=False,
+        )
+        monkeypatch.setenv(settings.ENV_TPX_PLAN_ARTIFACT, res.artifact_path)
+        win = res.winner.candidate
+        app = tuned_app(batch=str(win.batch), policy=win.remat_policy)
+        codes = [d.code for d in analyze(app).diagnostics]
+        assert "TPX706" not in codes and "TPX707" not in codes
+
+
+# ---------------------------------------------------------------------------
+# subprocess entrypoints
+# ---------------------------------------------------------------------------
+
+
+class TestProbeFits:
+    def test_probe_fits_and_refuses(self):
+        from torchx_tpu.parallel.aot_fit import probe_fits
+
+        base = {
+            "config": "tiny",
+            "mesh_spec": "fsdp=-1",
+            "batch": 8,
+            "seq": 128,
+            "remat_policy": "full",
+            "int8_scope": "none",
+        }
+        fits, starved, broken = probe_fits(
+            [base, {**base, "hbm_bytes": 1}, {**base, "mesh_spec": "tp=3"}]
+        )
+        assert fits["fits"] is True and fits["peak_bytes"] > 0
+        assert starved["fits"] is False
+        assert "error" in broken  # unresolvable mesh: advisory error
+
+
+class TestDriverPlumbing:
+    def test_last_json_prefix_and_noise(self):
+        noisy = "warn: blah\nTUNE_METRICS {\"a\": 1}\ntrailing garbage\n"
+        assert _last_json(noisy, prefix="TUNE_METRICS ") == {"a": 1}
+        assert _last_json(noisy) is None  # without the prefix: no bare JSON
+        assert _last_json("x\n{broken\n[1, 2]\n") == [1, 2]
+
+    def test_role_for_candidate_shape(self):
+        c = tiny_smoke_space().candidates()[0]
+        role = role_for_candidate(c, devices=8)
+        assert role.args[:2] == ["-m", "torchx_tpu.examples.train_llama"]
+        assert "--int8" not in role.args
+        assert "host_platform_device_count=8" in role.env["XLA_FLAGS"]
+
+    def test_devices_validated(self, tmp_path):
+        with pytest.raises(TuneError, match="devices"):
+            run_tune(
+                tiny_smoke_space(),
+                devices=0,
+                out_dir=str(tmp_path / "run"),
+            )
+
+
+@pytest.mark.integ
+class TestCliLayering:
+    def test_tune_help_never_imports_jax(self):
+        code = (
+            "import sys\n"
+            "from torchx_tpu.cli.main import main\n"
+            "try:\n"
+            "    main(['tune', '--help'])\n"
+            "except SystemExit:\n"
+            "    pass\n"
+            "assert 'jax' not in sys.modules, 'tune --help imported jax'\n"
+            "print('LAYERING_OK')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "LAYERING_OK" in proc.stdout

@@ -78,6 +78,8 @@ Diagnostic codes
 | TPX703 | error | deep preflight: the role is plan-shaped but the ``--mesh`` spec cannot resolve onto its device count | make the axis sizes multiply out to slices × chips (or replicas × nproc) |
 | TPX704 | warning | deep preflight: a serve-shaped role's params + KV pool do not fit the per-chip HBM | lower ``--max-batch``, shorten ``max_seq``, or use a larger-HBM generation |
 | TPX705 | info | deep preflight skipped: no parallelism plan resolvable from the role args (``tpx explain`` only — the submit gate falls back to the TPX110 heuristic) | use a builtin ``--config`` name to enable static sharding/HBM analysis |
+| TPX706 | error | the role's resolved plan diverges from the pinned ``tpx tune`` artifact (``$TPX_PLAN_ARTIFACT``): a tuned knob (config/mesh/batch/seq/remat/int8) was changed after tuning | re-run ``tpx tune`` for the new config, or fix the drifted flag to match the artifact (the message lists each diverging field) |
+| TPX707 | error | the pinned ``$TPX_PLAN_ARTIFACT`` file is unreadable, malformed, or fails its content digest (edited by hand?) | re-emit the artifact with ``tpx tune``, or unset ``TPX_PLAN_ARTIFACT`` to submit unpinned |
 """
 
 from torchx_tpu.analyze.diagnostics import (

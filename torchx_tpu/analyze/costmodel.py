@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from typing import Any, Optional
 
 from torchx_tpu.analyze.plan import ParallelPlan
 from torchx_tpu.parallel.mesh_config import axis_networks
@@ -94,8 +95,30 @@ def _ring(k: int) -> float:
     return (k - 1) / k if k > 1 else 0.0
 
 
-def hbm_fit(plan: ParallelPlan, headroom: float = DEFAULT_HEADROOM) -> HbmFit:
-    """Static per-chip HBM usage vs the plan's per-chip budget."""
+def _scale_of(calibration: Optional[Any], attr: str) -> float:
+    """Extract one multiplicative correction from a calibration object
+    (duck-typed: ``tune.calibrate.CalibrationScales`` or anything with
+    the attribute). ``None``/absent/non-positive -> identity, so every
+    existing caller and golden fixture is bit-identical."""
+    if calibration is None:
+        return 1.0
+    try:
+        scale = float(getattr(calibration, attr, 1.0) or 1.0)
+    except (TypeError, ValueError):
+        return 1.0
+    return scale if scale > 0 else 1.0
+
+
+def hbm_fit(
+    plan: ParallelPlan,
+    headroom: float = DEFAULT_HEADROOM,
+    calibration: Optional[Any] = None,
+) -> HbmFit:
+    """Static per-chip HBM usage vs the plan's per-chip budget.
+
+    ``calibration`` (a ``tune.calibrate.CalibrationScales`` learned from
+    measured runs) rescales the activation term — the only component that
+    is an estimate rather than exact arithmetic."""
     m = plan.model
     dtype = m.dtype_bytes
     pp = plan.axis("pp")
@@ -144,7 +167,10 @@ def hbm_fit(plan: ParallelPlan, headroom: float = DEFAULT_HEADROOM) -> HbmFit:
         comps["params"] = param_bytes
         comps["optimizer"] = 2 * param_bytes  # AdamW mu+nu in param dtype
         comps["gradients"] = param_bytes  # transient backward peak
-        comps["activations"] = _activation_bytes(plan, b_local, s_local)
+        comps["activations"] = int(
+            _activation_bytes(plan, b_local, s_local)
+            * _scale_of(calibration, "activation_scale")
+        )
         comps["logits"] = _logits_bytes(plan, b_local, s_local)
         comps["batch"] = b_local * plan.seq * 4 * 2  # tokens + targets i32
 
@@ -210,9 +236,12 @@ def _logits_bytes(plan: ParallelPlan, b: int, s: int) -> int:
     return int(2 * b * chunk * math.ceil(m.vocab_size / plan.axis("tp")) * 4)
 
 
-def collective_traffic(plan: ParallelPlan) -> list[AxisTraffic]:
+def collective_traffic(
+    plan: ParallelPlan, calibration: Optional[Any] = None
+) -> list[AxisTraffic]:
     """Per-step, per-device collective bytes for every live mesh axis,
-    classified ICI vs DCN from the slice topology."""
+    classified ICI vs DCN from the slice topology. ``calibration``
+    rescales every axis's bytes by the learned ``collective_scale``."""
     m = plan.model
     dtype = m.dtype_bytes
     pp = plan.axis("pp")
@@ -231,6 +260,7 @@ def collective_traffic(plan: ParallelPlan) -> list[AxisTraffic]:
     param_slice = m.param_count() * dtype / (pp * tp * (ep if m.is_moe else 1))
 
     networks = axis_networks(plan.sizes, plan.chips_per_slice)
+    coll_scale = _scale_of(calibration, "collective_scale")
     out: list[AxisTraffic] = []
 
     def add(axis: str, size: int, nbytes: float, ops: tuple[str, ...]):
@@ -239,7 +269,7 @@ def collective_traffic(plan: ParallelPlan) -> list[AxisTraffic]:
                 axis=axis,
                 size=size,
                 network=networks.get(axis, "none"),
-                bytes_per_step=int(nbytes),
+                bytes_per_step=int(nbytes * coll_scale),
                 ops=ops,
             )
         )

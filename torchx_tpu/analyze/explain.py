@@ -21,6 +21,10 @@ TPX7xx family:
 * **TPX705** (info) — no plan resolvable; deep preflight skipped
   (``tpx explain`` only — the submit gate stays silent and the TPX110
   heuristic covers the role).
+* **TPX706** (error) — the role's resolved plan diverges from a pinned
+  tune plan artifact (``$TPX_PLAN_ARTIFACT`` / ``--artifact``).
+* **TPX707** (error) — the pinned plan artifact is unreadable, malformed
+  or fails its content digest.
 
 Every :func:`explain` run opens a ``launcher.explain`` span and bumps the
 ``tpx_explain_*`` metrics. The optional ``aot=True`` cross-check is the
@@ -53,12 +57,15 @@ def deep_preflight(
     devices: Optional[int] = None,
     hbm_bytes: Optional[int] = None,
     headroom: float = costmodel.DEFAULT_HEADROOM,
+    calibration: Optional[Any] = None,
 ) -> tuple[Optional[ParallelPlan], list[Diagnostic]]:
     """Run the deep preflight over one role: ``(plan, diagnostics)``.
 
     ``plan`` is None when the role is not plan-shaped (TPX705 info is
     then the only diagnostic) or when the plan itself is broken (TPX703
-    error). Shared by the submit-gate rule and ``tpx explain``.
+    error). Shared by the submit-gate rule, ``tpx explain`` and the
+    ``tpx tune`` static-prune stage (which passes its per-generation
+    ``calibration`` scales so verdicts reflect measured reality).
     """
     try:
         plan = plan_from_role(role, devices=devices, hbm_bytes=hbm_bytes)
@@ -111,7 +118,7 @@ def deep_preflight(
             )
         )
 
-    fit = costmodel.hbm_fit(plan, headroom=headroom)
+    fit = costmodel.hbm_fit(plan, headroom=headroom, calibration=calibration)
     if not fit.fits:
         over = fit.total_bytes - int(fit.budget_bytes * fit.headroom)
         if plan.serve:
@@ -156,7 +163,7 @@ def deep_preflight(
                 )
             )
 
-    traffic = costmodel.collective_traffic(plan)
+    traffic = costmodel.collective_traffic(plan, calibration=calibration)
     for t in traffic:
         if t.axis in ICI_BOUND_AXES and t.network in ("dcn", "mixed"):
             diags.append(
@@ -284,6 +291,16 @@ class ExplainReport:
                         f" {_gib(t['bytes_per_step'])} |"
                         f" {','.join(t['ops'])} |"
                     )
+            art = r.get("artifact")
+            if art:
+                lines.append(
+                    f"  artifact: pinned {art['digest'][:12]}… -> "
+                    + (
+                        "DIVERGES: " + "; ".join(art["diffs"])
+                        if art["diverges"]
+                        else "matches the tuned plan"
+                    )
+                )
             aot = r.get("aot")
             if aot:
                 if aot.get("error"):
@@ -307,6 +324,61 @@ class ExplainReport:
         return "\n".join(lines)
 
 
+def artifact_diff_diagnostics(
+    artifact_path: str, role_name: str, plan: Optional[ParallelPlan]
+) -> tuple[list[Diagnostic], Optional[dict[str, Any]]]:
+    """Diff one role's resolved plan against a pinned tune artifact.
+
+    Returns ``(diagnostics, detail)`` — TPX707 when the artifact cannot
+    be trusted (unreadable/malformed/digest mismatch), TPX706 when the
+    plan diverges from the pinned winner on any tuned knob. ``detail``
+    is the JSON-safe record ``tpx explain`` embeds (None for non-plan
+    roles under a broken artifact). Shared by :func:`explain` and the
+    submit gate's ``rules.check_plan_artifact``."""
+    from torchx_tpu.tune.artifact import ArtifactError, load_artifact
+
+    try:
+        art = load_artifact(artifact_path)
+    except ArtifactError as e:
+        return [
+            Diagnostic(
+                code="TPX707",
+                severity=Severity.ERROR,
+                role=role_name,
+                field="env.TPX_PLAN_ARTIFACT",
+                message=f"pinned plan artifact rejected: {e}",
+                hint="re-run `tpx tune` to regenerate the artifact; never"
+                " edit it by hand (the digest is content-addressed)",
+            )
+        ], None
+    if plan is None:
+        return [], None
+    diffs = art.diff_plan(plan.to_dict())
+    detail: dict[str, Any] = {
+        "path": artifact_path,
+        "digest": art.digest,
+        "candidate": art.candidate,
+        "diverges": bool(diffs),
+        "diffs": diffs,
+    }
+    if not diffs:
+        return [], detail
+    return [
+        Diagnostic(
+            code="TPX706",
+            severity=Severity.ERROR,
+            role=role_name,
+            field="args",
+            message=(
+                "plan diverges from the pinned tune artifact"
+                f" ({art.digest[:12]}…): " + "; ".join(diffs)
+            ),
+            hint="match the tuned config (see `tpx explain --artifact`),"
+            " re-run `tpx tune`, or drop the $TPX_PLAN_ARTIFACT pin",
+        )
+    ], detail
+
+
 def explain(
     app: AppDef,
     *,
@@ -315,10 +387,16 @@ def explain(
     hbm_bytes: Optional[int] = None,
     headroom: float = costmodel.DEFAULT_HEADROOM,
     aot: bool = False,
+    artifact: Optional[str] = None,
+    calibration: Optional[Any] = None,
     session: str = "",
     gate: str = "api",
 ) -> ExplainReport:
-    """Deep-preflight every role of ``app`` and return the report."""
+    """Deep-preflight every role of ``app`` and return the report.
+
+    ``artifact`` diffs each plan-shaped role against a pinned tune plan
+    artifact (TPX706/707); ``calibration`` applies learned per-generation
+    cost-model scales (see :mod:`torchx_tpu.tune.calibrate`)."""
     from torchx_tpu.obs import metrics as obs_metrics
     from torchx_tpu.obs import trace as obs_trace
 
@@ -332,19 +410,35 @@ def explain(
     ) as sp:
         for role in app.roles:
             plan, diags = deep_preflight(
-                role, devices=devices, hbm_bytes=hbm_bytes, headroom=headroom
+                role,
+                devices=devices,
+                hbm_bytes=hbm_bytes,
+                headroom=headroom,
+                calibration=calibration,
             )
             entry: dict[str, Any] = {"role": role.name, "_diags": diags}
+            if artifact:
+                art_diags, art_detail = artifact_diff_diagnostics(
+                    artifact, role.name, plan
+                )
+                diags.extend(art_diags)
+                if art_detail is not None:
+                    entry["artifact"] = art_detail
             if plan is None:
                 entry["plan"] = None
             else:
                 flow = propagation.propagate(plan)
-                fit = costmodel.hbm_fit(plan, headroom=headroom)
+                fit = costmodel.hbm_fit(
+                    plan, headroom=headroom, calibration=calibration
+                )
                 entry["plan"] = plan.to_dict()
                 entry["sharding"] = flow.to_dict()
                 entry["hbm"] = fit.to_dict()
                 entry["collectives"] = [
-                    t.to_dict() for t in costmodel.collective_traffic(plan)
+                    t.to_dict()
+                    for t in costmodel.collective_traffic(
+                        plan, calibration=calibration
+                    )
                 ]
                 obs_metrics.EXPLAIN_HBM_TOTAL_BYTES.set(
                     fit.total_bytes, role=role.name

@@ -1113,3 +1113,38 @@ def check_deep_preflight(ctx: RuleContext) -> Iterator[Diagnostic]:
             if d.code == "TPX705":
                 continue  # explain-only: the gate stays quiet on skips
             yield d
+
+
+@rule("plan-artifact")
+def check_plan_artifact(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX706/TPX707: the tuned-plan pin.
+
+    When ``$TPX_PLAN_ARTIFACT`` points at a ``tpx tune`` winner artifact,
+    every plan-shaped role must resolve to the SAME tuned knobs (config,
+    mesh, batch, seq, remat policy, int8) — divergence is TPX706, and an
+    artifact that cannot be trusted (unreadable, malformed, content
+    digest mismatch) is TPX707. Roles with no resolvable plan are
+    skipped: the pin constrains tuned trainers, not sidecars. Unset pin
+    = rule silent, so nothing changes for untuned submits.
+    """
+    from torchx_tpu.analyze.explain import (
+        artifact_diff_diagnostics,
+        deep_preflight,
+    )
+    from torchx_tpu.tune.artifact import pinned_artifact_path
+
+    path = pinned_artifact_path()
+    if not path:
+        return
+    broken_reported = False
+    for role in ctx.app.roles:
+        plan, _diags = deep_preflight(role)
+        if plan is None:
+            continue
+        diags, _detail = artifact_diff_diagnostics(path, role.name, plan)
+        for d in diags:
+            if d.code == "TPX707":
+                if broken_reported:
+                    continue  # one broken-artifact error, not one per role
+                broken_reported = True
+            yield d

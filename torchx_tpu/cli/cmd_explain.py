@@ -70,6 +70,21 @@ class CmdExplain(SubCommand):
             help="fraction of HBM the fit may use (default 0.9)",
         )
         subparser.add_argument(
+            "--artifact",
+            type=str,
+            default=None,
+            help="diff each plan-shaped role against a pinned `tpx tune`"
+            " plan artifact (TPX706 on divergence, TPX707 if untrusted)",
+        )
+        subparser.add_argument(
+            "--calibrated",
+            type=str,
+            default=None,
+            metavar="GENERATION",
+            help="apply the persisted cost-model calibration for an"
+            " accelerator generation (e.g. v5p; see `tpx tune`)",
+        )
+        subparser.add_argument(
             "conf_args",
             nargs=argparse.REMAINDER,
             help="component name / file.py:fn / appdef.json / '-' (stdin),"
@@ -107,6 +122,13 @@ class CmdExplain(SubCommand):
         app = self._load_app(target, rest)
         from torchx_tpu.analyze.explain import explain
 
+        calibration = None
+        if args.calibrated:
+            from torchx_tpu.tune.calibrate import CalibrationTable
+
+            calibration = CalibrationTable.load_default().scales_for(
+                args.calibrated
+            )
         report = explain(
             app,
             scheduler=scheduler,
@@ -118,6 +140,8 @@ class CmdExplain(SubCommand):
                 args.headroom if args.headroom is not None else DEFAULT_HEADROOM
             ),
             aot=args.aot,
+            artifact=args.artifact,
+            calibration=calibration,
             gate="cli",
         )
         if target not in ("-",):

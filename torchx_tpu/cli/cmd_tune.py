@@ -1,0 +1,204 @@
+"""``tpx tune`` — closed-loop config autotuner over the explain cost model.
+
+Enumerates a declarative search space (mesh spec x remat policy x batch x
+prefetch x int8 scope), prunes statically through the deep-preflight cost
+model and the XLA AOT memory fit with ZERO device seconds, measures only
+the surviving top-k via short seeded bench trials, and emits the winner
+as a content-digested plan artifact that ``tpx run`` can pin
+(``$TPX_PLAN_ARTIFACT`` -> TPX706/707 in the submit gate) and
+``tpx explain --artifact`` can diff. Every measured trial folds its
+prediction-vs-actual error back into the persisted per-generation
+calibration table, so the cost model — and everything reading it: the
+explain report, future tune runs, the fleet placer's HBM-refusal oracle —
+gets sharper with every run.
+
+Module level stays jax-free (``tpx tune --help`` must not import jax);
+only the AOT-probe and measurement *subprocesses* touch a backend.
+
+Exit codes: 0 winner emitted, 1 tune failed (all candidates pruned, all
+measurements failed), 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+from torchx_tpu.cli.cmd_base import SubCommand
+
+logger = logging.getLogger(__name__)
+
+
+class CmdTune(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument(
+            "--space",
+            type=str,
+            default="tiny-smoke",
+            help="builtin search-space name (see --list-spaces) or a JSON"
+            " file describing a SearchSpace",
+        )
+        subparser.add_argument(
+            "--list-spaces",
+            action="store_true",
+            help="print the builtin search spaces and exit",
+        )
+        subparser.add_argument(
+            "--devices",
+            type=int,
+            default=None,
+            help="device count to tune for (default: $TPX_TUNE_DEVICES or 8)",
+        )
+        subparser.add_argument(
+            "--hbm-gb",
+            type=float,
+            default=None,
+            help="per-chip HBM budget in GiB (default: generation table)",
+        )
+        subparser.add_argument(
+            "--generation",
+            type=str,
+            default="",
+            help="accelerator generation for ranking + calibration"
+            " (e.g. v5p; default: inferred, cpu-sim off-TPU)",
+        )
+        subparser.add_argument(
+            "--top-k",
+            type=int,
+            default=3,
+            help="how many ranked survivors get measured (default 3)",
+        )
+        subparser.add_argument(
+            "--out-dir",
+            type=str,
+            default=None,
+            help="journal/artifact directory (default:"
+            " $TPX_TUNE_DIR/<space digest>; reuse to resume)",
+        )
+        subparser.add_argument(
+            "--no-aot",
+            action="store_true",
+            help="skip the XLA AOT memory-fit prune stage",
+        )
+        subparser.add_argument(
+            "--no-measure",
+            action="store_true",
+            help="static-only: rank and emit the predicted winner without"
+            " running any trial",
+        )
+        subparser.add_argument(
+            "--data-path",
+            type=str,
+            default=None,
+            help="tokenized dataset for measured trials (default synthetic)",
+        )
+        subparser.add_argument(
+            "--json",
+            action="store_true",
+            help="emit the full tune result as JSON",
+        )
+
+    def run(self, args: argparse.Namespace) -> None:
+        from torchx_tpu.tune.space import BUILTIN_SPACES, SearchSpace
+
+        if args.list_spaces:
+            for name, factory in sorted(BUILTIN_SPACES.items()):
+                space = factory()
+                print(
+                    f"{name}: config={space.config}"
+                    f" candidates={len(space.candidates())}"
+                    f" digest={space.digest()}"
+                )
+            return
+        if args.space in BUILTIN_SPACES:
+            space = BUILTIN_SPACES[args.space]()
+        else:
+            try:
+                with open(args.space) as f:
+                    space = SearchSpace.from_dict(json.load(f))
+            except (OSError, json.JSONDecodeError, ValueError, KeyError) as e:
+                print(
+                    f"error: --space must be one of"
+                    f" {sorted(BUILTIN_SPACES)} or a SearchSpace JSON file:"
+                    f" {e}",
+                    file=sys.stderr,
+                )
+                sys.exit(2)
+
+        devices = args.devices or int(os.environ.get("TPX_TUNE_DEVICES", 8))
+        from torchx_tpu.tune.driver import TuneError, run_tune
+
+        try:
+            result = run_tune(
+                space,
+                devices=devices,
+                hbm_bytes=(
+                    int(args.hbm_gb * 1024**3)
+                    if args.hbm_gb is not None
+                    else None
+                ),
+                generation=args.generation,
+                out_dir=args.out_dir,
+                top_k=args.top_k,
+                aot=not args.no_aot,
+                measure=not args.no_measure,
+                data_path=args.data_path,
+            )
+        except TuneError as e:
+            print(f"error: tune failed: {e}", file=sys.stderr)
+            sys.exit(1)
+
+        if args.json:
+            print(json.dumps(result.to_dict(), indent=2, default=str))
+        else:
+            print(self._render(result))
+        sys.exit(0 if result.winner is not None else 1)
+
+    @staticmethod
+    def _render(result) -> str:  # noqa: ANN001 - TuneResult
+        r = result.report
+        lines = [
+            f"tune: {result.space.config} — {r['candidates']} candidate(s),"
+            f" {r['pruned_static']} pruned static,"
+            f" {r['pruned_aot']} pruned AOT, {r['measured']} measured"
+            f" ({r['prune_rate']:.0%} decided with zero device seconds)"
+        ]
+        if r.get("pruned_by_code"):
+            lines.append(
+                "  pruned by: "
+                + ", ".join(
+                    f"{code}x{n}" for code, n in r["pruned_by_code"].items()
+                )
+            )
+        for t in result.trials:
+            if t.status not in ("measured", "measure_failed", "selected"):
+                continue
+            pred = (t.predicted.get("step_cost") or {}).get("step_s")
+            pred_s = f" predicted {pred * 1e3:.1f}ms" if pred else ""
+            meas = t.metrics.get("step_time_s")
+            meas_s = f" measured {meas * 1e3:.1f}ms" if meas else ""
+            tok = t.metrics.get("tokens_per_sec_per_chip")
+            tok_s = f" {tok:,.0f} tok/s/chip" if tok else ""
+            replay = " (replayed)" if t.replayed else ""
+            lines.append(
+                f"  {t.status:<15} {t.candidate.cid}{pred_s}{meas_s}"
+                f"{tok_s}{replay}"
+            )
+        if result.winner is not None:
+            lines.append(
+                f"winner: {result.winner.candidate.cid}"
+                f"\nartifact: {result.artifact_path}"
+                "\npin it:  TPX_PLAN_ARTIFACT="
+                f"{result.artifact_path} tpx run ..."
+            )
+        cal = result.calibration.get("step_time")
+        if cal:
+            lines.append(
+                f"calibration: step-time error"
+                f" {cal['err_before']:.1%} -> {cal['err_after']:.1%}"
+                f" (generation {result.calibration['generation']})"
+            )
+        return "\n".join(lines)

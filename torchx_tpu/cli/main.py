@@ -30,6 +30,7 @@ BUILTIN_CMDS: dict[str, tuple[str, str]] = {
     "run": ("torchx_tpu.cli.cmd_run", "CmdRun"),
     "lint": ("torchx_tpu.cli.cmd_lint", "CmdLint"),
     "explain": ("torchx_tpu.cli.cmd_explain", "CmdExplain"),
+    "tune": ("torchx_tpu.cli.cmd_tune", "CmdTune"),
     "supervise": ("torchx_tpu.cli.cmd_supervise", "CmdSupervise"),
     "status": ("torchx_tpu.cli.cmd_simple", "CmdStatus"),
     "describe": ("torchx_tpu.cli.cmd_simple", "CmdDescribe"),

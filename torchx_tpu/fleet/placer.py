@@ -55,7 +55,7 @@ class PlacementDecision:
 
 
 def hbm_refusal(
-    role: Role, gang: GangRequest, hbm_bytes: int
+    role: Role, gang: GangRequest, hbm_bytes: int, generation: str = ""
 ) -> Optional[str]:
     """The placement oracle for one (role, pool-generation) pair.
 
@@ -63,14 +63,25 @@ def hbm_refusal(
     the gang's total chips as the device count. Any ERROR-severity
     verdict (TPX701 static HBM overflow, TPX703 unresolvable plan) is a
     refusal; roles that are not plan-shaped pass (nothing to verify —
-    the TPX705 skip is info, not an error)."""
+    the TPX705 skip is info, not an error).
+
+    ``generation`` (the pool's accelerator, e.g. ``v5e``) applies the
+    persisted ``tpx tune`` calibration for that generation, so the same
+    measured activation-memory corrections that sharpen the explain
+    report also sharpen which pools the fleet refuses."""
     from torchx_tpu.analyze.diagnostics import Severity
     from torchx_tpu.analyze.explain import deep_preflight
 
+    calibration = None
+    if generation:
+        from torchx_tpu.tune.calibrate import CalibrationTable
+
+        calibration = CalibrationTable.load_default().scales_for(generation)
     _plan, diags = deep_preflight(
         role,
         devices=gang.replicas * gang.chips_per_replica,
         hbm_bytes=hbm_bytes,
+        calibration=calibration,
     )
     errors = [d for d in diags if d.severity == Severity.ERROR]
     if not errors:
@@ -103,7 +114,12 @@ def plan_placement(
     allowed = []
     for pool in capable:
         if role is not None:
-            refusal = hbm_refusal(role, gang, pool.shape.hbm_bytes_per_chip)
+            refusal = hbm_refusal(
+                role,
+                gang,
+                pool.shape.hbm_bytes_per_chip,
+                generation=pool.shape.accelerator,
+            )
             if refusal is not None:
                 decision.refusals[pool.name] = refusal
                 continue

@@ -406,6 +406,30 @@ EXPLAIN_HBM_TOTAL_BYTES = REGISTRY.gauge(
     ("role",),
 )
 
+#: candidates the config autotuner (``tpx tune``) enumerated, by model
+#: config — the top of the prune funnel.
+TUNE_CANDIDATES = REGISTRY.counter(
+    "tpx_tune_candidates_total",
+    "autotuner candidates enumerated from the search space",
+    ("config",),
+)
+
+#: autotuner candidates killed before any device time, by prune stage
+#: ("static" = deep-preflight verdict, "aot" = XLA AOT memory fit) and
+#: the diagnostic code / verdict that killed them.
+TUNE_PRUNED = REGISTRY.counter(
+    "tpx_tune_pruned_total",
+    "autotuner candidates pruned with zero device seconds",
+    ("stage", "code"),
+)
+
+#: autotuner trials that reached a device, by outcome ("ok"/"failed").
+TUNE_MEASURED = REGISTRY.counter(
+    "tpx_tune_measured_total",
+    "autotuner measured trials",
+    ("status",),
+)
+
 #: control-plane calls issued through the resilient seam, by backend +
 #: logical op + outcome ("ok"/"error"/"rejected" — rejected means the
 #: backend's circuit breaker refused the call).

@@ -193,3 +193,77 @@ def model_state_bytes_per_device(cfg: Any, n_devices: int) -> int:
     at scale): 3x the bf16 param bytes spread over the mesh."""
     itemsize = jnp.dtype(cfg.dtype).itemsize
     return 3 * cfg.param_count() * itemsize // n_devices
+
+
+def probe_fits(requests: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """Batch :func:`compile_fit` for the ``tpx tune`` AOT prune stage.
+
+    One jax process serves the whole candidate batch (the tune driver is
+    jax-free; spawning one interpreter per candidate would pay the jax
+    import tax N times). Each request dict carries ``config`` (builtin
+    name), ``mesh_spec``, ``batch``, ``seq`` and optionally
+    ``remat_policy``, ``int8_scope``, ``hbm_bytes``, ``headroom``; each
+    result mirrors :class:`FitResult` plus the echoed request, or carries
+    ``error`` — per-candidate failures never kill the batch.
+    """
+    from torchx_tpu.examples.train_llama import all_configs
+    from torchx_tpu.parallel.mesh import make_mesh
+    from torchx_tpu.parallel.mesh_config import MeshConfig, parse_mesh_spec
+
+    configs = all_configs()
+    out: list[dict[str, Any]] = []
+    for req in requests:
+        result: dict[str, Any] = {"request": req}
+        try:
+            overrides: dict[str, Any] = {}
+            if req.get("remat_policy"):
+                overrides["remat_policy"] = req["remat_policy"]
+            scope = req.get("int8_scope") or "none"
+            if scope != "none":
+                overrides["int8_matmuls"] = True
+                overrides["int8_scope"] = scope
+            cfg = configs[req["config"]](**overrides)
+            mesh_cfg = (
+                parse_mesh_spec(req["mesh_spec"])
+                if req.get("mesh_spec")
+                else MeshConfig()
+            )
+            mesh = make_mesh(mesh_cfg)
+            r = compile_fit(
+                cfg,
+                mesh,
+                int(req["batch"]),
+                int(req["seq"]),
+                hbm_bytes=int(req.get("hbm_bytes") or V5P_HBM_BYTES),
+                headroom=float(req.get("headroom") or DEFAULT_HEADROOM),
+            )
+            result.update(
+                {
+                    "fits": r.fits,
+                    "args_bytes": int(r.args_bytes),
+                    "temp_bytes": int(r.temp_bytes),
+                    "peak_bytes": int(r.peak_bytes),
+                    "remat_policy": r.remat_policy,
+                }
+            )
+        except Exception as e:  # noqa: BLE001 - advisory batch probe
+            result["error"] = f"{type(e).__name__}: {e}"
+        out.append(result)
+    return out
+
+
+def _probe_main() -> int:
+    """``python -m torchx_tpu.parallel.aot_fit``: JSON requests on stdin,
+    one JSON results line on stdout (the tune driver's subprocess ABI)."""
+    import json
+    import sys
+
+    requests = json.load(sys.stdin)
+    if not isinstance(requests, list):
+        raise SystemExit("expected a JSON list of probe requests on stdin")
+    print(json.dumps(probe_fits(requests)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_probe_main())

@@ -131,13 +131,18 @@ class CircuitBreaker:
 class FailureLedger:
     """Durable consecutive-failure counter, keyed by string.
 
-    One line per failure is appended to ``path``; a success for a key
-    rewrites the file without that key's lines (atomic tmp +
-    ``os.replace``, so lock-free readers never see a torn file). A key
+    The file is APPEND-ONLY: one ``key`` line per failure, one
+    ``key|clear`` tombstone line per success. :meth:`failures` replays
+    the lines in order, so a tombstone erases every failure recorded
+    before it and none after. Clears used to rewrite the whole file
+    (tmp + ``os.replace``), which could silently drop a failure appended
+    between the read and the replace; a tombstone is a single O_APPEND
+    write, so concurrent writers can no longer undo each other. A key
     with >= ``threshold`` unbroken failures is *tripped* and should sit
-    out until a success clears it. Everything is best-effort: a lost
-    concurrent update costs at most one miscounted failure, which the
-    next observation corrects."""
+    out until a success clears it. Keys must not end with ``|clear``
+    (they would parse as tombstones)."""
+
+    CLEAR_SUFFIX = "|clear"
 
     def __init__(self, path: str, threshold: int = 3) -> None:
         if threshold < 1:
@@ -146,38 +151,41 @@ class FailureLedger:
         self.threshold = threshold
 
     def failures(self) -> dict[str, int]:
-        """Unbroken failure count per key (missing file = empty)."""
+        """Unbroken failure count per key (missing file = empty),
+        replaying failure lines and ``|clear`` tombstones in order."""
         out: dict[str, int] = {}
         try:
             with open(self.path) as f:
                 for line in f:
                     key = line.strip()
-                    if key:
+                    if not key:
+                        continue
+                    if key.endswith(self.CLEAR_SUFFIX):
+                        out.pop(key[: -len(self.CLEAR_SUFFIX)], None)
+                    else:
                         out[key] = out.get(key, 0) + 1
         except OSError:
             pass
         return out
 
     def note(self, key: str, ok: bool) -> None:
-        """Record one observation: a failure appends a line; a success
-        clears every line for ``key``."""
+        """Record one observation: a failure appends a ``key`` line; a
+        success appends a ``key|clear`` tombstone (only when the key has
+        recorded failures, so a success on a clean ledger stays a no-op
+        and never creates the file). Each append is one O_APPEND write
+        of one line — concurrent notes interleave per-line instead of
+        racing a whole-file rewrite."""
         try:
-            if ok:
-                fails = self.failures()
-                if key in fails:
-                    remaining = [
-                        line
-                        for k, n in fails.items()
-                        if k != key
-                        for line in [k] * n
-                    ]
-                    tmp = self.path + ".tmp"
-                    with open(tmp, "w") as f:
-                        f.write("".join(f"{line}\n" for line in remaining))
-                    os.replace(tmp, self.path)
-            else:
-                with open(self.path, "a") as f:
-                    f.write(f"{key}\n")
+            if ok and key not in self.failures():
+                return
+            line = f"{key}{self.CLEAR_SUFFIX}\n" if ok else f"{key}\n"
+            fd = os.open(
+                self.path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644
+            )
+            try:
+                os.write(fd, line.encode())
+            finally:
+                os.close(fd)
         except OSError:
             pass
 

@@ -88,6 +88,17 @@ ENV_TPX_DESCRIBE_CACHE_TTL = "TPX_DESCRIBE_CACHE_TTL"
 # successive wait ticks always observe fresh state.
 DEFAULT_DESCRIBE_CACHE_TTL = 1.0
 
+# State root for the config autotuner (`tpx tune`): per-run trial
+# journals + the persisted per-generation cost-model calibration table
+# (torchx_tpu/tune/). Default ~/.torchx_tpu/tune.
+ENV_TPX_TUNE_DIR = "TPX_TUNE_DIR"
+
+# Path to a tune plan artifact (torchx_tpu/tune/artifact.py) pinned for
+# submission: the submit gate (rules.check_plan_artifact) diffs every
+# plan-shaped role against it and errors on divergence (TPX706) or an
+# unreadable/digest-mismatched artifact (TPX707). Unset = no pinning.
+ENV_TPX_PLAN_ARTIFACT = "TPX_PLAN_ARTIFACT"
+
 # Address ("host:port") of a running `tpx control` daemon. When set, the
 # CLI transparently proxies submit/status/list/cancel/log through the
 # daemon's HTTP API instead of driving schedulers directly — thousands of

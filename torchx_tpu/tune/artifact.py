@@ -1,0 +1,157 @@
+"""The tune plan artifact: a content-digested, pin-able winner record.
+
+One JSON file carrying the winning candidate, the plan it resolves to,
+the cost-model predictions, the measured trial metrics, the calibration
+observation, and the prune-funnel report. The ``digest`` field is the
+sha256 of the canonical JSON of everything else, so
+
+* ``tpx run`` can PIN it: ``$TPX_PLAN_ARTIFACT=<path>`` makes the submit
+  gate (``rules.check_plan_artifact``) diff every plan-shaped role
+  against the artifact — divergence is TPX706, a corrupt/tampered file
+  is TPX707;
+* ``tpx explain --artifact <path>`` shows the same diff inline.
+
+No timestamps: the artifact of a deterministic space + measurements is
+itself deterministic, which keeps digests reproducible in CI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Any, Optional
+
+ARTIFACT_VERSION = 1
+
+#: plan fields the pin actually compares — the knobs a tune run chose.
+#: Topology fields (devices, hbm) deliberately excluded: the same tuned
+#: config is valid on any pool the preflight HBM fit accepts.
+PINNED_PLAN_FIELDS = (
+    "config",
+    "mesh",
+    "batch",
+    "seq",
+    "remat_policy",
+    "int8",
+)
+
+
+class ArtifactError(ValueError):
+    """The artifact file is unreadable, malformed, or fails its digest."""
+
+
+def _canonical(core: dict[str, Any]) -> bytes:
+    return json.dumps(core, sort_keys=True, separators=(",", ":")).encode()
+
+
+@dataclasses.dataclass
+class PlanArtifact:
+    """The winner of one tune run (see module docstring)."""
+
+    space: dict[str, Any]
+    candidate: dict[str, Any]
+    plan: dict[str, Any]
+    predictions: dict[str, Any] = dataclasses.field(default_factory=dict)
+    measurements: dict[str, Any] = dataclasses.field(default_factory=dict)
+    calibration: dict[str, Any] = dataclasses.field(default_factory=dict)
+    report: dict[str, Any] = dataclasses.field(default_factory=dict)
+    version: int = ARTIFACT_VERSION
+
+    def core_dict(self) -> dict[str, Any]:
+        """Everything the digest covers (all fields but the digest)."""
+        return {
+            "version": self.version,
+            "space": self.space,
+            "candidate": self.candidate,
+            "plan": self.plan,
+            "predictions": self.predictions,
+            "measurements": self.measurements,
+            "calibration": self.calibration,
+            "report": self.report,
+        }
+
+    @property
+    def digest(self) -> str:
+        """sha256 of the canonical JSON of :meth:`core_dict`."""
+        return hashlib.sha256(_canonical(self.core_dict())).hexdigest()
+
+    def to_dict(self) -> dict[str, Any]:
+        """The saved JSON form: the core plus its digest."""
+        return {**self.core_dict(), "digest": self.digest}
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "PlanArtifact":
+        """Parse + digest-verify (raises :class:`ArtifactError`)."""
+        try:
+            art = cls(
+                space=dict(raw["space"]),
+                candidate=dict(raw["candidate"]),
+                plan=dict(raw["plan"]),
+                predictions=dict(raw.get("predictions", {})),
+                measurements=dict(raw.get("measurements", {})),
+                calibration=dict(raw.get("calibration", {})),
+                report=dict(raw.get("report", {})),
+                version=int(raw.get("version", ARTIFACT_VERSION)),
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            raise ArtifactError(f"malformed plan artifact: {e}") from e
+        recorded = raw.get("digest")
+        if recorded is not None and recorded != art.digest:
+            raise ArtifactError(
+                f"plan artifact digest mismatch: recorded {recorded[:12]}…"
+                f" != computed {art.digest[:12]}… (edited by hand?)"
+            )
+        return art
+
+    def save(self, path: str) -> str:
+        """Atomically write the artifact (tmp + fsync + replace)."""
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+    def diff_plan(self, plan_dict: dict[str, Any]) -> list[str]:
+        """Field-level differences between a role's resolved plan and the
+        pinned winner, restricted to :data:`PINNED_PLAN_FIELDS`. The mesh
+        compares only axes either side sets > 1 (wildcard resolution may
+        differ in trivial axes)."""
+        diffs: list[str] = []
+        for key in PINNED_PLAN_FIELDS:
+            want, got = self.plan.get(key), plan_dict.get(key)
+            if key == "mesh":
+                want = {
+                    a: v for a, v in (want or {}).items() if int(v) != 1
+                }
+                got = {a: v for a, v in (got or {}).items() if int(v) != 1}
+            if want != got:
+                diffs.append(f"{key}: artifact={want!r} plan={got!r}")
+        return diffs
+
+
+def load_artifact(path: str) -> PlanArtifact:
+    """Load + digest-verify an artifact file (raises ArtifactError)."""
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ArtifactError(f"cannot read plan artifact {path!r}: {e}") from e
+    if not isinstance(raw, dict):
+        raise ArtifactError(f"plan artifact {path!r} is not a JSON object")
+    return PlanArtifact.from_dict(raw)
+
+
+def pinned_artifact_path() -> Optional[str]:
+    """The ``$TPX_PLAN_ARTIFACT`` pin, if set (submit-gate entry)."""
+    from torchx_tpu import settings
+
+    path = os.environ.get(settings.ENV_TPX_PLAN_ARTIFACT, "").strip()
+    return path or None

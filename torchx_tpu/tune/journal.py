@@ -1,0 +1,77 @@
+"""Fsync'd JSONL trial journal — a killed tune resumes, not restarts.
+
+One event per line, fsync'd after every append (a tune run is low-rate:
+tens of events, each potentially minutes apart — durability beats
+throughput here). Replay skips torn trailing lines (a kill mid-write
+leaves at most one), so resume sees exactly the completed events.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterator, Optional
+
+
+class TuneJournal:
+    """Append-only event journal for one tune run."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+    def append(self, event: dict[str, Any]) -> None:
+        """Durably append one event (mkdir + O_APPEND + flush + fsync)."""
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(event, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def replay(self) -> list[dict[str, Any]]:
+        """Every durably-written event, in order; torn lines skipped."""
+        out: list[dict[str, Any]] = []
+        try:
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        # a kill mid-append leaves one torn line; anything
+                        # after it was never acknowledged, so stop here
+                        break
+        except OSError:
+            pass
+        return out
+
+    def events(self, kind: str) -> Iterator[dict[str, Any]]:
+        """Replayed events of one kind."""
+        for e in self.replay():
+            if e.get("event") == kind:
+                yield e
+
+    def space_digest(self) -> Optional[str]:
+        """The space digest of the run this journal belongs to, if any."""
+        for e in self.events("enumerated"):
+            return str(e.get("space_digest", "")) or None
+        return None
+
+    def measured(self) -> dict[str, dict[str, Any]]:
+        """cid -> metrics for every trial with a durable ``measured``
+        event (the resume unit: a trial with only ``measure_start`` was
+        killed mid-flight and re-measures)."""
+        return {
+            str(e["cid"]): dict(e.get("metrics", {}))
+            for e in self.events("measured")
+        }
+
+    def reset(self) -> None:
+        """Discard the journal (space changed: a resume would lie)."""
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass

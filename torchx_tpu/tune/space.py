@@ -1,0 +1,195 @@
+"""Declarative search space for the config autotuner.
+
+A :class:`SearchSpace` is the cross product of the launcher-visible
+training knobs the cost model can reason about: mesh spec x remat policy
+x per-device batch x prefetch depth x int8 scope. Enumeration order is
+deterministic (itertools.product over the declared tuples), so candidate
+ids are stable across runs — the resumable journal and the plan-artifact
+digest both key off them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import json
+
+#: int8 scopes the trainer accepts ("none" = bf16 baseline; see
+#: models/llama.py int8_scope).
+INT8_SCOPES = ("none", "ffn", "all")
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point of the search space (all launcher-side knobs resolved)."""
+
+    config: str
+    mesh_spec: str
+    remat_policy: str
+    batch: int
+    seq: int
+    prefetch: int = 2
+    int8_scope: str = "none"
+
+    @property
+    def int8(self) -> bool:
+        """True when any int8 scope is enabled (the ``--int8`` flag)."""
+        return self.int8_scope != "none"
+
+    @property
+    def cid(self) -> str:
+        """Stable, human-readable candidate id (journal / artifact key)."""
+        return (
+            f"{self.config}|{self.mesh_spec}|{self.remat_policy}"
+            f"|b{self.batch}|s{self.seq}|pf{self.prefetch}"
+            f"|i8={self.int8_scope}"
+        )
+
+    def to_dict(self) -> dict:
+        """JSON-ready form (journal events, the plan artifact)."""
+        return {
+            "config": self.config,
+            "mesh_spec": self.mesh_spec,
+            "remat_policy": self.remat_policy,
+            "batch": self.batch,
+            "seq": self.seq,
+            "prefetch": self.prefetch,
+            "int8_scope": self.int8_scope,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Candidate":
+        """Inverse of :meth:`to_dict`."""
+        return cls(
+            config=str(d["config"]),
+            mesh_spec=str(d["mesh_spec"]),
+            remat_policy=str(d["remat_policy"]),
+            batch=int(d["batch"]),
+            seq=int(d["seq"]),
+            prefetch=int(d.get("prefetch", 2)),
+            int8_scope=str(d.get("int8_scope", "none")),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchSpace:
+    """The declarative config space one ``tpx tune`` run explores."""
+
+    config: str
+    mesh_specs: tuple[str, ...]
+    remat_policies: tuple[str, ...]
+    batches: tuple[int, ...]
+    seq: int
+    prefetch_depths: tuple[int, ...] = (2,)
+    int8_scopes: tuple[str, ...] = ("none",)
+    #: steps per measured trial (short seeded runs; step 1 is warmup)
+    measure_steps: int = 8
+
+    def __post_init__(self) -> None:
+        for s in self.int8_scopes:
+            if s not in INT8_SCOPES:
+                raise ValueError(
+                    f"int8_scope must be one of {INT8_SCOPES}, got {s!r}"
+                )
+        if not (self.mesh_specs and self.remat_policies and self.batches):
+            raise ValueError("search space has an empty axis")
+
+    def candidates(self) -> list[Candidate]:
+        """Deterministic enumeration (the declared tuple order)."""
+        return [
+            Candidate(
+                config=self.config,
+                mesh_spec=mesh,
+                remat_policy=policy,
+                batch=batch,
+                seq=self.seq,
+                prefetch=pf,
+                int8_scope=scope,
+            )
+            for mesh, policy, batch, pf, scope in itertools.product(
+                self.mesh_specs,
+                self.remat_policies,
+                self.batches,
+                self.prefetch_depths,
+                self.int8_scopes,
+            )
+        ]
+
+    def digest(self) -> str:
+        """Content digest — a resumed journal must match it."""
+        blob = json.dumps(self.to_dict(), sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        """JSON-ready form — also the digest's canonical content."""
+        return {
+            "config": self.config,
+            "mesh_specs": list(self.mesh_specs),
+            "remat_policies": list(self.remat_policies),
+            "batches": list(self.batches),
+            "seq": self.seq,
+            "prefetch_depths": list(self.prefetch_depths),
+            "int8_scopes": list(self.int8_scopes),
+            "measure_steps": self.measure_steps,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SearchSpace":
+        """Inverse of :meth:`to_dict` (CLI ``--space file.json`` entry)."""
+        return cls(
+            config=str(d["config"]),
+            mesh_specs=tuple(str(m) for m in d["mesh_specs"]),
+            remat_policies=tuple(str(p) for p in d["remat_policies"]),
+            batches=tuple(int(b) for b in d["batches"]),
+            seq=int(d["seq"]),
+            prefetch_depths=tuple(
+                int(p) for p in d.get("prefetch_depths", (2,))
+            ),
+            int8_scopes=tuple(str(s) for s in d.get("int8_scopes", ("none",))),
+            measure_steps=int(d.get("measure_steps", 8)),
+        )
+
+
+def bench_1b_space() -> SearchSpace:
+    """The 1B bench space: every knob bench.py hand-picks today.
+
+    The static funnel is expected to kill most of it — llama3_1b at
+    seq 2048 overruns a 16 GiB chip for most of the batch x remat grid
+    (TPX701), and the tp/sp specs cannot resolve on single-chip hosts
+    (TPX703) — which is exactly the point: zero device seconds spent
+    discovering what arithmetic already knows.
+    """
+    return SearchSpace(
+        config="llama3_1b",
+        mesh_specs=("fsdp=-1", "dp=-1", "fsdp=-1,tp=2", "fsdp=-1,sp=2"),
+        remat_policies=("dots", "dots_attn", "full"),
+        batches=(1, 2, 4, 8),
+        seq=2048,
+        prefetch_depths=(2, 4),
+        int8_scopes=("none", "ffn"),
+        measure_steps=12,
+    )
+
+
+def tiny_smoke_space() -> SearchSpace:
+    """<= 6 candidates for the tier-1 TUNE_SMOKE / CPU bench fallback.
+
+    ``tp=3`` cannot resolve onto a power-of-two device count, so static
+    pruning deterministically kills half the space with TPX703.
+    """
+    return SearchSpace(
+        config="tiny",
+        mesh_specs=("fsdp=-1", "tp=3"),
+        remat_policies=("full", "dots"),
+        batches=(8,),
+        seq=128,
+        measure_steps=2,
+    )
+
+
+#: Builtin spaces addressable by name from the CLI (`tpx tune --space`).
+BUILTIN_SPACES = {
+    "bench-1b": bench_1b_space,
+    "tiny-smoke": tiny_smoke_space,
+}
