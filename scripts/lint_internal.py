@@ -41,6 +41,10 @@ JAX_FREE = (
     "fleet",
     "tune",
     os.path.join("parallel", "mesh_config.py"),
+    # the telemetry plane runs inside the daemon and `tpx top`
+    os.path.join("obs", "telemetry.py"),
+    os.path.join("obs", "slo.py"),
+    os.path.join("obs", "stitch.py"),
 )
 
 #: functions inside schedulers/ allowed to call subprocess directly

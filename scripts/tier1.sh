@@ -510,4 +510,62 @@ assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
 EOF
 then echo "TUNE_SMOKE=ok"; else echo "TUNE_SMOKE=FAILED"; rc=1; fi
 rm -rf "$tune_dir"
+
+# Top smoke: boot `tpx control` with an SLO spec, render one `tpx top
+# --once` frame against it (header + slo line + metrics section), check
+# the --json snapshot parses, and keep the verb off the help fast path.
+top_dir=$(mktemp -d /tmp/tpx_top_smoke.XXXXXX)
+if timeout -k 10 120 env JAX_PLATFORMS=cpu TPX_OBS_DIR="$top_dir/obs" \
+    TPX_CONTROL_DIR="$top_dir/control" \
+    python - <<'EOF'
+import json, os, subprocess, sys, time
+
+ctl = os.environ["TPX_CONTROL_DIR"]
+daemon = subprocess.Popen(
+    [sys.executable, "-m", "torchx_tpu.cli.main", "control",
+     "--slo", "p99-ttft", "--scrape-interval", "0.2"],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+)
+try:
+    discovery = os.path.join(ctl, "control.json")
+    deadline = time.monotonic() + 60
+    while not os.path.exists(discovery):
+        assert daemon.poll() is None, daemon.stdout.read()
+        assert time.monotonic() < deadline, "daemon never wrote discovery"
+        time.sleep(0.1)
+    addr = json.load(open(discovery))["addr"]
+    env = dict(os.environ, TPX_CONTROL_ADDR=addr)
+    tpx = [sys.executable, "-m", "torchx_tpu.cli.main", "top"]
+    r = subprocess.run(tpx + ["--once"], capture_output=True, text=True,
+                       env=env, timeout=60)
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    assert r.stdout.startswith("tpx top —"), r.stdout
+    assert "slo:" in r.stdout, r.stdout
+    r = subprocess.run(tpx + ["--json"], capture_output=True, text=True,
+                       env=env, timeout=60)
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    snap = json.loads(r.stdout)
+    assert snap["alerts"]["enabled"] and "p99-ttft" in snap["alerts"]["slos"], snap
+finally:
+    daemon.terminate()
+    daemon.wait(timeout=10)
+
+# the top verb rides the lazy dispatcher: help never imports it (or jax)
+r = subprocess.run(
+    [sys.executable, "-c", (
+        "import sys\n"
+        "from torchx_tpu.cli.main import main\n"
+        "try: main(['--help'])\n"
+        "except SystemExit: pass\n"
+        "leaked = [m for m in ('jax', 'torchx_tpu.cli.cmd_top')"
+        " if m in sys.modules]\n"
+        "assert not leaked, f'tpx --help imported {leaked}'\n"
+    )],
+    capture_output=True, text=True, timeout=60,
+)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+assert "top" in r.stdout, r.stdout
+EOF
+then echo "TOP_SMOKE=ok"; else echo "TOP_SMOKE=FAILED"; rc=1; fi
+rm -rf "$top_dir"
 exit $rc

@@ -447,6 +447,35 @@ class TestPortAndMountRules:
         report = analyze(generate_server_disagg("llama3_1b"))
         assert "TPX213" not in codes(report)
 
+    def test_slo_on_unscrapable_backend_warns(self):
+        report = analyze(
+            app_with(args=["--slo", "p99-ttft"]), scheduler="tpu_vm"
+        )
+        assert "TPX214" in codes(report)
+        d = next(d for d in report.diagnostics if d.code == "TPX214")
+        assert d.severity == Severity.WARNING
+        assert "metricz_scrape" in d.message
+        assert "textfile" in d.hint
+
+    def test_slo_equals_form_and_metadata_detected(self):
+        report = analyze(app_with(args=["--slo=goodput"]), scheduler="tpu_vm")
+        assert "TPX214" in codes(report)
+        report = analyze(
+            app_with(metadata={"tpx/slo": "p99-ttft"}), scheduler="tpu_vm"
+        )
+        assert "TPX214" in codes(report)
+
+    def test_slo_on_scrapable_backend_is_silent(self):
+        for backend in ("local", "local_docker", "gke", "slurm"):
+            report = analyze(
+                app_with(args=["--slo", "p99-ttft"]), scheduler=backend
+            )
+            assert "TPX214" not in codes(report), backend
+
+    def test_no_slo_declared_is_silent(self):
+        report = analyze(app_with(), scheduler="tpu_vm")
+        assert "TPX214" not in codes(report)
+
     def test_duplicate_mount_dst(self):
         report = analyze(
             app_with(

@@ -43,6 +43,7 @@ class TestParser:
             "builtins",
             "configure",
             "tracker",
+            "top",
         ):
             assert expected in cmds, expected
 

@@ -19,7 +19,9 @@ from torchx_tpu.runner.api import Runner
 from torchx_tpu.runner.events import record
 from torchx_tpu.runner.events.api import TpxEvent
 from torchx_tpu.schedulers.api import DescribeAppResponse, Scheduler
+from torchx_tpu import settings
 from torchx_tpu.settings import (
+    ENV_TPX_METRICS_MIN_INTERVAL,
     ENV_TPX_PARENT_SPAN,
     ENV_TPX_SIMULATE_PREEMPTION_EXIT,
     ENV_TPX_TRACE,
@@ -312,6 +314,106 @@ class TestSinksAndTimeline:
         assert "_bucket" not in out
         out_all = timeline.render_metrics_table(rows, include_buckets=True)
         assert "tpx_launch_seconds_bucket" in out_all
+
+    def test_load_metrics_mixed_pid_dir_with_torn_tail(self, tmp_path):
+        d = tmp_path / "sess"
+        d.mkdir()
+        (d / "metrics-100.prom").write_text(
+            "# TYPE tpx_runs_total counter\ntpx_runs_total 3\n"
+        )
+        # a second process's file, its writer killed mid-line
+        (d / "metrics-200.prom").write_text(
+            "tpx_runs_total 4\ntpx_queue_depth 2\ntorn_met"
+        )
+        rows = timeline.load_metrics(str(d))
+        assert ("tpx_runs_total", "", 7.0) in rows  # per-pid files sum
+        assert ("tpx_queue_depth", "", 2.0) in rows
+        assert not any(n.startswith("torn") for n, _, _ in rows)
+
+
+# -- metrics flush debounce -------------------------------------------------
+
+
+class TestMetricsFlushDebounce:
+    def _record(self):
+        return logging.LogRecord(
+            "tpx", logging.INFO, __file__, 0, "{}", None, None
+        )
+
+    def test_burst_collapses_to_one_write(self, monkeypatch):
+        writes = []
+        monkeypatch.setattr(
+            sinks, "flush_metrics", lambda session=None: writes.append(1)
+        )
+        h = sinks.PromMetricsHandler(min_interval_s=60.0)
+        for _ in range(25):
+            h.emit(self._record())
+        assert len(writes) == 1  # first emit flushes, the burst defers
+        h.flush()
+        assert len(writes) == 2  # the deferred final state
+        h.flush()
+        assert len(writes) == 2  # nothing dirty: flush is a no-op
+
+    def test_writes_resume_after_the_interval(self, monkeypatch):
+        writes = []
+        monkeypatch.setattr(
+            sinks, "flush_metrics", lambda session=None: writes.append(1)
+        )
+        now = [0.0]
+        monkeypatch.setattr(sinks.time, "monotonic", lambda: now[0])
+        h = sinks.PromMetricsHandler(min_interval_s=2.0)
+        h.emit(self._record())
+        h.emit(self._record())
+        assert len(writes) == 1
+        now[0] = 5.0
+        h.emit(self._record())
+        assert len(writes) == 2
+
+    def test_close_writes_deferred_state(self, monkeypatch):
+        writes = []
+        monkeypatch.setattr(
+            sinks, "flush_metrics", lambda session=None: writes.append(1)
+        )
+        h = sinks.PromMetricsHandler(min_interval_s=60.0)
+        h.emit(self._record())
+        h.emit(self._record())
+        h.close()  # logging shutdown path
+        assert len(writes) == 2
+
+    def test_env_configures_interval(self, monkeypatch):
+        monkeypatch.setenv(ENV_TPX_METRICS_MIN_INTERVAL, "7.5")
+        assert sinks.PromMetricsHandler().min_interval_s == 7.5
+        monkeypatch.setenv(ENV_TPX_METRICS_MIN_INTERVAL, "junk")
+        assert (
+            sinks.PromMetricsHandler().min_interval_s
+            == settings.DEFAULT_METRICS_MIN_INTERVAL
+        )
+
+    def test_operator_alias(self):
+        assert sinks.MetricsFlushHandler is sinks.PromMetricsHandler
+
+
+# -- exposition round trip --------------------------------------------------
+
+
+class TestExpositionRoundTrip:
+    def test_registry_render_parses_back_exactly(self):
+        from torchx_tpu.obs.telemetry import parse_exposition
+
+        reg = MetricsRegistry()
+        c = reg.counter("rt_total", "help", ("path",))
+        c.inc(3, path='a"b\\c\nd')  # every escapable character
+        h = reg.histogram("rt_seconds", "help", buckets=(0.5,))
+        h.observe(0.1)
+        h.observe(2.0)
+        samples = parse_exposition(reg.render())
+        by = {(s.name, s.labels): s for s in samples}
+        counter = by[("rt_total", (("path", 'a"b\\c\nd'),))]
+        assert counter.value == 3.0 and counter.kind == "counter"
+        assert by[("rt_seconds_bucket", (("le", "0.5"),))].value == 1.0
+        assert by[("rt_seconds_bucket", (("le", "+Inf"),))].value == 2.0
+        assert by[("rt_seconds_count", ())].kind == "histogram"
+        assert by[("rt_seconds_sum", ())].value == pytest.approx(2.1)
 
 
 # -- the acceptance scenario ----------------------------------------------

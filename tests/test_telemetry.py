@@ -1,0 +1,865 @@
+"""Fleet telemetry plane tests: the Prometheus exposition parser, the
+bounded MetricStore and its query reducers, the scrape Collector, the SLO
+burn-rate engine with journaled alert transitions, cross-process trace
+stitching (the acceptance scenarios: ONE stitched trace for a
+disaggregated serve request, and a fleet job's lifecycle including the
+shrink and grow-back reshapes), the daemon's /v1/metrics/query +
+/v1/alerts endpoints, the burn-gated fleet market, the autoscaler's burn
+input, and the ``tpx top`` snapshot/render path."""
+
+import http.server
+import json
+import math
+import os
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from torchx_tpu.cli.cmd_top import build_snapshot, render_top
+from torchx_tpu.control.client import ControlClient, ControlClientError
+from torchx_tpu.control.daemon import ControlDaemon
+from torchx_tpu.fleet import FleetModel, FleetScheduler, GangRequest
+from torchx_tpu.obs import sinks, stitch, timeline
+from torchx_tpu.obs import trace as obs_trace
+from torchx_tpu.obs.slo import SLO_PRESETS, SloEngine, parse_slo
+from torchx_tpu.obs.telemetry import (
+    Collector,
+    MetricStore,
+    PromSample,
+    parse_exposition,
+    scrape_metricz,
+)
+from torchx_tpu.runner.api import get_runner
+from torchx_tpu.serve import kv_transfer
+from torchx_tpu.serve.pool import AutoscalePolicy, Autoscaler
+
+
+TTFT = "tpx_serve_ttft_seconds"
+
+
+def ttft_text(le_05: int, inf: int, le_01: int = 0) -> str:
+    """A TTFT histogram exposition: ``inf - le_05`` observations breach
+    the 500ms p99-ttft threshold."""
+    return (
+        f"# HELP {TTFT} time to first token\n"
+        f"# TYPE {TTFT} histogram\n"
+        f'{TTFT}_bucket{{le="0.1"}} {le_01}\n'
+        f'{TTFT}_bucket{{le="0.5"}} {le_05}\n'
+        f'{TTFT}_bucket{{le="+Inf"}} {inf}\n'
+        f"{TTFT}_sum {float(inf)}\n"
+        f"{TTFT}_count {inf}\n"
+    )
+
+
+def store_with_clock(t0: float = 0.0, capacity: int = 720):
+    clock = [t0]
+    return MetricStore(capacity=capacity, clock=lambda: clock[0]), clock
+
+
+# ---------------------------------------------------------------------------
+# exposition parsing
+# ---------------------------------------------------------------------------
+
+
+class TestParseExposition:
+    def test_typed_samples(self):
+        text = (
+            "# HELP tpx_runs_total runs\n"
+            "# TYPE tpx_runs_total counter\n"
+            'tpx_runs_total{scheduler="local"} 3\n'
+            "# TYPE tpx_queue_depth gauge\n"
+            "tpx_queue_depth 2.5\n"
+        )
+        samples = parse_exposition(text)
+        assert samples == [
+            PromSample(
+                "tpx_runs_total", (("scheduler", "local"),), 3.0, "counter"
+            ),
+            PromSample("tpx_queue_depth", (), 2.5, "gauge"),
+        ]
+
+    def test_histogram_family_inherits_kind(self):
+        samples = parse_exposition(ttft_text(10, 100))
+        assert all(s.kind == "histogram" for s in samples)
+        bucket = samples[2]
+        assert bucket.labels == (("le", "+Inf"),)
+        assert bucket.value == 100.0
+
+    def test_trailing_timestamp_and_inf_values(self):
+        samples = parse_exposition(
+            "a 1 1690000000\nb +Inf\nc -Inf\nd -3.5e-2\n"
+        )
+        assert [(s.name, s.value) for s in samples] == [
+            ("a", 1.0),
+            ("b", math.inf),
+            ("c", -math.inf),
+            ("d", -0.035),
+        ]
+
+    def test_label_escapes_and_brace_in_value(self):
+        text = 'm{msg="a\\"b\\\\c\\nd",shape="{2,4}"} 7\n'
+        (s,) = parse_exposition(text)
+        assert dict(s.labels) == {"msg": 'a"b\\c\nd', "shape": "{2,4}"}
+        assert s.value == 7.0
+
+    def test_torn_lines_skip_only_themselves(self):
+        text = (
+            "good 1\n"
+            'torn{a="trunca'  # no closing brace: writer died mid-line
+            "\n"
+            'half{a="x",b="tr} 2\n'  # torn INSIDE a quoted value
+            "bad_value nope\n"
+            "also_good 2\n"
+        )
+        samples = parse_exposition(text)
+        assert [s.name for s in samples] == ["good", "also_good"]
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+
+class TestMetricStore:
+    def test_latest_sums_across_sources(self):
+        store, _ = store_with_clock()
+        store.ingest_text("r0", "# TYPE c counter\nc 3\n")
+        store.ingest_text("r1", "# TYPE c counter\nc 4\n")
+        assert store.latest("c") == {(): 7.0}
+        assert store.kind_of("c") == "counter"
+        assert store.names() == ["c"]
+        assert len(store) == 2  # one per-source series each
+
+    def test_ring_buffer_is_bounded(self):
+        store, clock = store_with_clock(capacity=4)
+        for i in range(10):
+            clock[0] = float(i)
+            store.ingest_text("r0", f"g {i}\n")
+        doc = store.query("g")
+        (series,) = doc["series"]
+        assert len(series["points"]) == 4
+        assert series["points"][-1] == [9.0, 9.0]
+
+    def test_scalar_reducers(self):
+        store, clock = store_with_clock()
+        for i, v in enumerate([1.0, 5.0, 3.0]):
+            clock[0] = float(i * 10)
+            store.ingest_text("r0", f"g {v}\n")
+        clock[0] = 20.0
+        assert store.query("g", reduce="last")["result"][0]["value"] == 3.0
+        assert store.query("g", reduce="max")["result"][0]["value"] == 5.0
+        assert store.query("g", reduce="min")["result"][0]["value"] == 1.0
+        assert store.query("g", reduce="avg")["result"][0]["value"] == 3.0
+
+    def test_rate_survives_counter_reset(self):
+        store, clock = store_with_clock()
+        for t, v in [(0.0, 100.0), (10.0, 160.0), (20.0, 40.0)]:
+            clock[0] = t
+            store.ingest_text("r0", f"# TYPE c counter\nc {v}\n")
+        # increase = 60 (100->160) + 40 (post-reset value) = 100 over 20s
+        doc = store.query("c", reduce="rate", range_s=20.0)
+        assert doc["result"][0]["value"] == pytest.approx(5.0)
+
+    def test_percentile_from_bucket_deltas(self):
+        store, clock = store_with_clock()
+        store.ingest_text("r0", ttft_text(0, 0), ts=0.0)
+        # 90 of 100 new observations land in (0.1, 0.5]
+        store.ingest_text("r0", ttft_text(90, 100, le_01=0), ts=30.0)
+        clock[0] = 30.0
+        doc = store.query(TTFT, reduce="p50", range_s=60.0)
+        value = doc["result"][0]["value"]
+        assert 0.1 < value <= 0.5
+        # p99 rank falls in the +Inf bucket -> clamp to last finite bound
+        doc = store.query(TTFT, reduce="p99", range_s=60.0)
+        assert doc["result"][0]["value"] == pytest.approx(0.5)
+
+    def test_unknown_reducer_raises(self):
+        store, _ = store_with_clock()
+        store.ingest_text("r0", "g 1\n")
+        with pytest.raises(ValueError, match="unknown reducer"):
+            store.query("g", reduce="median")
+
+    def test_render_prom_round_trips_through_the_parser(self):
+        store, _ = store_with_clock()
+        text = (
+            "# TYPE tpx_requests_total counter\n"
+            'tpx_requests_total{status="ok",msg="a\\"b\\\\c"} 5\n'
+        )
+        store.ingest_text("r0", text)
+        store.ingest_text("r1", text)
+        reparsed = parse_exposition(store.render_prom())
+        (s,) = [r for r in reparsed if r.name == "tpx_requests_total"]
+        assert s.kind == "counter"
+        assert s.value == 10.0  # summed aggregate survived the round trip
+        assert dict(s.labels) == {"status": "ok", "msg": 'a"b\\c'}
+
+
+# ---------------------------------------------------------------------------
+# the collector
+# ---------------------------------------------------------------------------
+
+
+class _MetriczHandler(http.server.BaseHTTPRequestHandler):
+    body = "# TYPE up gauge\nup 1\n"
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        data = self.body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+@pytest.fixture
+def metricz_server():
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _MetriczHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestCollector:
+    def test_scrapes_http_targets(self, metricz_server, tmp_path):
+        store, _ = store_with_clock()
+        col = Collector(store, interval_s=999, obs_dir=str(tmp_path / "none"))
+        src = col.add_target(metricz_server, name="replica-0")
+        assert src == "replica-0"
+        assert col.collect_once() == 1
+        assert store.latest("up") == {(): 1.0}
+        assert col.errors == {}
+        assert scrape_metricz(metricz_server).startswith("# TYPE up")
+
+    def test_dead_target_is_data_not_an_exception(self, tmp_path):
+        store, _ = store_with_clock()
+        col = Collector(store, interval_s=999, obs_dir=str(tmp_path / "none"))
+        col.add_target("http://127.0.0.1:9", name="gone")
+        assert col.collect_once() == 0
+        assert "gone" in col.errors
+        assert col.remove_target("gone") is True
+        assert col.remove_target("gone") is False
+        assert col.targets() == {}
+
+    def test_tails_textfile_sessions_per_file(self, tmp_path):
+        root = tmp_path / "obsroot"
+        for session, pid, v in [("s1", 11, 3), ("s1", 22, 4), ("s2", 33, 5)]:
+            d = root / session
+            d.mkdir(parents=True, exist_ok=True)
+            (d / f"metrics-{pid}.prom").write_text(
+                f"# TYPE tpx_runs_total counter\ntpx_runs_total {v}\n"
+            )
+        store, _ = store_with_clock()
+        col = Collector(store, interval_s=999, obs_dir=str(root))
+        assert col.collect_once() == 3
+        # per-pid files are distinct sources; the read side sums them
+        assert store.latest("tpx_runs_total") == {(): 12.0}
+        assert len(store) == 3
+
+    def test_hooks_run_and_never_kill_the_cycle(self, tmp_path):
+        store, _ = store_with_clock()
+        col = Collector(store, interval_s=999, obs_dir=str(tmp_path / "none"))
+        seen = []
+        col.hooks.append(lambda: seen.append("ok"))
+        col.hooks.append(lambda: 1 / 0)
+        col.collect_once()
+        col.collect_once()
+        assert seen == ["ok", "ok"]
+        assert col.cycles == 2
+
+
+# ---------------------------------------------------------------------------
+# the SLO engine
+# ---------------------------------------------------------------------------
+
+
+class TestParseSlo:
+    def test_presets(self):
+        spec = parse_slo("p99-ttft")
+        assert spec.metric == TTFT and spec.kind == "latency"
+        assert spec.threshold_s == 0.5 and spec.objective == 0.99
+        for name in SLO_PRESETS:
+            parse_slo(name)  # every preset must parse
+
+    def test_latency_grammar_with_ms_suffix(self):
+        spec = parse_slo("fast:my_hist<250ms@0.95")
+        assert spec.threshold_s == 0.25
+        assert spec.budget == pytest.approx(0.05)
+
+    def test_ratio_grammar(self):
+        spec = parse_slo('gp:req_total{status="ok"}/req_total@0.999')
+        assert spec.kind == "ratio"
+        assert spec.good_labels == {"status": "ok"}
+
+    def test_rejects_garbage(self):
+        with pytest.raises(ValueError, match="unparseable SLO"):
+            parse_slo("nonsense")
+        with pytest.raises(ValueError, match="objective"):
+            parse_slo("x:m<1s@1.5")
+        with pytest.raises(ValueError, match="one metric"):
+            parse_slo("x:a/b@0.9")
+
+
+class TestSloEngine:
+    def engine(self, tmp_path, spec="p99-ttft"):
+        store, clock = store_with_clock()
+        journal = str(tmp_path / "slo_alerts.jsonl")
+        eng = SloEngine(
+            store, [parse_slo(spec)], journal_path=journal,
+            clock=lambda: clock[0],
+        )
+        return eng, store, clock, journal
+
+    def test_induced_regression_pages_once(self, tmp_path):
+        eng, store, clock, journal = self.engine(tmp_path)
+        store.ingest_text("r0", ttft_text(0, 0), ts=0.0)
+        # 90% of requests breach 500ms: burn 0.9/0.01 = 90 >> fast_burn
+        store.ingest_text("r0", ttft_text(10, 100), ts=50.0)
+        clock[0] = 50.0
+        (alert,) = eng.evaluate()
+        assert alert.severity == "page" and alert.state == "firing"
+        assert alert.burn_short >= 14 and alert.burn_long >= 14
+        assert [a.slo for a in eng.active()] == ["p99-ttft"]
+        assert eng.max_burn() >= 14
+        assert eng.max_burn("tpx_serve") >= 14
+        assert eng.max_burn("tpx_step") == 0.0
+        # still firing: burns refresh, nothing re-journaled
+        assert eng.evaluate() == []
+        lines = open(journal).read().splitlines()
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert rec["kind"] == "slo_alert" and rec["severity"] == "page"
+
+    def test_steady_run_trips_nothing(self, tmp_path):
+        eng, store, clock, journal = self.engine(tmp_path)
+        store.ingest_text("r0", ttft_text(0, 0), ts=0.0)
+        store.ingest_text("r0", ttft_text(100, 100), ts=50.0)  # all < 500ms
+        clock[0] = 50.0
+        assert eng.evaluate() == []
+        assert eng.active() == []
+        assert not os.path.exists(journal)  # no transition, no journal
+        assert eng.burns()["p99-ttft"] == (0.0, 0.0)
+
+    def test_recovery_journals_resolved(self, tmp_path):
+        eng, store, clock, journal = self.engine(tmp_path)
+        store.ingest_text("r0", ttft_text(0, 0), ts=0.0)
+        store.ingest_text("r0", ttft_text(10, 100), ts=50.0)
+        clock[0] = 50.0
+        eng.evaluate()
+        # a fast clean minute: the short window drops under the threshold
+        store.ingest_text("r0", ttft_text(1010, 1100), ts=700.0)
+        clock[0] = 700.0
+        (alert,) = eng.evaluate()
+        assert alert.state == "resolved"
+        assert eng.active() == []
+        kinds = [
+            json.loads(l)["state"] for l in open(journal).read().splitlines()
+        ]
+        assert kinds == ["firing", "resolved"]
+
+    def test_ratio_burn(self, tmp_path):
+        eng, store, clock, _ = self.engine(tmp_path, spec="goodput")
+        base = (
+            "# TYPE tpx_serve_requests_total counter\n"
+            'tpx_serve_requests_total{{status="ok"}} {ok}\n'
+            'tpx_serve_requests_total{{status="error"}} {err}\n'
+        )
+        store.ingest_text("r0", base.format(ok=1000, err=0), ts=0.0)
+        store.ingest_text("r0", base.format(ok=1990, err=10), ts=30.0)
+        clock[0] = 30.0
+        # 1% errors against a 0.1% budget: burn 10 -> warn, not page
+        (alert,) = eng.evaluate()
+        assert alert.severity == "warn"
+        short, long_ = eng.burns()["goodput"]
+        assert short == pytest.approx(10.0, rel=0.01)
+
+    def test_zero_traffic_is_zero_burn(self, tmp_path):
+        eng, _, clock, journal = self.engine(tmp_path)
+        clock[0] = 100.0
+        assert eng.evaluate() == []
+        assert eng.burns()["p99-ttft"] == (0.0, 0.0)
+        assert not os.path.exists(journal)
+
+
+# ---------------------------------------------------------------------------
+# stitching: the acceptance scenarios
+# ---------------------------------------------------------------------------
+
+
+def _split_sessions(names_to_move: set, other_session: str) -> None:
+    """Rewrite this process's trace.jsonl keeping only some spans, moving
+    the rest into a second session dir — simulating the decode replica's
+    separate obs session without a second process."""
+    path = sinks.trace_path()
+    records = timeline.load_records(path)
+    keep, move = [], []
+    for r in records:
+        (move if r.get("name") in names_to_move else keep).append(r)
+    with open(path, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in keep)
+    other = os.path.join(sinks.obs_root(), other_session)
+    os.makedirs(other, exist_ok=True)
+    with open(os.path.join(other, sinks.TRACE_FILE), "a") as f:
+        f.writelines(json.dumps(r) + "\n" for r in move)
+
+
+def make_payload(request_id: str) -> kv_transfer.KvPayload:
+    kv = np.zeros((1, 1, 1, 1, 1), dtype=np.float32)
+    return kv_transfer.KvPayload(
+        request_id=request_id,
+        tokens=[1, 2],
+        generated=[3],
+        cache_len=2,
+        max_new_tokens=4,
+        temperature=0.0,
+        seed=0,
+        eos_id=None,
+        block_size=1,
+        k=kv,
+        v=kv,
+    )
+
+
+class TestStitchServeRequest:
+    def test_disagg_request_is_one_stitched_trace(self):
+        rid = "req-stitch-01"
+        # router: open the request span, stamp the HTTP headers
+        with obs_trace.span("serve.route", request_id=rid):
+            headers = obs_trace.inject_headers({})
+        # prefill replica: adopt the header context, stamp the payload
+        payload = make_payload(rid)
+        tid, sid = obs_trace.extract_headers(headers)
+        with obs_trace.trace_context(tid, sid):
+            with obs_trace.span("serve.prefill", request_id=rid):
+                kv_transfer.stamp_trace(payload)
+        assert payload.trace_id == tid
+        # transfer + decode: only the payload's trace context crosses
+        with kv_transfer.payload_span(payload, "serve.kv_transfer"):
+            pass
+        with kv_transfer.payload_span(payload, "serve.decode"):
+            pass
+        # decode's spans live in ANOTHER session dir
+        _split_sessions({"serve.kv_transfer", "serve.decode"}, "tpx_decode")
+
+        records, _ = stitch.collect_records()
+        assert stitch.resolve_trace_ids(records, rid) == [tid]  # exactly one
+        st = stitch.stitch(rid)
+        assert st is not None and st.trace_id == tid
+        assert st.span_count == 4
+        assert len(st.sessions) == 2
+        (root,) = st.roots
+        assert root.span.name == "serve.route"
+        (prefill,) = root.children
+        assert prefill.span.name == "serve.prefill"
+        assert sorted(c.span.name for c in prefill.children) == [
+            "serve.decode",
+            "serve.kv_transfer",
+        ]
+        rendered = stitch.render_stitched(st)
+        assert "4 spans from 2 sessions" in rendered
+        assert "serve.kv_transfer" in rendered
+
+    def test_unstamped_payload_spans_do_not_join(self):
+        rid = "req-stitch-02"
+        with obs_trace.span("serve.route", request_id=rid) as route:
+            pass
+        payload = make_payload(rid)  # never stamped: pre-trace sender
+        with kv_transfer.payload_span(payload, "serve.decode") as sp:
+            assert sp.trace_id != route.trace_id
+
+    def test_stitch_unknown_ident_is_none(self):
+        assert stitch.stitch("no-such-request") is None
+
+
+def fleet_fixture(tmp_path, spec="sim:v5e-1x4"):
+    class FakeExec:
+        def __init__(self):
+            self.n = 0
+            self.calls = []
+
+        def schedule(self, job, mesh_spec):
+            self.n += 1
+            self.calls.append((job.req.job, job.cur_replicas, mesh_spec))
+            return f"local://fake/app-{self.n}"
+
+        def cancel(self, handle):
+            self.calls.append(("cancel", handle))
+
+    clock = [0.0]
+    fs = FleetScheduler(
+        FleetModel.from_spec(spec),
+        state_dir=str(tmp_path),
+        clock=lambda: clock[0],
+    )
+    ex = FakeExec()
+    fs.bind(ex)
+    return fs, ex, clock
+
+
+def terminal_event(app_id: str, state: str = "SUCCEEDED"):
+    return types.SimpleNamespace(
+        scheduler="local",
+        app_id=app_id,
+        terminal=True,
+        state=types.SimpleNamespace(name=state),
+    )
+
+
+class TestStitchFleetJob:
+    def test_lifecycle_includes_shrink_and_growback(self, tmp_path):
+        fs, ex, _ = fleet_fixture(tmp_path / "fleet")
+        fs.submit(
+            GangRequest(
+                job="batchjob",
+                tenant="research",
+                klass="batch",
+                replicas=4,
+                chips_per_replica=1,
+                elastic=True,
+                mesh="fsdp=-1",
+                min_replicas=1,
+            ),
+            {"scheduler": "local"},
+        )
+        fs.submit(
+            GangRequest(
+                job="servejob",
+                tenant="prod",
+                klass="serve",
+                replicas=2,
+                chips_per_replica=1,
+            ),
+            {"scheduler": "local"},
+        )
+        assert fs.reshapes == 1
+        fs.on_event(terminal_event("app-3"))  # serve done -> grow back
+        assert fs.grows == 1
+
+        st = stitch.stitch("batchjob")
+        assert st is not None
+        assert st.trace_id == fs.job("batchjob").recipe["trace_id"]
+        spans = []
+
+        def walk(node):
+            spans.append(node.span)
+            for c in node.children:
+                walk(c)
+
+        for r in st.roots:
+            walk(r)
+        names = [s.name for s in spans]
+        assert "fleet.submit" in names and "fleet.place" in names
+        directions = [
+            s.attrs.get("direction")
+            for s in spans
+            if s.name == "fleet.reshape"
+        ]
+        assert sorted(directions) == ["grow", "shrink"]
+        assert all(s.attrs.get("fleet_job") == "batchjob" for s in spans)
+
+        # the serve gang owns its own distinct trace
+        st2 = stitch.stitch("servejob")
+        assert st2 is not None and st2.trace_id != st.trace_id
+        names2 = {r.span.name for r in st2.roots}
+        assert "fleet.terminal" in names2
+
+    def test_trace_id_survives_rehydration(self, tmp_path):
+        fs, _, _ = fleet_fixture(tmp_path / "fleet")
+        fs.submit(
+            GangRequest(
+                job="jobx", tenant="t", klass="batch",
+                replicas=1, chips_per_replica=1,
+            ),
+            {"scheduler": "local"},
+        )
+        tid = fs.job("jobx").recipe["trace_id"]
+        fs2, _, _ = fleet_fixture(tmp_path / "fleet")
+        assert fs2.rehydrate() >= 1
+        assert fs2.job("jobx").recipe["trace_id"] == tid
+
+
+# ---------------------------------------------------------------------------
+# the burn-gated market + autoscaler input
+# ---------------------------------------------------------------------------
+
+
+class TestGentleMarket:
+    def submit_pair(self, fs):
+        low = fs.submit(
+            GangRequest(
+                job="spotjob", tenant="spot", klass="preemptible",
+                replicas=2, chips_per_replica=1,
+            ),
+            {"scheduler": "local"},
+        )
+        high = fs.submit(
+            GangRequest(
+                job="devjob", tenant="dev", klass="interactive",
+                replicas=2, chips_per_replica=1,
+            ),
+            {"scheduler": "local"},
+        )
+        return low, high
+
+    def test_healthy_budgets_defer_checkpoint_kills(self, tmp_path):
+        fs, ex, _ = fleet_fixture(tmp_path / "fleet", spec="sim:v5e-1x2")
+        fs.set_slo_signal(lambda: 0.3)
+        low, high = self.submit_pair(fs)
+        assert high["status"] == "queued"
+        assert fs.kills == 0
+        assert fs.job("spotjob").state == "running"
+        assert ("cancel", "local://fake/app-1") not in ex.calls
+
+    def test_burning_budget_runs_the_full_market(self, tmp_path):
+        fs, ex, _ = fleet_fixture(tmp_path / "fleet", spec="sim:v5e-1x2")
+        fs.set_slo_signal(lambda: 1.5)
+        low, high = self.submit_pair(fs)
+        assert high["status"] == "placed"
+        assert fs.kills == 1
+        assert fs.job("spotjob").state == "queued"
+
+    def test_no_signal_means_no_gating(self, tmp_path):
+        fs, ex, _ = fleet_fixture(tmp_path / "fleet", spec="sim:v5e-1x2")
+        assert fs._gentle_market() is False
+        low, high = self.submit_pair(fs)
+        assert high["status"] == "placed" and fs.kills == 1
+
+    def test_failing_probe_means_no_gating(self, tmp_path):
+        fs, _, _ = fleet_fixture(tmp_path / "fleet", spec="sim:v5e-1x2")
+
+        def boom():
+            raise RuntimeError("telemetry down")
+
+        fs.set_slo_signal(boom)
+        assert fs._gentle_market() is False
+
+    def test_elastic_shrinks_still_run_under_gentle(self, tmp_path):
+        fs, _, _ = fleet_fixture(tmp_path / "fleet")
+        fs.set_slo_signal(lambda: 0.2)
+        fs.submit(
+            GangRequest(
+                job="batchjob", tenant="r", klass="batch",
+                replicas=4, chips_per_replica=1,
+                elastic=True, mesh="fsdp=-1", min_replicas=1,
+            ),
+            {"scheduler": "local"},
+        )
+        high = fs.submit(
+            GangRequest(
+                job="servejob", tenant="prod", klass="serve",
+                replicas=2, chips_per_replica=1,
+            ),
+            {"scheduler": "local"},
+        )
+        assert high["status"] == "placed"
+        assert fs.reshapes == 1 and fs.kills == 0
+
+
+class TestAutoscalerBurnInput:
+    def policy(self):
+        return AutoscalePolicy(
+            min_replicas=1, max_replicas=4, up_streak=1,
+            down_streak=1, cooldown_s=0.0,
+        )
+
+    def test_burning_counts_as_hot_even_when_calm(self):
+        asc = Autoscaler(self.policy(), clock=lambda: 0.0)
+        assert asc.observe(2, queue_depth=0.0, burn_rate=2.0) == 3
+
+    def test_burning_vetoes_scale_down(self):
+        asc = Autoscaler(self.policy(), clock=lambda: 0.0)
+        # calm queue + intact budgets: the normal scale-down fires
+        assert asc.observe(2, queue_depth=0.0, burn_rate=0.2) == 1
+
+    def test_no_signal_preserves_depth_behavior(self):
+        asc = Autoscaler(self.policy(), clock=lambda: 0.0)
+        assert asc.observe(2, queue_depth=10.0) == 3
+
+
+# ---------------------------------------------------------------------------
+# daemon endpoints + tpx top
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def tel_daemon(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPX_WATCH_INTERVAL", "0.05")
+    # _ingest_self folds the process-global registry into the store;
+    # give the daemon a fresh one so metrics recorded by earlier tests
+    # in this process don't sum into the queries below
+    from torchx_tpu.obs import metrics as obs_metrics
+
+    monkeypatch.setattr(obs_metrics, "REGISTRY", obs_metrics.MetricsRegistry())
+    d = ControlDaemon(
+        runner=get_runner("telemetry-test"),
+        state_dir=str(tmp_path / "control"),
+        slos=["p99-ttft"],
+        scrape_interval=999.0,
+    ).start()
+    yield d
+    d.close()
+    d.runner.close()
+
+
+class TestDaemonTelemetryPlane:
+    def test_query_alerts_and_top_see_a_regression(self, tel_daemon):
+        import time as _time
+
+        d = tel_daemon
+        client = ControlClient(d.addr, d.root_token)
+        now = _time.time()
+        d.telemetry_store.ingest_text("replica-0", ttft_text(0, 0), ts=now - 30)
+        d.telemetry_store.ingest_text("replica-0", ttft_text(10, 100), ts=now)
+
+        names = client.metrics_query()["names"]
+        assert f"{TTFT}_bucket" in names
+        doc = client.metrics_query(name=TTFT, reduce="p99", range_s=600.0)
+        # the p99 rank lands in the +Inf bucket: clamped to the last
+        # finite bound, i.e. exactly the breached 500ms threshold
+        assert doc["result"]
+        assert doc["result"][0]["value"] == pytest.approx(0.5)
+
+        # no evaluation yet: specs known, nothing firing
+        reply = client.alerts()
+        assert reply["enabled"] and reply["slos"] == ["p99-ttft"]
+        assert reply["alerts"] == []
+
+        d.slo_engine.evaluate()
+        reply = client.alerts()
+        (alert,) = reply["alerts"]
+        assert alert["severity"] == "page" and alert["state"] == "firing"
+        assert reply["burns"]["p99-ttft"]["long"] >= 14
+        assert os.path.exists(
+            os.path.join(d.state_dir, "slo_alerts.jsonl")
+        )
+
+        # the same regression surfaces in the tpx top frame
+        snap = build_snapshot(client)
+        frame = render_top(snap)
+        assert frame.startswith("tpx top —")
+        assert "[PAGE] p99-ttft burning" in frame
+        # and in the scalar the autoscaler/market consume
+        assert d.slo_engine.max_burn("tpx_serve") >= 14
+
+    def test_scrape_target_registration(self, tel_daemon, metricz_server):
+        client = ControlClient(tel_daemon.addr, tel_daemon.root_token)
+        reply = client.add_scrape_target(metricz_server, name="r0")
+        assert reply["source"] == "r0"
+        assert reply["targets"] == {"r0": metricz_server}
+        tel_daemon.collector.collect_once()
+        assert tel_daemon.telemetry_store.latest("up") == {(): 1.0}
+        assert client.remove_scrape_target("r0")["ok"] is True
+        with pytest.raises(ControlClientError):
+            client.remove_scrape_target("r0")
+
+    def test_bad_reducer_is_a_clean_400(self, tel_daemon):
+        client = ControlClient(tel_daemon.addr, tel_daemon.root_token)
+        tel_daemon.telemetry_store.ingest_text("r0", "g 1\n")
+        with pytest.raises(ControlClientError) as ei:
+            client.metrics_query(name="g", reduce="median")
+        assert "unknown reducer" in str(ei.value)
+
+    def test_metricz_serves_the_fleet_aggregate(self, tel_daemon):
+        tel_daemon.telemetry_store.ingest_text(
+            "r0", "# TYPE up gauge\nup 1\n"
+        )
+        tel_daemon.telemetry_store.ingest_text(
+            "r1", "# TYPE up gauge\nup 1\n"
+        )
+        body = tel_daemon.render_metricz()
+        (s,) = [r for r in parse_exposition(body) if r.name == "up"]
+        assert s.value == 2.0 and s.kind == "gauge"
+
+
+class TestTopSnapshot:
+    def fake_client(self, **overrides):
+        def default_metrics_query(name=None, labels=None, reduce=None, range_s=None):
+            if name is None:
+                return {"names": [TTFT]}
+            return {
+                "result": [{"labels": {}, "value": 0.123}],
+            }
+
+        client = types.SimpleNamespace(
+            addr="127.0.0.1:7171",
+            healthz=lambda: {"status": "ok", "jobs": 2, "fleet": True},
+            queue=lambda: {"enabled": False},
+            alerts=lambda: {
+                "enabled": True,
+                "alerts": [],
+                "burns": {"p99-ttft": {"short": 0.0, "long": 0.1}},
+                "slos": ["p99-ttft"],
+            },
+            metrics_query=default_metrics_query,
+        )
+        for k, v in overrides.items():
+            setattr(client, k, v)
+        return client
+
+    def test_snapshot_composes_all_sections(self):
+        snap = build_snapshot(self.fake_client())
+        assert snap["health"]["jobs"] == 2
+        (panel,) = snap["metrics"]["panels"]
+        assert panel["title"] == "p99 TTFT"
+        frame = render_top(snap)
+        assert frame.startswith("tpx top — 127.0.0.1:7171  jobs 2  fleet on")
+        assert "slo: 1 spec(s), no alerts" in frame
+        assert "burn: p99-ttft 0.0/0.1" in frame
+        assert "p99 TTFT" in frame and "0.123" in frame
+
+    def test_sections_degrade_independently(self):
+        def broken():
+            raise ControlClientError(500, "boom")
+
+        snap = build_snapshot(self.fake_client(queue=broken))
+        assert snap["queue"] == {"error": "boom"}
+        assert snap["health"]["jobs"] == 2  # other sections intact
+        frame = render_top(snap)
+        assert "fleet: error: boom" in frame
+
+    def test_render_tolerates_nan_and_fleet_rows(self):
+        snap = {
+            "ts": 0,
+            "addr": "a:1",
+            "health": {"jobs": 0, "fleet": True},
+            "alerts": {"enabled": False},
+            "queue": {
+                "enabled": True,
+                "fleet": {"chips_free": 1, "chips_total": 4},
+                "market": {"reshapes": 1, "growbacks": 0, "kills": 2},
+                "running": [
+                    {
+                        "job": "j1", "class": "batch", "replicas": 2,
+                        "launch_replicas": 4, "shrunk": True,
+                    }
+                ],
+                "queue": [
+                    {"position": 1, "job": "j2", "class": "serve",
+                     "replicas": 2}
+                ],
+            },
+            "metrics": {
+                "panels": [
+                    {
+                        "title": "p99 TTFT",
+                        "result": [
+                            {"labels": {}, "value": float("nan")}
+                        ],
+                    },
+                    {"title": "req rate", "result": []},
+                ]
+            },
+        }
+        frame = render_top(snap)
+        assert "slo: telemetry plane disabled" in frame
+        assert "fleet: 1/4 chips free" in frame
+        assert "shrinks 1 grows 0 kills 2" in frame
+        assert "SHRUNK 2/4" in frame
+        assert "wait #1" in frame
+        assert "-" in frame  # NaN renders as a dash

@@ -645,6 +645,45 @@ def check_serve_disagg(ctx: RuleContext) -> Iterator[Diagnostic]:
         )
 
 
+@rule("serve_slo")
+def check_serve_slo(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX214: a role declaring SLO specs (``--slo`` args or ``tpx/slo``
+    metadata) on a backend whose capability profile has no ``/metricz``
+    scrape path. The telemetry plane's burn rates come from scraping
+    replica metrics; on an unreachable backend every SLO over replica
+    metrics sees zero samples, so the burn stays zero and the alert can
+    never fire — a silent no-op, hence a WARNING before submit."""
+    from torchx_tpu.obs.slo import ROLE_METADATA_KEY as SLO_METADATA_KEY
+
+    cap = ctx.capabilities
+    if ctx.scheduler is None or cap is None or cap.metricz_scrape:
+        return
+    for role in ctx.app.roles:
+        args = [str(a) for a in role.args]
+        has_slo = any(
+            a == "--slo" or a.startswith("--slo=") for a in args
+        ) or bool(role.metadata.get(SLO_METADATA_KEY))
+        if not has_slo:
+            continue
+        yield Diagnostic(
+            code="TPX214",
+            severity=Severity.WARNING,
+            role=role.name,
+            field="args",
+            message=(
+                f"role declares SLO specs but scheduler"
+                f" {ctx.scheduler!r} has no /metricz scrape path"
+                " (metricz_scrape=False); burn rates over replica"
+                " metrics will stay zero and the alerts can never fire"
+            ),
+            hint=(
+                "target a scrape-reachable backend (local, docker, gke,"
+                " slurm), or push metrics via the obs textfile sink and"
+                " drop the replica-scrape SLOs"
+            ),
+        )
+
+
 @rule("mounts")
 def check_mounts(ctx: RuleContext) -> Iterator[Diagnostic]:
     """TPX220-TPX221: duplicate destinations and relative paths in mounts."""

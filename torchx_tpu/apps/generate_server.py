@@ -344,7 +344,12 @@ class GenerateService:
             raise TransferRejected("decode replica draining; requeue")
         with self._count_lock:
             self.requests += 1
-        return serve_kv_payload(self._engine, payload)
+        # decode inside the handoff's originating trace, so the request's
+        # stitched timeline covers router -> prefill -> transfer -> decode
+        from torchx_tpu.serve.kv_transfer import payload_span
+
+        with payload_span(payload, "serve.decode"):
+            return serve_kv_payload(self._engine, payload)
 
     # -- batcher thread ----------------------------------------------------
 
@@ -852,13 +857,23 @@ def _make_handler(service: GenerateService):
                     self._stream(tokens[0], req, text_mode)
                     return
                 eos = req.get("eos_id")
-                out, timing = service.generate_timed(
-                    tokens,
-                    max_new_tokens=int(req.get("max_new_tokens", 16)),
-                    temperature=float(req.get("temperature", 0.0)),
-                    seed=int(req.get("seed", 0)),
-                    eos_id=None if eos is None else int(eos),
-                )
+                # adopt the router's trace context (HTTP headers): the
+                # replica's span — and, on a prefill role, the KV
+                # transfer it triggers — join the request's one trace
+                from torchx_tpu.obs import trace as obs_trace
+
+                tid, sid = obs_trace.extract_headers(self.headers)
+                with obs_trace.trace_context(tid, sid):
+                    with obs_trace.span(
+                        f"serve.{service.serve_role}", sequences=len(tokens)
+                    ):
+                        out, timing = service.generate_timed(
+                            tokens,
+                            max_new_tokens=int(req.get("max_new_tokens", 16)),
+                            temperature=float(req.get("temperature", 0.0)),
+                            seed=int(req.get("seed", 0)),
+                            eos_id=None if eos is None else int(eos),
+                        )
                 if text_mode:
                     self._reply(
                         200,

@@ -62,6 +62,24 @@ class CmdControl(SubCommand):
             help="per-tenant chip quota for the fleet scheduler"
             " (repeatable; tenants without one are unlimited)",
         )
+        subparser.add_argument(
+            "--slo",
+            action="append",
+            default=None,
+            metavar="SPEC",
+            help="SLO spec the telemetry plane evaluates as burn rates"
+            " (repeatable): name:metric<thresh@obj,"
+            " name:metric{k=v}/metric@obj, or a preset"
+            " (p99-ttft, goodput, step-time, gang-wait)",
+        )
+        subparser.add_argument(
+            "--scrape-interval",
+            type=float,
+            default=None,
+            metavar="SECONDS",
+            help="telemetry collector cycle"
+            " (default $TPX_TELEMETRY_INTERVAL or 5s)",
+        )
 
     def run(self, args: argparse.Namespace) -> None:
         from torchx_tpu.control.daemon import ControlDaemon, control_dir
@@ -82,6 +100,8 @@ class CmdControl(SubCommand):
             state_dir=args.state_dir,
             tenant_cap=args.tenant_cap,
             fleet=fleet,
+            slos=args.slo,
+            scrape_interval=args.scrape_interval,
         )
         recovered = len(daemon.store)
         print(
@@ -96,6 +116,12 @@ class CmdControl(SubCommand):
                 f" {len(snap['fleet']['pools'])} pool(s),"
                 f" {len(snap['queue'])} queued /"
                 f" {len(snap['running'])} running rehydrated",
+                flush=True,
+            )
+        if daemon.slo_engine is not None and daemon.slo_engine.specs:
+            print(
+                "  slo: "
+                + ", ".join(s.name for s in daemon.slo_engine.specs),
                 flush=True,
             )
         print(f"  export TPX_CONTROL_ADDR={daemon.addr}", flush=True)

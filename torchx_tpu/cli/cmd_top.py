@@ -1,0 +1,239 @@
+"""``tpx top`` — a live fleet dashboard over the control daemon.
+
+Composes one screenful from the daemon's telemetry plane: the health
+probe, the fleet queue snapshot, active SLO alerts with their burn
+rates, and a few headline metric reductions (p99 TTFT, request rate,
+step time, gang wait) from ``/v1/metrics/query``. ``--once`` prints a
+single snapshot and exits (scripts/tests); the default is a
+clear-and-redraw refresh loop until Ctrl-C.
+
+Finds the daemon like every other proxied verb — ``$TPX_CONTROL_ADDR``
+or the discovery file (``require_env=False``). Pure stdlib: the render
+path is jax-free and testable as :func:`render_top` over a plain dict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any
+
+from torchx_tpu.cli.cmd_base import SubCommand
+
+#: Headline reductions shown when the metric exists in the store:
+#: (title, metric name, reducer, window seconds).
+TOP_PANELS: list[tuple[str, str, str, float]] = [
+    ("p99 TTFT", "tpx_serve_ttft_seconds", "p99", 60.0),
+    ("req rate", "tpx_serve_requests_total", "rate", 60.0),
+    ("p95 step time", "tpx_step_seconds", "p95", 300.0),
+    ("p95 gang wait", "tpx_fleet_gang_wait_seconds", "p95", 600.0),
+]
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def build_snapshot(client: Any) -> dict:
+    """One ``tpx top`` frame as a plain dict (the ``--json`` body).
+
+    Every section degrades independently: a failing daemon verb becomes
+    an ``{"error": ...}`` section instead of killing the dashboard."""
+    from torchx_tpu.control.client import ControlClientError
+
+    snap: dict[str, Any] = {"ts": time.time(), "addr": client.addr}
+    for key, fetch in (
+        ("health", client.healthz),
+        ("queue", client.queue),
+        ("alerts", client.alerts),
+    ):
+        try:
+            snap[key] = fetch()
+        except ControlClientError as e:
+            snap[key] = {"error": e.message}
+    panels = []
+    try:
+        names = set(client.metrics_query().get("names", []))
+        for title, name, reduce_, range_s in TOP_PANELS:
+            # a histogram's series are its _bucket/_sum/_count components;
+            # the base name itself never appears in the store's name list
+            if name not in names and f"{name}_bucket" not in names:
+                continue
+            reply = client.metrics_query(
+                name=name, reduce=reduce_, range_s=range_s
+            )
+            panels.append(
+                {
+                    "title": title,
+                    "name": name,
+                    "reduce": reduce_,
+                    "range_s": range_s,
+                    "result": reply.get("result", []),
+                }
+            )
+    except ControlClientError as e:
+        snap["metrics"] = {"error": e.message}
+    else:
+        snap["metrics"] = {"panels": panels}
+    return snap
+
+
+def _fmt_labels(labels: Any) -> str:
+    if not labels:
+        return ""
+    items = ",".join(f"{k}={v}" for k, v in sorted(dict(labels).items()))
+    return "{" + items + "}"
+
+
+def _fmt_value(value: Any) -> str:
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return str(value)
+    if v != v:  # NaN (not enough samples in the window)
+        return "-"
+    return f"{v:.4g}"
+
+
+def render_top(snap: dict) -> str:
+    """Render one snapshot dict to the dashboard text (pure, jax-free)."""
+    lines = []
+    stamp = time.strftime("%H:%M:%S", time.localtime(snap.get("ts", 0)))
+    health = snap.get("health", {})
+    if "error" in health:
+        head = f"daemon UNREACHABLE ({health['error']})"
+    else:
+        head = (
+            f"jobs {health.get('jobs', 0)}"
+            f"  fleet {'on' if health.get('fleet') else 'off'}"
+        )
+    lines.append(f"tpx top — {snap.get('addr', '?')}  {head}  {stamp}")
+
+    alerts = snap.get("alerts", {})
+    if "error" in alerts:
+        lines.append(f"slo: error: {alerts['error']}")
+    elif not alerts.get("enabled"):
+        lines.append("slo: telemetry plane disabled")
+    else:
+        active = alerts.get("alerts", [])
+        if active:
+            for a in active:
+                lines.append(
+                    f"slo: [{str(a.get('severity', '')).upper()}]"
+                    f" {a.get('slo')} burning"
+                    f" {a.get('burn_short')}x/{a.get('burn_long')}x"
+                    " (short/long)"
+                )
+        else:
+            lines.append(f"slo: {len(alerts.get('slos', []))} spec(s), no alerts")
+        burns = alerts.get("burns", {})
+        if burns:
+            lines.append(
+                "burn: "
+                + "  ".join(
+                    f"{name} {b.get('short')}/{b.get('long')}"
+                    for name, b in sorted(burns.items())
+                )
+            )
+
+    queue = snap.get("queue", {})
+    if "error" in queue:
+        lines.append(f"fleet: error: {queue['error']}")
+    elif queue.get("enabled"):
+        fleet = queue.get("fleet", {})
+        market = queue.get("market", {})
+        lines.append(
+            f"fleet: {fleet.get('chips_free')}/{fleet.get('chips_total')}"
+            f" chips free | running {len(queue.get('running', []))}"
+            f" queued {len(queue.get('queue', []))}"
+            f" | shrinks {market.get('reshapes', 0)}"
+            f" grows {market.get('growbacks', 0)}"
+            f" kills {market.get('kills', 0)}"
+        )
+        for r in queue.get("running", []):
+            shape = (
+                f"SHRUNK {r.get('replicas')}/{r.get('launch_replicas')}"
+                if r.get("shrunk")
+                else f"x{r.get('replicas')}"
+            )
+            lines.append(
+                f"  run  {str(r.get('job', '')):<12}"
+                f" {str(r.get('class', '')):<12} {shape}"
+            )
+        for q in queue.get("queue", []):
+            lines.append(
+                f"  wait #{q.get('position'):<3}"
+                f" {str(q.get('job', '')):<12}"
+                f" {str(q.get('class', '')):<12} x{q.get('replicas')}"
+            )
+
+    metrics = snap.get("metrics", {})
+    if "error" in metrics:
+        lines.append(f"metrics: error: {metrics['error']}")
+    else:
+        panels = metrics.get("panels", [])
+        if panels:
+            lines.append("metrics:")
+        for panel in panels:
+            results = panel.get("result", [])
+            if not results:
+                lines.append(f"  {panel['title']:<16} -")
+                continue
+            for entry in results:
+                lines.append(
+                    f"  {panel['title']:<16}"
+                    f" {_fmt_value(entry.get('value')):>10}"
+                    f"  {_fmt_labels(entry.get('labels'))}"
+                )
+    return "\n".join(lines)
+
+
+class CmdTop(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument(
+            "--once",
+            action="store_true",
+            help="print one snapshot and exit (no screen clearing)",
+        )
+        subparser.add_argument(
+            "--interval",
+            type=float,
+            default=2.0,
+            metavar="SECONDS",
+            help="refresh period for the live loop (default 2s)",
+        )
+        subparser.add_argument(
+            "--json",
+            action="store_true",
+            help="print one raw snapshot as JSON and exit",
+        )
+
+    def run(self, args: argparse.Namespace) -> None:
+        from torchx_tpu.control.client import ControlClientError, maybe_client
+
+        try:
+            client = maybe_client(require_env=False)
+        except ControlClientError as e:
+            print(f"top: {e.message}", file=sys.stderr)
+            sys.exit(1)
+        if client is None:
+            print(
+                "top: no control daemon found (start `tpx control` or set"
+                " TPX_CONTROL_ADDR)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if args.json:
+            print(json.dumps(build_snapshot(client), indent=2, sort_keys=True))
+            return
+        if args.once:
+            print(render_top(build_snapshot(client)))
+            return
+        try:
+            while True:
+                frame = render_top(build_snapshot(client))
+                sys.stdout.write(_CLEAR + frame + "\n")
+                sys.stdout.flush()
+                time.sleep(max(0.2, args.interval))
+        except KeyboardInterrupt:
+            print()

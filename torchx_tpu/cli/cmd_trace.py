@@ -59,6 +59,13 @@ class CmdTrace(SubCommand):
             help="with --metrics: include histogram _bucket series",
         )
         subparser.add_argument(
+            "--stitch",
+            action="store_true",
+            help="stitch one timeline across ALL session dirs (router,"
+            " replicas, KV transfer, fleet daemon); identifier may also"
+            " be a serve request_id or fleet job name",
+        )
+        subparser.add_argument(
             "--obs-dir",
             default=None,
             help="obs root to search (default: $TPX_OBS_DIR or"
@@ -67,6 +74,10 @@ class CmdTrace(SubCommand):
 
     def run(self, args: argparse.Namespace) -> None:
         from torchx_tpu.obs import timeline
+
+        if args.stitch:
+            self._run_stitch(args)
+            return
 
         files = list(timeline.iter_trace_files(args.obs_dir))
         if not files:
@@ -114,6 +125,26 @@ class CmdTrace(SubCommand):
         if args.metrics:
             rows: list[tuple[str, str, float]] = []
             for d in session_dirs:
+                rows.extend(timeline.load_metrics(d))
+            print()
+            print(
+                timeline.render_metrics_table(
+                    rows, include_buckets=args.buckets
+                )
+            )
+
+    def _run_stitch(self, args: argparse.Namespace) -> None:
+        from torchx_tpu.obs import stitch, timeline
+
+        ident = _app_id_of(args.identifier)
+        st = stitch.stitch(ident, obs_dir=args.obs_dir)
+        if st is None:
+            print(f"no trace found for: {args.identifier}", file=sys.stderr)
+            sys.exit(1)
+        print(stitch.render_stitched(st, include_events=args.events))
+        if args.metrics:
+            rows: list[tuple[str, str, float]] = []
+            for d in st.sessions:
                 rows.extend(timeline.load_metrics(d))
             print()
             print(

@@ -48,6 +48,7 @@ BUILTIN_CMDS: dict[str, tuple[str, str]] = {
     "serve-pool": ("torchx_tpu.cli.cmd_serve_pool", "CmdServePool"),
     "control": ("torchx_tpu.cli.cmd_control", "CmdControl"),
     "queue": ("torchx_tpu.cli.cmd_queue", "CmdQueue"),
+    "top": ("torchx_tpu.cli.cmd_top", "CmdTop"),
 }
 
 

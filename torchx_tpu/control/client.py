@@ -165,6 +165,49 @@ class ControlClient:
         (``{"enabled": false}`` when the daemon has no fleet)."""
         return self._request("/v1/queue")
 
+    def metrics_query(
+        self,
+        name: Optional[str] = None,
+        labels: Optional[dict] = None,
+        reduce: Optional[str] = None,
+        range_s: Optional[float] = None,
+    ) -> dict:
+        """Query the daemon's telemetry plane (``/v1/metrics/query``).
+
+        No ``name`` lists the known metric names; with one, returns the
+        raw windowed series plus the reducer's per-label-set scalars
+        (``reduce`` = last/sum/avg/max/min/rate/pNN)."""
+        from urllib.parse import quote
+
+        parts = []
+        if name:
+            parts.append(f"name={quote(name, safe='')}")
+        if reduce:
+            parts.append(f"reduce={quote(reduce, safe='')}")
+        if range_s is not None:
+            parts.append(f"range={range_s:g}")
+        for k, v in (labels or {}).items():
+            parts.append(f"label.{quote(k, safe='')}={quote(str(v), safe='')}")
+        return self._request(
+            "/v1/metrics/query" + ("?" + "&".join(parts) if parts else "")
+        )
+
+    def alerts(self) -> dict:
+        """Active SLO alerts + last burn rates (``/v1/alerts``)."""
+        return self._request("/v1/alerts")
+
+    def add_scrape_target(self, url: str, name: Optional[str] = None) -> dict:
+        """Register a replica ``/metricz`` URL with the daemon's
+        collector; returns ``{"source", "targets"}``."""
+        payload: dict = {"url": url}
+        if name:
+            payload["name"] = name
+        return self._request("/v1/metrics/targets", payload)
+
+    def remove_scrape_target(self, name: str) -> dict:
+        """Drop a scrape target by source name."""
+        return self._request("/v1/metrics/targets", {"remove": name})
+
     def status(self, handle: str) -> dict:
         """One job's recorded state: answered from the daemon's
         reconciler journal + shared describe cache, not a fresh backend

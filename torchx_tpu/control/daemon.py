@@ -24,6 +24,22 @@ API (JSON; Bearer-token auth on every ``/v1`` route):
     GET  /v1/logs?handle=&role=&k= -> JSONL line stream (log attach)
     GET  /v1/queue                -> fleet queue + placements snapshot
                                   ({"enabled": false} without --fleet)
+    GET  /v1/metrics/query?name=&reduce=&range=&label.K=V
+                                  -> telemetry series + reduced scalars
+                                  (no ``name``: {"names": [...]})
+    GET  /v1/alerts               -> active SLO alerts + last burn rates
+    POST /v1/metrics/targets {"url", "name"?, "remove"?}
+                                  -> register/remove a /metricz scrape
+
+The daemon also hosts the fleet **telemetry plane**: a
+:class:`~torchx_tpu.obs.telemetry.Collector` scrapes registered replica
+``/metricz`` targets and every obs session's textfiles into a bounded
+:class:`~torchx_tpu.obs.telemetry.MetricStore` (plus the daemon's own
+registry, source ``control``), ``/metricz`` serves the cross-source
+aggregate, and an optional :class:`~torchx_tpu.obs.slo.SloEngine`
+(``--slo`` specs) evaluates burn rates each cycle, journals alert
+transitions to ``state_dir/slo_alerts.jsonl``, and feeds the fleet
+market its SLO signal.
 
 Security model: the daemon binds loopback only. At start it mints a root
 token and records ``{"addr", "token", "pid"}`` in a 0600 discovery file
@@ -123,6 +139,11 @@ class _FleetExecutor:
         for role in app.roles:
             role.env[settings.ENV_TPX_FLEET_JOB] = job.req.job
             role.env[settings.ENV_TPX_FLEET_CLASS] = job.req.klass
+            # every attempt of a gang (first place, preempt-requeue,
+            # shrink/grow reshape) joins the job's journaled trace, so
+            # `tpx trace --stitch <job>` sees one lifecycle timeline
+            if recipe.get("trace_id"):
+                role.env[settings.ENV_TPX_TRACE_ID] = str(recipe["trace_id"])
             if mesh_spec:
                 role.env[settings.ENV_TPX_MESH] = mesh_spec
             else:
@@ -174,6 +195,14 @@ class ControlDaemon:
         fleet: an optional :class:`~torchx_tpu.fleet.api.FleetScheduler`;
             the daemon binds itself as its executor, subscribes it to the
             watch stream, and rehydrates its journal.
+        slos: SLO spec strings/objects (see
+            :func:`torchx_tpu.obs.slo.parse_slo`) the telemetry plane
+            evaluates each collect cycle.
+        scrape_interval: collector cycle seconds (default
+            ``$TPX_TELEMETRY_INTERVAL`` or
+            :data:`~torchx_tpu.settings.DEFAULT_TELEMETRY_INTERVAL`).
+        telemetry: set False to run without the collector/SLO plane
+            (``/metricz`` then serves only the daemon's own registry).
     """
 
     def __init__(
@@ -184,6 +213,9 @@ class ControlDaemon:
         state_dir: Optional[str] = None,
         tenant_cap: Optional[int] = None,
         fleet: Optional[Any] = None,
+        slos: Optional[list] = None,
+        scrape_interval: Optional[float] = None,
+        telemetry: bool = True,
     ) -> None:
         if runner is None:
             from torchx_tpu.runner.api import get_runner
@@ -210,8 +242,42 @@ class ControlDaemon:
         self._thread: Optional[threading.Thread] = None
         self._serving = False
         self._closed = False
+        self.telemetry_store: Optional[Any] = None
+        self.collector: Optional[Any] = None
+        self.slo_engine: Optional[Any] = None
+        if telemetry:
+            from torchx_tpu.obs.slo import SloEngine, SloSpec, parse_slo
+            from torchx_tpu.obs.telemetry import Collector, MetricStore
+
+            self.telemetry_store = MetricStore()
+            self.collector = Collector(
+                self.telemetry_store, interval_s=scrape_interval
+            )
+            # the daemon's own registry is a first-class source: control
+            # verbs, fleet gauges, and gang-wait histograms flow through
+            # obs_metrics.REGISTRY in this process
+            self.collector.hooks.append(self._ingest_self)
+            specs = [
+                s if isinstance(s, SloSpec) else parse_slo(str(s))
+                for s in (slos or [])
+            ]
+            self.slo_engine = SloEngine(
+                self.telemetry_store,
+                specs,
+                journal_path=os.path.join(self.state_dir, "slo_alerts.jsonl"),
+            )
+            self.collector.hooks.append(lambda: self.slo_engine.evaluate())
         self.fleet = fleet
         if fleet is not None:
+            if self.slo_engine is not None and hasattr(
+                fleet, "set_slo_signal"
+            ):
+                # market input: the worst long-window burn across
+                # fleet-scoped SLOs (gang wait, step time)
+                engine = self.slo_engine
+                fleet.set_slo_signal(
+                    lambda: engine.max_burn(metric_prefix="tpx_")
+                )
             fleet.bind(_FleetExecutor(self))
             self.reconciler.subscribe(fleet.on_event)
             fleet.rehydrate()
@@ -264,6 +330,8 @@ class ControlDaemon:
     def start(self) -> "ControlDaemon":
         """Write the discovery file and serve on a background thread."""
         self._write_discovery()
+        if self.collector is not None:
+            self.collector.start()
         self._serving = True
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="tpx-control", daemon=True
@@ -275,6 +343,8 @@ class ControlDaemon:
     def serve_forever(self) -> None:
         """Foreground mode (what ``tpx control`` runs)."""
         self._write_discovery()
+        if self.collector is not None:
+            self.collector.start()
         logger.info("tpx control serving on %s", self.addr)
         self._serving = True
         try:
@@ -289,6 +359,8 @@ class ControlDaemon:
         if self._closed:
             return
         self._closed = True
+        if self.collector is not None:
+            self.collector.stop()
         if self._serving:
             # shutdown() blocks on the serve loop acknowledging — never
             # call it on a server whose serve_forever was never entered
@@ -605,6 +677,94 @@ class ControlDaemon:
             raise _DaemonError(400, f"missing query parameter {key!r}")
         return str(vals[0])
 
+    # -- telemetry plane ---------------------------------------------------
+
+    def _ingest_self(self) -> None:
+        """Fold this process's own registry into the store (source
+        ``control``) — collector hook AND pre-read refresh, so the
+        aggregate never lags the daemon's own counters."""
+        if self.telemetry_store is not None:
+            self.telemetry_store.ingest_text(
+                "control", obs_metrics.REGISTRY.render()
+            )
+
+    def _require_telemetry(self) -> Any:
+        if self.telemetry_store is None:
+            raise _DaemonError(
+                501, "telemetry plane disabled on this daemon"
+            )
+        return self.telemetry_store
+
+    def _op_metrics_query(self, tenant: str, query: dict) -> dict:
+        """``/v1/metrics/query``: ``name`` (omit to list), ``reduce``
+        (last/sum/avg/max/min/rate/pNN), ``range`` seconds, and
+        ``label.K=V`` filters."""
+        store = self._require_telemetry()
+        self._ingest_self()
+        names = query.get("name") or []
+        if not names or not names[0]:
+            return {"names": store.names()}
+        labels = {
+            k[len("label.") :]: vals[0]
+            for k, vals in query.items()
+            if k.startswith("label.") and vals
+        }
+        raw_range = query.get("range", [None])[0]
+        try:
+            range_s = float(raw_range) if raw_range else None
+        except ValueError as e:
+            raise _DaemonError(400, f"bad range: {raw_range!r}") from e
+        reduce = query.get("reduce", [None])[0] or None
+        try:
+            return store.query(
+                str(names[0]),
+                labels=labels or None,
+                reduce=reduce,
+                range_s=range_s,
+            )
+        except ValueError as e:
+            raise _DaemonError(400, str(e)) from e
+
+    def _op_alerts(self, tenant: str, query: dict) -> dict:
+        if self.slo_engine is None:
+            return {"enabled": False, "alerts": [], "burns": {}}
+        return {
+            "enabled": True,
+            "alerts": [a.to_json() for a in self.slo_engine.active()],
+            "burns": {
+                name: {"short": round(s, 3), "long": round(l, 3)}
+                for name, (s, l) in sorted(self.slo_engine.burns().items())
+            },
+            "slos": [s.name for s in self.slo_engine.specs],
+        }
+
+    def _op_metrics_targets(self, tenant: str, req: dict) -> dict:
+        """Register (``{"url", "name"?}``) or drop (``{"remove": name}``)
+        a replica ``/metricz`` scrape target."""
+        self._require_telemetry()
+        assert self.collector is not None
+        remove = str(req.get("remove") or "")
+        if remove:
+            if not self.collector.remove_target(remove):
+                raise _DaemonError(404, f"unknown scrape target {remove!r}")
+            return {"ok": True, "targets": self.collector.targets()}
+        url = str(req.get("url") or "")
+        if not url.startswith(("http://", "https://")):
+            raise _DaemonError(400, f"scrape url must be http(s): {url!r}")
+        name = req.get("name")
+        source = self.collector.add_target(
+            url, name=str(name) if name else None
+        )
+        return {"source": source, "targets": self.collector.targets()}
+
+    def render_metricz(self) -> str:
+        """The ``/metricz`` body: the cross-source fleet aggregate when
+        the telemetry plane is up, else just this process's registry."""
+        if self.telemetry_store is None:
+            return obs_metrics.REGISTRY.render()
+        self._ingest_self()
+        return self.telemetry_store.render_prom()
+
     # -- HTTP plumbing -----------------------------------------------------
 
     def _make_handler(self) -> Any:
@@ -678,7 +838,7 @@ class ControlDaemon:
                         },
                     )
                 elif url.path == "/metricz":
-                    text = obs_metrics.REGISTRY.render().encode()
+                    text = daemon.render_metricz().encode()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type", "text/plain; version=0.0.4"
@@ -686,6 +846,18 @@ class ControlDaemon:
                     self.send_header("Content-Length", str(len(text)))
                     self.end_headers()
                     self.wfile.write(text)
+                elif url.path == "/v1/metrics/query":
+                    self._run(
+                        "metrics_query",
+                        lambda: daemon._op_metrics_query(
+                            self._tenant(), query
+                        ),
+                    )
+                elif url.path == "/v1/alerts":
+                    self._run(
+                        "alerts",
+                        lambda: daemon._op_alerts(self._tenant(), query),
+                    )
                 elif url.path == "/v1/status":
                     self._run(
                         "status",
@@ -725,6 +897,13 @@ class ControlDaemon:
                     self._run(
                         "cancel",
                         lambda: daemon._op_cancel(self._tenant(), self._body()),
+                    )
+                elif url.path == "/v1/metrics/targets":
+                    self._run(
+                        "metrics_targets",
+                        lambda: daemon._op_metrics_targets(
+                            self._tenant(), self._body()
+                        ),
                     )
                 else:
                     self._reply(404, {"error": f"unknown path {url.path}"})

@@ -25,6 +25,7 @@ scheduler implementation.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import threading
@@ -156,6 +157,9 @@ class FleetScheduler:
         self.reshapes = 0  # shrinks executed (kills avoided)
         self.grows = 0
         self.kills = 0  # checkpoint-preempts (non-elastic victims)
+        # telemetry-plane burn-rate probe (set_slo_signal); None = no
+        # telemetry, market behaves as before
+        self._slo_signal: Optional[Callable[[], Optional[float]]] = None
 
     # -- wiring ------------------------------------------------------------
 
@@ -167,6 +171,26 @@ class FleetScheduler:
         """The per-job attempt ledger (``submitted`` entries carry the
         ``$TPX_MESH`` of every reshape, PR 7 style)."""
         return AttemptLedger(job, root=self._ledger_root)
+
+    def set_slo_signal(
+        self, fn: Callable[[], Optional[float]]
+    ) -> None:
+        """Attach the telemetry plane's burn-rate probe (the daemon wires
+        its :class:`~torchx_tpu.obs.slo.SloEngine` here). While the worst
+        long-window burn stays below 1.0 — error budget not actually
+        burning — the market executes elastic shrinks only and defers
+        checkpoint-preempt kills; at or past 1.0 the full market runs."""
+        self._slo_signal = fn
+
+    @contextlib.contextmanager
+    def _job_span(self, job: FleetJob, name: str, **attrs: Any):
+        """Emit one lifecycle span inside the gang's own journaled trace,
+        tagged ``fleet_job`` so ``tpx trace --stitch <job>`` resolves it
+        by name."""
+        tid = str(job.recipe.get("trace_id") or "") or None
+        with obs_trace.trace_context(tid):
+            with obs_trace.span(name, fleet_job=job.req.job, **attrs) as sp:
+                yield sp
 
     # -- submit ------------------------------------------------------------
 
@@ -187,21 +211,29 @@ class FleetScheduler:
             job = FleetJob(
                 req=req, recipe=dict(recipe or {}), seq=seq, enqueued_at=now
             )
+            # One trace per gang lifecycle. Stamping the id into the
+            # journaled recipe makes it survive daemon restarts AND lets
+            # the executor export $TPX_TRACE_ID into the gang's env, so
+            # replica spans land in the same stitched timeline.
+            job.recipe.setdefault("trace_id", obs_trace.new_trace_id())
             self._jobs[req.job] = job
-            self.journal.append(
-                "submit",
-                job=req.job,
-                seq=seq,
-                tenant=req.tenant,
-                klass=req.klass,
-                replicas=req.replicas,
-                chips_per_replica=req.chips_per_replica,
-                elastic=req.elastic,
-                mesh=req.mesh,
-                min_replicas=req.min_replicas,
-                recipe=job.recipe,
-            )
-            self.queue.push(req, now, seq=seq)
+            with self._job_span(
+                job, "fleet.submit", klass=req.klass, replicas=req.replicas
+            ):
+                self.journal.append(
+                    "submit",
+                    job=req.job,
+                    seq=seq,
+                    tenant=req.tenant,
+                    klass=req.klass,
+                    replicas=req.replicas,
+                    chips_per_replica=req.chips_per_replica,
+                    elastic=req.elastic,
+                    mesh=req.mesh,
+                    min_replicas=req.min_replicas,
+                    recipe=job.recipe,
+                )
+                self.queue.push(req, now, seq=seq)
             self._schedule_loop()
             return self._submit_reply(job)
 
@@ -265,7 +297,8 @@ class FleetScheduler:
             job.reason = getattr(event.state, "name", str(event.state))
             self.model.release_job(job_id)
             job.units = []
-            self.journal.append("terminal", job=job_id, state=job.reason)
+            with self._job_span(job, "fleet.terminal", state=job.reason):
+                self.journal.append("terminal", job=job_id, state=job.reason)
             self._schedule_loop()
 
     def running_handles(self) -> list[str]:
@@ -358,6 +391,10 @@ class FleetScheduler:
         actions = plan_market(
             job.req.replicas - free_suitable, job.req.priority, victims
         )
+        if actions and self._gentle_market():
+            # SLO budgets are healthy: defer the expensive checkpoint
+            # kills and take only the elastic shrinks this pass.
+            actions = [a for a in actions if isinstance(a, Shrink)]
         if not actions:
             return False
         with obs_trace.span(
@@ -380,6 +417,19 @@ class FleetScheduler:
         if decision.placed:
             self._place(job, decision.units)
         return True
+
+    def _gentle_market(self) -> bool:
+        """True when the telemetry plane reports every SLO burning below
+        1.0 — budgets intact, so preemption kills can wait. No signal
+        (or a failing probe) means no gating: full market."""
+        if self._slo_signal is None:
+            return False
+        try:
+            burn = self._slo_signal()
+        except Exception:  # noqa: BLE001 - telemetry must not wedge placement
+            logger.debug("fleet: slo signal probe failed", exc_info=True)
+            return False
+        return burn is not None and burn < 1.0
 
     def _pass_growback(self) -> bool:
         """Repay shrink debts, highest class / oldest first, when free
@@ -414,18 +464,21 @@ class FleetScheduler:
         uids = [u.uid for u in units]
         job.cur_replicas = job.req.replicas
         job.debt = 0
-        self.journal.append(
-            "place",
-            job=job.req.job,
-            units=uids,
-            replicas=job.cur_replicas,
-        )
-        self.queue.remove(job.req.job)
-        self.model.assign(uids, job.req.job)
-        job.units = uids
-        if not self._try_schedule(job, mesh_spec=None):
-            return
-        job.state = RUNNING
+        with self._job_span(
+            job, "fleet.place", replicas=job.cur_replicas, units=len(uids)
+        ):
+            self.journal.append(
+                "place",
+                job=job.req.job,
+                units=uids,
+                replicas=job.cur_replicas,
+            )
+            self.queue.remove(job.req.job)
+            self.model.assign(uids, job.req.job)
+            job.units = uids
+            if not self._try_schedule(job, mesh_spec=None):
+                return
+            job.state = RUNNING
         waited = max(0.0, self.clock() - job.enqueued_at)
         obs_metrics.FLEET_GANG_WAIT_SECONDS.observe(
             waited, klass=job.req.klass
@@ -440,26 +493,33 @@ class FleetScheduler:
         spec = self._mesh_spec_for(job, to_replicas)
         keep = job.units[:to_replicas]
         freed = job.units[to_replicas:]
-        self.journal.append(
-            "reshape",
-            job=job.req.job,
+        with self._job_span(
+            job,
+            "fleet.reshape",
             direction=kind,
             replicas=to_replicas,
-            mesh=spec,
-            units=keep,
             beneficiary=beneficiary,
-        )
-        old_handle = job.handle
-        self._unmap_handle(old_handle)
-        if self._executor is not None and old_handle:
-            self._executor.cancel(old_handle)
-        self.model.release(freed)
-        job.units = keep
-        job.cur_replicas = to_replicas
-        job.debt = (
-            job.req.replicas if to_replicas < job.req.replicas else 0
-        )
-        self._try_schedule(job, mesh_spec=spec)
+        ):
+            self.journal.append(
+                "reshape",
+                job=job.req.job,
+                direction=kind,
+                replicas=to_replicas,
+                mesh=spec,
+                units=keep,
+                beneficiary=beneficiary,
+            )
+            old_handle = job.handle
+            self._unmap_handle(old_handle)
+            if self._executor is not None and old_handle:
+                self._executor.cancel(old_handle)
+            self.model.release(freed)
+            job.units = keep
+            job.cur_replicas = to_replicas
+            job.debt = (
+                job.req.replicas if to_replicas < job.req.replicas else 0
+            )
+            self._try_schedule(job, mesh_spec=spec)
         if kind == "shrink":
             self.reshapes += 1
             obs_metrics.FLEET_PREEMPTIONS.inc(kind="shrink")
@@ -489,21 +549,22 @@ class FleetScheduler:
     def _checkpoint_preempt(self, job: FleetJob, beneficiary: str) -> None:
         """Non-elastic victim: cancel and requeue at its original class
         position (priority-ordered requeue)."""
-        self.journal.append(
-            "requeue", job=job.req.job, beneficiary=beneficiary
-        )
-        old_handle = job.handle
-        self._unmap_handle(old_handle)
-        if self._executor is not None and old_handle:
-            self._executor.cancel(old_handle)
-        self.model.release_job(job.req.job)
-        job.units = []
-        job.handle = ""
-        job.cur_replicas = 0
-        job.debt = 0
-        job.state = QUEUED
-        job.enqueued_at = self.clock()
-        self.queue.push(job.req, job.enqueued_at, seq=job.seq)
+        with self._job_span(job, "fleet.requeue", beneficiary=beneficiary):
+            self.journal.append(
+                "requeue", job=job.req.job, beneficiary=beneficiary
+            )
+            old_handle = job.handle
+            self._unmap_handle(old_handle)
+            if self._executor is not None and old_handle:
+                self._executor.cancel(old_handle)
+            self.model.release_job(job.req.job)
+            job.units = []
+            job.handle = ""
+            job.cur_replicas = 0
+            job.debt = 0
+            job.state = QUEUED
+            job.enqueued_at = self.clock()
+            self.queue.push(job.req, job.enqueued_at, seq=job.seq)
         self.kills += 1
         obs_metrics.FLEET_PREEMPTIONS.inc(kind="requeue")
         logger.info(

@@ -16,7 +16,19 @@ The subsystem answers "where did my launch time go" end to end:
   Prometheus-textfile metrics exporter, shared with ``TpxEvent`` through
   the events-logger pipeline;
 * :mod:`torchx_tpu.obs.timeline` — reads it all back for
-  ``tpx trace <app-handle>``.
+  ``tpx trace <app-handle>``;
+* :mod:`torchx_tpu.obs.telemetry` — the fleet telemetry plane: the
+  control daemon's collector scrapes replica ``/metricz`` endpoints and
+  tails textfile sessions into bounded ring-buffer series, served back
+  as an aggregated fleet ``/metricz`` and a ``/v1/metrics/query`` JSON
+  API (``tpx top`` renders it);
+* :mod:`torchx_tpu.obs.slo` — declarative SLO specs evaluated as
+  multi-window burn rates with journaled alert transitions; the serve
+  autoscaler and the fleet market consume the burn signal;
+* :mod:`torchx_tpu.obs.stitch` — cross-process trace stitching: the
+  trace context crosses HTTP hops (``X-Tpx-Trace-Id``), KV-transfer
+  payloads, and fleet gang env, and ``tpx trace --stitch`` reassembles
+  the one timeline per request or fleet-job lifecycle.
 """
 
 from torchx_tpu.obs.metrics import (

@@ -26,6 +26,8 @@ from __future__ import annotations
 import logging
 import os
 import tempfile
+import threading
+import time
 from typing import Optional
 
 from torchx_tpu import settings
@@ -94,15 +96,71 @@ class JsonlTraceHandler(logging.Handler):
 
 
 class PromMetricsHandler(logging.Handler):
-    """Logging handler that re-renders the metrics textfile on every event
-    — for operators who point ``$TPX_EVENT_DESTINATION=prom`` at a node
-    exporter's textfile directory and want metrics without traces."""
+    """Logging handler keeping the metrics textfile current — for
+    operators who point ``$TPX_EVENT_DESTINATION=prom`` at a node
+    exporter's textfile directory and want metrics without traces.
+
+    Flushes are DEBOUNCED: re-rendering the full registry per event is
+    O(metrics) disk work, and a burst (a supervisor restarting a gang, a
+    serve pool draining) can emit hundreds of events in a second. The
+    first event of a quiet period flushes immediately; later events
+    inside ``min_interval_s`` (``$TPX_METRICS_MIN_INTERVAL``, default
+    2s) only mark the registry dirty, and the next emit past the
+    interval — or :meth:`flush`/:meth:`close`, which ``logging`` calls
+    at shutdown — writes the final state. Nothing is ever lost: the
+    textfile is a snapshot of the whole registry, so one deferred write
+    covers every skipped one."""
+
+    def __init__(self, min_interval_s: Optional[float] = None) -> None:
+        super().__init__()
+        if min_interval_s is None:
+            raw = os.environ.get(settings.ENV_TPX_METRICS_MIN_INTERVAL, "")
+            try:
+                min_interval_s = float(raw) if raw else None
+            except ValueError:
+                min_interval_s = None
+        self.min_interval_s = (
+            settings.DEFAULT_METRICS_MIN_INTERVAL
+            if min_interval_s is None
+            else float(min_interval_s)
+        )
+        self._lock_flush = threading.Lock()
+        self._last_flush = -float("inf")  # monotonic stamp of last write
+        self._dirty = False
 
     def emit(self, record: logging.LogRecord) -> None:
         try:
+            with self._lock_flush:
+                now = time.monotonic()
+                if now - self._last_flush < self.min_interval_s:
+                    self._dirty = True
+                    return
+                self._last_flush = now
+                self._dirty = False
             flush_metrics()
         except Exception:  # noqa: BLE001
             self.handleError(record)
+
+    def flush(self) -> None:
+        """Write any debounce-deferred state now (logging shutdown and
+        tests call this — the 'final flush' of the burst)."""
+        with self._lock_flush:
+            if not self._dirty:
+                return
+            self._dirty = False
+            self._last_flush = time.monotonic()
+        try:
+            flush_metrics()
+        except Exception:  # noqa: BLE001 - never break shutdown
+            pass
+
+    def close(self) -> None:
+        self.flush()
+        super().close()
+
+
+#: alias matching the handler's role name in operator docs/issues.
+MetricsFlushHandler = PromMetricsHandler
 
 
 def flush_metrics(session: Optional[str] = None) -> Optional[str]:

@@ -35,6 +35,12 @@ from torchx_tpu.util.times import epoch_usec
 #: record-discriminator value in the shared JSONL stream ("kind" key).
 SPAN_KIND = "span"
 
+#: HTTP headers carrying trace context across service hops (router →
+#: replica, client → daemon) — the header-shaped twin of ``$TPX_TRACE_ID``
+#: / ``$TPX_PARENT_SPAN``.
+HDR_TRACE_ID = "X-Tpx-Trace-Id"
+HDR_PARENT_SPAN = "X-Tpx-Parent-Span"
+
 _CURRENT: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
     "tpx_current_span", default=None
 )
@@ -156,7 +162,9 @@ def start_span(name: str, session: str = "", **attrs: Any) -> tuple[Optional[Spa
     parent = _CURRENT.get()
     if parent is not None:
         trace_id: str = parent.trace_id
-        parent_id: Optional[str] = parent.span_id
+        # an anchor from trace_context() may carry an empty span id
+        # (remote trace known, remote span not): parent on nothing then
+        parent_id: Optional[str] = parent.span_id or None
     else:
         trace_id = os.environ.get(settings.ENV_TPX_TRACE_ID) or new_trace_id()
         parent_id = os.environ.get(settings.ENV_TPX_PARENT_SPAN) or None
@@ -208,6 +216,59 @@ def span(name: str, session: str = "", **attrs: Any) -> Iterator[Optional[Span]]
         raise
     else:
         end_span(sp, token)
+
+
+@contextmanager
+def trace_context(
+    trace_id: Optional[str], parent_span_id: Optional[str] = None
+) -> Iterator[None]:
+    """Adopt a remote trace context for the duration of a block.
+
+    Installs a synthetic (never-emitted) anchor span carrying
+    ``trace_id``/``parent_span_id``, so every span opened inside the block
+    joins the remote trace — the receive-side hook for contexts arriving
+    via HTTP headers (:func:`extract_headers`), a ``KvPayload``, or a
+    journaled fleet recipe. No-op when ``trace_id`` is falsy or tracing is
+    disabled."""
+    if not trace_id or not tracing_enabled():
+        yield
+        return
+    anchor = Span(
+        name="",  # marker: anchors are context carriers, never recorded
+        trace_id=trace_id,
+        span_id=parent_span_id or "",
+    )
+    token = _CURRENT.set(anchor)
+    try:
+        yield
+    finally:
+        _CURRENT.reset(token)
+
+
+def inject_headers(headers: dict[str, str]) -> dict[str, str]:
+    """Stamp the current trace context into an HTTP header dict (the
+    send-side twin of :func:`inject_env` for service hops). Returns the
+    dict for chaining; untouched when there is no context or tracing is
+    disabled."""
+    if not tracing_enabled():
+        return headers
+    trace_id = current_trace_id()
+    span_id = current_span_id()
+    if trace_id:
+        headers[HDR_TRACE_ID] = trace_id
+    if span_id:
+        headers[HDR_PARENT_SPAN] = span_id
+    return headers
+
+
+def extract_headers(headers: Any) -> tuple[Optional[str], Optional[str]]:
+    """Read ``(trace_id, parent_span_id)`` out of request headers (any
+    mapping with ``.get``, e.g. ``http.server`` message objects — their
+    lookups are case-insensitive already). Returns ``(None, None)`` when
+    absent; feed the result to :func:`trace_context`."""
+    tid = headers.get(HDR_TRACE_ID) or None
+    sid = headers.get(HDR_PARENT_SPAN) or None
+    return tid, sid
 
 
 def heartbeat(name: str, session: str = "", **attrs: Any) -> Optional[Span]:

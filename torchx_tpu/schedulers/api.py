@@ -104,6 +104,12 @@ class SchedulerCapabilities:
             same ``watch()`` interface still works but rides the generic
             poll adapter, so hang/terminal detection latency degrades to
             the watch poll interval (what analyze rule TPX601 warns about).
+        metricz_scrape: replicas launched by this backend expose a
+            ``/metricz`` endpoint the control daemon's telemetry
+            collector can reach over the network (loopback for local
+            backends, cluster DNS for GKE). Without it, SLO specs over
+            replica-side metrics see no samples — burn rates stay zero
+            and the alerts are dead weight (analyze rule TPX214).
     """
 
     mounts: bool = False
@@ -117,6 +123,7 @@ class SchedulerCapabilities:
     concrete_resources: bool = False
     classifies_preemption: bool = False
     watch: bool = False
+    metricz_scrape: bool = False
 
 
 def dquote(s: str) -> str:

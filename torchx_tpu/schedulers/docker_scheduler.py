@@ -112,6 +112,8 @@ CAPABILITIES = SchedulerCapabilities(
     native_retries=True,
     concrete_resources=False,  # unset cpu/memMB simply means "no limits"
     classifies_preemption=False,
+    # published container ports are scrapeable from the docker host
+    metricz_scrape=True,
 )
 
 

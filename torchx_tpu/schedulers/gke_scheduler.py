@@ -783,6 +783,8 @@ CAPABILITIES = SchedulerCapabilities(
     # native event source: kubectl's streaming watch (see
     # GKEScheduler.watch); degrades to the poll adapter without kubectl
     watch=True,
+    # pod IPs resolve over cluster DNS; /metricz is scrapeable in-cluster
+    metricz_scrape=True,
 )
 
 

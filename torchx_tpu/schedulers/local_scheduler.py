@@ -455,6 +455,8 @@ CAPABILITIES = SchedulerCapabilities(
     # native event source: the state file + exitcode sidecars every job
     # leaves next to its logs (see LocalScheduler.watch)
     watch=True,
+    # replicas bind loopback ports the daemon's collector can scrape
+    metricz_scrape=True,
 )
 
 

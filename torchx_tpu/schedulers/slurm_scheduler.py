@@ -399,6 +399,8 @@ CAPABILITIES = SchedulerCapabilities(
     native_retries=True,
     concrete_resources=True,
     classifies_preemption=True,
+    # compute nodes share the cluster network with the control daemon
+    metricz_scrape=True,
 )
 
 

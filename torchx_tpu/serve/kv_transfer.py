@@ -37,6 +37,7 @@ import the config types.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import io
 import json
@@ -51,6 +52,7 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from torchx_tpu.obs import metrics as obs_metrics
+from torchx_tpu.obs import trace as obs_trace
 
 __all__ = [
     "ROLE_METADATA_KEY",
@@ -58,6 +60,8 @@ __all__ = [
     "TransferRejected",
     "TransferError",
     "KvPayload",
+    "stamp_trace",
+    "payload_span",
     "KvTransfer",
     "LocalTransfer",
     "HttpTransfer",
@@ -103,6 +107,11 @@ class KvPayload:
     block_size: int
     k: np.ndarray
     v: np.ndarray
+    # originating trace context: the decode side opens its spans inside
+    # this trace, so router -> prefill -> transfer -> decode stitches
+    # into ONE timeline. Defaults keep pre-trace payloads deserializable.
+    trace_id: str = ""
+    parent_span_id: str = ""
 
     def meta(self) -> dict:
         """The JSON-scalar side of the payload (everything but K/V)."""
@@ -116,6 +125,8 @@ class KvPayload:
             "seed": self.seed,
             "eos_id": self.eos_id,
             "block_size": self.block_size,
+            "trace_id": self.trace_id,
+            "parent_span_id": self.parent_span_id,
         }
 
     def to_bytes(self) -> bytes:
@@ -138,6 +149,32 @@ class KvPayload:
             meta = json.loads(z["meta"].tobytes().decode())
             k, v = z["k"], z["v"]
         return cls(k=k, v=v, **meta)
+
+
+def stamp_trace(payload: KvPayload) -> KvPayload:
+    """Fill the payload's trace context from the ambient one (no-op on
+    already-stamped payloads): the prefill side calls this right before
+    :meth:`KvTransfer.send` so the decode replica joins the request's
+    trace. Returns the payload for chaining."""
+    if not payload.trace_id:
+        payload.trace_id = obs_trace.current_trace_id() or ""
+    if not payload.parent_span_id:
+        payload.parent_span_id = obs_trace.current_span_id() or ""
+    return payload
+
+
+@contextlib.contextmanager
+def payload_span(payload: KvPayload, name: str, **attrs):
+    """Open span ``name`` inside the payload's originating trace context
+    — the decode-side (and transfer-side) hook that makes a cross-process
+    handoff one stitched trace. Yields the open span (or None)."""
+    with obs_trace.trace_context(
+        payload.trace_id or None, payload.parent_span_id or None
+    ):
+        with obs_trace.span(
+            name, request_id=payload.request_id, **attrs
+        ) as sp:
+            yield sp
 
 
 @dataclasses.dataclass(frozen=True)
@@ -203,22 +240,29 @@ class KvTransfer:
     def send(self, payload: KvPayload, timeout: float = 60.0) -> dict:
         """Transfer to the first accepting target, requeueing past
         draining/unreachable ones. The drain-race contract: a target
-        that rejects mid-transfer costs a retry, never the request."""
-        last: Optional[Exception] = None
-        for target in self.targets():
-            try:
-                out = self.transfer(payload, target, timeout=timeout)
-                obs_metrics.SERVE_KV_TRANSFERS.inc(status="ok")
-                return out
-            except TransferRejected as e:
-                obs_metrics.SERVE_KV_TRANSFERS.inc(status="rejected")
-                last = e
-            except TransferError as e:
-                obs_metrics.SERVE_KV_TRANSFERS.inc(status="error")
-                last = e
-        raise TransferError(
-            f"no decode target accepted request {payload.request_id}: {last}"
-        )
+        that rejects mid-transfer costs a retry, never the request.
+        Timed as a ``serve.kv_transfer`` span in the payload's
+        originating trace."""
+        stamp_trace(payload)
+        with payload_span(payload, "serve.kv_transfer") as sp:
+            last: Optional[Exception] = None
+            for target in self.targets():
+                try:
+                    out = self.transfer(payload, target, timeout=timeout)
+                    obs_metrics.SERVE_KV_TRANSFERS.inc(status="ok")
+                    if sp is not None:
+                        sp.attrs["target"] = str(target)
+                    return out
+                except TransferRejected as e:
+                    obs_metrics.SERVE_KV_TRANSFERS.inc(status="rejected")
+                    last = e
+                except TransferError as e:
+                    obs_metrics.SERVE_KV_TRANSFERS.inc(status="error")
+                    last = e
+            raise TransferError(
+                f"no decode target accepted request"
+                f" {payload.request_id}: {last}"
+            )
 
 
 class LocalTransfer(KvTransfer):

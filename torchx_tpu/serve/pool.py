@@ -133,23 +133,37 @@ class Autoscaler:
         replicas: int,
         queue_depth: float,
         p99_s: Optional[float] = None,
+        burn_rate: Optional[float] = None,
     ) -> int:
         """One control observation -> desired replica count.
 
         ``queue_depth`` is the mean per-replica depth across healthy
         replicas; ``p99_s`` the recent TTFT p99 (None = no latency signal,
-        depth alone decides).
+        depth alone decides). ``burn_rate`` is the optional SLO signal
+        (the engine's worst long-window burn): at >= 1.0 the error budget
+        is burning faster than sustainable, which counts as hot and
+        vetoes scale-down — the pool must not shrink its way deeper into
+        a burning SLO even when the queue looks calm.
         """
         p = self.policy
-        hot = queue_depth > p.target_queue_depth or (
-            p.target_p99_s is not None
-            and p99_s is not None
-            and p99_s > p.target_p99_s
+        burning = burn_rate is not None and burn_rate >= 1.0
+        hot = (
+            burning
+            or queue_depth > p.target_queue_depth
+            or (
+                p.target_p99_s is not None
+                and p99_s is not None
+                and p99_s > p.target_p99_s
+            )
         )
-        cold = queue_depth < p.target_queue_depth * p.down_fraction and not (
-            p.target_p99_s is not None
-            and p99_s is not None
-            and p99_s > p.target_p99_s
+        cold = (
+            not burning
+            and queue_depth < p.target_queue_depth * p.down_fraction
+            and not (
+                p.target_p99_s is not None
+                and p99_s is not None
+                and p99_s > p.target_p99_s
+            )
         )
         self._up = self._up + 1 if hot else 0
         self._down = self._down + 1 if cold else 0
@@ -346,6 +360,7 @@ class ServePool:
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
         reconciler: Optional[Any] = None,
+        slo_signal: Optional[Callable[[], Optional[float]]] = None,
     ) -> None:
         self._runner = runner
         self._app = app
@@ -363,6 +378,9 @@ class ServePool:
         # watch events (terminal detection at event latency, zero describe
         # calls) instead of polling Runner.status every interval
         self._reconciler = reconciler
+        # optional SLO burn-rate feed (a callable so the engine's latest
+        # evaluation is read per step, e.g. daemon.slo_engine.max_burn)
+        self._slo_signal = slo_signal
         self.autoscaler = Autoscaler(self.policy, clock=clock)
         self.handle: Optional[str] = None
         self._replicas = next(
@@ -438,7 +456,15 @@ class ServePool:
             depth = self.router.queue_depth()
             p99 = self.router.p99_s()
             obs_metrics.SERVE_QUEUE_DEPTH.set(depth)
-            desired = self.autoscaler.observe(self._replicas, depth, p99)
+            burn: Optional[float] = None
+            if self._slo_signal is not None:
+                try:
+                    burn = self._slo_signal()
+                except Exception as e:  # noqa: BLE001 - probes still decide
+                    logger.debug("slo signal failed: %s", e)
+            desired = self.autoscaler.observe(
+                self._replicas, depth, p99, burn_rate=burn
+            )
             if desired == self._replicas:
                 return None
             return self._resize(desired)
@@ -706,6 +732,16 @@ def _make_router_handler(pool: ServePool) -> type:
                         "p99_s": router.p99_s(),
                     },
                 )
+            elif self.path == "/metricz":
+                # the router process's registry (routing counters, pool
+                # gauges) in proper exposition format — a scrape target
+                # for the control daemon's telemetry collector
+                text = obs_metrics.REGISTRY.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
@@ -729,31 +765,50 @@ def _make_router_handler(pool: ServePool) -> type:
                     tokens = list(req["text"][0].encode("utf-8"))
             except (ValueError, TypeError, KeyError, IndexError):
                 tokens = None
-            target = router.pick(tokens)
-            if target is None:
-                self._reply(503, {"error": "no healthy replicas"})
-                return
-            t0 = time.perf_counter()
-            try:
-                req = urllib.request.Request(
-                    f"{target.url}{self.path}",
-                    data=payload,
-                    headers={"Content-Type": "application/json"},
-                )
-                with urllib.request.urlopen(req, timeout=600) as r:
-                    body = r.read()
-                    code = r.status
-            except urllib.error.HTTPError as e:
-                body = e.read()
-                code = e.code
-            except (urllib.error.URLError, OSError) as e:
-                self._reply(502, {"error": f"replica {target.replica_id}: {e}"})
-                router.record(target.replica_id, time.perf_counter() - t0)
-                return
-            router.record(target.replica_id, time.perf_counter() - t0)
+            # adopt the caller's trace (or start one) and forward the
+            # context to the replica, so router + replica + KV transfer
+            # + decode stitch into one timeline per request
+            in_tid, in_sid = obs_trace.extract_headers(self.headers)
+            with obs_trace.trace_context(in_tid, in_sid):
+                with obs_trace.span("serve.route") as sp:
+                    trace_id = sp.trace_id if sp is not None else in_tid
+                    target = router.pick(tokens)
+                    if target is None:
+                        self._reply(503, {"error": "no healthy replicas"})
+                        return
+                    if sp is not None:
+                        sp.attrs["replica"] = target.replica_id
+                    t0 = time.perf_counter()
+                    try:
+                        req = urllib.request.Request(
+                            f"{target.url}{self.path}",
+                            data=payload,
+                            headers=obs_trace.inject_headers(
+                                {"Content-Type": "application/json"}
+                            ),
+                        )
+                        with urllib.request.urlopen(req, timeout=600) as r:
+                            body = r.read()
+                            code = r.status
+                    except urllib.error.HTTPError as e:
+                        body = e.read()
+                        code = e.code
+                    except (urllib.error.URLError, OSError) as e:
+                        self._reply(
+                            502,
+                            {"error": f"replica {target.replica_id}: {e}"},
+                        )
+                        router.record(
+                            target.replica_id, time.perf_counter() - t0
+                        )
+                        return
+                    router.record(target.replica_id, time.perf_counter() - t0)
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if trace_id:
+                # callers (and tests) learn which trace to stitch
+                self.send_header(obs_trace.HDR_TRACE_ID, trace_id)
             self.end_headers()
             self.wfile.write(body)
 

@@ -114,6 +114,24 @@ ENV_TPX_CONTROL_TOKEN = "TPX_CONTROL_TOKEN"
 # sharded job-state store live here. Default ~/.torchx_tpu/control.
 ENV_TPX_CONTROL_DIR = "TPX_CONTROL_DIR"
 
+# Minimum interval (seconds) between full-registry metrics textfile
+# re-renders by the prom event handler (obs/sinks.py); events arriving
+# inside the window mark the registry dirty and a final flush on handler
+# close writes them. "0" restores flush-on-every-event.
+ENV_TPX_METRICS_MIN_INTERVAL = "TPX_METRICS_MIN_INTERVAL"
+DEFAULT_METRICS_MIN_INTERVAL = 2.0
+
+# Scrape/ingest interval (seconds) of the control daemon's telemetry
+# collector (obs/telemetry.py): replica /metricz scrapes + obs-session
+# textfile ingestion each cycle, followed by one SLO evaluation.
+ENV_TPX_TELEMETRY_INTERVAL = "TPX_TELEMETRY_INTERVAL"
+DEFAULT_TELEMETRY_INTERVAL = 5.0
+
+# Bounded per-series ring-buffer capacity (samples) of the telemetry
+# collector's metric store. At the default 5s interval, 720 samples is
+# one hour of history per series.
+DEFAULT_TELEMETRY_CAPACITY = 720
+
 # Poll interval (seconds) for watch adapters that fall back to polling
 # (generic backends) and for the local scheduler's sidecar mtime watcher.
 # Watch streams coalesce N callers into one scan, so this can be much
