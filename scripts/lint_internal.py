@@ -40,6 +40,7 @@ JAX_FREE = (
     "analyze",
     "fleet",
     "tune",
+    "pipelines",
     os.path.join("parallel", "mesh_config.py"),
     # the telemetry plane runs inside the daemon and `tpx top`
     os.path.join("obs", "telemetry.py"),

@@ -568,4 +568,115 @@ assert "top" in r.stdout, r.stdout
 EOF
 then echo "TOP_SMOKE=ok"; else echo "TOP_SMOKE=FAILED"; rc=1; fi
 rm -rf "$top_dir"
+
+# Pipeline smoke: a tiny train→eval→promote DAG through `tpx control` on
+# the real local scheduler must reach PROMOTED, its journaled stages must
+# be visible via `tpx pipeline status`, and the verb rides the lazy
+# dispatcher (`tpx pipeline --help` never imports jax).
+pl_dir=$(mktemp -d /tmp/tpx_pipeline_smoke.XXXXXX)
+if timeout -k 10 180 env JAX_PLATFORMS=cpu TPX_OBS_DIR="$pl_dir/obs" \
+    TPX_CONTROL_DIR="$pl_dir/control" TPX_WATCH_INTERVAL=0.1 \
+    PL_DIR="$pl_dir" \
+    python - <<'EOF'
+import json, os, subprocess, sys, time
+
+base = os.environ["PL_DIR"]
+ckpt = os.path.join(base, "ckpt")
+score = os.path.join(base, "score.json")
+logs = os.path.join(base, "logs")
+# the train stage writes a checkpoint payload + MANIFEST.json with the
+# same sha256 relpath+bytes digest recipe the checkpoint writer uses
+train_code = (
+    "import hashlib,json,os\n"
+    f"ckpt={ckpt!r}\n"
+    "p=os.path.join(ckpt,'1'); os.makedirs(p,exist_ok=True)\n"
+    "open(os.path.join(p,'w.bin'),'wb').write(b'weights-v1')\n"
+    "h=hashlib.sha256()\n"
+    "fp=os.path.join(p,'w.bin')\n"
+    "h.update(os.path.relpath(fp,p).encode()); h.update(open(fp,'rb').read())\n"
+    "json.dump({'latest_step':1,'steps':{'1':{'digest':h.hexdigest()}}},"
+    "open(os.path.join(ckpt,'MANIFEST.json'),'w'))\n"
+)
+spec = {
+    "name": "smoke",
+    "stages": [
+        {"name": "train", "kind": "train", "component": "utils.python",
+         "args": ["-c", train_code], "ckpt_dir": ckpt,
+         "cfg": {"log_dir": logs}},
+        {"name": "eval", "kind": "eval", "component": "utils.python",
+         "args": ["-m", "torchx_tpu.apps.eval_main", "--",
+                  "--ckpt", "{train.path}", "--out", score,
+                  "--score", "0.9"],
+         "depends_on": ["train"], "score_file": score, "threshold": 0.5,
+         "cfg": {"log_dir": logs}},
+        {"name": "promote", "kind": "promote", "depends_on": ["eval"],
+         "observe_s": 0.1},
+    ],
+}
+spec_file = os.path.join(base, "spec.json")
+json.dump(spec, open(spec_file, "w"))
+
+ctl = os.environ["TPX_CONTROL_DIR"]
+daemon = subprocess.Popen(
+    [sys.executable, "-m", "torchx_tpu.cli.main", "control"],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+)
+try:
+    discovery = os.path.join(ctl, "control.json")
+    deadline = time.monotonic() + 60
+    while not os.path.exists(discovery):
+        assert daemon.poll() is None, daemon.stdout.read()
+        assert time.monotonic() < deadline, "daemon never wrote discovery"
+        time.sleep(0.1)
+    addr = json.load(open(discovery))["addr"]
+    env = dict(os.environ, TPX_CONTROL_ADDR=addr)
+    tpx = [sys.executable, "-m", "torchx_tpu.cli.main", "pipeline"]
+    r = subprocess.run(tpx + ["submit", "--file", spec_file],
+                       capture_output=True, text=True, env=env, timeout=60)
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    pid = r.stdout.strip()
+    assert pid.startswith("pl_"), r.stdout
+    deadline = time.monotonic() + 120
+    doc = {}
+    while time.monotonic() < deadline:
+        r = subprocess.run(tpx + ["status", pid, "--json"],
+                           capture_output=True, text=True, env=env,
+                           timeout=60)
+        assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+        doc = json.loads(r.stdout)
+        if doc["state"] in ("PROMOTED", "SUCCEEDED", "FAILED",
+                            "ROLLED_BACK", "CANCELLED"):
+            break
+        time.sleep(0.2)
+    assert doc.get("state") == "PROMOTED", doc
+    states = {s["name"]: s["state"] for s in doc["stages"]}
+    assert states == {"train": "SUCCEEDED", "eval": "SUCCEEDED",
+                      "promote": "SUCCEEDED"}, states
+    assert doc["incumbent"]["ckpt"] == ckpt, doc["incumbent"]
+    # the journal backs the status view: every stage decision is on disk
+    kinds = set()
+    with open(os.path.join(ctl, "pipelines.jsonl")) as f:
+        for line in f:
+            kinds.add(json.loads(line).get("kind"))
+    assert {"submit", "stage_submit", "stage_done", "gate",
+            "promote_step", "incumbent"} <= kinds, kinds
+finally:
+    daemon.terminate()
+    daemon.wait(timeout=10)
+
+# the pipeline verb rides the lazy dispatcher: its help never imports jax
+r = subprocess.run(
+    [sys.executable, "-c", (
+        "import sys\n"
+        "from torchx_tpu.cli.main import main\n"
+        "try: main(['pipeline', '--help'])\n"
+        "except SystemExit: pass\n"
+        "assert 'jax' not in sys.modules, 'tpx pipeline --help imported jax'\n"
+    )],
+    capture_output=True, text=True, timeout=60,
+)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+EOF
+then echo "PIPELINE_SMOKE=ok"; else echo "PIPELINE_SMOKE=FAILED"; rc=1; fi
+rm -rf "$pl_dir"
 exit $rc

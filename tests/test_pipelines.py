@@ -1,0 +1,919 @@
+"""Pipeline subsystem tests: the DAG model, the eval app's digest chain,
+the router's rollout seams (drain exclusion, canary weights), the serve
+pool's zero-drop per-replica checkpoint rollout, the promotion
+controller's gates, the end-to-end engine on the real local scheduler
+(happy path, forced eval regression, SLO burn, daemon kill+restart
+mid-canary), the deprecation shims, and the TPX603 analyze rule."""
+
+import hashlib
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from torchx_tpu.pipelines.dag import (
+    Artifact,
+    PipelineSpec,
+    PipelineStage,
+    checkpoint_artifact,
+    resolve_args,
+    score_artifact,
+)
+from torchx_tpu.pipelines.promote import PROMOTED, ROLLED_BACK, PromotionController
+from torchx_tpu.serve.pool import LeastLoadedRouter, ReplicaStatus
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def write_checkpoint(ckpt_dir: str, payload: bytes = b"weights-v1") -> str:
+    """A minimal finalized checkpoint: step-1 payload + MANIFEST.json with
+    the writer's sha256 relpath+bytes digest. Returns the digest."""
+    step_dir = os.path.join(ckpt_dir, "1")
+    os.makedirs(step_dir, exist_ok=True)
+    fp = os.path.join(step_dir, "w.bin")
+    with open(fp, "wb") as f:
+        f.write(payload)
+    h = hashlib.sha256()
+    h.update(os.path.relpath(fp, step_dir).encode())
+    h.update(payload)
+    digest = h.hexdigest()
+    with open(os.path.join(ckpt_dir, "MANIFEST.json"), "w") as f:
+        json.dump({"latest_step": 1, "steps": {"1": {"digest": digest}}}, f)
+    return digest
+
+
+def statuses(n: int, healthy=None) -> list:
+    healthy = set(range(n)) if healthy is None else set(healthy)
+    return [
+        ReplicaStatus(replica_id=i, url=f"http://x:{i}", healthy=i in healthy)
+        for i in range(n)
+    ]
+
+
+class FakePool:
+    """The promotion controller's pool contract, recording every roll."""
+
+    def __init__(self, replicas: int = 4, fail_on=(), block_on=None):
+        self.replicas = replicas
+        self.router = LeastLoadedRouter()
+        self.router.update(statuses(replicas))
+        self.rolls: list = []  # (replica_id, ckpt)
+        self._fail_on = set(fail_on)
+        self._block_on = block_on  # (replica_id, threading.Event)
+
+    def rollout_replica(self, replica_id: int, ckpt: str, **kw) -> bool:
+        if self._block_on and replica_id == self._block_on[0]:
+            self._block_on[1].wait()
+        self.rolls.append((replica_id, ckpt))
+        return replica_id not in self._fail_on
+
+
+# ---------------------------------------------------------------------------
+# DAG model
+# ---------------------------------------------------------------------------
+
+
+class TestDagModel:
+    def spec(self):
+        return PipelineSpec(
+            name="p",
+            stages=[
+                PipelineStage(name="train", kind="train", component="utils.python"),
+                PipelineStage(
+                    name="eval",
+                    kind="eval",
+                    component="utils.python",
+                    depends_on=["train"],
+                    score_file="/tmp/s.json",
+                ),
+                PipelineStage(
+                    name="promote", kind="promote", depends_on=["eval"]
+                ),
+            ],
+        )
+
+    def test_validate_accepts_well_formed(self):
+        self.spec().validate()
+
+    def test_generations_are_topological(self):
+        gens = self.spec().generations()
+        assert [[s.name for s in g] for g in gens] == [
+            ["train"],
+            ["eval"],
+            ["promote"],
+        ]
+
+    def test_default_priorities_by_kind(self):
+        spec = self.spec()
+        assert spec.stage("train").priority == "batch"
+        assert spec.stage("eval").priority == "interactive"
+        assert spec.stage("promote").priority == "serve"
+
+    def test_rejects_bad_kind(self):
+        with pytest.raises(ValueError, match="kind"):
+            PipelineStage(name="x", kind="deploy")
+
+    def test_eval_requires_score_file(self):
+        with pytest.raises(ValueError, match="score_file"):
+            PipelineStage(name="e", kind="eval")
+
+    def test_rejects_duplicate_names(self):
+        spec = self.spec()
+        spec.stages.append(PipelineStage(name="train", kind="train"))
+        with pytest.raises(ValueError, match="duplicate"):
+            spec.validate()
+
+    def test_rejects_unknown_dependency(self):
+        spec = self.spec()
+        spec.stages[1].depends_on = ["nope"]
+        with pytest.raises(ValueError, match="unknown"):
+            spec.validate()
+
+    def test_rejects_cycle(self):
+        spec = self.spec()
+        spec.stages[0].depends_on = ["promote"]
+        with pytest.raises(ValueError, match="cycle"):
+            spec.validate()
+
+    def test_round_trips_through_dict(self):
+        spec = self.spec()
+        again = PipelineSpec.from_dict(spec.to_dict())
+        assert again.to_dict() == spec.to_dict()
+
+    def test_resolve_args_substitutes_artifact_fields(self):
+        arts = {
+            "train": Artifact(kind="checkpoint", path="/c", digest="abc", step=7)
+        }
+        out = resolve_args(
+            ["--ckpt", "{train.path}", "--expect", "{train.digest}@{train.step}"],
+            arts,
+        )
+        assert out == ["--ckpt", "/c", "--expect", "abc@7"]
+
+    def test_resolve_args_rejects_dangling_reference(self):
+        with pytest.raises(KeyError, match="eval"):
+            resolve_args(["{eval.score}"], {})
+
+    def test_checkpoint_artifact_reads_manifest(self, tmp_path):
+        digest = write_checkpoint(str(tmp_path))
+        art = checkpoint_artifact(str(tmp_path))
+        assert (art.kind, art.step, art.digest) == ("checkpoint", 1, digest)
+
+    def test_checkpoint_artifact_requires_manifest(self, tmp_path):
+        with pytest.raises(ValueError, match="manifest"):
+            checkpoint_artifact(str(tmp_path))
+
+    def test_checkpoint_artifact_requires_finalized_step(self, tmp_path):
+        (tmp_path / "MANIFEST.json").write_text('{"steps": {}}')
+        with pytest.raises(ValueError, match="finalized"):
+            checkpoint_artifact(str(tmp_path))
+
+    def test_score_artifact_requires_score(self, tmp_path):
+        f = tmp_path / "s.json"
+        f.write_text('{"ckpt": "/c"}')
+        with pytest.raises(ValueError, match="score"):
+            score_artifact(str(f))
+        f.write_text('{"score": 0.25, "step": 3}')
+        art = score_artifact(str(f))
+        assert (art.kind, art.score, art.step) == ("score", 0.25, 3)
+
+
+# ---------------------------------------------------------------------------
+# eval app: digest re-verification
+# ---------------------------------------------------------------------------
+
+
+class TestEvalMain:
+    def test_scores_a_verified_checkpoint(self, tmp_path):
+        from torchx_tpu.apps.eval_main import main
+
+        write_checkpoint(str(tmp_path / "ckpt"))
+        out = str(tmp_path / "score.json")
+        rc = main(["--ckpt", str(tmp_path / "ckpt"), "--out", out, "--score", "0.9"])
+        assert rc == 0
+        doc = json.load(open(out))
+        assert doc["score"] == 0.9
+        assert doc["step"] == 1
+        assert doc["digest"]
+
+    def test_rejects_tampered_payload(self, tmp_path, capsys):
+        from torchx_tpu.apps.eval_main import main
+
+        write_checkpoint(str(tmp_path / "ckpt"))
+        # corrupt the payload after the manifest recorded its digest
+        with open(tmp_path / "ckpt" / "1" / "w.bin", "wb") as f:
+            f.write(b"tampered")
+        out = str(tmp_path / "score.json")
+        rc = main(["--ckpt", str(tmp_path / "ckpt"), "--out", out])
+        assert rc == 1
+        assert not os.path.exists(out)
+        assert "digest mismatch" in capsys.readouterr().err
+
+    def test_digest_derived_score_is_deterministic(self, tmp_path):
+        from torchx_tpu.apps.eval_main import main
+
+        write_checkpoint(str(tmp_path / "ckpt"))
+        a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        assert main(["--ckpt", str(tmp_path / "ckpt"), "--out", a]) == 0
+        assert main(["--ckpt", str(tmp_path / "ckpt"), "--out", b]) == 0
+        assert json.load(open(a))["score"] == json.load(open(b))["score"]
+
+
+# ---------------------------------------------------------------------------
+# router: drain exclusion + canary weights
+# ---------------------------------------------------------------------------
+
+
+class TestRouterRollout:
+    def test_draining_replica_leaves_split_immediately(self):
+        r = LeastLoadedRouter()
+        r.update(statuses(2))
+        r.mark_draining(0)
+        # no probe sweep between mark and pick: 0 must already be gone
+        for _ in range(5):
+            assert r.pick().replica_id == 1
+        r.clear_draining(0)
+        # readmitted and now the least loaded: it takes the next pick
+        assert r.pick().replica_id == 0
+
+    def test_drain_mark_survives_probe_update(self):
+        r = LeastLoadedRouter()
+        r.update(statuses(2))
+        r.mark_draining(0)
+        r.update(statuses(2))  # probe sweep rebuilds the table
+        assert r.pick().replica_id == 1
+
+    def test_weight_attracts_traffic(self):
+        r = LeastLoadedRouter()
+        r.update(statuses(2))
+        r.set_weight(1, 4.0)
+        # equal load: ties break toward the lower id unless weighted
+        picks = [r.pick().replica_id for _ in range(4)]
+        assert picks.count(1) > picks.count(0)
+
+    def test_weight_scales_negative_scores_toward_canary(self):
+        # a cache bonus can push load negative; weight must still attract
+        r = LeastLoadedRouter(cache_bonus=3.0)
+        summary = ("d0",)
+        r.update(
+            [
+                ReplicaStatus(
+                    replica_id=i,
+                    url=f"http://x:{i}",
+                    healthy=True,
+                    prefix_summary=summary,
+                    block_size=4,
+                )
+                for i in range(2)
+            ]
+        )
+        r.set_weight(1, 4.0)
+        from torchx_tpu.serve.prefix_cache import prefix_chain
+
+        tokens = list(range(4))
+        assert prefix_chain(tokens, 4)  # sanity: at least one block
+        # patch the summaries to actually match the prompt's first chain digest
+        chain = prefix_chain(tokens, 4)
+        r.update(
+            [
+                ReplicaStatus(
+                    replica_id=i,
+                    url=f"http://x:{i}",
+                    healthy=True,
+                    prefix_summary=(chain[0],),
+                    block_size=4,
+                )
+                for i in range(2)
+            ]
+        )
+        r.set_weight(1, 4.0)
+        assert r.pick(tokens).replica_id == 1
+
+    def test_inflight_counts_route_and_record(self):
+        r = LeastLoadedRouter()
+        r.update(statuses(1))
+        assert r.inflight(0) == 0
+        r.pick()
+        r.pick()
+        assert r.inflight(0) == 2
+        r.record(0, 0.01)
+        assert r.inflight(0) == 1
+
+
+# ---------------------------------------------------------------------------
+# serve pool: zero-drop per-replica rollout
+# ---------------------------------------------------------------------------
+
+
+class TestServePoolRollout:
+    def make_pool(self, restarted, drain_log):
+        from torchx_tpu.serve.pool import ServePool
+        from torchx_tpu.specs.api import AppDef, Role
+
+        app = AppDef(
+            name="srv",
+            roles=[
+                Role(name="server", image="i", entrypoint="x", num_replicas=2)
+            ],
+        )
+        router = LeastLoadedRouter()
+        router.update(statuses(2))
+        clock = {"t": 0.0}
+
+        def sleep(dt):
+            clock["t"] += dt
+            # in-flight requests complete while the rollout waits: this is
+            # the drain the seam must observe before restarting
+            if router.inflight(0) > 0:
+                router.record(0, 0.01)
+
+        def restart(rid, ckpt):
+            drain_log.append(router.inflight(rid))
+            restarted.append((rid, ckpt))
+
+        pool = ServePool(
+            runner=object(),
+            app=app,
+            router=router,
+            probe=lambda rid, url: ReplicaStatus(
+                replica_id=rid, url=url, healthy=True
+            ),
+            clock=lambda: clock["t"],
+            sleep=sleep,
+            restart=restart,
+        )
+        return pool, router
+
+    def test_rollout_waits_for_inflight_then_restarts(self):
+        restarted, drain_log = [], []
+        pool, router = self.make_pool(restarted, drain_log)
+        # two requests in flight to replica 0 (replica 1 briefly unhealthy
+        # so the least-loaded split can't spread them)
+        router.update(statuses(2, healthy=[0]))
+        router.pick(), router.pick()
+        router.update(statuses(2))
+        assert router.inflight(0) == 2
+        assert pool.rollout_replica(0, "/new/ckpt") is True
+        # the restart fired with ZERO requests still in flight (no drops)
+        assert restarted == [(0, "/new/ckpt")]
+        assert drain_log == [0]
+        # the replica rejoined the split after health-confirm
+        assert 0 in {router.pick().replica_id for _ in range(4)}
+
+    def test_rollout_fails_on_drain_timeout(self):
+        restarted, drain_log = [], []
+        pool, router = self.make_pool(restarted, drain_log)
+        # a request that never records back
+        router._inflight[0] = 1
+        pool._sleep = lambda dt: setattr(
+            pool, "_now", getattr(pool, "_now", 0.0) + dt
+        )
+        pool._clock = lambda: getattr(pool, "_now", 0.0)
+        assert pool.rollout_replica(0, "/new", drain_timeout_s=0.2) is False
+        assert restarted == []
+        # the drain mark was cleared even on failure
+        assert 0 in {router.pick().replica_id for _ in range(4)}
+
+    def test_restart_exception_fails_rollout(self):
+        restarted, drain_log = [], []
+        pool, router = self.make_pool(restarted, drain_log)
+
+        def bad_restart(rid, ckpt):
+            raise RuntimeError("boom")
+
+        pool._restart = bad_restart
+        assert pool.rollout_replica(0, "/new") is False
+
+
+# ---------------------------------------------------------------------------
+# promotion controller (unit, fake pool)
+# ---------------------------------------------------------------------------
+
+
+class TestPromotionController:
+    def candidate(self):
+        return Artifact(kind="checkpoint", path="/new", digest="d", step=5)
+
+    def test_promotes_canary_then_rest(self):
+        pool = FakePool(replicas=4)
+        events = []
+        c = PromotionController(
+            pool,
+            canary_fraction=0.5,
+            journal=lambda e, **f: events.append((e, f)),
+        )
+        assert c.run(self.candidate(), score=0.9, baseline_score=0.5) == PROMOTED
+        assert [r for r, _ in pool.rolls] == [0, 1, 2, 3]
+        kinds = [e for e, _ in events]
+        assert kinds[0] == "canary_start"
+        assert ("gate", True) in [
+            (e, f.get("passed")) for e, f in events if e == "gate"
+        ]
+        assert kinds[-1] == "promoted"
+
+    def test_eval_regression_rolls_canary_back(self):
+        pool = FakePool(replicas=4)
+        events = []
+        c = PromotionController(
+            pool,
+            canary_fraction=0.5,
+            journal=lambda e, **f: events.append((e, f)),
+        )
+        out = c.run(
+            self.candidate(), score=0.2, baseline_score=0.9, incumbent_ckpt="/old"
+        )
+        assert out == ROLLED_BACK
+        # canaries 0,1 rolled forward, then restored to the incumbent;
+        # replicas 2,3 never touched
+        assert pool.rolls == [
+            (0, "/new"),
+            (1, "/new"),
+            (0, "/old"),
+            (1, "/old"),
+        ]
+        rb = next(f for e, f in events if e == "rollback")
+        assert rb["reason"] == "eval_regression"
+        assert rb["incumbent"] == "/old"
+
+    def test_slo_burn_rolls_canary_back(self):
+        pool = FakePool(replicas=2)
+        events = []
+        c = PromotionController(
+            pool,
+            slo_signal=lambda: 2.5,
+            burn_threshold=1.0,
+            observe_s=0.5,
+            canary_fraction=0.5,
+            journal=lambda e, **f: events.append((e, f)),
+            clock=lambda: 0.0,
+            sleep=lambda dt: None,
+        )
+        out = c.run(self.candidate(), score=0.9, incumbent_ckpt="/old")
+        assert out == ROLLED_BACK
+        rb = next(f for e, f in events if e == "rollback")
+        assert rb["reason"] == "slo_burn"
+
+    def test_resume_skips_already_rolled(self):
+        pool = FakePool(replicas=4)
+        c = PromotionController(
+            pool, canary_fraction=0.5, already_rolled=[0]
+        )
+        assert c.run(self.candidate(), score=0.9) == PROMOTED
+        # replica 0 was rolled by the pre-restart attempt: never re-rolled
+        assert [r for r, _ in pool.rolls] == [1, 2, 3]
+
+    def test_failed_rollout_rolls_back(self):
+        pool = FakePool(replicas=4, fail_on={1})
+        c = PromotionController(pool, canary_fraction=0.5)
+        out = c.run(self.candidate(), score=0.9, incumbent_ckpt="/old")
+        assert out == ROLLED_BACK
+        # only replica 0 completed a forward roll; it alone is restored
+        assert pool.rolls[-1] == (0, "/old")
+
+    def test_gate_only_mode_without_pool(self):
+        c = PromotionController(None)
+        assert c.run(self.candidate(), score=0.9, baseline_score=0.5) == PROMOTED
+        assert (
+            c.run(self.candidate(), score=0.2, baseline_score=0.9)
+            == ROLLED_BACK
+        )
+
+    def test_weights_restored_after_promotion(self):
+        pool = FakePool(replicas=2)
+        c = PromotionController(pool, canary_fraction=0.5, canary_weight=3.0)
+        assert c.run(self.candidate(), score=0.9) == PROMOTED
+        assert pool.router._weights == {}
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end on the real local scheduler
+# ---------------------------------------------------------------------------
+
+
+def _train_code(ckpt: str) -> str:
+    return (
+        "import hashlib,json,os\n"
+        f"ckpt={ckpt!r}\n"
+        "p=os.path.join(ckpt,'1'); os.makedirs(p,exist_ok=True)\n"
+        "fp=os.path.join(p,'w.bin')\n"
+        "open(fp,'wb').write(b'weights-'+os.path.basename(ckpt).encode())\n"
+        "h=hashlib.sha256()\n"
+        "h.update(os.path.relpath(fp,p).encode())\n"
+        "h.update(open(fp,'rb').read())\n"
+        "json.dump({'latest_step':1,'steps':{'1':{'digest':h.hexdigest()}}},"
+        "open(os.path.join(ckpt,'MANIFEST.json'),'w'))\n"
+    )
+
+
+def _spec(base: str, tag: str, score: float, **promote_kw) -> dict:
+    ckpt = os.path.join(base, f"ckpt-{tag}")
+    score_file = os.path.join(base, f"score-{tag}.json")
+    logs = os.path.join(base, "logs")
+    stages = [
+        {
+            "name": "train",
+            "kind": "train",
+            "component": "utils.python",
+            "args": ["-c", _train_code(ckpt)],
+            "ckpt_dir": ckpt,
+            "cfg": {"log_dir": logs},
+        },
+        {
+            "name": "eval",
+            "kind": "eval",
+            "component": "utils.python",
+            "args": [
+                "-m",
+                "torchx_tpu.apps.eval_main",
+                "--",
+                "--ckpt",
+                "{train.path}",
+                "--out",
+                score_file,
+                "--score",
+                str(score),
+            ],
+            "depends_on": ["train"],
+            "score_file": score_file,
+            "threshold": 0.1,
+            "baseline": "incumbent",
+            "cfg": {"log_dir": logs},
+        },
+        {
+            "name": "promote",
+            "kind": "promote",
+            "depends_on": ["eval"],
+            "observe_s": promote_kw.pop("observe_s", 0.0),
+            **promote_kw,
+        },
+    ]
+    return {"name": f"pl-{tag}", "stages": stages}
+
+
+def _wait_terminal(daemon, pid: str, timeout: float = 60.0) -> dict:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        doc = daemon.pipelines.status(pid)
+        if doc["state"] in (
+            "PROMOTED",
+            "SUCCEEDED",
+            "FAILED",
+            "ROLLED_BACK",
+            "CANCELLED",
+        ):
+            return doc
+        time.sleep(0.05)
+    raise AssertionError(f"pipeline {pid} never terminal: {doc}")
+
+
+def _journal_entries(state_dir: str) -> list:
+    out = []
+    with open(os.path.join(state_dir, "pipelines.jsonl")) as f:
+        for line in f:
+            out.append(json.loads(line))
+    return out
+
+
+@pytest.fixture
+def daemon_factory(tmp_path, monkeypatch):
+    """Builds ControlDaemons over one shared state_dir (restart tests
+    construct a second one after closing the first)."""
+    from torchx_tpu.control.daemon import ControlDaemon
+    from torchx_tpu.runner.api import get_runner
+
+    monkeypatch.setenv("TPX_WATCH_INTERVAL", "0.05")
+    made = []
+
+    def make(**kw):
+        d = ControlDaemon(
+            runner=get_runner(f"pl-test-{len(made)}"),
+            state_dir=str(tmp_path / "control"),
+            tenant_cap=8,
+            telemetry=False,
+            **kw,
+        )
+        made.append(d)
+        return d
+
+    yield make
+    for d in made:
+        d.close()
+
+
+class TestPipelineEndToEnd:
+    def test_happy_path_promotes_over_http(self, tmp_path, daemon_factory):
+        from torchx_tpu.control.client import ControlClient
+
+        daemon = daemon_factory().start()
+        client = ControlClient(daemon.addr, daemon.root_token)
+        reply = client.pipeline_submit(_spec(str(tmp_path), "v1", 0.9))
+        pid = reply["pipeline"]
+        doc = _wait_terminal(daemon, pid)
+        assert doc["state"] == "PROMOTED", doc
+        states = {s["name"]: s["state"] for s in doc["stages"]}
+        assert states == {
+            "train": "SUCCEEDED",
+            "eval": "SUCCEEDED",
+            "promote": "SUCCEEDED",
+        }
+        # the artifact edge carried the digest train published
+        ckpt_art = next(
+            s["artifact"] for s in doc["stages"] if s["name"] == "train"
+        )
+        assert ckpt_art["digest"]
+        assert doc["incumbent"]["digest"] == ckpt_art["digest"]
+        assert doc["incumbent"]["score"] == 0.9
+        # the same record over the HTTP list + status verbs
+        listing = client.pipeline_status()
+        assert [p["pipeline"] for p in listing["pipelines"]] == [pid]
+        # every decision journaled
+        kinds = {e["kind"] for e in _journal_entries(daemon.state_dir)}
+        assert {
+            "submit",
+            "stage_submit",
+            "stage_done",
+            "gate",
+            "promote_step",
+            "pipeline_state",
+            "incumbent",
+        } <= kinds
+
+    def test_eval_threshold_gate_fails_pipeline(self, tmp_path, daemon_factory):
+        daemon = daemon_factory()
+        spec = _spec(str(tmp_path), "bad", 0.05)  # below threshold 0.1
+        pid = daemon.pipelines.submit(
+            PipelineSpec.from_dict(spec), tenant="root"
+        )
+        doc = _wait_terminal(daemon, pid)
+        assert doc["state"] == "FAILED"
+        states = {s["name"]: s["state"] for s in doc["stages"]}
+        assert states["eval"] == "FAILED"
+        assert states["promote"] == "PENDING"  # never started
+        gates = [
+            e
+            for e in _journal_entries(daemon.state_dir)
+            if e["kind"] == "gate"
+        ]
+        assert gates and gates[-1]["passed"] is False
+
+    def test_eval_regression_rolls_canary_back(self, tmp_path, daemon_factory):
+        """The acceptance scenario: an induced eval-score regression on
+        the candidate auto-rolls the canary back onto the incumbent
+        checkpoint, with the rollback decision journaled."""
+        pools = []
+
+        def pool_provider(stage):
+            pool = FakePool(replicas=4)
+            pools.append(pool)
+            return pool
+
+        daemon = daemon_factory(pipeline_pool_provider=pool_provider)
+        # pipeline 1 promotes at 0.9 and becomes the incumbent
+        pid1 = daemon.pipelines.submit(
+            PipelineSpec.from_dict(
+                _spec(str(tmp_path), "v1", 0.9, canary_fraction=0.5)
+            ),
+            tenant="root",
+        )
+        assert _wait_terminal(daemon, pid1)["state"] == "PROMOTED"
+        incumbent_ckpt = daemon.pipelines.incumbent["ckpt"]
+        # pipeline 2 regresses to 0.3 < incumbent 0.9 -> auto-rollback
+        pid2 = daemon.pipelines.submit(
+            PipelineSpec.from_dict(
+                _spec(str(tmp_path), "v2", 0.3, canary_fraction=0.5)
+            ),
+            tenant="root",
+        )
+        doc = _wait_terminal(daemon, pid2)
+        assert doc["state"] == "ROLLED_BACK", doc
+        states = {s["name"]: s["state"] for s in doc["stages"]}
+        assert states["promote"] == "ROLLED_BACK"
+        # the canary cohort (replicas 0,1 of 4 at fraction 0.5) went
+        # forward onto v2, then back onto the incumbent's checkpoint
+        pool2 = pools[-1]
+        v2_ckpt = os.path.join(str(tmp_path), "ckpt-v2")
+        assert pool2.rolls == [
+            (0, v2_ckpt),
+            (1, v2_ckpt),
+            (0, incumbent_ckpt),
+            (1, incumbent_ckpt),
+        ]
+        # the rollback decision is durably journaled with its reason
+        rollbacks = [
+            e
+            for e in _journal_entries(daemon.state_dir)
+            if e["kind"] == "promote_step" and e.get("event") == "rollback"
+        ]
+        assert rollbacks and rollbacks[-1]["reason"] == "eval_regression"
+        assert rollbacks[-1]["incumbent"] == incumbent_ckpt
+        # the incumbent is unchanged: v1 still owns the pool
+        assert daemon.pipelines.incumbent["ckpt"] == incumbent_ckpt
+
+    def test_slo_burn_rolls_canary_back(self, tmp_path, daemon_factory):
+        """The other acceptance gate: an induced SLO burn at/over the
+        threshold during the canary window rolls back."""
+        pools = []
+
+        def pool_provider(stage):
+            pool = FakePool(replicas=2)
+            pools.append(pool)
+            return pool
+
+        daemon = daemon_factory(pipeline_pool_provider=pool_provider)
+        daemon.pipelines.set_slo_signal(lambda: 2.0)  # burning hard
+        pid = daemon.pipelines.submit(
+            PipelineSpec.from_dict(
+                _spec(
+                    str(tmp_path),
+                    "v1",
+                    0.9,
+                    canary_fraction=0.5,
+                    burn_threshold=1.0,
+                    observe_s=0.2,
+                )
+            ),
+            tenant="root",
+        )
+        doc = _wait_terminal(daemon, pid)
+        assert doc["state"] == "ROLLED_BACK", doc
+        rollbacks = [
+            e
+            for e in _journal_entries(daemon.state_dir)
+            if e["kind"] == "promote_step" and e.get("event") == "rollback"
+        ]
+        assert rollbacks and rollbacks[-1]["reason"] == "slo_burn"
+        assert daemon.pipelines.incumbent is None  # nothing ever promoted
+
+    def test_restart_mid_canary_resumes_pipeline(
+        self, tmp_path, daemon_factory
+    ):
+        """Kill the daemon mid-canary: the restarted daemon rehydrates the
+        pipeline from its journal and resumes the canary from the exact
+        replica it stopped at — completed stages are not re-run, rolled
+        replicas are not re-rolled."""
+        release = threading.Event()
+        pool1 = FakePool(replicas=4, block_on=(1, release))
+
+        daemon1 = daemon_factory(pipeline_pool_provider=lambda s: pool1)
+        pid = daemon1.pipelines.submit(
+            PipelineSpec.from_dict(
+                _spec(str(tmp_path), "v1", 0.9, canary_fraction=0.5)
+            ),
+            tenant="root",
+        )
+        # wait until replica 0 is rolled and journaled, replica 1 blocked
+        deadline = time.monotonic() + 60
+        while not pool1.rolls:
+            assert time.monotonic() < deadline, "canary never started"
+            time.sleep(0.02)
+        assert pool1.rolls[0][0] == 0
+        # kill the daemon mid-canary (the promote thread is parked on
+        # replica 1; close() gives up on joining it after its timeout)
+        daemon1.close()
+        mid = _journal_entries(daemon1.state_dir)
+        rolled = [
+            e
+            for e in mid
+            if e["kind"] == "promote_step"
+            and e.get("event") == "replica_rolled"
+        ]
+        assert [e["replica"] for e in rolled] == [0]
+
+        pool2 = FakePool(replicas=4)
+        daemon2 = daemon_factory(pipeline_pool_provider=lambda s: pool2)
+        doc = _wait_terminal(daemon2, pid)
+        assert doc["state"] == "PROMOTED", doc
+        # replica 0 (already rolled pre-restart) was NOT re-rolled; the
+        # resumed canary started at replica 1 and promotion finished 2,3
+        assert [r for r, _ in pool2.rolls] == [1, 2, 3]
+        # completed train/eval stages were not re-submitted: exactly one
+        # stage_submit journal entry per app stage across both daemons
+        submits = [
+            e
+            for e in _journal_entries(daemon2.state_dir)
+            if e["kind"] == "stage_submit" and not e.get("promote")
+        ]
+        assert sorted(e["stage"] for e in submits) == ["eval", "train"]
+        # the resumed attempt journaled what it inherited
+        starts = [
+            e
+            for e in _journal_entries(daemon2.state_dir)
+            if e["kind"] == "promote_step"
+            and e.get("event") == "canary_start"
+        ]
+        assert starts[-1]["resumed"] == [0]
+        release.set()  # unpark the orphaned thread
+
+    def test_cancel_over_http(self, tmp_path, daemon_factory):
+        from torchx_tpu.control.client import ControlClient
+
+        daemon = daemon_factory().start()
+        client = ControlClient(daemon.addr, daemon.root_token)
+        spec = _spec(str(tmp_path), "v1", 0.9)
+        # a train stage that runs long enough to cancel
+        spec["stages"][0]["args"] = ["-c", "import time; time.sleep(60)"]
+        pid = client.pipeline_submit(spec)["pipeline"]
+        doc = client.pipeline_cancel(pid)
+        assert doc["state"] == "CANCELLED"
+        assert daemon.pipelines.status(pid)["state"] == "CANCELLED"
+
+    def test_unknown_pipeline_is_404_over_http(self, daemon_factory):
+        from torchx_tpu.control.client import ControlClient, ControlClientError
+
+        daemon = daemon_factory().start()
+        client = ControlClient(daemon.addr, daemon.root_token)
+        with pytest.raises(ControlClientError) as ei:
+            client.pipeline_status("pl_999")
+        assert ei.value.code == 404
+        with pytest.raises(ControlClientError) as ei:
+            client.pipeline_submit({"name": "x", "stages": []})
+        assert ei.value.code == 400
+
+
+# ---------------------------------------------------------------------------
+# deprecation shims
+# ---------------------------------------------------------------------------
+
+
+class TestLegacyShims:
+    def test_kfp_shim_warns_and_reexports(self):
+        import importlib
+        import warnings
+
+        import torchx_tpu.pipelines.kfp as kfp
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            importlib.reload(kfp)
+        assert any(
+            issubclass(x.category, UserWarning) and "deprecated" in str(x.message)
+            for x in w
+        ), [x.category for x in w]
+        from torchx_tpu.pipelines.legacy import pipeline_to_workflow
+
+        assert kfp.pipeline_to_workflow is pipeline_to_workflow
+
+    def test_local_runner_shim_warns_and_reexports(self):
+        import importlib
+        import warnings
+
+        import torchx_tpu.pipelines.local_runner as lr
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            importlib.reload(lr)
+        assert any(
+            issubclass(x.category, UserWarning) and "deprecated" in str(x.message)
+            for x in w
+        ), [x.category for x in w]
+        from torchx_tpu.pipelines.legacy import run_pipeline
+
+        assert lr.run_pipeline is run_pipeline
+
+
+# ---------------------------------------------------------------------------
+# TPX603: promotion without a scrape path
+# ---------------------------------------------------------------------------
+
+
+class TestPromotionScrapeRule:
+    def app(self, kind="promote"):
+        from torchx_tpu.specs.api import AppDef, Role
+
+        role = Role(name="p", image="i", entrypoint="x")
+        role.metadata["tpx/pipeline"] = kind
+        return AppDef(name="app", roles=[role])
+
+    def report(self, app, scrape: bool):
+        from torchx_tpu.analyze import analyze
+        from torchx_tpu.schedulers.api import SchedulerCapabilities
+
+        return analyze(
+            app,
+            scheduler="local",
+            capabilities=SchedulerCapabilities(metricz_scrape=scrape),
+        )
+
+    @staticmethod
+    def codes(report):
+        return {d.code for d in report.diagnostics}
+
+    def test_warns_on_scrapeless_backend(self):
+        report = self.report(self.app(), scrape=False)
+        assert "TPX603" in self.codes(report)
+        d = next(x for x in report.diagnostics if x.code == "TPX603")
+        assert d.severity.name == "WARNING"
+        assert "eval-score-only" in d.message
+
+    def test_quiet_with_scrape_path(self):
+        assert "TPX603" not in self.codes(self.report(self.app(), scrape=True))
+
+    def test_quiet_for_non_promote_stages(self):
+        assert "TPX603" not in self.codes(
+            self.report(self.app(kind="eval"), scrape=False)
+        )

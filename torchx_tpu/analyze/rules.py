@@ -1125,6 +1125,48 @@ def check_fleet_class(ctx: RuleContext) -> Iterator[Diagnostic]:
         )
 
 
+@rule("promotion-scrape")
+def check_promotion_scrape(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX603: a promotion stage on a backend the canary gate can't see.
+
+    The pipeline engine's promote stage gates promote-to-100% on BOTH the
+    eval score and the SLO engine's live burn rate over the canary
+    replicas. Burn rates come from scraping replica ``/metricz``; on a
+    backend whose capability profile has no scrape path the burn signal
+    sees zero samples, so the canary gate silently degrades to
+    eval-score-only — an SLO regression on the canary would promote
+    anyway. Promotion stages are recognized by the
+    ``tpx/pipeline=promote`` role metadata the pipeline executor stamps."""
+    from torchx_tpu.pipelines.dag import ROLE_METADATA_KEY
+
+    cap = ctx.capabilities
+    if ctx.scheduler is None or cap is None or cap.metricz_scrape:
+        return
+    for role in ctx.app.roles:
+        if role.metadata.get(ROLE_METADATA_KEY) != "promote":
+            continue
+        yield Diagnostic(
+            code="TPX603",
+            severity=Severity.WARNING,
+            role=role.name,
+            field="metadata",
+            message=(
+                f"promotion stage targets scheduler {ctx.scheduler!r}"
+                " which has no /metricz scrape path"
+                " (metricz_scrape=False): the canary burn-rate gate sees"
+                " zero samples and silently degrades to eval-score-only —"
+                " an SLO regression on the canary replicas would be"
+                " promoted to 100%"
+            ),
+            hint=(
+                "run the promote stage on a scrape-reachable backend"
+                " (local, docker, gke, slurm) so the burn gate has"
+                " samples, or accept eval-score-only gating and lower the"
+                " eval threshold margin accordingly"
+            ),
+        )
+
+
 # ---------------------------------------------------------------------------
 # TPX7xx — deep preflight: static sharding / HBM / collective analysis
 # ---------------------------------------------------------------------------

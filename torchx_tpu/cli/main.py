@@ -49,6 +49,7 @@ BUILTIN_CMDS: dict[str, tuple[str, str]] = {
     "control": ("torchx_tpu.cli.cmd_control", "CmdControl"),
     "queue": ("torchx_tpu.cli.cmd_queue", "CmdQueue"),
     "top": ("torchx_tpu.cli.cmd_top", "CmdTop"),
+    "pipeline": ("torchx_tpu.cli.cmd_pipeline", "CmdPipeline"),
 }
 
 

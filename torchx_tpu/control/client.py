@@ -208,6 +208,29 @@ class ControlClient:
         """Drop a scrape target by source name."""
         return self._request("/v1/metrics/targets", {"remove": name})
 
+    def pipeline_submit(self, spec: dict) -> dict:
+        """Submit a train→eval→promote DAG (``POST /v1/pipelines``).
+
+        ``spec`` is a :class:`~torchx_tpu.pipelines.dag.PipelineSpec`
+        dict (``{"name", "stages": [...]}``); returns
+        ``{"pipeline": "pl_N"}``."""
+        return self._request("/v1/pipelines", {"spec": spec})
+
+    def pipeline_status(self, pipeline: Optional[str] = None) -> dict:
+        """One pipeline's stage-by-stage record, or the full list +
+        current incumbent when ``pipeline`` is None."""
+        path = "/v1/pipelines"
+        if pipeline:
+            from urllib.parse import quote
+
+            path += f"?pipeline={quote(pipeline, safe='')}"
+        return self._request(path)
+
+    def pipeline_cancel(self, pipeline: str) -> dict:
+        """Cancel a running pipeline: in-flight stages are cancelled on
+        their backends and the pipeline journals CANCELLED."""
+        return self._request("/v1/pipelines/cancel", {"pipeline": pipeline})
+
     def status(self, handle: str) -> dict:
         """One job's recorded state: answered from the daemon's
         reconciler journal + shared describe cache, not a fresh backend

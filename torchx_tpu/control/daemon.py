@@ -30,6 +30,10 @@ API (JSON; Bearer-token auth on every ``/v1`` route):
     GET  /v1/alerts               -> active SLO alerts + last burn rates
     POST /v1/metrics/targets {"url", "name"?, "remove"?}
                                   -> register/remove a /metricz scrape
+    POST /v1/pipelines {"spec"}   -> {"pipeline"}: submit a train→eval→
+                                  promote DAG to the pipeline engine
+    GET  /v1/pipelines[?pipeline=] -> one pipeline's full record, or all
+    POST /v1/pipelines/cancel {"pipeline"} -> the cancelled record
 
 The daemon also hosts the fleet **telemetry plane**: a
 :class:`~torchx_tpu.obs.telemetry.Collector` scrapes registered replica
@@ -178,6 +182,107 @@ class _FleetExecutor:
             logger.debug("fleet cancel of %s failed: %s", handle, e)
 
 
+class _PipelineExecutor:
+    """The pipeline engine's stage submitter.
+
+    Materializes the stage component, stamps every role with the stage
+    kind (``tpx/pipeline`` metadata — the TPX603 rule's anchor), and
+    submits: through the fleet scheduler with the stage's priority class
+    when one is attached (eval=interactive, canary=serve), else directly
+    through the Runner with the same journal/track bookkeeping as
+    ``/v1/submit``."""
+
+    def __init__(self, daemon: "ControlDaemon") -> None:
+        self._daemon = daemon
+
+    def submit(
+        self, tenant: str, pipeline: str, stage: Any, args: list[str]
+    ) -> dict:
+        from torchx_tpu.pipelines.dag import ROLE_METADATA_KEY
+
+        daemon = self._daemon
+        cfg = daemon._parse_cfg(stage.scheduler, {"cfg": dict(stage.cfg)})
+        info = daemon.runner.dryrun_component(
+            stage.component, list(args), stage.scheduler, cfg=cfg
+        )
+        app = info._app
+        for role in app.roles:
+            role.metadata[ROLE_METADATA_KEY] = stage.kind
+        if daemon.fleet is not None:
+            return self._fleet_submit(tenant, stage, app, cfg)
+        handle = daemon.runner.run(
+            app, stage.scheduler, cfg=cfg, no_lint=True
+        )
+        sched_name, app_id = daemon._split_handle(handle)
+        with daemon._lock:
+            daemon._jobs[handle] = tenant
+        daemon.reconciler.ingest(
+            StateEvent(
+                scheduler=sched_name,
+                app_id=app_id,
+                state=AppState.SUBMITTED,
+                source="pipeline",
+            )
+        )
+        daemon.reconciler.track(
+            sched_name, daemon.runner._scheduler(sched_name), app_id
+        )
+        return {"handle": handle}
+
+    def _fleet_submit(
+        self, tenant: str, stage: Any, app: Any, cfg: dict
+    ) -> dict:
+        from torchx_tpu.fleet.model import GangRequest
+        from torchx_tpu.specs.serialize import appdef_to_dict
+
+        daemon = self._daemon
+        role = app.roles[0] if app.roles else None
+        tpu = role.resource.tpu if role is not None else None
+        for r in app.roles:
+            r.metadata["fleet/class"] = stage.priority
+        gang = GangRequest(
+            job="",
+            tenant=tenant,
+            klass=stage.priority,
+            replicas=(
+                int(stage.replicas)
+                if int(stage.replicas) > 1
+                else (role.num_replicas if role is not None else 1)
+            ),
+            chips_per_replica=tpu.chips if tpu is not None else 1,
+        )
+        recipe = {
+            "appdef": appdef_to_dict(app),
+            "scheduler": stage.scheduler,
+            "cfg": cfg,
+            "workspace": None,
+        }
+        result = daemon.fleet.submit(gang, recipe)
+        status = result.get("status")
+        if status == "infeasible":
+            raise RuntimeError(
+                f"gang cannot fit this fleet: {result.get('reason')}"
+            )
+        if status == "placed":
+            return {"handle": result.get("handle", "")}
+        return {"queued": True, "fleet_job": result["job"]}
+
+    def resolve(self, fleet_job: str) -> str:
+        """Handle of a fleet-queued stage once the market placed it."""
+        if self._daemon.fleet is None:
+            return ""
+        for entry in self._daemon.fleet.queue_snapshot().get("running", []):
+            if str(entry.get("job", "")) == fleet_job:
+                return str(entry.get("handle", ""))
+        return ""
+
+    def cancel(self, handle: str) -> None:
+        try:
+            self._daemon.runner.cancel(handle)
+        except Exception as e:  # noqa: BLE001 - fail-fast cancel is best-effort
+            logger.debug("pipeline cancel of %s failed: %s", handle, e)
+
+
 class ControlDaemon:
     """The daemon's state + HTTP server; see the module docstring.
 
@@ -216,6 +321,7 @@ class ControlDaemon:
         slos: Optional[list] = None,
         scrape_interval: Optional[float] = None,
         telemetry: bool = True,
+        pipeline_pool_provider: Optional[Any] = None,
     ) -> None:
         if runner is None:
             from torchx_tpu.runner.api import get_runner
@@ -298,6 +404,42 @@ class ControlDaemon:
                     logger.warning(
                         "fleet rehydrate: cannot track %s: %s", handle, e
                     )
+        # the pipeline engine rides the same reconciler event stream and
+        # the same journal-then-act durability contract as the fleet; it
+        # is always on (a daemon without pipelines is just one that never
+        # received a /v1/pipelines submit)
+        from torchx_tpu.pipelines.engine import PipelineEngine
+
+        pipeline_slo = None
+        if self.slo_engine is not None:
+            slo_engine = self.slo_engine
+            pipeline_slo = lambda: slo_engine.max_burn(  # noqa: E731
+                metric_prefix="tpx_"
+            )
+        self.pipelines = PipelineEngine(
+            os.path.join(self.state_dir, "pipelines.jsonl"),
+            executor=_PipelineExecutor(self),
+            reconciler=self.reconciler,
+            slo_signal=pipeline_slo,
+            pool_provider=pipeline_pool_provider,
+        )
+        self.reconciler.subscribe(self.pipelines.on_event)
+        for item in self.pipelines.rehydrate():
+            handle = str(item.get("handle") or "")
+            if not handle:
+                continue
+            with self._lock:
+                self._jobs[handle] = str(item.get("tenant", ""))
+            try:
+                self.reconciler.track(
+                    item["scheduler"],
+                    runner._scheduler(item["scheduler"]),
+                    item["app_id"],
+                )
+            except Exception as e:  # noqa: BLE001 - degrade to poll
+                logger.warning(
+                    "pipeline rehydrate: cannot track %s: %s", handle, e
+                )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -359,6 +501,8 @@ class ControlDaemon:
         if self._closed:
             return
         self._closed = True
+        if self.pipelines is not None:
+            self.pipelines.close()
         if self.collector is not None:
             self.collector.stop()
         if self._serving:
@@ -757,6 +901,43 @@ class ControlDaemon:
         )
         return {"source": source, "targets": self.collector.targets()}
 
+    # -- pipelines ---------------------------------------------------------
+
+    def _op_pipeline_submit(self, tenant: str, req: dict) -> dict:
+        """``POST /v1/pipelines``: validate the spec, journal, start."""
+        from torchx_tpu.pipelines.dag import PipelineSpec
+
+        doc = req.get("spec")
+        if not isinstance(doc, dict):
+            raise _DaemonError(400, "submit needs a 'spec' object")
+        try:
+            spec = PipelineSpec.from_dict(doc)
+        except (ValueError, KeyError, TypeError) as e:
+            raise _DaemonError(400, f"bad pipeline spec: {e}") from e
+        try:
+            pid = self.pipelines.submit(spec, tenant=tenant)
+        except Exception as e:  # noqa: BLE001 - surfaced to the client
+            raise _DaemonError(400, f"{type(e).__name__}: {e}") from e
+        return {"pipeline": pid}
+
+    def _op_pipeline_status(self, tenant: str, query: dict) -> dict:
+        """``GET /v1/pipelines[?pipeline=]``: one record or the list."""
+        pid = (query.get("pipeline") or [None])[0]
+        try:
+            return self.pipelines.status(str(pid) if pid else None)
+        except KeyError as e:
+            raise _DaemonError(404, str(e)) from e
+
+    def _op_pipeline_cancel(self, tenant: str, req: dict) -> dict:
+        """``POST /v1/pipelines/cancel``: cancel a pipeline's stages."""
+        pid = str(req.get("pipeline", ""))
+        if not pid:
+            raise _DaemonError(400, "missing pipeline id")
+        try:
+            return self.pipelines.cancel(pid)
+        except KeyError as e:
+            raise _DaemonError(404, str(e)) from e
+
     def render_metricz(self) -> str:
         """The ``/metricz`` body: the cross-source fleet aggregate when
         the telemetry plane is up, else just this process's registry."""
@@ -876,6 +1057,13 @@ class ControlDaemon:
                         "queue",
                         lambda: daemon._op_queue(self._tenant(), query),
                     )
+                elif url.path == "/v1/pipelines":
+                    self._run(
+                        "pipeline_status",
+                        lambda: daemon._op_pipeline_status(
+                            self._tenant(), query
+                        ),
+                    )
                 elif url.path == "/v1/logs":
                     self._logs(query)
                 else:
@@ -902,6 +1090,20 @@ class ControlDaemon:
                     self._run(
                         "metrics_targets",
                         lambda: daemon._op_metrics_targets(
+                            self._tenant(), self._body()
+                        ),
+                    )
+                elif url.path == "/v1/pipelines":
+                    self._run(
+                        "pipeline_submit",
+                        lambda: daemon._op_pipeline_submit(
+                            self._tenant(), self._body()
+                        ),
+                    )
+                elif url.path == "/v1/pipelines/cancel":
+                    self._run(
+                        "pipeline_cancel",
+                        lambda: daemon._op_pipeline_cancel(
                             self._tenant(), self._body()
                         ),
                     )

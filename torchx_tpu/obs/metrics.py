@@ -675,3 +675,49 @@ FLEET_GANG_WAIT_SECONDS = REGISTRY.histogram(
     "gang wait time from enqueue to placement in seconds",
     ("klass",),
 )
+
+
+# -- pipelines (torchx_tpu/pipelines/) ------------------------------------
+
+#: pipelines that reached a terminal state, by that state
+#: (PROMOTED/SUCCEEDED/FAILED/ROLLED_BACK/CANCELLED).
+PIPELINE_RUNS = REGISTRY.counter(
+    "tpx_pipeline_runs_total",
+    "pipelines finished, by terminal state",
+    ("state",),
+)
+
+#: pipelines currently in a non-terminal state.
+PIPELINE_ACTIVE = REGISTRY.gauge(
+    "tpx_pipeline_active",
+    "pipelines currently pending, running, or in canary",
+)
+
+#: stage transitions, by stage kind and the state entered.
+PIPELINE_STAGES = REGISTRY.counter(
+    "tpx_pipeline_stages_total",
+    "pipeline stage transitions, by kind and state",
+    ("kind", "state"),
+)
+
+#: eval-gate and canary-gate verdicts.
+PIPELINE_GATES = REGISTRY.counter(
+    "tpx_pipeline_gate_decisions_total",
+    "pipeline gate decisions (eval threshold + canary gates)",
+    ("decision",),
+)
+
+#: automatic canary rollbacks, by reason (eval_regression/slo_burn/
+#: rollout_failed).
+PIPELINE_ROLLBACKS = REGISTRY.counter(
+    "tpx_pipeline_rollbacks_total",
+    "canary rollbacks executed, by reason",
+    ("reason",),
+)
+
+#: wall-clock from stage submit to terminal, per stage kind.
+PIPELINE_STAGE_SECONDS = REGISTRY.histogram(
+    "tpx_pipeline_stage_seconds",
+    "pipeline stage duration from submit to terminal in seconds",
+    ("kind",),
+)

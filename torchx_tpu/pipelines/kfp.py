@@ -1,111 +1,11 @@
-"""Kubeflow Pipelines adapter: Pipeline DAG -> Argo Workflow spec.
+"""Deprecated shim: moved to :mod:`torchx_tpu.pipelines.legacy`.
 
-The reference's pipelines namespace promises provider adapters without
-shipping one (torchx/pipelines/__init__.py:1-14); this module delivers the
-KFP path for the TPU build: each stage's AppDef role becomes an Argo
-Workflow template (container + TPU resource limits + node selectors,
-reusing the GKE scheduler's pod materialization), and the DAG wires
-dependencies. The result is a plain dict — submit it with `argo submit`,
-the Argo REST API, or mount it into a KFP v2 pipeline; no kfp package is
-required to materialize it.
-
-Multi-host TPU stages inside a linear workflow engine: Argo steps are
-single pods, so a stage whose role needs a multi-host slice is emitted as
-a ``resource`` template creating the same JobSet the GKE scheduler would
-submit, with success/failure conditions watching the JobSet status.
+The DAG engine (:mod:`torchx_tpu.pipelines.engine`) owns the pipelines
+namespace now; the KFP/Argo workflow materializer lives on unchanged in
+``legacy`` and stays importable from here.
 """
 
-from __future__ import annotations
+from torchx_tpu.deprecations import deprecated_module
+from torchx_tpu.pipelines.legacy import pipeline_to_workflow  # noqa: F401
 
-import json
-from typing import Any
-
-from torchx_tpu.pipelines.api import Pipeline, topo_order
-from torchx_tpu.schedulers.gke_scheduler import (
-    app_to_jobset,
-    role_to_pod_template,
-    sanitize_name,
-)
-from torchx_tpu.specs.api import AppDef
-
-
-def _stage_template(name: str, app: AppDef, namespace: str) -> dict[str, Any]:
-    role = app.roles[0]
-    multi_host = (
-        (role.resource.tpu is not None and role.resource.tpu.hosts > 1)
-        or len(app.roles) > 1
-        or role.num_replicas > 1
-    )
-    if multi_host:
-        jobset = app_to_jobset(
-            app,
-            # same 40-char budget as GKEScheduler._submit_dryrun: leaves
-            # room in the 63-char pod-name cap for the role name plus
-            # job/pod index suffixes
-            app_name=sanitize_name(f"{name}-{app.name}", max_len=40),
-            namespace=namespace,
-            queue=None,
-            service_account=None,
-        )
-        return {
-            "name": name,
-            "resource": {
-                "action": "create",
-                "setOwnerReference": True,
-                "successCondition": "status.terminalState == Completed",
-                "failureCondition": "status.terminalState == Failed",
-                # Argo's resource.manifest field is a string (YAML/JSON)
-                "manifest": json.dumps(jobset, indent=2),
-            },
-        }
-    pod = role_to_pod_template(
-        role,
-        app_name=sanitize_name(app.name),
-        coordinator_host="localhost",
-        coordinator_port=8476,
-        service_account=None,
-    )
-    return {
-        "name": name,
-        "container": pod["spec"]["containers"][0],
-        "metadata": pod["metadata"],
-        "nodeSelector": pod["spec"].get("nodeSelector", {}),
-        "tolerations": pod["spec"].get("tolerations", []),
-        "volumes": pod["spec"].get("volumes", []),
-    }
-
-
-def pipeline_to_workflow(
-    pipeline: Pipeline, namespace: str = "default"
-) -> dict[str, Any]:
-    """-> Argo Workflow resource dict implementing the DAG."""
-    topo_order(pipeline)  # validates names/cycles
-    # sanitize each stage name once and reuse the result so template/task/
-    # dependency refs all carry the identical string
-    names = {s.name: sanitize_name(s.name) for s in pipeline.stages}
-    templates = [
-        _stage_template(names[s.name], s.app, namespace) for s in pipeline.stages
-    ]
-    dag_tasks = [
-        {
-            "name": names[s.name],
-            "template": names[s.name],
-            "dependencies": [names[d] for d in s.depends_on],
-        }
-        for s in pipeline.stages
-    ]
-    return {
-        "apiVersion": "argoproj.io/v1alpha1",
-        "kind": "Workflow",
-        "metadata": {
-            "generateName": f"{sanitize_name(pipeline.name)}-",
-            "namespace": namespace,
-        },
-        "spec": {
-            "entrypoint": "dag",
-            "templates": [
-                {"name": "dag", "dag": {"tasks": dag_tasks}},
-                *templates,
-            ],
-        },
-    }
+deprecated_module(__name__, replacement="torchx_tpu.pipelines.legacy")

@@ -1,113 +1,14 @@
-"""Run a Pipeline DAG through the Runner on any registered scheduler.
+"""Deprecated shim: moved to :mod:`torchx_tpu.pipelines.legacy`.
 
-Generations run stage-by-stage: all stages of a generation are submitted
-concurrently, then awaited; a failed stage fails the pipeline and cancels
-its in-flight siblings (fail-fast). Each stage's run is lineage-linked to
-its dependencies via the tracker's parent-run mechanism.
+The blocking generation-by-generation runner predates the journaled,
+event-driven :mod:`torchx_tpu.pipelines.engine`; it lives on unchanged
+in ``legacy`` and stays importable from here.
 """
 
-from __future__ import annotations
+from torchx_tpu.deprecations import deprecated_module
+from torchx_tpu.pipelines.legacy import (  # noqa: F401
+    PipelineRun,
+    run_pipeline,
+)
 
-import logging
-import time
-from dataclasses import dataclass, field
-from typing import Mapping, Optional
-
-from torchx_tpu.pipelines.api import Pipeline, topo_order
-from torchx_tpu.runner.api import Runner
-from torchx_tpu.specs.api import AppHandle, AppState, AppStatus, CfgVal
-
-logger = logging.getLogger(__name__)
-
-
-@dataclass
-class PipelineRun:
-    pipeline: str
-    handles: dict[str, AppHandle] = field(default_factory=dict)
-    statuses: dict[str, AppStatus] = field(default_factory=dict)
-
-    @property
-    def state(self) -> AppState:
-        if any(
-            s.state in (AppState.FAILED, AppState.CANCELLED)
-            for s in self.statuses.values()
-        ):
-            return AppState.FAILED
-        if len(self.statuses) < len(self.handles) or not self.handles:
-            return AppState.RUNNING
-        return AppState.SUCCEEDED
-
-
-def run_pipeline(
-    runner: Runner,
-    pipeline: Pipeline,
-    scheduler: str,
-    cfg: Optional[Mapping[str, CfgVal]] = None,
-    wait_interval: float = 1.0,
-) -> PipelineRun:
-    """Execute the DAG; returns per-stage handles + terminal statuses."""
-    run = PipelineRun(pipeline=pipeline.name)
-    for generation in topo_order(pipeline):
-        # submit the whole generation
-        for stage in generation:
-            parent = (
-                run.handles.get(stage.depends_on[0]) if stage.depends_on else None
-            )
-            handle = runner.run(
-                stage.app, scheduler, cfg, parent_run_id=parent
-            )
-            run.handles[stage.name] = handle
-            _link_extra_parents(run, stage, handle)
-            logger.info("pipeline %s: stage %s -> %s", pipeline.name, stage.name, handle)
-
-        # poll the generation concurrently: first failure cancels the
-        # still-running siblings (fail-fast — a dead stage must not let a
-        # 3-hour TPU sibling run to completion)
-        pending = {s.name for s in generation}
-        failed = False
-        while pending:
-            for name in list(pending):
-                status = runner.status(run.handles[name])
-                if status is None:
-                    raise RuntimeError(f"stage {name} vanished ({run.handles[name]})")
-                if status.is_terminal():
-                    pending.discard(name)
-                    run.statuses[name] = status
-                    if status.state != AppState.SUCCEEDED:
-                        failed = True
-            if failed and pending:
-                for name in list(pending):
-                    logger.warning("cancelling in-flight stage %s", name)
-                    runner.cancel(run.handles[name])
-                    st = runner.status(run.handles[name])
-                    if st is not None:
-                        run.statuses[name] = st
-                    pending.discard(name)
-                break
-            if pending:
-                time.sleep(wait_interval)
-        if failed:
-            logger.error("pipeline %s failed; skipping downstream stages", pipeline.name)
-            return run
-    return run
-
-
-def _link_extra_parents(run: PipelineRun, stage, handle: AppHandle) -> None:  # noqa: ANN001
-    """Stages with multiple dependencies get lineage to ALL parents: the
-    first rides the runner's parent_run_id env; the rest are written
-    client-side into the configured trackers (best-effort)."""
-    extra = [run.handles[d] for d in stage.depends_on[1:] if d in run.handles]
-    if not extra:
-        return
-    try:
-        from torchx_tpu.runner.config import load_tracker_sections
-        from torchx_tpu.tracker.api import _load_tracker
-
-        for name, config in load_tracker_sections().items():
-            tracker = _load_tracker(name, config)
-            if tracker is None:
-                continue
-            for parent in extra:
-                tracker.add_source(handle, parent)
-    except Exception as e:  # noqa: BLE001 - lineage is best-effort
-        logger.warning("could not record extra lineage for %s: %s", stage.name, e)
+deprecated_module(__name__, replacement="torchx_tpu.pipelines.legacy")
