@@ -6,39 +6,39 @@ does class-aware scheduling with an elastic preemption market buy over
 the naive baseline (one FIFO queue, all-or-nothing placement, no
 preemption — the pre-fleet daemon's behavior with a queue bolted on)?
 
-Both phases run the SAME Poisson job trace (diurnal rate modulation,
-seeded RNG) against the same modeled fleet in virtual time:
+Both phases run the SAME Poisson job trace
+(:func:`~torchx_tpu.sim.traffic.diurnal_trace`, seeded) against the same
+modeled fleet in virtual time:
 
 * **fifo** — strict arrival order with head-of-line blocking: a gang
   waits until the head of the queue fits, serve traffic stuck behind
   wide batch gangs.
 * **fleet** — the real :class:`~torchx_tpu.fleet.FleetScheduler` (not a
-  reimplementation) driven through a simulator
-  :class:`~torchx_tpu.fleet.FleetExecutor` and an injected virtual
-  clock: priority classes, fair share, gang placement, and the market
-  (elastic victims shrink via mesh-reshape instead of dying; grow-backs
-  repay the debt when capacity frees).
+  reimplementation) driven through the simulator's
+  :class:`~torchx_tpu.sim.SimExecutor`: priority classes, fair share,
+  gang placement, and the market (elastic victims shrink via
+  mesh-reshape instead of dying; grow-backs repay the debt when
+  capacity frees).
 
-Shrunk gangs run slower (speed scales with the replica fraction), so
-the market's cost side is modeled, not assumed away. Reported per
-phase: gang wait p50/p99 per class, chip utilization over the
-makespan, completions, and kills — for the fleet phase, `reshapes` is
-the count of preemptions the market turned into shrinks (kills
-avoided). The headline: serve/interactive p99 wait must drop vs FIFO
+This script is a thin client of :mod:`torchx_tpu.sim` — the trace
+generator and the virtual-time executor live there (the full scenario
+harness is ``tpx sim run``); only the FIFO baseline and the scorecard
+are bench-specific. Shrunk gangs run slower (speed scales with the
+replica fraction), so the market's cost side is modeled, not assumed
+away. The headline: serve/interactive p99 wait must drop vs FIFO
 without killing batch throughput.
 
 Usage:
-    python scripts/bench_fleet.py [--hours 2] [--slices 16]
-        [--seed 11] [--out BENCH_FLEET_r01.json]
+    python scripts/bench_fleet.py [--hours 2] [--slices 160]
+        [--seed 11] [--out BENCH_FLEET_r02.json]
 """
 
 from __future__ import annotations
 
 import argparse
 import heapq
-import json
 import math
-import random
+import json
 import statistics
 import tempfile
 
@@ -56,73 +56,14 @@ def _quantiles(samples: list[float]) -> dict:
 
 
 # ---------------------------------------------------------------------------
-# the trace
-# ---------------------------------------------------------------------------
-
-#: class -> (arrival weight, (min,max) duration seconds, replica choices)
-CLASS_MIX = {
-    "serve": (0.15, (120.0, 600.0), (1, 2)),
-    "interactive": (0.25, (60.0, 300.0), (1, 2)),
-    "batch": (0.40, (600.0, 1800.0), (2, 4)),
-    "preemptible": (0.20, (600.0, 1800.0), (2, 4)),
-}
-
-
-def make_trace(hours: float, seed: int) -> list[dict]:
-    """Poisson arrivals with a diurnal rate (one peak per simulated
-    'day' compressed into the horizon), seeded -> identical for both
-    phases."""
-    rng = random.Random(seed)
-    horizon = hours * 3600.0
-    base_rate = 1.0 / 45.0  # one arrival every ~45s off-peak
-    jobs = []
-    t = 0.0
-    i = 0
-    while True:
-        # thinning: sample at the peak rate, accept by the diurnal curve
-        peak = base_rate * 2.5
-        t += rng.expovariate(peak)
-        if t >= horizon:
-            break
-        phase = 2.0 * math.pi * (t / horizon)
-        rate = base_rate * (1.75 + 1.5 * math.sin(phase))  # 0.25x..3.25x
-        if rng.random() > rate / peak:
-            continue
-        r = rng.random()
-        acc = 0.0
-        klass = "batch"
-        for name, (w, _dur, _reps) in CLASS_MIX.items():
-            acc += w
-            if r <= acc:
-                klass = name
-                break
-        _w, (dlo, dhi), reps = CLASS_MIX[klass]
-        elastic = klass in ("batch", "preemptible")
-        replicas = rng.choice(reps)
-        jobs.append(
-            {
-                "job": f"sim-{i:04d}",
-                "arrival": t,
-                "klass": klass,
-                "tenant": rng.choice(("ads", "search", "research")),
-                "replicas": replicas,
-                "duration": rng.uniform(dlo, dhi),
-                "elastic": elastic and replicas > 1,
-            }
-        )
-        i += 1
-    return jobs
-
-
-# ---------------------------------------------------------------------------
 # phase A: FIFO baseline
 # ---------------------------------------------------------------------------
 
 
-def bench_fifo(trace: list[dict], slices: int) -> dict:
+def bench_fifo(trace: list[dict], slices: int, class_mix: dict) -> dict:
     """Strict arrival order, all-or-nothing, head-of-line blocking."""
     free = slices
-    waits: dict[str, list[float]] = {k: [] for k in CLASS_MIX}
+    waits: dict[str, list[float]] = {k: [] for k in class_mix}
     pending: list[dict] = []
     events: list[tuple[float, int, int]] = []  # (finish, tie, replicas)
     busy_integral = 0.0
@@ -178,85 +119,30 @@ def bench_fifo(trace: list[dict], slices: int) -> dict:
 # ---------------------------------------------------------------------------
 
 
-class SimExecutor:
-    """FleetExecutor over virtual time: each schedule() becomes a timed
-    attempt; shrunk gangs run at cur/launch speed; cancel() banks the
-    remaining work so the resubmit picks it up."""
-
-    def __init__(self, clock, work: dict) -> None:
-        self.clock = clock
-        self.work = work  # fleet job id -> remaining full-speed seconds
-        self.attempts: dict[str, dict] = {}  # handle -> attempt record
-        self.events: list[tuple[float, int, str]] = []  # (finish, tie, handle)
-        self.busy_integral = 0.0
-        self._n = 0
-
-    def schedule(self, job, mesh_spec):
-        self._n += 1
-        handle = f"local://sim/app-{self._n}"
-        speed = job.cur_replicas / job.req.replicas
-        finish = self.clock() + self.work[job.req.job] / speed
-        self.attempts[handle] = {
-            "job": job.req.job,
-            "start": self.clock(),
-            "speed": speed,
-            "slices": job.cur_replicas,
-            "live": True,
-        }
-        heapq.heappush(self.events, (finish, self._n, handle))
-        return handle
-
-    def cancel(self, handle):
-        att = self.attempts.get(handle)
-        if att is None or not att["live"]:
-            return
-        att["live"] = False
-        elapsed = self.clock() - att["start"]
-        self.work[att["job"]] = max(
-            0.0, self.work[att["job"]] - elapsed * att["speed"]
-        )
-        self.busy_integral += att["slices"] * elapsed
-
-    def finish(self, handle) -> str:
-        """Retire a live attempt at its finish time; returns its app id."""
-        att = self.attempts[handle]
-        att["live"] = False
-        self.work[att["job"]] = 0.0
-        self.busy_integral += att["slices"] * (self.clock() - att["start"])
-        return handle.rsplit("/", 1)[1]
-
-
-def bench_fleet(trace: list[dict], slices: int, state_dir: str) -> dict:
+def bench_fleet(
+    trace: list[dict], slices: int, state_dir: str, class_mix: dict
+) -> dict:
     import types
 
     from torchx_tpu.fleet import FleetModel, FleetScheduler, GangRequest
+    from torchx_tpu.sim import SimExecutor
 
     now = [0.0]
-    work = {j["job"]: j["duration"] for j in trace}
     fs = FleetScheduler(
         FleetModel.from_spec(f"sim:v5e-1x{slices}"),
         state_dir=state_dir,
         clock=lambda: now[0],
     )
-    ex = SimExecutor(lambda: now[0], work)
+    ex = SimExecutor(lambda: now[0], {j["job"]: j["duration"] for j in trace})
     fs.bind(ex)
-
-    placed_at: dict[str, float] = {}
-    orig_schedule = ex.schedule
-
-    def schedule(job, mesh_spec):
-        placed_at.setdefault(job.req.job, now[0])
-        return orig_schedule(job, mesh_spec)
-
-    ex.schedule = schedule
 
     arrivals = list(trace)
     done = 0
-    while arrivals or ex.events:
+    while True:
         next_arrival = arrivals[0]["arrival"] if arrivals else math.inf
-        while ex.events and not ex.attempts[ex.events[0][2]]["live"]:
-            heapq.heappop(ex.events)  # cancelled attempt: dead entry
-        next_finish = ex.events[0][0] if ex.events else math.inf
+        next_finish = ex.next_finish()
+        if next_finish is None:
+            next_finish = math.inf
         if next_arrival is math.inf and next_finish is math.inf:
             break
         if next_arrival <= next_finish:
@@ -275,8 +161,7 @@ def bench_fleet(trace: list[dict], slices: int, state_dir: str) -> dict:
             )
         else:
             now[0] = next_finish
-            _t, _tie, handle = heapq.heappop(ex.events)
-            app_id = ex.finish(handle)
+            app_id = ex.finish(ex.pop_finished())
             done += 1
             fs.on_event(
                 types.SimpleNamespace(
@@ -287,11 +172,11 @@ def bench_fleet(trace: list[dict], slices: int, state_dir: str) -> dict:
                 )
             )
 
-    waits: dict[str, list[float]] = {k: [] for k in CLASS_MIX}
+    waits: dict[str, list[float]] = {k: [] for k in class_mix}
     unplaced = 0
     for j in trace:
-        if j["job"] in placed_at:
-            waits[j["klass"]].append(placed_at[j["job"]] - j["arrival"])
+        if j["job"] in ex.placed_at:
+            waits[j["klass"]].append(ex.placed_at[j["job"]] - j["arrival"])
         else:
             unplaced += 1
     makespan = max(now[0], 1e-9)
@@ -316,30 +201,44 @@ def bench_fleet(trace: list[dict], slices: int, state_dir: str) -> dict:
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--hours", type=float, default=2.0)
-    parser.add_argument("--slices", type=int, default=16)
+    parser.add_argument("--slices", type=int, default=160)
     parser.add_argument("--seed", type=int, default=11)
+    parser.add_argument(
+        "--rate-scale",
+        type=float,
+        default=None,
+        help="arrival-rate multiplier (default: slices/16, keeping"
+        " pressure comparable to the original 16-slice bench)",
+    )
     parser.add_argument("--out", default=None, help="write results JSON here")
     args = parser.parse_args()
 
     import os
 
     os.environ.setdefault("TPX_EVENT_DESTINATION", "null")
-    trace = make_trace(args.hours, args.seed)
+    os.environ.setdefault("TPX_TRACE", "0")
+
+    from torchx_tpu.sim import CLASS_MIX, diurnal_trace
+
+    rate_scale = (
+        args.rate_scale if args.rate_scale is not None else args.slices / 16.0
+    )
+    trace = diurnal_trace(args.hours, args.seed, rate_scale=rate_scale)
     by_class = {
         k: sum(1 for j in trace if j["klass"] == k) for k in CLASS_MIX
     }
     print(
         f"bench_fleet: {len(trace)} gangs over {args.hours}h virtual"
-        f" onto {args.slices} slices ({by_class})"
+        f" onto {args.slices} slices, rate x{rate_scale:g} ({by_class})"
     )
 
-    fifo = bench_fifo(trace, args.slices)
+    fifo = bench_fifo(trace, args.slices, CLASS_MIX)
     print(
         f"  fifo:  serve p99 wait {fifo['wait_by_class']['serve']['p99_s']}s,"
         f" util {fifo['utilization']:.0%}, kills {fifo['kills']}"
     )
     state_dir = tempfile.mkdtemp(prefix="tpx-bench-fleet-")
-    fleet = bench_fleet(trace, args.slices, state_dir)
+    fleet = bench_fleet(trace, args.slices, state_dir, CLASS_MIX)
     print(
         f"  fleet: serve p99 wait {fleet['wait_by_class']['serve']['p99_s']}s,"
         f" util {fleet['utilization']:.0%}, kills {fleet['kills']},"
@@ -351,6 +250,7 @@ def main() -> None:
         "hours": args.hours,
         "slices": args.slices,
         "seed": args.seed,
+        "rate_scale": rate_scale,
         "gangs": len(trace),
         "gangs_by_class": by_class,
         "fifo": fifo,

@@ -18,6 +18,16 @@ Two rules, both load-bearing for the launcher's design:
    local scheduler's ``_popen`` (data-plane replica spawn, not a
    control-plane call).
 
+3. **sim-hosted modules never read the wall clock directly.** Every
+   module the virtual-time simulator hosts (``fleet/``, ``control/``,
+   ``obs/``, ``pipelines/``, ``supervisor/``, the serve control plane,
+   ``sim/`` itself) must call ``time.time``/``time.sleep``/
+   ``time.monotonic`` only through its injected clock seam — one raw
+   call site breaks virtual-time determinism silently (the sim keeps
+   running, the journal stops being a pure function of the seed).
+   ``sim/clock.py`` is the seam and is exempt; ``time.perf_counter`` is
+   allowed everywhere (wall-cost measurement, never scheduling).
+
 Run directly (``python scripts/lint_internal.py``) or via the tier1.sh
 SELF_LINT step. Exit 0 clean, 1 violations (one line each).
 """
@@ -46,12 +56,34 @@ JAX_FREE = (
     os.path.join("obs", "telemetry.py"),
     os.path.join("obs", "slo.py"),
     os.path.join("obs", "stitch.py"),
+    "sim",
 )
 
 #: functions inside schedulers/ allowed to call subprocess directly
 SUBPROCESS_SEAM_FUNCS = ("_run_cmd", "_popen")
 
 SUBPROCESS_CALLS = ("run", "Popen", "check_call", "check_output", "call")
+
+#: packages/modules (relative to torchx_tpu/) the virtual-time simulator
+#: hosts: raw wall-clock calls here break sim determinism
+SIM_HOSTED = (
+    "fleet",
+    "control",
+    "obs",
+    "pipelines",
+    "supervisor",
+    "sim",
+    os.path.join("serve", "pool.py"),
+    os.path.join("serve", "engine.py"),
+    os.path.join("serve", "kv_transfer.py"),
+)
+
+#: the clock seam itself — the one sanctioned home of raw time calls
+SIM_CLOCK_EXEMPT = os.path.join("sim", "clock.py")
+
+#: time attributes that schedule or stamp (perf_counter measures wall
+#: cost and is deliberately NOT listed)
+WALL_CLOCK_CALLS = ("time", "sleep", "monotonic")
 
 
 def _py_files(path: str) -> list[str]:
@@ -150,6 +182,37 @@ def check_scheduler_subprocess(path: str) -> list[str]:
     ]
 
 
+def check_wall_clock(path: str) -> list[str]:
+    """Raw ``time.time()``/``time.sleep()``/``time.monotonic()`` *call*
+    sites in one sim-hosted file. Only ``ast.Call`` nodes are flagged:
+    ``clock: Callable[[], float] = time.time`` default-arg references are
+    the injection idiom itself and must stay legal."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    bad = []
+
+    class V(ast.NodeVisitor):
+        def visit_Call(self, node: ast.Call) -> None:
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "time"
+                and fn.attr in WALL_CLOCK_CALLS
+            ):
+                bad.append((node.lineno, f"time.{fn.attr}()"))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    rel = os.path.relpath(path, REPO)
+    return [
+        f"{rel}:{line}: raw {call} in a sim-hosted module; go through"
+        " the injected clock seam (sim/clock.py) so virtual time stays"
+        " deterministic"
+        for line, call in bad
+    ]
+
+
 def main() -> int:
     violations: list[str] = []
     for target in JAX_FREE:
@@ -157,6 +220,12 @@ def main() -> int:
             violations.extend(check_jax_free(path))
     for path in _py_files(os.path.join(PKG, "schedulers")):
         violations.extend(check_scheduler_subprocess(path))
+    exempt = os.path.join(PKG, SIM_CLOCK_EXEMPT)
+    for target in SIM_HOSTED:
+        for path in _py_files(os.path.join(PKG, target)):
+            if path == exempt:
+                continue
+            violations.extend(check_wall_clock(path))
     for v in violations:
         print(v)
     if violations:

@@ -62,9 +62,10 @@ EOF
 then echo "LINT_SMOKE=ok"; else echo "LINT_SMOKE=FAILED"; rc=1; fi
 
 # Self-lint: AST-enforced repo invariants — no module-level jax import in
-# the jax-free layers (cli/, supervisor/, control/, analyze/,
+# the jax-free layers (cli/, supervisor/, control/, analyze/, sim/,
 # parallel/mesh_config.py), no raw subprocess in schedulers/ outside the
-# resilient _run_cmd/_popen seam.
+# resilient _run_cmd/_popen seam, no raw time.time/sleep/monotonic calls
+# in the sim-hosted modules outside the sim/clock.py seam.
 if timeout -k 10 60 python scripts/lint_internal.py
 then echo "SELF_LINT=ok"; else echo "SELF_LINT=FAILED"; rc=1; fi
 
@@ -679,4 +680,49 @@ assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
 EOF
 then echo "PIPELINE_SMOKE=ok"; else echo "PIPELINE_SMOKE=FAILED"; rc=1; fi
 rm -rf "$pl_dir"
+
+# Sim smoke: two same-seed `tpx sim run` invocations of the bundled
+# smoke scenario must produce byte-identical journals (the determinism
+# contract), the journal must land on disk, and `tpx sim --help` must
+# stay jax-free (the whole sim subsystem rides the CLI fast path).
+sim_dir=$(mktemp -d /tmp/tpx_sim_smoke.XXXXXX)
+if timeout -k 10 180 env JAX_PLATFORMS=cpu SIM_DIR="$sim_dir" \
+    python - <<'EOF'
+import hashlib, json, os, subprocess, sys
+
+base = os.environ["SIM_DIR"]
+tpx = [sys.executable, "-m", "torchx_tpu.cli.main", "sim"]
+reports = []
+for i in (1, 2):
+    out = os.path.join(base, f"run{i}")
+    r = subprocess.run(
+        tpx + ["run", "--scenario", "smoke-tiny", "--seed", "7",
+               "--out", out, "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    reports.append(json.loads(r.stdout))
+a, b = reports
+assert os.path.exists(a["journal"]), a
+raw = open(a["journal"], "rb").read()
+assert raw and hashlib.sha256(raw).hexdigest() == a["journal_sha256"], a
+assert a["journal_sha256"] == b["journal_sha256"], (a, b)
+assert a["stats"]["submitted"] > 0, a
+assert a["stats"]["faults"] == 2, a
+
+# the sim verb rides the lazy dispatcher: its help never imports jax
+r = subprocess.run(
+    [sys.executable, "-c", (
+        "import sys\n"
+        "from torchx_tpu.cli.main import main\n"
+        "try: main(['sim', '--help'])\n"
+        "except SystemExit: pass\n"
+        "assert 'jax' not in sys.modules, 'tpx sim --help imported jax'\n"
+    )],
+    capture_output=True, text=True, timeout=60,
+)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+EOF
+then echo "SIM_SMOKE=ok"; else echo "SIM_SMOKE=FAILED"; rc=1; fi
+rm -rf "$sim_dir"
 exit $rc

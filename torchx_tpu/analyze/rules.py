@@ -23,7 +23,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 from string import Template
-from typing import Callable, Iterable, Iterator, Mapping, Optional
+from typing import Any, Callable, Iterable, Iterator, Mapping, Optional
 
 from torchx_tpu import settings as s
 from torchx_tpu.analyze.diagnostics import Diagnostic, Severity
@@ -1165,6 +1165,37 @@ def check_promotion_scrape(ctx: RuleContext) -> Iterator[Diagnostic]:
                 " eval threshold margin accordingly"
             ),
         )
+
+
+def check_sim_scenario(scenario: Mapping[str, Any]) -> Iterator[Diagnostic]:
+    """TPX604: a simulation scenario naming a backend other than ``sim``.
+
+    Not an AppDef rule — scenarios are plain dicts, so ``tpx sim`` calls
+    this directly instead of going through the engine. The virtual-time
+    harness only ever drives :class:`~torchx_tpu.sim.executor
+    .SimExecutor`; a scenario declaring ``"backend": "gke"`` (say,
+    copied from a production job file) still runs entirely in the
+    simulator, and an operator reading the journal could mistake modeled
+    placements for real ones. WARNING, never gating: the run is valid,
+    the label is misleading."""
+    backend = scenario.get("backend")
+    if backend is None or str(backend) == "sim":
+        return
+    yield Diagnostic(
+        code="TPX604",
+        severity=Severity.WARNING,
+        field="backend",
+        message=(
+            f"scenario {str(scenario.get('name', '?'))!r} names backend"
+            f" {str(backend)!r}, but the simulator only drives the"
+            " virtual-time executor — every placement in the journal is"
+            " modeled, none touch a real scheduler"
+        ),
+        hint=(
+            'set "backend": "sim" (or drop the key) so the journal'
+            " cannot be mistaken for a real-backend run"
+        ),
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,145 @@
+"""``tpx sim`` — run the fleet control plane on virtual time.
+
+Two verbs over :mod:`torchx_tpu.sim`:
+
+* ``tpx sim scenarios`` lists the bundled scenarios;
+* ``tpx sim run --scenario <name|file.json>`` wires the **production**
+  scheduler/reconciler/SLO/pipeline stack onto the virtual clock and
+  replays the scenario, printing a run report and the journal path. The
+  journal bytes are a pure function of ``(scenario, seed)`` — diff two
+  journals to regression-test a control-plane change at fleet scale.
+
+Module level stays jax-free (``tpx sim --help`` must not import jax):
+the whole sim subsystem is on the lint gate's JAX_FREE list, and the
+harness only pulls in jax-free control-plane modules.
+
+Exit codes: 0 run completed, 1 scenario/run errors, 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from torchx_tpu.cli.cmd_base import SubCommand
+
+
+class CmdSim(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        sub = subparser.add_subparsers(dest="action", required=True)
+
+        run = sub.add_parser(
+            "run", help="run one scenario on the virtual clock"
+        )
+        run.add_argument(
+            "--scenario",
+            type=str,
+            default="smoke-tiny",
+            help="bundled scenario name (see `tpx sim scenarios`) or a"
+            " scenario JSON file path",
+        )
+        run.add_argument(
+            "--seed",
+            type=int,
+            default=None,
+            help="override the scenario's seed (same seed ="
+            " byte-identical journal)",
+        )
+        run.add_argument(
+            "--journal",
+            type=str,
+            default=None,
+            help="where to write the run journal (default:"
+            " <state-dir>/sim_journal.jsonl)",
+        )
+        run.add_argument(
+            "--out",
+            type=str,
+            default=None,
+            help="state directory for component journals and artifacts"
+            " (default: a throwaway temp dir)",
+        )
+        run.add_argument(
+            "--json",
+            action="store_true",
+            help="emit the full run report as JSON",
+        )
+
+        sub.add_parser("scenarios", help="list the bundled scenarios")
+
+    def run(self, args: argparse.Namespace) -> None:
+        from torchx_tpu.sim import BUNDLED_SCENARIOS, get_scenario
+
+        if args.action == "scenarios":
+            for name in sorted(BUNDLED_SCENARIOS):
+                sc = BUNDLED_SCENARIOS[name]
+                print(
+                    f"{name}: fleet={sc['fleet']}"
+                    f" hours={sc.get('hours', 0)}"
+                    f" faults={len(sc.get('faults', []))}"
+                    f" pipelines={len(sc.get('pipelines', []))}"
+                )
+            return
+
+        try:
+            scenario = get_scenario(args.scenario)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            sys.exit(2)
+
+        from torchx_tpu.analyze.rules import check_sim_scenario
+
+        for diag in check_sim_scenario(scenario):
+            print(
+                f"{diag.severity.value}[{diag.code}]: {diag.message}"
+                + (f"\n  hint: {diag.hint}" if diag.hint else ""),
+                file=sys.stderr,
+            )
+
+        from torchx_tpu.sim import SimHarness
+
+        try:
+            report = SimHarness(
+                scenario,
+                seed=args.seed,
+                state_dir=args.out,
+                journal_path=args.journal,
+            ).run()
+        except (ValueError, OSError) as e:
+            print(f"error: sim run failed: {e}", file=sys.stderr)
+            sys.exit(1)
+
+        if args.json:
+            print(json.dumps(report.to_dict(), indent=2))
+        else:
+            print(self._render(report))
+
+    @staticmethod
+    def _render(report) -> str:  # noqa: ANN001 - SimReport
+        s = report.stats
+        lines = [
+            f"sim: {report.scenario} seed={report.seed} —"
+            f" {report.virtual_s / 3600.0:.2f} virtual hours in"
+            f" {report.wall_s:.2f}s wall ({report.speedup:,.0f}x)",
+            f"  gangs: {s.get('submitted', 0)} submitted,"
+            f" {s.get('completed', 0)} completed,"
+            f" {s.get('resubmitted', 0)} resubmitted,"
+            f" {s.get('infeasible', 0)} infeasible,"
+            f" {s.get('queued_end', 0)} queued at end",
+            f"  market: {s.get('kills', 0)} kills,"
+            f" {s.get('reshapes', 0)} reshapes, {s.get('grows', 0)} grows;"
+            f" utilization {s.get('utilization', 0.0):.1%}",
+            f"  faults: {s.get('faults', 0)} injected,"
+            f" slo alerts: {s.get('slo_alerts', 0)},"
+            f" autoscales: {s.get('autoscales', 0)}",
+        ]
+        pipelines = s.get("pipelines") or {}
+        if pipelines:
+            lines.append(
+                "  pipelines: "
+                + ", ".join(f"{p}={st}" for p, st in sorted(pipelines.items()))
+            )
+        lines.append(f"journal: {report.journal_path}")
+        lines.append(f"sha256:  {report.journal_sha256}")
+        return "\n".join(lines)

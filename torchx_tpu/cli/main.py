@@ -50,6 +50,7 @@ BUILTIN_CMDS: dict[str, tuple[str, str]] = {
     "queue": ("torchx_tpu.cli.cmd_queue", "CmdQueue"),
     "top": ("torchx_tpu.cli.cmd_top", "CmdTop"),
     "pipeline": ("torchx_tpu.cli.cmd_pipeline", "CmdPipeline"),
+    "sim": ("torchx_tpu.cli.cmd_sim", "CmdSim"),
 }
 
 

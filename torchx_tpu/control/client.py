@@ -16,9 +16,10 @@ from __future__ import annotations
 
 import json
 import os
+import time
 import urllib.error
 import urllib.request
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional
 
 from torchx_tpu import settings
 
@@ -38,11 +39,16 @@ class ControlClient:
     """Thin JSON-over-HTTP wrapper mirroring the daemon's verb set."""
 
     def __init__(
-        self, addr: str, token: str, timeout: float = DEFAULT_TIMEOUT
+        self,
+        addr: str,
+        token: str,
+        timeout: float = DEFAULT_TIMEOUT,
+        clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self.addr = addr.rstrip("/")
         self.token = token
         self.timeout = timeout
+        self._clock = clock
 
     # -- plumbing ----------------------------------------------------------
 
@@ -256,22 +262,20 @@ class ControlClient:
         """Block until terminal: chained bounded long-polls against
         ``/v1/wait`` (each HTTP request stays short; the daemon's
         reconciler wakes it the moment the terminal event lands)."""
-        import time
-
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else self._clock() + timeout
         from urllib.parse import quote
 
         while True:
             budget = 30.0
             if deadline is not None:
-                budget = min(budget, max(0.1, deadline - time.monotonic()))
+                budget = min(budget, max(0.1, deadline - self._clock()))
             payload = self._request(
                 f"/v1/wait?handle={quote(handle, safe='')}&timeout={budget:g}",
                 timeout=budget + 15.0,
             )
             if payload.get("terminal"):
                 return payload
-            if deadline is not None and time.monotonic() >= deadline:
+            if deadline is not None and self._clock() >= deadline:
                 raise TimeoutError(
                     f"app {handle} still {payload.get('state')} after {timeout}s"
                 )

@@ -73,7 +73,7 @@ import secrets
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 from torchx_tpu import settings
@@ -322,12 +322,14 @@ class ControlDaemon:
         scrape_interval: Optional[float] = None,
         telemetry: bool = True,
         pipeline_pool_provider: Optional[Any] = None,
+        clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if runner is None:
             from torchx_tpu.runner.api import get_runner
 
             runner = get_runner("tpx-control")
         self.runner = runner
+        self.clock = clock
         self.state_dir = state_dir or control_dir()
         self.tenant_cap = (
             tenant_cap
@@ -335,7 +337,7 @@ class ControlDaemon:
             else settings.DEFAULT_CONTROL_TENANT_CAP
         )
         self.store = JobStateStore(os.path.join(self.state_dir, "store"))
-        self.reconciler = Reconciler(store=self.store)
+        self.reconciler = Reconciler(store=self.store, clock=clock)
         runner.attach_reconciler(self.reconciler)
         self.root_token = secrets.token_hex(16)
         self._tokens: dict[str, str] = {self.root_token: "root"}
@@ -799,14 +801,14 @@ class ControlDaemon:
         self.reconciler.track(
             scheduler, self.runner._scheduler(scheduler), app_id
         )
-        deadline = time.monotonic() + budget
+        deadline = self.clock() + budget
         while True:
             status = self.runner.status(handle)
             if status is None:
                 return {"handle": handle, "state": "UNKNOWN", "terminal": True}
             if status.is_terminal():
                 return self._status_payload(handle, status)
-            remaining = deadline - time.monotonic()
+            remaining = deadline - self.clock()
             if remaining <= 0:
                 payload = self._status_payload(handle, status)
                 payload["terminal"] = False

@@ -27,7 +27,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 from torchx_tpu.control.events import StateEvent
 from torchx_tpu.control.store import JobStateStore
@@ -42,10 +42,17 @@ class Reconciler:
     Args:
         store: optional durable journal; events are appended before any
             in-memory state changes (crash ordering: disk first).
+        clock: injectable monotonic clock for :meth:`wait_event` deadlines
+            (the sim harness runs the reconciler on virtual time).
     """
 
-    def __init__(self, store: Optional[JobStateStore] = None) -> None:
+    def __init__(
+        self,
+        store: Optional[JobStateStore] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
         self.store = store
+        self._clock = clock
         self._cond = threading.Condition()
         # (scheduler, app_id) -> (seq, event); seq is a global monotonic
         # counter so waiters can tell "new since I started waiting"
@@ -179,7 +186,7 @@ class Reconciler:
         two polls must not cost a full poll-interval sleep. Returns the
         event, or None on timeout (callers fall back to their poll)."""
         key = (scheduler, app_id)
-        deadline = time.monotonic() + max(0.0, timeout)
+        deadline = self._clock() + max(0.0, timeout)
         with self._cond:
             entry = self._events.get(key)
             start_seq = entry[0] if entry else 0
@@ -191,7 +198,7 @@ class Reconciler:
                 entry = self._events.get(key)
                 if entry is not None and entry[0] > start_seq:
                     return entry[1]
-                remaining = deadline - time.monotonic()
+                remaining = deadline - self._clock()
                 if remaining <= 0 or self._closed:
                     return None
                 self._cond.wait(remaining)

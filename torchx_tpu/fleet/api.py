@@ -336,13 +336,39 @@ class FleetScheduler:
 
     def _pass_queue(self) -> bool:
         placed_chips = self._placed_chips()
+        # shared per-pass market context: nothing mutates between FAILED
+        # market attempts inside one pass, so the running-victim snapshot
+        # is computed once and fruitless (priority, replicas, chips) keys
+        # are never re-planned — the difference between O(queue * fleet)
+        # and O(distinct shapes * fleet) per pass at 1000-slice sim scale
+        ctx: dict[str, Any] = {
+            "memo": set(),
+            "victims": None,
+            "free": {},
+            "blocked": set(),
+        }
         for entry in self.queue.ordered(placed_chips):
             job = self._jobs[entry.req.job]
             if job.req.job in self._loop_failed:
                 continue
             if over_quota(job.req, placed_chips, self.quotas):
                 continue
-            decision = plan_placement(job.req, self.model, role=job.role())
+            role = job.role()
+            # role-less demand makes plan_placement a pure function of
+            # (shape, free units), and free units don't change between
+            # blocked outcomes within a pass — skip re-planning a shape
+            # that already came back blocked (never memoize placed or
+            # infeasible: those return out of the pass immediately)
+            pkey = (
+                (entry.req.replicas, entry.req.chips_per_replica, entry.req.mesh)
+                if role is None
+                else None
+            )
+            if pkey is not None and pkey in ctx["blocked"]:
+                if self._run_market(job, ctx):
+                    return True
+                continue
+            decision = plan_placement(job.req, self.model, role=role)
             if decision.infeasible:
                 self.queue.remove(job.req.job)
                 job.state = INFEASIBLE
@@ -359,35 +385,54 @@ class FleetScheduler:
             if decision.placed:
                 self._place(job, decision.units)
                 return True
-            if self._run_market(job):
+            if pkey is not None:
+                ctx["blocked"].add(pkey)
+            if self._run_market(job, ctx):
                 return True
         return False
 
-    def _run_market(self, job: FleetJob) -> bool:
+    def _run_market(
+        self, job: FleetJob, ctx: Optional[dict] = None
+    ) -> bool:
         """Try to free capacity for one blocked gang via the market."""
         need = job.req.chips_per_replica
-        victims = []
-        for other in self._jobs.values():
-            if other.state != RUNNING or other.req.job == job.req.job:
-                continue
-            units = self.model.units_of(other.req.job)
-            suitable = bool(units) and all(u.chips >= need for u in units)
-            victims.append(
-                Victim(
-                    job=other.req.job,
-                    priority=other.req.priority,
-                    elastic=other.req.elastic and other.req.mesh != "",
-                    replicas=other.cur_replicas,
-                    min_replicas=other.req.min_replicas,
-                    seq=other.seq,
-                    suitable=suitable,
+        if ctx is None:
+            ctx = {"memo": set(), "victims": None, "free": {}}
+        key = (job.req.priority, job.req.replicas, need)
+        if key in ctx["memo"]:
+            return False
+        if ctx["victims"] is None:
+            snapshot = []
+            for other in self._jobs.values():
+                if other.state != RUNNING:
+                    continue
+                units = self.model.units_of(other.req.job)
+                snapshot.append(
+                    (
+                        other,
+                        bool(units),
+                        min((u.chips for u in units), default=0),
+                    )
                 )
+            ctx["victims"] = snapshot
+        victims = [
+            Victim(
+                job=other.req.job,
+                priority=other.req.priority,
+                elastic=other.req.elastic and other.req.mesh != "",
+                replicas=other.cur_replicas,
+                min_replicas=other.req.min_replicas,
+                seq=other.seq,
+                suitable=has_units and min_chips >= need,
             )
-        free_suitable = sum(
-            1
-            for u in self.model.free_units()
-            if u.chips >= need
-        )
+            for other, has_units, min_chips in ctx["victims"]
+            if other.req.job != job.req.job
+        ]
+        if need not in ctx["free"]:
+            ctx["free"][need] = sum(
+                1 for u in self.model.free_units() if u.chips >= need
+            )
+        free_suitable = ctx["free"][need]
         actions = plan_market(
             job.req.replicas - free_suitable, job.req.priority, victims
         )
@@ -396,6 +441,7 @@ class FleetScheduler:
             # kills and take only the elastic shrinks this pass.
             actions = [a for a in actions if isinstance(a, Shrink)]
         if not actions:
+            ctx["memo"].add(key)
             return False
         with obs_trace.span(
             "fleet.preempt",

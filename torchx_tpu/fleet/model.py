@@ -166,6 +166,11 @@ class FleetModel:
                 )
         self._by_uid = {u.uid: u for u in self._units}
         self._owner: dict[str, str] = {}  # uid -> job id
+        # reverse index + stable position, so units_of is O(holdings) —
+        # the market calls it per candidate victim per pass, which at
+        # 1000-slice sim scale made the O(fleet) scan the bottleneck
+        self._held: dict[str, set[str]] = {}  # job id -> uids
+        self._pos = {u.uid: i for i, u in enumerate(self._units)}
 
     # -- construction ------------------------------------------------------
 
@@ -219,8 +224,13 @@ class FleetModel:
         return self._owner.get(uid)
 
     def units_of(self, job: str) -> list[SliceUnit]:
-        """The slices a job currently holds."""
-        return [u for u in self._units if self._owner.get(u.uid) == job]
+        """The slices a job currently holds, pool/index order."""
+        held = self._held.get(job)
+        if not held:
+            return []
+        return [
+            self._by_uid[uid] for uid in sorted(held, key=self._pos.__getitem__)
+        ]
 
     @property
     def total_chips(self) -> int:
@@ -248,11 +258,18 @@ class FleetModel:
                 )
         for uid in uids:
             self._owner[uid] = job
+            self._held.setdefault(job, set()).add(uid)
 
     def release(self, uids: Iterable[str]) -> None:
         """Free specific slices (no-op for already-free uids)."""
         for uid in uids:
-            self._owner.pop(uid, None)
+            owner = self._owner.pop(uid, None)
+            if owner is not None:
+                held = self._held.get(owner)
+                if held is not None:
+                    held.discard(uid)
+                    if not held:
+                        del self._held[owner]
 
     def release_job(self, job: str) -> list[str]:
         """Free every slice a job holds; returns the freed uids."""

@@ -721,3 +721,39 @@ PIPELINE_STAGE_SECONDS = REGISTRY.histogram(
     "pipeline stage duration from submit to terminal in seconds",
     ("kind",),
 )
+
+
+# -- virtual-time simulation (tpx sim) --------------------------------------
+
+#: events processed by the sim harness's virtual-time loop, by kind
+#: (arrival/gang_done/fault/tick/pipeline/wake).
+SIM_EVENTS = REGISTRY.counter(
+    "tpx_sim_events_total",
+    "virtual-time events processed by the sim harness, by kind",
+    ("kind",),
+)
+
+#: faults the harness injected, by kind.
+SIM_FAULTS = REGISTRY.counter(
+    "tpx_sim_faults_total",
+    "faults injected into the simulated fleet, by kind",
+    ("kind",),
+)
+
+#: virtual seconds covered by the last completed sim run.
+SIM_VIRTUAL_SECONDS = REGISTRY.gauge(
+    "tpx_sim_virtual_seconds",
+    "virtual time span of the last completed sim run in seconds",
+)
+
+#: wall seconds the last completed sim run took to execute.
+SIM_WALL_SECONDS = REGISTRY.gauge(
+    "tpx_sim_wall_seconds",
+    "wall-clock execution time of the last completed sim run in seconds",
+)
+
+#: virtual/wall speedup of the last completed sim run.
+SIM_SPEEDUP = REGISTRY.gauge(
+    "tpx_sim_speedup",
+    "virtual-over-wall time ratio of the last completed sim run",
+)

@@ -28,7 +28,7 @@ import os
 import tempfile
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from torchx_tpu import settings
 from torchx_tpu.obs.trace import tracing_enabled
@@ -111,8 +111,15 @@ class PromMetricsHandler(logging.Handler):
     textfile is a snapshot of the whole registry, so one deferred write
     covers every skipped one."""
 
-    def __init__(self, min_interval_s: Optional[float] = None) -> None:
+    def __init__(
+        self,
+        min_interval_s: Optional[float] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ) -> None:
         super().__init__()
+        # resolved at construction, not in the signature: tests patch
+        # time.monotonic on the module and must see the substitute
+        self._clock = clock if clock is not None else time.monotonic
         if min_interval_s is None:
             raw = os.environ.get(settings.ENV_TPX_METRICS_MIN_INTERVAL, "")
             try:
@@ -131,7 +138,7 @@ class PromMetricsHandler(logging.Handler):
     def emit(self, record: logging.LogRecord) -> None:
         try:
             with self._lock_flush:
-                now = time.monotonic()
+                now = self._clock()
                 if now - self._last_flush < self.min_interval_s:
                     self._dirty = True
                     return
@@ -148,7 +155,7 @@ class PromMetricsHandler(logging.Handler):
             if not self._dirty:
                 return
             self._dirty = False
-            self._last_flush = time.monotonic()
+            self._last_flush = self._clock()
         try:
             flush_metrics()
         except Exception:  # noqa: BLE001 - never break shutdown

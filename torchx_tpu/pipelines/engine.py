@@ -160,6 +160,11 @@ class PipelineEngine:
         slo_signal: current worst SLO burn rate (promotion burn gate).
         pool_provider: ``pool_provider(stage) -> ServePool | None`` —
             where a promote stage finds the serve pool to roll.
+        clock: injectable wall clock (stage start/finish stamps and the
+            promotion controller's observation window run on it — the sim
+            harness passes a :class:`~torchx_tpu.sim.clock.VirtualClock`).
+        sleep: injectable sleep, paired with ``clock`` (promotion canary
+            observation windows).
     """
 
     def __init__(
@@ -170,12 +175,16 @@ class PipelineEngine:
         reconciler: Optional[Any] = None,
         slo_signal: Optional[Callable[[], Optional[float]]] = None,
         pool_provider: Optional[Callable[[PipelineStage], Any]] = None,
+        clock: Callable[[], float] = time.time,
+        sleep: Callable[[float], None] = time.sleep,
     ) -> None:
         self._journal = FleetJournal(journal_path)
         self._executor = executor
         self._reconciler = reconciler
         self._slo_signal = slo_signal
         self._pool_provider = pool_provider
+        self._clock = clock
+        self._sleep = sleep
         self._lock = threading.RLock()
         self._runs: dict[str, PipelineRun] = {}
         self._handles: dict[tuple[str, str], tuple[str, str]] = {}
@@ -207,6 +216,13 @@ class PipelineEngine:
         ``score``) — the baseline the next candidate is gated against."""
         with self._lock:
             return dict(self._incumbent) if self._incumbent else None
+
+    def active_threads(self) -> list[threading.Thread]:
+        """Promotion threads started by this engine (live and dead). The
+        sim harness waits on these between virtual-time steps so canary
+        outcomes land deterministically."""
+        with self._lock:
+            return list(self._threads)
 
     def close(self) -> None:
         """Stop accepting work and give in-flight promotion threads a
@@ -388,7 +404,7 @@ class PipelineEngine:
             obs_metrics.PIPELINE_STAGES.inc(kind=stage.kind, state="FAILED")
             self._fail(run, f"stage {stage.name} submit failed: {srun.error}")
             return
-        srun.started_usec = int(time.time() * 1e6)
+        srun.started_usec = int(self._clock() * 1e6)
         if result.get("handle"):
             self._record_handle(run, srun, str(result["handle"]))
         else:
@@ -413,7 +429,7 @@ class PipelineEngine:
         srun.scheduler = scheduler
         srun.app_id = app_id
         if not srun.started_usec:
-            srun.started_usec = int(time.time() * 1e6)
+            srun.started_usec = int(self._clock() * 1e6)
         self._handles[(scheduler, app_id)] = (run.pid, srun.stage.name)
         self._journal.append(
             "stage_submit",
@@ -479,7 +495,7 @@ class PipelineEngine:
     ) -> None:
         srun.state = state
         srun.error = error
-        srun.finished_usec = int(time.time() * 1e6)
+        srun.finished_usec = int(self._clock() * 1e6)
         self._journal.append(
             "stage_done",
             pipeline=run.pid,
@@ -536,7 +552,7 @@ class PipelineEngine:
 
     def _start_promotion(self, run: PipelineRun, srun: StageRun) -> None:
         srun.state = "RUNNING"
-        srun.started_usec = int(time.time() * 1e6)
+        srun.started_usec = int(self._clock() * 1e6)
         self._journal.append(
             "stage_submit",
             pipeline=run.pid,
@@ -647,8 +663,14 @@ class PipelineEngine:
             canary_fraction=stage.canary_fraction,
             burn_threshold=stage.burn_threshold,
             observe_s=stage.observe_s,
+            # bound the observe window to ~200 burn samples so long
+            # windows (hours of virtual time in the simulator) don't
+            # degenerate into tens of thousands of poll wakeups
+            poll_s=max(0.05, stage.observe_s / 200.0),
             journal=journal,
             already_rolled=rolled,
+            clock=self._clock,
+            sleep=self._sleep,
         )
         with obs_trace.span(
             "pipeline.promote", pipeline=run.pid, stage=stage.name

@@ -16,7 +16,7 @@ import json
 import logging
 import time
 from dataclasses import dataclass, field
-from typing import Any, Mapping, Optional
+from typing import Any, Callable, Mapping, Optional
 
 from torchx_tpu.pipelines.api import Pipeline, topo_order
 from torchx_tpu.specs.api import AppDef, AppHandle, AppState, AppStatus, CfgVal
@@ -160,6 +160,7 @@ def run_pipeline(
     scheduler: str,
     cfg: Optional[Mapping[str, CfgVal]] = None,
     wait_interval: float = 1.0,
+    sleep: Callable[[float], None] = time.sleep,
 ) -> PipelineRun:
     """Execute the DAG generation-by-generation; returns per-stage handles
     + terminal statuses. All stages of a generation are submitted
@@ -206,7 +207,7 @@ def run_pipeline(
                     pending.discard(name)
                 break
             if pending:
-                time.sleep(wait_interval)
+                sleep(wait_interval)
         if failed:
             logger.error("pipeline %s failed; skipping downstream stages", pipeline.name)
             return run

@@ -22,15 +22,30 @@ Three layers, bottom-up:
   independent policies.
 """
 
-from torchx_tpu.serve.kv_pool import BlockAllocator, PoolPlan, plan_pool
-from torchx_tpu.serve.kv_transfer import TransferConfig
-from torchx_tpu.serve.prefix_cache import PrefixCache, prefix_chain
+# Lazy re-exports (PEP 562): kv_pool pulls in the jax-backed paged
+# attention op, but jax-free consumers (the fleet simulator runs the
+# production Autoscaler from serve.pool) must be able to import their
+# submodule without paying for — or even having — jax.
+_EXPORTS = {
+    "BlockAllocator": "torchx_tpu.serve.kv_pool",
+    "PoolPlan": "torchx_tpu.serve.kv_pool",
+    "plan_pool": "torchx_tpu.serve.kv_pool",
+    "PrefixCache": "torchx_tpu.serve.prefix_cache",
+    "prefix_chain": "torchx_tpu.serve.prefix_cache",
+    "TransferConfig": "torchx_tpu.serve.kv_transfer",
+}
 
-__all__ = [
-    "BlockAllocator",
-    "PoolPlan",
-    "plan_pool",
-    "PrefixCache",
-    "prefix_chain",
-    "TransferConfig",
-]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -199,6 +199,7 @@ class ServeEngine:
         enable_prefix_cache: bool = True,
         prefix_cache_reserve: float = 0.0,
         clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
     ) -> None:
         if block_size & (block_size - 1):
             raise ValueError(f"block_size must be a power of 2, got {block_size}")
@@ -217,6 +218,7 @@ class ServeEngine:
         self.num_blocks = num_blocks
         self.max_prefill_batch = max(1, max_prefill_batch)
         self._clock = clock
+        self._sleep = sleep
 
         self.pools = gen.init_kv_pools(cfg, num_blocks, block_size)
         self.alloc = BlockAllocator(num_blocks)
@@ -459,7 +461,7 @@ class ServeEngine:
         empty (False on timeout). The SIGTERM grace path."""
         with self._lock:
             self._draining = True
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else self._clock() + timeout
         while True:
             with self._lock:
                 empty = (
@@ -470,9 +472,9 @@ class ServeEngine:
                 )
             if empty:
                 return True
-            if deadline is not None and time.monotonic() > deadline:
+            if deadline is not None and self._clock() > deadline:
                 return False
-            time.sleep(0.005)
+            self._sleep(0.005)
 
     def stop(self, timeout: float = 5.0) -> None:
         """Kill the loop thread; in-flight requests get ``error`` set."""

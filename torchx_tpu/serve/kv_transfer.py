@@ -318,9 +318,17 @@ class FileTransfer(KvTransfer):
     """Spool-directory transport: atomic ``<id>.req.npz`` writes, reply
     polled from ``<id>.resp.json`` (written by :func:`serve_spool`)."""
 
-    def __init__(self, spool_dir: str, poll_s: float = 0.01) -> None:
+    def __init__(
+        self,
+        spool_dir: str,
+        poll_s: float = 0.01,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
         self.spool_dir = spool_dir
         self.poll_s = poll_s
+        self._clock = clock
+        self._sleep = sleep
         os.makedirs(spool_dir, exist_ok=True)
 
     def targets(self) -> list[str]:
@@ -335,8 +343,8 @@ class FileTransfer(KvTransfer):
         os.replace(tmp, f"{base}.req.npz")  # atomic: readers never see partials
         obs_metrics.SERVE_KV_TRANSFER_BYTES.inc(len(raw))
         resp_path = f"{base}.resp.json"
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = self._clock() + timeout
+        while self._clock() < deadline:
             if os.path.exists(resp_path):
                 with open(resp_path) as f:
                     out = json.load(f)
@@ -344,7 +352,7 @@ class FileTransfer(KvTransfer):
                 if out.get("rejected"):
                     raise TransferRejected(f"spool target draining: {target}")
                 return out
-            time.sleep(self.poll_s)
+            self._sleep(self.poll_s)
         raise TransferError(f"no spool reply for {payload.request_id} in {timeout}s")
 
 

@@ -36,10 +36,12 @@ from __future__ import annotations
 import hashlib
 import itertools
 import threading
-from typing import Optional, Sequence
+from typing import TYPE_CHECKING, Optional, Sequence
 
 from torchx_tpu.obs import metrics as obs_metrics
-from torchx_tpu.serve.kv_pool import BlockAllocator
+
+if TYPE_CHECKING:  # annotation-only: kv_pool pulls the jax-backed op stack
+    from torchx_tpu.serve.kv_pool import BlockAllocator
 
 __all__ = ["PrefixCache", "prefix_chain"]
 

@@ -1,0 +1,50 @@
+"""Virtual-time simulation of the fleet control plane.
+
+The sim subsystem runs the PRODUCTION control plane — the real
+:class:`~torchx_tpu.fleet.FleetScheduler`, :class:`~torchx_tpu.control
+.reconciler.Reconciler`, :class:`~torchx_tpu.obs.slo.SloEngine`,
+:class:`~torchx_tpu.serve.pool.Autoscaler` and :class:`~torchx_tpu
+.pipelines.engine.PipelineEngine` — unmodified, on a deterministic
+discrete-event :class:`~torchx_tpu.sim.clock.VirtualClock` instead of
+wall time. Hours of fleet behavior (diurnal arrivals, correlated slice
+loss, canary promotions under SLO burn) replay in seconds of wall
+clock, and the same seed produces a byte-identical run journal.
+
+Everything here is jax-free (enforced by ``scripts/lint_internal.py``):
+the simulator must import on the daemon's fast path and inside the CLI
+without dragging in an accelerator runtime.
+
+Layout:
+
+* :mod:`~torchx_tpu.sim.clock` — the virtual clock and its seams;
+* :mod:`~torchx_tpu.sim.executor` — the modeled-fleet
+  :class:`~torchx_tpu.fleet.FleetExecutor`;
+* :mod:`~torchx_tpu.sim.traffic` — seeded synthetic traces + journal
+  replay;
+* :mod:`~torchx_tpu.sim.faults` — seeded, replayable fault storms;
+* :mod:`~torchx_tpu.sim.scenarios` — bundled scenario files;
+* :mod:`~torchx_tpu.sim.harness` — the wiring + event loop.
+"""
+
+from torchx_tpu.sim.clock import ClockProto, SystemClock, VirtualClock
+from torchx_tpu.sim.executor import SimExecutor
+from torchx_tpu.sim.faults import FaultEvent, FaultStorm
+from torchx_tpu.sim.harness import SimHarness, SimReport
+from torchx_tpu.sim.scenarios import BUNDLED_SCENARIOS, get_scenario
+from torchx_tpu.sim.traffic import CLASS_MIX, diurnal_trace, replay_trace
+
+__all__ = [
+    "ClockProto",
+    "SystemClock",
+    "VirtualClock",
+    "SimExecutor",
+    "FaultEvent",
+    "FaultStorm",
+    "SimHarness",
+    "SimReport",
+    "BUNDLED_SCENARIOS",
+    "get_scenario",
+    "CLASS_MIX",
+    "diurnal_trace",
+    "replay_trace",
+]

@@ -1,0 +1,198 @@
+"""Deterministic virtual time: the clock every sim-hosted component runs on.
+
+The control plane takes time through two injectable seams — a ``clock()
+-> float`` callable and a ``sleep(seconds)`` callable — everywhere
+(``scripts/lint_internal.py`` bans raw ``time.time()`` / ``time.sleep()``
+/ ``time.monotonic()`` calls in the sim-hosted packages). In production
+those default to the stdlib; under simulation both are bound to one
+:class:`VirtualClock`, so a 2-hour diurnal trace advances in however
+long the *decisions* take, and two runs with the same seed traverse the
+identical sequence of instants.
+
+Cross-thread determinism is the hard part: the pipeline engine runs
+canary promotions on worker threads that ``sleep()`` through their
+observation windows. The clock therefore distinguishes the **driver**
+thread (the harness event loop, which advances time) from **worker**
+threads (which park in :meth:`sleep` until the driver advances past
+their deadline). The driver's advance settles every woken worker —
+waits until it is parked again or dead — before moving further, so the
+interleaving of virtual instants is a pure function of the event times,
+never of OS scheduling.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Optional, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class ClockProto(Protocol):
+    """The pair of seams a sim-hosted component needs from time."""
+
+    def now(self) -> float:
+        """Current time in seconds (monotonic within one clock)."""
+        ...
+
+    def sleep(self, seconds: float) -> None:
+        """Block the caller for ``seconds`` of this clock's time."""
+        ...
+
+
+class SystemClock:
+    """Wall time behind the :class:`ClockProto` seams (production).
+
+    This module is the one approved place the stdlib time functions are
+    called directly — everything else takes them through injection.
+    """
+
+    def now(self) -> float:
+        """Wall time via ``time.monotonic()``."""
+        return time.monotonic()
+
+    def __call__(self) -> float:
+        return self.now()
+
+    def sleep(self, seconds: float) -> None:
+        """Real ``time.sleep``."""
+        time.sleep(seconds)
+
+
+class VirtualClock:
+    """Discrete-event virtual time with deterministic cross-thread sleeps.
+
+    The clock is callable (``clock()``), so it drops into every
+    ``clock: Callable[[], float]`` parameter in the codebase; pass
+    ``clock.sleep`` wherever a ``sleep`` seam is taken.
+
+    One thread — the **driver**, by default the constructing thread — owns
+    time: only its :meth:`advance_to` / :meth:`advance` (and its own
+    :meth:`sleep`, which advances inline) move ``now``. Any other thread
+    calling :meth:`sleep` parks on an event keyed by its virtual deadline;
+    the driver's advance pops due sleepers in ``(deadline, seq)`` order,
+    wakes each, and *settles* — waits until the woken thread has either
+    parked in its next sleep or exited — before waking the next. Virtual
+    time is therefore a total order independent of the OS scheduler.
+    """
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._cond = threading.Condition()
+        self._now = float(start)
+        self._seq = 0
+        # (deadline, seq, wake event, thread) — seq breaks deadline ties
+        # in registration order, which is deterministic under settling
+        self._sleepers: list[tuple[float, int, threading.Event, threading.Thread]] = []
+        self._parked: set[threading.Thread] = set()
+        self._woken: set[threading.Thread] = set()
+        self._driver = threading.current_thread()
+
+    # -- reading -----------------------------------------------------------
+
+    def now(self) -> float:
+        """Current virtual time in seconds."""
+        with self._cond:
+            return self._now
+
+    def __call__(self) -> float:
+        return self.now()
+
+    def next_wake(self) -> Optional[float]:
+        """Earliest parked sleeper's virtual deadline (None when idle) —
+        the harness merges this into its event heap so worker sleeps are
+        first-class events."""
+        with self._cond:
+            return self._sleepers[0][0] if self._sleepers else None
+
+    # -- driver ------------------------------------------------------------
+
+    def set_driver(self, thread: Optional[threading.Thread] = None) -> None:
+        """Re-home the driver role (default: the calling thread)."""
+        with self._cond:
+            self._driver = thread or threading.current_thread()
+
+    def advance(self, delta: float) -> None:
+        """Move time forward by ``delta`` seconds (driver only)."""
+        with self._cond:
+            self._advance_locked(self._now + max(0.0, float(delta)))
+
+    def advance_to(self, target: float) -> None:
+        """Move time to ``target`` (driver only; past targets are no-ops),
+        waking and settling every sleeper due on the way."""
+        with self._cond:
+            self._advance_locked(float(target))
+
+    def _advance_locked(self, target: float) -> None:
+        target = max(self._now, target)
+        while True:
+            self._settle_locked()
+            if not self._sleepers or self._sleepers[0][0] > target:
+                break
+            deadline, _seq, event, thread = heapq.heappop(self._sleepers)
+            self._now = max(self._now, deadline)
+            if thread.is_alive():
+                # un-park here, not in the waker's own sleep() epilogue:
+                # settling filters on _parked, and a woken thread still
+                # listed there would let the driver race past its wake
+                self._woken.add(thread)
+                self._parked.discard(thread)
+            event.set()
+        self._settle_locked()
+        self._now = target
+
+    def _settle_locked(self) -> None:
+        """Wait until every woken worker is parked again or dead. Workers
+        notify the condition when they re-park; the short timed wait only
+        covers threads that exit without sleeping again (liveness is
+        polled — the outcome does not depend on the poll interval)."""
+        while True:
+            self._woken = {t for t in self._woken if t.is_alive() and t not in self._parked}
+            if not self._woken:
+                return
+            self._cond.wait(0.002)
+
+    # -- sleeping ----------------------------------------------------------
+
+    def sleep(self, seconds: float) -> None:
+        """Sleep ``seconds`` of virtual time.
+
+        From the driver thread this advances time inline (a synchronous
+        component sleeping on the event-loop thread must not deadlock).
+        From any other thread it parks until the driver advances past the
+        deadline."""
+        seconds = max(0.0, float(seconds))
+        me = threading.current_thread()
+        with self._cond:
+            if me is self._driver:
+                self._advance_locked(self._now + seconds)
+                return
+            self._seq += 1
+            event = threading.Event()
+            heapq.heappush(
+                self._sleepers, (self._now + seconds, self._seq, event, me)
+            )
+            self._parked.add(me)
+            self._woken.discard(me)
+            self._cond.notify_all()
+        event.wait()
+        with self._cond:
+            self._parked.discard(me)
+
+    def wait_parked(
+        self, thread: threading.Thread, timeout: float = 30.0
+    ) -> bool:
+        """Block (wall time, bounded by ``timeout``) until ``thread`` is
+        parked in :meth:`sleep` or has exited. The harness calls this on
+        freshly spawned promotion threads before advancing, so their
+        first sleep registers deterministically."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._cond:
+            while True:
+                if not thread.is_alive():
+                    return True
+                if thread in self._parked and thread not in self._woken:
+                    return True
+                if time.monotonic() >= deadline:
+                    return False
+                self._cond.wait(0.002)

@@ -1,0 +1,129 @@
+"""The modeled-fleet :class:`~torchx_tpu.fleet.api.FleetExecutor`.
+
+Promoted from the original ``scripts/bench_fleet.py`` inline simulator
+(the bench now imports it from here). Each :meth:`schedule` call becomes
+one timed *attempt*: a gang runs at ``cur_replicas / launch_replicas``
+speed (the market's shrink cost is modeled, not assumed away), finishing
+after its remaining full-speed work divided by that speed plus the
+configured gang-launch latency. :meth:`cancel` banks the remaining work,
+so the mesh-reshape resubmit — or a fault-storm restart — picks the job
+up where it left off instead of restarting it.
+
+Per-generation chip and HBM facts come from the
+:class:`~torchx_tpu.fleet.model.FleetModel` the scheduler places onto
+(its :class:`~torchx_tpu.specs.api.TpuSlice` shapes feed the placement
+oracle); the executor only models *when* an attempt finishes.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Callable, Optional
+
+
+class SimExecutor:
+    """FleetExecutor over virtual time.
+
+    Args:
+        clock: the virtual clock (any ``() -> float`` callable).
+        work: fleet job id -> remaining full-speed seconds; jobs are
+            added with :meth:`set_work` (or pre-seeded by the caller).
+        launch_latency_s: virtual seconds from ``schedule()`` to the gang
+            actually computing (image pull + TPU init in the model).
+        complete_latency_s: virtual seconds between the last step and the
+            terminal event becoming observable (teardown + watch lag).
+    """
+
+    def __init__(
+        self,
+        clock: Callable[[], float],
+        work: Optional[dict] = None,
+        launch_latency_s: float = 0.0,
+        complete_latency_s: float = 0.0,
+    ) -> None:
+        self.clock = clock
+        self.work: dict[str, float] = dict(work or {})
+        self.launch_latency_s = max(0.0, float(launch_latency_s))
+        self.complete_latency_s = max(0.0, float(complete_latency_s))
+        self.attempts: dict[str, dict] = {}  # handle -> attempt record
+        self.events: list[tuple[float, int, str]] = []  # (finish, tie, handle)
+        self.busy_integral = 0.0  # slice-seconds actually computed
+        self.placed_at: dict[str, float] = {}  # job -> first placement time
+        self._n = 0
+
+    def set_work(self, job: str, seconds: float) -> None:
+        """Declare (or reset) a job's remaining full-speed work."""
+        self.work[job] = max(0.0, float(seconds))
+
+    # -- FleetExecutor -----------------------------------------------------
+
+    def schedule(self, job, mesh_spec):  # noqa: ANN001 - FleetExecutor seam
+        """FleetExecutor seam: start one gang attempt and model its
+        finish time (remaining work scaled by the placed replica
+        fraction, plus launch/complete latency). Returns a
+        ``local://sim/app-N`` handle."""
+        self._n += 1
+        handle = f"local://sim/app-{self._n}"
+        now = self.clock()
+        self.placed_at.setdefault(job.req.job, now)
+        speed = job.cur_replicas / job.req.replicas
+        finish = (
+            now
+            + self.launch_latency_s
+            + self.work.get(job.req.job, 0.0) / speed
+            + self.complete_latency_s
+        )
+        self.attempts[handle] = {
+            "job": job.req.job,
+            "start": now + self.launch_latency_s,
+            "speed": speed,
+            "slices": job.cur_replicas,
+            "live": True,
+        }
+        heapq.heappush(self.events, (finish, self._n, handle))
+        return handle
+
+    def cancel(self, handle):  # noqa: ANN001 - FleetExecutor seam
+        """FleetExecutor seam: stop an attempt, banking the work it
+        completed so a later resubmit resumes from the checkpoint."""
+        att = self.attempts.get(handle)
+        if att is None or not att["live"]:
+            return
+        att["live"] = False
+        elapsed = max(0.0, self.clock() - att["start"])
+        job = att["job"]
+        self.work[job] = max(0.0, self.work.get(job, 0.0) - elapsed * att["speed"])
+        self.busy_integral += att["slices"] * elapsed
+
+    # -- the harness's side ------------------------------------------------
+
+    def next_finish(self) -> Optional[float]:
+        """Earliest live attempt's finish time (dead heap entries from
+        cancelled attempts are dropped on the way); None when idle."""
+        while self.events and not self.attempts[self.events[0][2]]["live"]:
+            heapq.heappop(self.events)
+        return self.events[0][0] if self.events else None
+
+    def pop_finished(self) -> str:
+        """Pop the earliest live attempt's heap entry (the caller has
+        already advanced the clock to its finish time); returns the
+        handle. Raises ``IndexError`` when nothing is due."""
+        while self.events and not self.attempts[self.events[0][2]]["live"]:
+            heapq.heappop(self.events)
+        _t, _tie, handle = heapq.heappop(self.events)
+        return handle
+
+    def finish(self, handle) -> str:  # noqa: ANN001
+        """Retire a live attempt at its finish time; returns its app id
+        (the ``local`` scheduler app id inside the handle)."""
+        att = self.attempts[handle]
+        att["live"] = False
+        self.work[att["job"]] = 0.0
+        self.busy_integral += att["slices"] * max(
+            0.0, self.clock() - self.complete_latency_s - att["start"]
+        )
+        return handle.rsplit("/", 1)[1]
+
+    def job_of(self, handle: str) -> str:
+        """Fleet job id behind an attempt handle."""
+        return self.attempts[handle]["job"]

@@ -1,0 +1,106 @@
+"""Seeded, replayable fault storms for the simulator.
+
+A fault spec is plain JSON inside a scenario file. Each entry is either
+one concrete :class:`FaultEvent`::
+
+    {"t": 1800, "kind": "slice_loss", "count": 4, "duration_s": 900}
+
+or a *storm* — a window that expands into many events at seeded-uniform
+times::
+
+    {"kind": "preemption_wave", "start": 600, "end": 4200,
+     "events": 12, "count": 2, "klass": "preemptible"}
+
+:meth:`FaultStorm.from_spec` does the expansion with its own
+``random.Random(seed)``, so the same spec + seed always yields the same
+event list (replayable storms are what make same-seed journals
+byte-identical). The *application* of each event — which slices die,
+which gangs restart — lives in :class:`~torchx_tpu.sim.harness
+.SimHarness`; this module only decides *when* and *how big*.
+
+Kinds:
+
+* ``slice_loss`` — ``count`` topologically-adjacent slices of one pool
+  go dark for ``duration_s``; every gang touching them dies and is
+  resubmitted with its banked remaining work.
+* ``pool_drain`` — a pool stops accepting placements for ``duration_s``
+  (maintenance drain); running gangs finish, freed slices cordon.
+* ``preemption_wave`` — ``count`` running gangs of ``klass`` are
+  externally preempted (defender-capacity reclaim) and resubmitted.
+* ``control_flap`` — the control plane is unreachable for
+  ``duration_s``: submits and terminal events buffer and land late.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+FAULT_KINDS = ("slice_loss", "pool_drain", "preemption_wave", "control_flap")
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One concrete injected fault at one virtual instant."""
+
+    t: float
+    kind: str
+    count: int = 1
+    pool: str = ""
+    duration_s: float = 900.0
+    klass: str = ""
+    seq: int = 0
+
+    def __post_init__(self) -> None:
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; known: {FAULT_KINDS}"
+            )
+
+
+@dataclass
+class FaultStorm:
+    """The expanded, time-ordered fault schedule of one run."""
+
+    events: list[FaultEvent] = field(default_factory=list)
+
+    @classmethod
+    def from_spec(cls, spec: list, seed: int) -> "FaultStorm":
+        """Expand a scenario's ``faults`` list deterministically.
+
+        Entries with ``t`` are taken as-is; entries with
+        ``start``/``end``/``events`` expand into that many events at
+        seeded-uniform times inside the window. Event order is
+        ``(t, seq)`` with ``seq`` assigned in expansion order, so ties
+        resolve identically run to run."""
+        rng = random.Random(seed)
+        out: list[FaultEvent] = []
+        seq = 0
+        for entry in spec or []:
+            kind = str(entry.get("kind", ""))
+            common = {
+                "kind": kind,
+                "count": int(entry.get("count", 1)),
+                "pool": str(entry.get("pool", "")),
+                "duration_s": float(entry.get("duration_s", 900.0)),
+                "klass": str(entry.get("klass", "")),
+            }
+            if "t" in entry:
+                out.append(FaultEvent(t=float(entry["t"]), seq=seq, **common))
+                seq += 1
+                continue
+            start = float(entry.get("start", 0.0))
+            end = float(entry.get("end", start))
+            n = int(entry.get("events", 1))
+            times = sorted(rng.uniform(start, end) for _ in range(n))
+            for t in times:
+                out.append(FaultEvent(t=t, seq=seq, **common))
+                seq += 1
+        out.sort(key=lambda e: (e.t, e.seq))
+        return cls(events=out)
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def __len__(self) -> int:
+        return len(self.events)

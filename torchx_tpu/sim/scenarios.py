@@ -1,0 +1,182 @@
+"""Bundled simulation scenarios + scenario loading.
+
+A scenario is one JSON-shaped dict describing a full run:
+
+``fleet``
+    :meth:`~torchx_tpu.fleet.model.FleetModel.from_spec` string.
+``hours`` / ``rate_scale`` / ``seed``
+    :func:`~torchx_tpu.sim.traffic.diurnal_trace` arguments (``seed`` is
+    the default; ``tpx sim run --seed`` overrides it).
+``replay_journal``
+    optional recorded fleet journal path — replaces the synthetic trace
+    with :func:`~torchx_tpu.sim.traffic.replay_trace`.
+``backend``
+    executor the scenario is modeled against (must be ``"sim"`` — the
+    analyzer's TPX604 rule warns when a scenario names a real backend,
+    because the virtual-time executor is the only thing that runs).
+``faults``
+    :meth:`~torchx_tpu.sim.faults.FaultStorm.from_spec` entries.
+``serve``
+    synthetic serve-plane telemetry: ``ttft_base_s``,
+    ``ttft_degraded_s`` (TTFT while a serve-degrading fault is active),
+    ``requests_per_tick``, ``slos`` (SLO spec strings over the
+    ``tpx_sim_*`` metrics), ``autoscale`` (AutoscalePolicy fields +
+    ``replicas``/``load``).
+``pipelines``
+    ``[{"at": <virtual s>, "score": <eval score>, "spec": <PipelineSpec
+    dict>}]`` — submitted to the real PipelineEngine at ``at``.
+``launch_latency_s`` / ``complete_latency_s`` / ``metrics_interval_s``
+    executor latencies and the telemetry tick.
+
+:func:`get_scenario` resolves a bundled name or a JSON file path.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from typing import Any
+
+#: the default SLO set every scenario gets unless it declares its own.
+DEFAULT_SIM_SLOS = ["ttft:tpx_sim_serve_ttft_seconds<0.5@0.99"]
+
+BUNDLED_SCENARIOS: dict[str, dict[str, Any]] = {
+    # the SIM_SMOKE / unit-test scenario: small enough to run in well
+    # under a second, busy enough to exercise every journal row kind.
+    "smoke-tiny": {
+        "name": "smoke-tiny",
+        "backend": "sim",
+        "fleet": "sim:v5e-4x8",
+        "seed": 11,
+        "hours": 0.5,
+        "rate_scale": 1.0,
+        "metrics_interval_s": 30.0,
+        "faults": [
+            {"t": 420.0, "kind": "slice_loss", "count": 2, "duration_s": 300.0},
+            {"t": 900.0, "kind": "preemption_wave", "count": 1, "klass": "batch"},
+        ],
+    },
+    # the bench companion: 10x the original 16-slice bench fleet under
+    # the same diurnal curve, no faults — pure scheduling behavior.
+    "fleet-diurnal": {
+        "name": "fleet-diurnal",
+        "backend": "sim",
+        "fleet": "sim:v5e-4x160",
+        "seed": 11,
+        "hours": 2.0,
+        "rate_scale": 10.0,
+        "metrics_interval_s": 60.0,
+        "faults": [],
+    },
+    # the acceptance scenario: 1000 slices, ~2700 gangs over 3 virtual
+    # hours, correlated slice loss + a preemption wave + a maintenance
+    # drain + a control-plane flap.
+    "failure-storm": {
+        "name": "failure-storm",
+        "backend": "sim",
+        "fleet": "sim:v5e-4x1000",
+        "seed": 11,
+        "hours": 3.0,
+        "rate_scale": 6.7,
+        "metrics_interval_s": 120.0,
+        "faults": [
+            {"t": 2400.0, "kind": "slice_loss", "count": 50, "duration_s": 1800.0},
+            {
+                "kind": "preemption_wave",
+                "start": 3600.0,
+                "end": 7200.0,
+                "events": 8,
+                "count": 3,
+                "klass": "preemptible",
+            },
+            {"t": 5400.0, "kind": "pool_drain", "pool": "sim", "duration_s": 600.0},
+            {"t": 8100.0, "kind": "control_flap", "duration_s": 120.0},
+        ],
+    },
+    # the full-stack scenario: a train -> eval -> promote pipeline whose
+    # canary window collides with a serve-degrading slice loss; the SLO
+    # burn gate must roll the promotion back in virtual time.
+    "pipeline-canary-under-storm": {
+        "name": "pipeline-canary-under-storm",
+        "backend": "sim",
+        "fleet": "sim:v5e-4x16",
+        "seed": 11,
+        "hours": 1.0,
+        "rate_scale": 0.5,
+        "metrics_interval_s": 15.0,
+        "serve": {
+            "ttft_base_s": 0.08,
+            "ttft_degraded_s": 1.2,
+            "requests_per_tick": 50,
+            "slos": DEFAULT_SIM_SLOS,
+        },
+        "faults": [
+            {
+                "t": 1000.0,
+                "kind": "slice_loss",
+                "count": 4,
+                "duration_s": 900.0,
+                "klass": "serve",
+            },
+        ],
+        "pipelines": [
+            {
+                "at": 60.0,
+                "score": 0.93,
+                "spec": {
+                    "name": "canary-under-storm",
+                    "stages": [
+                        {
+                            "name": "train",
+                            "kind": "train",
+                            "replicas": 2,
+                            "ckpt_dir": "ckpt",
+                            "cfg": {"sim_duration_s": 600.0},
+                        },
+                        {
+                            "name": "eval",
+                            "kind": "eval",
+                            "depends_on": ["train"],
+                            "score_file": "score.json",
+                            "threshold": 0.9,
+                            "cfg": {"sim_duration_s": 120.0},
+                        },
+                        {
+                            "name": "promote",
+                            "kind": "promote",
+                            "depends_on": ["eval"],
+                            "observe_s": 600.0,
+                            "burn_threshold": 1.0,
+                        },
+                    ],
+                },
+            },
+        ],
+    },
+}
+
+
+def get_scenario(name_or_path: str) -> dict[str, Any]:
+    """Resolve a scenario by bundled name or JSON file path.
+
+    Returns a deep copy (callers mutate freely). Raises ``ValueError``
+    for an unknown name / unreadable file / non-object JSON."""
+    if name_or_path in BUNDLED_SCENARIOS:
+        return copy.deepcopy(BUNDLED_SCENARIOS[name_or_path])
+    if os.path.exists(name_or_path):
+        try:
+            with open(name_or_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            raise ValueError(f"cannot load scenario {name_or_path!r}: {e}") from e
+        if not isinstance(doc, dict):
+            raise ValueError(
+                f"scenario {name_or_path!r} must be a JSON object"
+            )
+        doc.setdefault("name", os.path.splitext(os.path.basename(name_or_path))[0])
+        return doc
+    raise ValueError(
+        f"unknown scenario {name_or_path!r}; bundled:"
+        f" {', '.join(sorted(BUNDLED_SCENARIOS))}"
+    )

@@ -1,0 +1,136 @@
+"""Synthetic and replayed job traces for the simulator.
+
+Two sources, one shape — a list of job dicts (``job``, ``arrival``,
+``klass``, ``tenant``, ``replicas``, ``duration``, ``elastic``) sorted
+by arrival:
+
+* :func:`diurnal_trace` — seeded Poisson arrivals whose rate follows a
+  diurnal sine (one peak per horizon), the generalization of the
+  original ``scripts/bench_fleet.py`` generator with a ``rate_scale``
+  knob for 10x-fleet runs;
+* :func:`replay_trace` — arrivals reconstructed from a recorded
+  :class:`~torchx_tpu.fleet.queue.FleetJournal` (or pipeline journal),
+  so a production incident replays against a what-if fleet.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+
+#: class -> (arrival weight, (min,max) duration seconds, replica choices)
+CLASS_MIX = {
+    "serve": (0.15, (120.0, 600.0), (1, 2)),
+    "interactive": (0.25, (60.0, 300.0), (1, 2)),
+    "batch": (0.40, (600.0, 1800.0), (2, 4)),
+    "preemptible": (0.20, (600.0, 1800.0), (2, 4)),
+}
+
+#: fallback duration for replayed jobs whose journal lacks a terminal
+#: entry (the incident cut the recording short).
+DEFAULT_REPLAY_DURATION_S = 600.0
+
+
+def diurnal_trace(
+    hours: float,
+    seed: int,
+    rate_scale: float = 1.0,
+    base_interval_s: float = 45.0,
+) -> list[dict]:
+    """Poisson arrivals with a diurnal rate (one peak per simulated
+    'day' compressed into the horizon), seeded -> identical traces for
+    identical arguments. ``rate_scale`` multiplies the arrival rate
+    (scale it with fleet size to keep pressure comparable)."""
+    rng = random.Random(seed)
+    horizon = hours * 3600.0
+    base_rate = rate_scale / base_interval_s
+    jobs = []
+    t = 0.0
+    i = 0
+    while True:
+        # thinning: sample at the peak rate, accept by the diurnal curve
+        peak = base_rate * 3.25
+        t += rng.expovariate(peak)
+        if t >= horizon:
+            break
+        phase = 2.0 * math.pi * (t / horizon)
+        rate = base_rate * (1.75 + 1.5 * math.sin(phase))  # 0.25x..3.25x
+        if rng.random() > rate / peak:
+            continue
+        r = rng.random()
+        acc = 0.0
+        klass = "batch"
+        for name, (w, _dur, _reps) in CLASS_MIX.items():
+            acc += w
+            if r <= acc:
+                klass = name
+                break
+        _w, (dlo, dhi), reps = CLASS_MIX[klass]
+        elastic = klass in ("batch", "preemptible")
+        replicas = rng.choice(reps)
+        jobs.append(
+            {
+                "job": f"sim-{i:04d}",
+                "arrival": t,
+                "klass": klass,
+                "tenant": rng.choice(("ads", "search", "research")),
+                "replicas": replicas,
+                "duration": rng.uniform(dlo, dhi),
+                "elastic": elastic and replicas > 1,
+            }
+        )
+        i += 1
+    return jobs
+
+
+def replay_trace(journal_path: str) -> list[dict]:
+    """Rebuild a job trace from a recorded fleet journal.
+
+    ``submit`` entries give arrival (relative to the first entry's
+    stamp), class, tenant and gang shape; each job's duration is the
+    span from its first ``place`` to its ``terminal`` entry (falling
+    back to :data:`DEFAULT_REPLAY_DURATION_S` when the recording ends
+    first). Unparseable lines are skipped — a torn journal tail must not
+    kill the replay."""
+    submits: dict[str, dict] = {}
+    placed: dict[str, float] = {}
+    done: dict[str, float] = {}
+    t0: float | None = None
+    with open(journal_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            ts = float(e.get("time_usec", 0) or 0) / 1e6
+            if t0 is None:
+                t0 = ts
+            kind, job = e.get("kind"), str(e.get("job", ""))
+            if not job:
+                continue
+            if kind == "submit":
+                submits[job] = {
+                    "job": job,
+                    "arrival": max(0.0, ts - t0),
+                    "klass": str(e.get("klass", "batch")),
+                    "tenant": str(e.get("tenant", "replay")),
+                    "replicas": int(e.get("replicas", 1)),
+                    "elastic": bool(e.get("elastic", False)),
+                }
+            elif kind == "place":
+                placed.setdefault(job, ts)
+            elif kind == "terminal":
+                done.setdefault(job, ts)
+    out = []
+    for job, doc in submits.items():
+        if job in placed and job in done:
+            doc["duration"] = max(1.0, done[job] - placed[job])
+        else:
+            doc["duration"] = DEFAULT_REPLAY_DURATION_S
+        out.append(doc)
+    out.sort(key=lambda d: (d["arrival"], d["job"]))
+    return out

@@ -144,6 +144,7 @@ class Supervisor:
         sleep: Callable[[float], None] = time.sleep,
         rng: Optional[random.Random] = None,
         session: Optional[str] = None,
+        clock: Callable[[], float] = time.time,
     ) -> None:
         if dryrun_info._app is None or not dryrun_info._scheduler:
             raise ValueError(
@@ -154,6 +155,7 @@ class Supervisor:
         self._dryrun_info = dryrun_info
         self._policy = policy or SupervisorPolicy()
         self._sleep = sleep
+        self._clock = clock
         self._rng = rng or random.Random()
         self.session = session or make_unique("sup")
         self._ledger = AttemptLedger(self.session)
@@ -402,7 +404,7 @@ class Supervisor:
             # floor BEFORE scheduling so nothing the new attempt emits can
             # land below it; the first attempt keeps floor 0 (pre-submit
             # evidence can only be ours)
-            self._evidence_floor = time.time()
+            self._evidence_floor = self._clock()
         self._gang_was_full = False
         info = self._dryrun_info
         app = copy.deepcopy(info._app)
