@@ -56,6 +56,8 @@ JAX_FREE = (
     os.path.join("obs", "telemetry.py"),
     os.path.join("obs", "slo.py"),
     os.path.join("obs", "stitch.py"),
+    # the step profiler backs `tpx profile` and the analyzers' attribution
+    os.path.join("obs", "profile.py"),
     "sim",
 )
 

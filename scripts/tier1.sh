@@ -725,4 +725,65 @@ assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
 EOF
 then echo "SIM_SMOKE=ok"; else echo "SIM_SMOKE=FAILED"; rc=1; fi
 rm -rf "$sim_dir"
+
+# Profile smoke: a tiny profiled CPU train run (TPX_PROFILE=1) must leave
+# one profile.jsonl whose `tpx profile --json` summary has every core
+# phase nonzero, MFU in (0, 1], phases summing to the measured wall time
+# (the 5% attribution acceptance bound), and a calibration table whose
+# collective_scale moved off 1.0 (the measured-overlap feedback loop).
+# `tpx profile --help` must stay jax-free (lint JAX_FREE covers the
+# module; this covers the CLI dispatch path).
+prof_dir=$(mktemp -d /tmp/tpx_profile_smoke.XXXXXX)
+if timeout -k 10 300 env JAX_PLATFORMS=cpu PROF_DIR="$prof_dir" \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - <<'EOF'
+import glob, json, os, subprocess, sys
+
+base = os.environ["PROF_DIR"]
+os.environ["TPX_OBS_DIR"] = os.path.join(base, "obs")
+os.environ["TPX_TUNE_DIR"] = os.path.join(base, "tune")
+os.environ["TPX_PROFILE"] = "1"  # the env switch, not the --profile flag
+
+from torchx_tpu.examples.train_llama import main as train_main
+
+train_main(["--config", "tiny", "--mesh", "fsdp=-1", "--batch", "8",
+            "--seq", "128", "--steps", "8"])
+
+journals = glob.glob(os.path.join(base, "obs", "*", "profile.jsonl"))
+assert len(journals) == 1, journals
+r = subprocess.run(
+    [sys.executable, "-m", "torchx_tpu.cli.main", "profile",
+     journals[0], "--json"],
+    capture_output=True, text=True, timeout=120,
+)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+s = json.loads(r.stdout)
+assert s["v"] == 1 and s["steps"] > 0, s
+for ph in ("data_wait", "forward_backward", "optimizer", "host"):
+    assert s["phase_seconds"].get(ph, 0) > 0, (ph, s["phase_seconds"])
+assert 0 < s["mfu"] <= 1, s["mfu"]
+total = sum(s["phase_seconds"].values()) + sum(s["grad_sync_seconds"].values())
+assert abs(total - s["wall_s"]) / s["wall_s"] < 0.05, (total, s["wall_s"])
+
+# the measured-residual loop closed: one profiled run moved the scale
+from torchx_tpu.tune.calibrate import CalibrationTable
+
+scale = CalibrationTable.load_default().scales_for("cpu-sim").collective_scale
+assert scale != 1.0, scale
+
+# the profile verb rides the lazy dispatcher: its help never imports jax
+r = subprocess.run(
+    [sys.executable, "-c", (
+        "import sys\n"
+        "from torchx_tpu.cli.main import main\n"
+        "try: main(['profile', '--help'])\n"
+        "except SystemExit: pass\n"
+        "assert 'jax' not in sys.modules, 'tpx profile --help imported jax'\n"
+    )],
+    capture_output=True, text=True, timeout=60,
+)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+EOF
+then echo "PROFILE_SMOKE=ok"; else echo "PROFILE_SMOKE=FAILED"; rc=1; fi
+rm -rf "$prof_dir"
 exit $rc

@@ -476,6 +476,39 @@ class TestPortAndMountRules:
         report = analyze(app_with(), scheduler="tpu_vm")
         assert "TPX214" not in codes(report)
 
+    def test_profile_on_unscrapable_backend_warns(self):
+        report = analyze(app_with(args=["--profile"]), scheduler="tpu_vm")
+        assert "TPX215" in codes(report)
+        d = next(d for d in report.diagnostics if d.code == "TPX215")
+        assert d.severity == Severity.WARNING
+        assert "metricz_scrape" in d.message
+        assert "tpx profile" in d.hint
+
+    def test_profile_env_switch_detected(self):
+        report = analyze(
+            app_with(env={"TPX_PROFILE": "1"}), scheduler="tpu_vm"
+        )
+        assert "TPX215" in codes(report)
+        # a disabled switch is silent
+        report = analyze(
+            app_with(env={"TPX_PROFILE": "0"}), scheduler="tpu_vm"
+        )
+        assert "TPX215" not in codes(report)
+
+    def test_profile_dir_flag_does_not_trigger(self):
+        # --profile-dir is the xprof trace flag, a different feature
+        report = analyze(
+            app_with(args=["--profile-dir", "/tmp/x"]), scheduler="tpu_vm"
+        )
+        assert "TPX215" not in codes(report)
+
+    def test_profile_on_scrapable_backend_is_silent(self):
+        for backend in ("local", "local_docker", "gke", "slurm"):
+            report = analyze(
+                app_with(args=["--profile"]), scheduler=backend
+            )
+            assert "TPX215" not in codes(report), backend
+
     def test_duplicate_mount_dst(self):
         report = analyze(
             app_with(

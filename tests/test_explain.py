@@ -70,6 +70,9 @@ def test_model_shapes_match_jax_configs():
         assert shape.param_count() == cfg.param_count(), name
         if shape.is_moe:
             assert shape.active_param_count() == cfg.active_param_count(), name
+        # the step profiler's MFU denominator reuses this mirror: the
+        # FLOP arithmetic must agree exactly too
+        assert shape.flops_per_token() == cfg.flops_per_token(), name
 
 
 def test_plan_from_spmd_role():

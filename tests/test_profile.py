@@ -1,0 +1,491 @@
+"""Step-profiler tests: attribution arithmetic, the fsync'd journal's
+crash safety (torn final line held back on read), summarize/diff schema
+stability, the ``observe_collectives`` calibration fold, the
+``tpx_profile_*`` gauge export, the ``tpx profile`` CLI, and the
+prefetcher's wait-observer seam."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from torchx_tpu.obs.profile import (
+    AttributionModel,
+    CORE_PHASES,
+    PROFILE_FILE,
+    StepProfiler,
+    diff_summaries,
+    export_metrics,
+    feed_calibration,
+    load_profile,
+    render_diff,
+    render_summary,
+    summarize,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def model(**overrides) -> AttributionModel:
+    defaults = dict(
+        flops_per_token=1000.0,
+        tokens_per_step=100,
+        peak_flops=1e6,
+        param_count=1000,
+        comm_axis_s={"fsdp": 0.02, "dp": 0.01},
+        generation="cpu",
+    )
+    defaults.update(overrides)
+    return AttributionModel(**defaults)
+
+
+def profiler(tmp_path, **overrides) -> StepProfiler:
+    return StepProfiler(
+        model(**overrides),
+        path=str(tmp_path / PROFILE_FILE),
+        clock=lambda: 123.0,
+    )
+
+
+MEASURED = {
+    "data_wait": 0.05,
+    "forward_backward": 0.2,
+    "checkpoint": 0.0,
+    "host": 0.01,
+}
+
+
+# ---------------------------------------------------------------------------
+# attribution arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_attribution_model_arithmetic():
+    m = model()
+    assert m.ideal_compute_s == pytest.approx(0.1)  # 100 * 1000 / 1e6
+    assert m.optimizer_s == pytest.approx(0.012)  # 12 * 1000 / 1e6
+    assert m.total_comm_s == pytest.approx(0.03)
+    # ASSUMED_MFU = 0.5 -> slack equals the ideal floor
+    assert m.compute_slack_s == pytest.approx(0.1)
+
+
+def test_record_step_splits_device_time(tmp_path):
+    p = profiler(tmp_path)
+    rec = p.record_step(1, wall_s=0.27, measured=dict(MEASURED))
+    phases = rec["phases"]
+    # the device slice is conserved: fb + optimizer + exposed == measured
+    device = (
+        phases["forward_backward"]
+        + phases["optimizer"]
+        + rec["comm_exposed_s"]
+    )
+    assert device == pytest.approx(0.2)
+    # residual above the floor split by modeled shares:
+    # residual = 0.2 - 0.1 - 0.012; comm share = 0.03 / (0.03 + 0.1)
+    assert rec["comm_exposed_s"] == pytest.approx(0.088 * 0.03 / 0.13)
+    # grad_sync distributes exposed by the per-axis model (2:1)
+    gs = rec["grad_sync"]
+    assert gs["fsdp"] == pytest.approx(2 * gs["dp"])
+    assert sum(gs.values()) == pytest.approx(rec["comm_exposed_s"])
+    # measured slices pass through untouched
+    assert phases["data_wait"] == pytest.approx(0.05)
+    assert phases["host"] == pytest.approx(0.01)
+    assert rec["mfu"] == pytest.approx(100 * 1000 / (0.27 * 1e6))
+    assert rec["overlap_frac"] == pytest.approx(
+        1.0 - rec["comm_exposed_s"] / 0.03
+    )
+
+
+def test_phase_seconds_sum_to_measured_slices(tmp_path):
+    # the 5%-of-wall acceptance bound holds by construction: phases +
+    # grad_sync sum exactly to the measured slices
+    p = profiler(tmp_path)
+    rec = p.record_step(1, wall_s=0.27, measured=dict(MEASURED))
+    total = sum(rec["phases"].values()) + sum(rec["grad_sync"].values())
+    assert total == pytest.approx(sum(MEASURED.values()))
+
+
+def test_no_comm_model_means_no_grad_sync(tmp_path):
+    p = profiler(tmp_path, comm_axis_s={})
+    rec = p.record_step(1, wall_s=0.27, measured=dict(MEASURED))
+    assert rec["grad_sync"] == {}
+    assert rec["comm_exposed_s"] == 0.0
+    assert rec["overlap_frac"] is None
+    # the whole device slice minus the optimizer stays forward_backward
+    assert rec["phases"]["forward_backward"] == pytest.approx(0.2 - 0.012)
+
+
+def test_device_faster_than_floor_exposes_nothing(tmp_path):
+    # device time below the roofline floor: no residual to attribute
+    p = profiler(tmp_path)
+    rec = p.record_step(1, wall_s=0.1, measured={"forward_backward": 0.05})
+    assert rec["comm_exposed_s"] == 0.0
+    assert rec["overlap_frac"] == pytest.approx(1.0)
+
+
+def test_end_step_without_begin_is_none(tmp_path):
+    p = profiler(tmp_path)
+    assert p.end_step(1) is None
+
+
+def test_hooks_accumulate_and_record(tmp_path):
+    p = profiler(tmp_path)
+    p.begin_step()
+    p.observe_wait(0.004)
+    p.observe_wait(0.001)
+    with p.phase("forward_backward"):
+        pass
+    rec = p.end_step(7)
+    assert rec is not None and rec["step"] == 7
+    assert rec["phases"]["data_wait"] == pytest.approx(0.005)
+    assert rec["wall_s"] > 0
+    # waits arriving outside a window are discarded by the next begin
+    p.observe_wait(9.0)
+    p.begin_step()
+    rec2 = p.end_step(8)
+    assert rec2["phases"]["data_wait"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# journal crash safety
+# ---------------------------------------------------------------------------
+
+
+def test_journal_meta_first_then_steps(tmp_path):
+    p = profiler(tmp_path)
+    p.record_step(1, wall_s=0.27, measured=dict(MEASURED))
+    p.record_step(2, wall_s=0.28, measured=dict(MEASURED))
+    lines = (tmp_path / PROFILE_FILE).read_text().splitlines()
+    assert len(lines) == 3
+    meta = json.loads(lines[0])
+    assert meta["kind"] == "meta"
+    assert meta["ts"] == 123.0  # the injected clock seam stamps records
+    assert meta["model"]["tokens_per_step"] == 100
+    assert [json.loads(ln)["step"] for ln in lines[1:]] == [1, 2]
+
+
+def test_torn_final_line_held_back(tmp_path):
+    # a kill mid-append leaves a torn final line; readers must skip it
+    p = profiler(tmp_path)
+    p.record_step(1, wall_s=0.27, measured=dict(MEASURED))
+    p.record_step(2, wall_s=0.28, measured=dict(MEASURED))
+    path = tmp_path / PROFILE_FILE
+    with open(path, "ab") as f:
+        f.write(b'{"v": 1, "kind": "step", "step": 3, "wall')
+    records = load_profile(str(path))
+    steps = [r["step"] for r in records if r.get("kind") == "step"]
+    assert steps == [1, 2]
+    # a directory target resolves to its profile.jsonl
+    assert load_profile(str(tmp_path)) == records
+
+
+def test_journal_failure_never_raises(tmp_path):
+    bad = StepProfiler(
+        model(), path=str(tmp_path / "no" / "such" / "x.jsonl"), clock=lambda: 0.0
+    )
+    # make the parent un-creatable by shadowing it with a file
+    (tmp_path / "no").write_text("a file, not a dir")
+    rec = bad.record_step(1, wall_s=0.1, measured=dict(MEASURED))
+    assert rec["step"] == 1  # in-memory record still produced
+
+
+# ---------------------------------------------------------------------------
+# summarize / diff / render
+# ---------------------------------------------------------------------------
+
+
+def summary_of(tmp_path, n=3) -> dict:
+    p = profiler(tmp_path)
+    for i in range(n):
+        p.record_step(i + 1, wall_s=0.27, measured=dict(MEASURED))
+    return summarize(load_profile(str(tmp_path)))
+
+
+def test_summarize_schema(tmp_path):
+    s = summary_of(tmp_path)
+    assert s["v"] == 1 and s["steps"] == 3
+    assert s["wall_s"] == pytest.approx(0.81)
+    assert s["step_s"] == pytest.approx(0.27)
+    for ph in ("data_wait", "forward_backward", "optimizer", "host"):
+        assert ph in s["phase_seconds"]
+    assert s["phase_fracs"]["data_wait"] == pytest.approx(0.05 / 0.27)
+    assert s["data_wait_frac"] == pytest.approx(0.05 / 0.27)
+    assert set(s["grad_sync_seconds"]) == {"fsdp", "dp"}
+    assert 0 < s["mfu"] <= 1
+    assert s["overlap_frac"] == pytest.approx(
+        1.0 - s["comm_exposed_s"] / s["comm_modeled_s"]
+    )
+    assert s["meta"]["peak_flops"] == 1e6  # meta record rides the summary
+
+
+def test_summarize_empty():
+    s = summarize([])
+    assert s["steps"] == 0 and s["overlap_frac"] is None
+
+
+def test_diff_tolerates_disjoint_phase_sets(tmp_path):
+    a = summary_of(tmp_path / "a")
+    # run b checkpoints; run a never did — the diff must still line up
+    pb = profiler(tmp_path / "b")
+    mb = dict(MEASURED, checkpoint=0.03)
+    pb.record_step(1, wall_s=0.30, measured=mb)
+    b = summarize(load_profile(str(tmp_path / "b")))
+    d = diff_summaries(a, b)
+    row = d["phase_step_s"]["checkpoint"]
+    assert row["a"] == pytest.approx(0.0)
+    assert row["b"] == pytest.approx(0.03)
+    assert row["delta"] == pytest.approx(0.03)
+    # fully disjoint dict inputs also survive
+    d2 = diff_summaries(
+        {"steps": 1, "phase_seconds": {"x": 1.0}},
+        {"steps": 1, "phase_seconds": {"y": 2.0}},
+    )
+    assert d2["phase_step_s"]["x"]["b"] == 0.0
+    assert d2["phase_step_s"]["y"]["a"] == 0.0
+
+
+def test_render_summary_and_diff_are_strings(tmp_path):
+    s = summary_of(tmp_path)
+    out = render_summary(s)
+    assert "forward_backward" in out and "roofline" in out and "overlap" in out
+    assert "grad_sync[fsdp]" in out
+    d = render_diff(diff_summaries(s, s))
+    assert "profile diff" in d and "mfu" in d
+
+
+# ---------------------------------------------------------------------------
+# calibration feedback
+# ---------------------------------------------------------------------------
+
+
+def test_observe_collectives_fold(tmp_path):
+    from torchx_tpu.tune.calibrate import CalibrationTable
+
+    table = CalibrationTable(str(tmp_path / "calibration.json"))
+    out = table.observe_collectives(
+        "cpu", predicted_collective_s=0.001, measured_collective_s=0.004
+    )
+    assert out["generation"] == "cpu-sim"
+    # EMA gain 0.5: scale moves halfway to the 4x measured ratio
+    assert out["scales"]["collective_scale"] == pytest.approx(2.5)
+    assert out["collectives"]["err_before"] == pytest.approx(0.75)
+    assert out["collectives"]["err_after"] == pytest.approx(0.375)
+    assert out["scales"]["samples"] == 1
+    # other scales untouched
+    assert out["scales"]["activation_scale"] == 1.0
+    assert out["scales"]["step_time_scale"] == 1.0
+    # roundtrip
+    table.save()
+    loaded = CalibrationTable.load(table.path)
+    assert loaded.scales_for("cpu").collective_scale == pytest.approx(2.5)
+
+
+def test_observe_collectives_rejects_bad_inputs(tmp_path):
+    from torchx_tpu.tune.calibrate import CalibrationTable
+
+    table = CalibrationTable(str(tmp_path / "c.json"))
+    with pytest.raises(ValueError, match="alpha"):
+        table.observe_collectives(
+            "v5e", predicted_collective_s=1.0, measured_collective_s=1.0, alpha=1.0
+        )
+    with pytest.raises(ValueError, match="> 0"):
+        table.observe_collectives(
+            "v5e", predicted_collective_s=0.0, measured_collective_s=1.0
+        )
+
+
+def test_feed_calibration_writes_default_table(tmp_path, monkeypatch):
+    from torchx_tpu.tune.calibrate import CalibrationTable
+
+    monkeypatch.setenv("TPX_TUNE_DIR", str(tmp_path))
+    s = {"steps": 2, "comm_modeled_s": 0.002, "comm_exposed_s": 0.008}
+    out = feed_calibration(s, generation="cpu")
+    assert out is not None
+    # per-step: predicted 0.001 vs measured 0.004 -> scale 2.5
+    assert CalibrationTable.load_default().scales_for(
+        "cpu"
+    ).collective_scale == pytest.approx(2.5)
+    # nothing to fold on a single-device run
+    assert (
+        feed_calibration(
+            {"steps": 2, "comm_modeled_s": 0.0, "comm_exposed_s": 0.0},
+            generation="cpu",
+        )
+        is None
+    )
+
+
+def test_profiler_close_feeds_calibration(tmp_path, monkeypatch):
+    from torchx_tpu.tune.calibrate import CalibrationTable
+
+    monkeypatch.setenv("TPX_TUNE_DIR", str(tmp_path / "tune"))
+    p = profiler(tmp_path)
+    p.record_step(1, wall_s=0.27, measured=dict(MEASURED))
+    s = p.close()
+    assert s["steps"] == 1
+    assert "calibration" in s
+    assert CalibrationTable.load_default().scales_for(
+        "cpu"
+    ).collective_scale != 1.0
+
+
+def test_profiler_close_calibrate_false(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPX_TUNE_DIR", str(tmp_path / "tune"))
+    p = profiler(tmp_path)
+    p.record_step(1, wall_s=0.27, measured=dict(MEASURED))
+    s = p.close(calibrate=False)
+    assert "calibration" not in s
+    assert not (tmp_path / "tune").exists()
+
+
+# ---------------------------------------------------------------------------
+# metrics export
+# ---------------------------------------------------------------------------
+
+
+def test_export_metrics_sets_gauges(tmp_path):
+    from torchx_tpu.obs import metrics as obs_metrics
+
+    s = summary_of(tmp_path)
+    export_metrics(s)
+    assert obs_metrics.PROFILE_MFU.value() == pytest.approx(s["mfu"])
+    assert obs_metrics.PROFILE_DATA_WAIT_FRAC.value() == pytest.approx(
+        s["data_wait_frac"]
+    )
+    assert obs_metrics.PROFILE_OVERLAP_FRAC.value() == pytest.approx(
+        s["overlap_frac"]
+    )
+    assert obs_metrics.PROFILE_PHASE_SECONDS.value(
+        phase="data_wait"
+    ) == pytest.approx(0.05)
+    assert obs_metrics.PROFILE_PHASE_SECONDS.value(
+        phase="grad_sync[fsdp]"
+    ) == pytest.approx(s["grad_sync_seconds"]["fsdp"] / s["steps"])
+
+
+# ---------------------------------------------------------------------------
+# tpx profile CLI
+# ---------------------------------------------------------------------------
+
+
+def make_session(root: Path, name: str, n=2, wall=0.27) -> Path:
+    d = root / name
+    d.mkdir(parents=True)
+    p = StepProfiler(model(), path=str(d / PROFILE_FILE), clock=lambda: 1.0)
+    for i in range(n):
+        p.record_step(i + 1, wall_s=wall, measured=dict(MEASURED))
+    return d
+
+
+def test_cli_json_explicit_path(tmp_path, capsys):
+    from torchx_tpu.cli.main import main
+
+    d = make_session(tmp_path, "tpx_aa")
+    main(["profile", str(d / PROFILE_FILE), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["steps"] == 2
+    for ph in CORE_PHASES:
+        assert ph in out["phase_seconds"]
+
+
+def test_cli_picks_newest_session(tmp_path, capsys, monkeypatch):
+    from torchx_tpu.cli.main import main
+
+    monkeypatch.setenv("TPX_OBS_DIR", str(tmp_path))
+    make_session(tmp_path, "tpx_old")
+    new = make_session(tmp_path, "tpx_new", n=3)
+    old_j, new_j = tmp_path / "tpx_old" / PROFILE_FILE, new / PROFILE_FILE
+    os.utime(old_j, (1_000, 1_000))
+    os.utime(new_j, (2_000, 2_000))
+    main(["profile", "--json"])
+    assert json.loads(capsys.readouterr().out)["steps"] == 3
+    # session NAME resolution against the obs root
+    main(["profile", "tpx_old", "--json"])
+    assert json.loads(capsys.readouterr().out)["steps"] == 2
+
+
+def test_cli_text_render(tmp_path, capsys):
+    from torchx_tpu.cli.main import main
+
+    d = make_session(tmp_path, "tpx_bb")
+    main(["profile", str(d)])
+    out = capsys.readouterr().out
+    assert "roofline" in out and "forward_backward" in out
+
+
+def test_cli_diff(tmp_path, capsys):
+    from torchx_tpu.cli.main import main
+
+    a = make_session(tmp_path, "a", wall=0.27)
+    b = make_session(tmp_path, "b", wall=0.30)
+    main(["profile", "--diff", str(a), str(b), "--json"])
+    d = json.loads(capsys.readouterr().out)
+    assert d["step_s"]["delta"] == pytest.approx(0.03)
+    main(["profile", "--diff", str(a), str(b)])
+    assert "profile diff" in capsys.readouterr().out
+
+
+def test_cli_missing_profile_errors(tmp_path, capsys, monkeypatch):
+    from torchx_tpu.cli.main import main
+
+    monkeypatch.setenv("TPX_OBS_DIR", str(tmp_path))
+    with pytest.raises(SystemExit):
+        main(["profile", "--json"])
+    assert "no profiles recorded" in capsys.readouterr().err
+    with pytest.raises(SystemExit):
+        main(["profile", "nope"])
+    assert "no profile found" in capsys.readouterr().err
+
+
+def test_cli_help_is_jax_free():
+    # the lazy-dispatch contract: `tpx profile --help` must not pay for
+    # jax (also enforced repo-wide by lint_internal JAX_FREE)
+    code = (
+        "import sys\n"
+        "from torchx_tpu.cli.main import main\n"
+        "try:\n"
+        "    main(['profile', '--help'])\n"
+        "except SystemExit:\n"
+        "    pass\n"
+        "assert 'jax' not in sys.modules, 'tpx profile --help imported jax'\n"
+    )
+    subprocess.run(
+        [sys.executable, "-c", code], check=True, cwd=REPO, timeout=120
+    )
+
+
+# ---------------------------------------------------------------------------
+# prefetcher wait-observer seam
+# ---------------------------------------------------------------------------
+
+
+def test_prefetcher_wait_observer():
+    from torchx_tpu.parallel.prefetch import Prefetcher
+
+    waits: list[float] = []
+    with Prefetcher(iter([1, 2, 3]), depth=0) as pf:
+        pf.set_wait_observer(waits.append)
+        assert next(pf) == 1
+        assert next(pf) == 2
+        assert len(waits) == 2 and all(w >= 0 for w in waits)
+        # cumulative account and the per-next observer agree
+        assert sum(waits) == pytest.approx(pf.data_wait_s, abs=1e-6)
+        pf.set_wait_observer(None)
+        assert next(pf) == 3
+        assert len(waits) == 2
+
+
+def test_prefetcher_observer_errors_are_swallowed():
+    from torchx_tpu.parallel.prefetch import Prefetcher
+
+    def boom(dt: float) -> None:
+        raise RuntimeError("observer bug")
+
+    with Prefetcher(iter([1, 2]), depth=0) as pf:
+        pf.set_wait_observer(boom)
+        assert next(pf) == 1  # the loop must survive a broken observer

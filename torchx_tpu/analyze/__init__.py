@@ -54,6 +54,7 @@ Diagnostic codes
 | TPX212 | warning | serve-shaped role binds ``--port`` with no matching ``port_map`` entry | map the port so routers/serve pools can reach it |
 | TPX213 | error | disaggregated serving role (``--serve-role prefill``/``decode``) declares no KV transfer path | add ``--kv-transfer`` or ``tpx/kv_transfer`` role metadata (``generate_server_disagg`` wires both) |
 | TPX214 | warning | role declares SLO specs (``--slo`` / ``tpx/slo`` metadata) but the backend has no ``/metricz`` scrape path | target a scrape-reachable backend or drop the replica-scrape SLOs |
+| TPX215 | warning | step profiling enabled (``--profile`` / ``TPX_PROFILE=1``) but the backend has no ``/metricz`` scrape path — ``tpx_profile_*`` summaries stay local to the replica's obs dir | target a scrape-reachable backend, or read the attribution locally with ``tpx profile`` |
 | TPX220 | error | two mounts share a destination path | each mount needs a distinct dst |
 | TPX221 | warning | mount destination is not absolute | use an absolute container path |
 | TPX300 | info | no capability profile for the scheduler; capability rules skipped | builtin backends declare ``CAPABILITIES`` |

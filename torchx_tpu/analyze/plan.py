@@ -120,6 +120,15 @@ class ModelShape:
             (self.top_k - 1) * ffn + d * self.n_experts
         )
 
+    def flops_per_token(self) -> int:
+        """Training FLOPs/token, fwd+bwd (mirror of
+        ``LlamaConfig.flops_per_token`` / ``MoEConfig.flops_per_token``):
+        ``6 * N + 12 * layers * dim * seq`` with N the ACTIVE parameter
+        count (MoE counts only the top_k routed experts) — the MFU
+        denominator the step profiler's roofline accounting reuses."""
+        attn = 12 * self.n_layers * self.dim * self.max_seq
+        return 6 * self.active_param_count() + attn
+
     def to_dict(self) -> dict:
         """Stable JSON form for the explain report."""
         return {

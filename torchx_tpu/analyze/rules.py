@@ -684,6 +684,53 @@ def check_serve_slo(ctx: RuleContext) -> Iterator[Diagnostic]:
         )
 
 
+@rule("profile_scrape")
+def check_profile_scrape(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX215: step profiling enabled (the trainer's ``--profile`` flag
+    or ``TPX_PROFILE=1`` in the role env) on a backend whose capability
+    profile has no ``/metricz`` scrape path. The profiler still writes
+    its per-step journal and ``tpx profile`` still renders it from the
+    replica's obs dir, but the ``tpx_profile_*`` summary gauges are
+    published via replica scrape — unreachable backend means no fleet
+    MFU / data-wait panels in ``tpx top``, which is usually why
+    profiling was turned on. WARNING, not ERROR: local-only attribution
+    is still useful."""
+    cap = ctx.capabilities
+    if ctx.scheduler is None or cap is None or cap.metricz_scrape:
+        return
+    for role in ctx.app.roles:
+        # exact-flag match: --profile-dir (the xprof trace flag) is a
+        # different feature and must not trigger this rule
+        enabled = any(
+            str(a) == "--profile" for a in role.args
+        ) or str(role.env.get(s.ENV_TPX_PROFILE, "")).lower() in (
+            "1",
+            "true",
+            "yes",
+            "on",
+        )
+        if not enabled:
+            continue
+        yield Diagnostic(
+            code="TPX215",
+            severity=Severity.WARNING,
+            role=role.name,
+            field="args",
+            message=(
+                f"role enables step profiling but scheduler"
+                f" {ctx.scheduler!r} has no /metricz scrape path"
+                " (metricz_scrape=False); tpx_profile_* summaries stay"
+                " local to the replica's obs dir and tpx top shows no"
+                " MFU / data-wait panels"
+            ),
+            hint=(
+                "target a scrape-reachable backend (local, docker, gke,"
+                " slurm) to publish the summaries, or read them locally"
+                " with `tpx profile` / the obs textfile sink"
+            ),
+        )
+
+
 @rule("mounts")
 def check_mounts(ctx: RuleContext) -> Iterator[Diagnostic]:
     """TPX220-TPX221: duplicate destinations and relative paths in mounts."""

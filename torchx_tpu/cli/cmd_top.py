@@ -29,6 +29,11 @@ TOP_PANELS: list[tuple[str, str, str, float]] = [
     ("req rate", "tpx_serve_requests_total", "rate", 60.0),
     ("p95 step time", "tpx_step_seconds", "p95", 300.0),
     ("p95 gang wait", "tpx_fleet_gang_wait_seconds", "p95", 600.0),
+    # step-profiler gauges (obs/profile.py): published only by profiled
+    # training runs, so the name-presence check below drops the panels
+    # cleanly when no job is profiling
+    ("train MFU", "tpx_profile_mfu", "last", 600.0),
+    ("data wait", "tpx_profile_data_wait_frac", "last", 600.0),
 ]
 
 _CLEAR = "\x1b[2J\x1b[H"

@@ -37,6 +37,7 @@ BUILTIN_CMDS: dict[str, tuple[str, str]] = {
     "list": ("torchx_tpu.cli.cmd_simple", "CmdList"),
     "log": ("torchx_tpu.cli.cmd_log", "CmdLog"),
     "trace": ("torchx_tpu.cli.cmd_trace", "CmdTrace"),
+    "profile": ("torchx_tpu.cli.cmd_profile", "CmdProfile"),
     "cancel": ("torchx_tpu.cli.cmd_simple", "CmdCancel"),
     "delete": ("torchx_tpu.cli.cmd_simple", "CmdDelete"),
     "resize": ("torchx_tpu.cli.cmd_simple", "CmdResize"),

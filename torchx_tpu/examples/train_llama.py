@@ -283,6 +283,88 @@ def _step_heartbeat(**attrs: Any) -> None:
     _renew_liveness_lease(int(attrs.get("step", -1)))
 
 
+def _profile_enabled(flag: bool) -> bool:
+    """True when per-step phase profiling is on: the trainer's
+    ``--profile`` flag or the launcher-injected ``TPX_PROFILE`` switch
+    (so a submitted role enables it via env without editing args)."""
+    if flag:
+        return True
+    import os
+
+    from torchx_tpu import settings
+
+    return os.environ.get(settings.ENV_TPX_PROFILE, "").lower() in (
+        "1",
+        "true",
+        "yes",
+        "on",
+    )
+
+
+def _make_profiler(
+    cfg: llama.LlamaConfig,
+    mesh: Mesh,
+    batch: int,
+    seq: int,
+    tokens_per_step: int,
+    flops_per_token: float,
+    peak_flops: float,
+) -> Optional[Any]:
+    """Best-effort :class:`~torchx_tpu.obs.profile.StepProfiler` wired to
+    this run's arithmetic.
+
+    Mirrors the live config and mesh into the jax-free
+    ``ModelShape``/``ParallelPlan`` IR so the attribution model's
+    collective terms come from the same calibrated cost model as
+    ``tpx explain``. Returns None when anything is off — profiling must
+    never fail the job.
+    """
+    try:
+        from torchx_tpu.analyze.plan import ModelShape, ParallelPlan
+        from torchx_tpu.obs.profile import StepProfiler, attribution_model
+
+        kind = getattr(jax.devices()[0], "device_kind", "cpu")
+        shape = ModelShape(
+            name="train",
+            vocab_size=cfg.vocab_size,
+            dim=cfg.dim,
+            n_layers=cfg.n_layers,
+            n_heads=cfg.n_heads,
+            n_kv_heads=cfg.n_kv_heads,
+            ffn_dim=cfg.ffn_dim,
+            max_seq=cfg.max_seq,
+            dtype_bytes=jnp.dtype(cfg.dtype).itemsize,
+            tie_embeddings=cfg.tie_embeddings,
+            loss_chunk=cfg.loss_chunk,
+            n_experts=getattr(cfg, "n_experts", 0),
+            top_k=getattr(cfg, "top_k", 0),
+        )
+        plan = ParallelPlan(
+            role="train",
+            model=shape,
+            mesh_spec="",
+            sizes={a: int(s) for a, s in mesh.shape.items()},
+            batch=batch,
+            seq=seq,
+            devices=jax.device_count(),
+            accelerator=kind,
+        )
+        return StepProfiler(
+            attribution_model(
+                flops_per_token=flops_per_token,
+                tokens_per_step=tokens_per_step,
+                peak_flops=peak_flops,
+                param_count=shape.param_count(),
+                plan=plan,
+                generation=kind,
+            )
+        )
+    except Exception as e:  # noqa: BLE001 - profiling is best-effort
+        if jax.process_index() == 0:
+            print(f"step profiler unavailable: {e}", flush=True)
+        return None
+
+
 def _install_preempt_handler() -> tuple[Optional[threading.Event], Any]:
     """Arm a SIGTERM preemption-grace handler (main thread only).
 
@@ -332,6 +414,7 @@ def train(
     data_path: Optional[str] = None,
     profile_dir: Optional[str] = None,
     prefetch: int = 2,
+    profile: bool = False,
 ) -> dict[str, float]:
     global _FIRST_TRAIN_PENDING
     t_call = time.monotonic()
@@ -603,6 +686,22 @@ def train(
         state, loss, aux = step_fn(state, next_batch())
     jax.block_until_ready(loss)
 
+    import contextlib
+
+    profiler = None
+    if _profile_enabled(profile):
+        profiler = _make_profiler(
+            cfg, mesh, batch, seq, tokens_per_step, flops_per_token, peak
+        )
+    if profiler is not None:
+        # per-next() wait intervals credit the current step's data_wait
+        _batches.set_wait_observer(profiler.observe_wait)
+
+    def _prof_phase(name: str):
+        return profiler.phase(name) if profiler is not None else (
+            contextlib.nullcontext()
+        )
+
     if profile_dir and jax.process_index() == 0:
         # xprof trace of the steady-state steps (view with tensorboard or
         # xprofiler; the TPU observability hook from SURVEY §5)
@@ -646,11 +745,23 @@ def train(
     preempted = False
     try:
         for i in range(timed_steps):
-            state, loss, aux = step_fn(state, next_batch())
+            if profiler is not None:
+                # the phase boundary is host-visible only behind a
+                # completion fence, so profiled steps serialize dispatch
+                # (a measured, documented perturbation — the headline
+                # bench legs run unprofiled)
+                profiler.begin_step()
+                b = next_batch()
+                with profiler.phase("forward_backward"):
+                    state, loss, aux = step_fn(state, b)
+                    jax.block_until_ready(loss)
+            else:
+                state, loss, aux = step_fn(state, next_batch())
             global_step += 1
             window_steps += 1
             if ckpt is not None and global_step % ckpt_every == 0:
-                ckpt.save(global_step, state)
+                with _prof_phase("checkpoint"):
+                    ckpt.save(global_step, state)
             if preempt_evt is not None and preempt_evt.is_set():
                 preempted = True
                 jax.block_until_ready(state.params)
@@ -665,46 +776,53 @@ def train(
                     )
                 break
             if (i + 1) % log_every == 0 or i + 1 == timed_steps:
-                jax.block_until_ready(loss)  # completion fence: timing only
-                now = time.monotonic()
-                dt = (now - t0) / (i + 1)
-                tps = tokens_per_step / dt
-                window_dt = (now - window_t0) / window_steps
-                window_mfu = tokens_per_step / window_dt * flops_per_token / peak
-                wait_now = _batches.data_wait_s
-                wait_per_step = (wait_now - window_wait) / window_steps
-                window_wait = wait_now
-                obs_metrics.STEP_SECONDS.observe(window_dt, phase="total")
-                obs_metrics.STEP_SECONDS.observe(wait_per_step, phase="data_wait")
-                _step_heartbeat(
-                    step=global_step,
-                    avg_step_s=round(window_dt, 6),
-                    data_wait_s=round(wait_per_step, 6),
-                    mfu=round(window_mfu, 4),
-                    remat_policy=remat_policy_used,
-                )
-                # Logging must not stall the device: a synchronous
-                # float(loss) here is a full device->host round trip
-                # (~100ms over a TPU tunnel) that lands INSIDE the next
-                # timed window — measured as a fake 52.8%->48.9% "MFU
-                # decay" in round 2. Instead start an async copy and print
-                # the PREVIOUS window's entry, so the transfer overlaps the
-                # next window's compute.
-                for arr in (loss, aux):
-                    copy_async = getattr(arr, "copy_to_host_async", None)
-                    if copy_async is not None:
-                        copy_async()
-                if pending is not None and jax.process_index() == 0:
-                    _emit_log(pending)
-                pending = {
-                    "step": global_step,
-                    "loss": loss,
-                    "aux": aux,
-                    "tps": tps,
-                    "mfu": tps * flops_per_token / peak,
-                    "window_mfu": window_mfu,
-                }
-                window_t0, window_steps = time.monotonic(), 0
+                with _prof_phase("host"):
+                    jax.block_until_ready(loss)  # completion fence: timing only
+                    now = time.monotonic()
+                    dt = (now - t0) / (i + 1)
+                    tps = tokens_per_step / dt
+                    window_dt = (now - window_t0) / window_steps
+                    window_mfu = (
+                        tokens_per_step / window_dt * flops_per_token / peak
+                    )
+                    wait_now = _batches.data_wait_s
+                    wait_per_step = (wait_now - window_wait) / window_steps
+                    window_wait = wait_now
+                    obs_metrics.STEP_SECONDS.observe(window_dt, phase="total")
+                    obs_metrics.STEP_SECONDS.observe(
+                        wait_per_step, phase="data_wait"
+                    )
+                    _step_heartbeat(
+                        step=global_step,
+                        avg_step_s=round(window_dt, 6),
+                        data_wait_s=round(wait_per_step, 6),
+                        mfu=round(window_mfu, 4),
+                        remat_policy=remat_policy_used,
+                    )
+                    # Logging must not stall the device: a synchronous
+                    # float(loss) here is a full device->host round trip
+                    # (~100ms over a TPU tunnel) that lands INSIDE the next
+                    # timed window — measured as a fake 52.8%->48.9% "MFU
+                    # decay" in round 2. Instead start an async copy and
+                    # print the PREVIOUS window's entry, so the transfer
+                    # overlaps the next window's compute.
+                    for arr in (loss, aux):
+                        copy_async = getattr(arr, "copy_to_host_async", None)
+                        if copy_async is not None:
+                            copy_async()
+                    if pending is not None and jax.process_index() == 0:
+                        _emit_log(pending)
+                    pending = {
+                        "step": global_step,
+                        "loss": loss,
+                        "aux": aux,
+                        "tps": tps,
+                        "mfu": tps * flops_per_token / peak,
+                        "window_mfu": window_mfu,
+                    }
+                    window_t0, window_steps = time.monotonic(), 0
+            if profiler is not None:
+                profiler.end_step(global_step)
         jax.block_until_ready(state.params)
         total = time.monotonic() - t0
         data_wait_s = _batches.data_wait_s - wait_anchor
@@ -724,7 +842,17 @@ def train(
         if ckpt.latest_step() != global_step:  # final state, any interval
             ckpt.save(global_step, state, force=True)
         ckpt.close()
-    return {
+    profile_summary = None
+    if profiler is not None:
+        _batches.set_wait_observer(None)
+        try:
+            # summarize + tpx_profile_* gauges + the observe_collectives
+            # calibration fold (when the mesh moved collective bytes)
+            profile_summary = profiler.close()
+        except Exception as e:  # noqa: BLE001 - profiling is best-effort
+            if jax.process_index() == 0:
+                print(f"profile summary failed: {e}", flush=True)
+    results = {
         "loss": float(loss),
         "tokens_per_sec": tps,
         "tokens_per_sec_per_chip": tps / n_devices,
@@ -744,6 +872,9 @@ def train(
         # final checkpoint is durable; the supervisor resubmits from it)
         "preempted": preempted,
     }
+    if profile_summary is not None:
+        results["profile"] = profile_summary
+    return results
 
 
 def all_configs() -> dict:
@@ -806,6 +937,15 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--profile-dir", default=None, help="write an xprof trace of the timed steps here"
     )
     parser.add_argument(
+        "--profile",
+        action="store_true",
+        help="per-step phase attribution (data_wait / forward_backward /"
+        " grad_sync / optimizer / checkpoint / host) appended to the obs"
+        " session's profile.jsonl — view with `tpx profile`; also"
+        " enabled by TPX_PROFILE=1. Fences every step: use for"
+        " attribution runs, not headline numbers",
+    )
+    parser.add_argument(
         "--ckpt-dir", default=None, help="checkpoint directory (enables save+resume)"
     )
     parser.add_argument(
@@ -850,6 +990,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         data_path=args.data,
         profile_dir=args.profile_dir,
         prefetch=args.prefetch,
+        profile=args.profile,
     )
     if jax.process_index() == 0:
         print("final:", metrics, flush=True)

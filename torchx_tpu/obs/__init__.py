@@ -28,7 +28,13 @@ The subsystem answers "where did my launch time go" end to end:
 * :mod:`torchx_tpu.obs.stitch` — cross-process trace stitching: the
   trace context crosses HTTP hops (``X-Tpx-Trace-Id``), KV-transfer
   payloads, and fleet gang env, and ``tpx trace --stitch`` reassembles
-  the one timeline per request or fleet-job lifecycle.
+  the one timeline per request or fleet-job lifecycle;
+* :mod:`torchx_tpu.obs.profile` — per-step phase attribution for the
+  trainer (``data_wait`` / ``forward_backward`` / ``grad_sync`` per mesh
+  axis / ``optimizer`` / ``checkpoint`` / ``host``): MFU/roofline
+  accounting, measured collective overlap, fsync'd ``profile.jsonl``
+  journals rendered by ``tpx profile``, and the measured-residual feed
+  into the tune calibration table.
 """
 
 from torchx_tpu.obs.metrics import (

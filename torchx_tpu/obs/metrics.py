@@ -345,6 +345,35 @@ STEP_SECONDS = REGISTRY.histogram(
     buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0),
 )
 
+#: step-profiler summary exports (obs/profile.py): the last profiled
+#: run's per-step attribution, published so the telemetry plane and
+#: ``tpx top`` can surface fleet-wide MFU / data-wait / overlap without
+#: reading any profile journal. Gauges (not histograms): each profiled
+#: run overwrites its process's snapshot.
+PROFILE_PHASE_SECONDS = REGISTRY.gauge(
+    "tpx_profile_phase_seconds",
+    "profiled per-step seconds by attribution phase",
+    ("phase",),
+)
+
+#: model FLOPs utilization of the last profiled run.
+PROFILE_MFU = REGISTRY.gauge(
+    "tpx_profile_mfu",
+    "model FLOPs utilization measured by the step profiler",
+)
+
+#: fraction of profiled step time the host spent blocked on input.
+PROFILE_DATA_WAIT_FRAC = REGISTRY.gauge(
+    "tpx_profile_data_wait_frac",
+    "fraction of profiled step time spent waiting on input",
+)
+
+#: collective overlap fraction (1 - exposed/modeled comm time).
+PROFILE_OVERLAP_FRAC = REGISTRY.gauge(
+    "tpx_profile_overlap_frac",
+    "profiled collective overlap fraction (1 - exposed/modeled comm)",
+)
+
 #: per-stage breakdown of launch-to-first-step (the ``launch.*`` span
 #: family): import / backend_init / init_state / restore / data_setup /
 #: compile / first_step — makes launch regressions attributable.

@@ -77,6 +77,7 @@ class Prefetcher:
         self._place = place if place is not None else (lambda x: x)
         self._depth = depth
         self._wait_s = 0.0
+        self._wait_observer: Optional[Callable[[float], None]] = None
         self._served = 0
         self._closed = False
         self._exhausted = False
@@ -139,7 +140,26 @@ class Prefetcher:
             self._served += 1
             return item
         finally:
-            self._wait_s += time.monotonic() - t0
+            dt = time.monotonic() - t0
+            self._wait_s += dt
+            if self._wait_observer is not None:
+                try:
+                    self._wait_observer(dt)
+                except Exception:  # noqa: BLE001 - observers never break the loop
+                    pass
+
+    def set_wait_observer(
+        self, observer: Optional[Callable[[float], None]]
+    ) -> None:
+        """Install a per-``next()`` wait callback (seconds blocked).
+
+        Runs on the CONSUMER thread inside ``next()`` — the step
+        profiler's ``observe_wait`` hook, which credits each blocked
+        interval to the current step's ``data_wait`` phase instead of
+        only the run-total :attr:`data_wait_s`. Best-effort: observer
+        exceptions are swallowed. Pass None to uninstall.
+        """
+        self._wait_observer = observer
 
     @property
     def data_wait_s(self) -> float:

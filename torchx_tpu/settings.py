@@ -45,6 +45,11 @@ ENV_TPX_TRACE = "TPX_TRACE"
 # ~/.torchx_tpu/obs (one subdir per client session). See obs/sinks.py.
 ENV_TPX_OBS_DIR = "TPX_OBS_DIR"
 
+# Step-profiler master switch: "1"/"true"/"yes"/"on" enables the trainer's
+# per-step phase attribution (equivalent to its ``--profile`` flag),
+# appending profile.jsonl under the obs session dir. See obs/profile.py.
+ENV_TPX_PROFILE = "TPX_PROFILE"
+
 # Escape hatch for the preflight analyzer gate in Runner.dryrun/run:
 # "1"/"true"/"yes"/"on" skips linting entirely (same effect as the
 # ``--no-lint`` CLI flag / ``no_lint=True`` Runner argument). Diagnostics

@@ -191,7 +191,9 @@ class CalibrationTable:
             if predicted_collective_s:
                 # attribute the same relative residual to the collective
                 # term (the step-level measurement cannot split compute
-                # from collectives; the shared ratio keeps both honest)
+                # from collectives; the shared ratio keeps both honest —
+                # profiled runs refine it via observe_collectives, whose
+                # measurement CAN split them)
                 coll = coll * (1.0 + alpha * (
                     measured_step_s / predicted_step_s - 1.0
                 ))
@@ -223,3 +225,53 @@ class CalibrationTable:
         )
         out["scales"] = self._scales[gen].to_dict()
         return out
+
+    def observe_collectives(
+        self,
+        generation: str,
+        *,
+        predicted_collective_s: float,
+        measured_collective_s: float,
+        alpha: float = DEFAULT_ALPHA,
+    ) -> dict[str, Any]:
+        """Fold a directly MEASURED collective-seconds observation into
+        ``collective_scale``.
+
+        :meth:`observe`'s step-level measurement cannot split compute
+        from collectives, so it only shares the whole-step residual with
+        the collective term. The step profiler (``obs/profile.py``)
+        removes that limit: its per-phase attribution yields measured
+        exposed-collective seconds per step, and this fold gives
+        ``collective_scale`` its own EMA on the same contraction math as
+        :meth:`observe` (``predicted_collective_s`` must be the
+        CALIBRATED prediction, so the residual strictly shrinks).
+        Returns the before/after relative errors and the new scales.
+        """
+        if not 0.0 < alpha < 1.0:
+            raise ValueError(f"alpha must be in (0, 1), got {alpha}")
+        if predicted_collective_s <= 0.0 or measured_collective_s <= 0.0:
+            raise ValueError(
+                "predicted_collective_s and measured_collective_s must be"
+                f" > 0, got {predicted_collective_s} / {measured_collective_s}"
+            )
+        gen = generation_key(generation)
+        cur = self._scales.get(gen, CalibrationScales())
+        p, m = float(predicted_collective_s), float(measured_collective_s)
+        new_scale = cur.collective_scale * (1.0 + alpha * (m / p - 1.0))
+        self._scales[gen] = CalibrationScales(
+            activation_scale=cur.activation_scale,
+            collective_scale=new_scale,
+            step_time_scale=cur.step_time_scale,
+            samples=cur.samples + 1,
+        )
+        return {
+            "generation": gen,
+            "alpha": alpha,
+            "collectives": {
+                "predicted": p,
+                "measured": m,
+                "err_before": abs(p - m) / m,
+                "err_after": abs(p * (new_scale / cur.collective_scale) - m) / m,
+            },
+            "scales": self._scales[gen].to_dict(),
+        }
