@@ -1,35 +1,28 @@
 #!/usr/bin/env python
-"""AST-based self-lint enforcing the repo's own layering invariants.
+"""Legacy self-lint entry point — now a thin shim over ``tpx selfcheck``.
 
-Two rules, both load-bearing for the launcher's design:
+The three original rules (module-level jax imports in jax-free layers,
+raw subprocess in ``schedulers/``, raw wall-clock calls in sim-hosted
+modules) live in the whole-program analyzer
+(:mod:`torchx_tpu.analyze.selfcheck`) as the ``jax-free`` /
+``subprocess`` / ``clock`` passes, upgraded with an import graph: the
+jax-free proof is now *transitive* (a chain of module-level imports
+reaching jax is flagged even when no single file imports it directly)
+and the sim-hosted set is *derived* by reachability from
+``sim/harness.py`` instead of hand-maintained here.
 
-1. **jax-free layers stay jax-free.** ``cli/``, ``supervisor/``,
-   ``control/``, ``analyze/`` and ``parallel/mesh_config.py`` must never
-   import ``jax`` (or ``jax.*``) at module level: the client-side
-   supervisor, the preflight analyzer and ``tpx --help`` all run on
-   machines without an accelerator runtime, and a single eager import
-   regresses CLI latency by seconds. Function-local (lazy) imports are
-   allowed — that is the sanctioned escape hatch (``tpx explain --aot``).
+This script keeps the old contract for callers and tests:
 
-2. **scheduler subprocess calls go through the resilient seam.** Raw
-   ``subprocess.run/Popen/check_*/call`` in ``schedulers/`` bypasses the
-   retry/circuit-breaker wrapper; the only sanctioned call sites are the
-   ``_run_cmd`` methods (the seam each backend funnels through) and the
-   local scheduler's ``_popen`` (data-plane replica spawn, not a
-   control-plane call).
+* ``python scripts/lint_internal.py`` prints one line per violation,
+  ``SELF_LINT: N violation(s)`` to stderr and exits 1 — or prints
+  ``SELF_LINT: clean`` and exits 0;
+* :func:`check_jax_free` / :func:`check_scheduler_subprocess` /
+  :func:`check_wall_clock` stay importable single-file checkers (the
+  unit tests drive them on synthetic files) with the old message
+  formats, now backed by the selfcheck pass primitives.
 
-3. **sim-hosted modules never read the wall clock directly.** Every
-   module the virtual-time simulator hosts (``fleet/``, ``control/``,
-   ``obs/``, ``pipelines/``, ``supervisor/``, the serve control plane,
-   ``sim/`` itself) must call ``time.time``/``time.sleep``/
-   ``time.monotonic`` only through its injected clock seam — one raw
-   call site breaks virtual-time determinism silently (the sim keeps
-   running, the journal stops being a pure function of the seed).
-   ``sim/clock.py`` is the seam and is exempt; ``time.perf_counter`` is
-   allowed everywhere (wall-cost measurement, never scheduling).
-
-Run directly (``python scripts/lint_internal.py``) or via the tier1.sh
-SELF_LINT step. Exit 0 clean, 1 violations (one line each).
+Prefer ``tpx selfcheck`` directly: it runs all six passes, applies the
+triaged baseline, and emits coded TPX9xx diagnostics with ``--json``.
 """
 
 from __future__ import annotations
@@ -39,195 +32,75 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "torchx_tpu")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-#: packages/modules (relative to torchx_tpu/) that must not import jax at
-#: module level
-JAX_FREE = (
-    "cli",
-    "supervisor",
-    "control",
-    "analyze",
-    "fleet",
-    "tune",
-    "pipelines",
-    os.path.join("parallel", "mesh_config.py"),
-    # the telemetry plane runs inside the daemon and `tpx top`
-    os.path.join("obs", "telemetry.py"),
-    os.path.join("obs", "slo.py"),
-    os.path.join("obs", "stitch.py"),
-    # the step profiler backs `tpx profile` and the analyzers' attribution
-    os.path.join("obs", "profile.py"),
-    "sim",
-)
+from torchx_tpu.analyze.selfcheck import clock as _clock  # noqa: E402
+from torchx_tpu.analyze.selfcheck import jaxfree as _jaxfree  # noqa: E402
+from torchx_tpu.analyze.selfcheck import subproc as _subproc  # noqa: E402
 
-#: functions inside schedulers/ allowed to call subprocess directly
+#: kept for importers of the old module-level constants
 SUBPROCESS_SEAM_FUNCS = ("_run_cmd", "_popen")
-
-SUBPROCESS_CALLS = ("run", "Popen", "check_call", "check_output", "call")
-
-#: packages/modules (relative to torchx_tpu/) the virtual-time simulator
-#: hosts: raw wall-clock calls here break sim determinism
-SIM_HOSTED = (
-    "fleet",
-    "control",
-    "obs",
-    "pipelines",
-    "supervisor",
-    "sim",
-    os.path.join("serve", "pool.py"),
-    os.path.join("serve", "engine.py"),
-    os.path.join("serve", "kv_transfer.py"),
-)
-
-#: the clock seam itself — the one sanctioned home of raw time calls
-SIM_CLOCK_EXEMPT = os.path.join("sim", "clock.py")
-
-#: time attributes that schedule or stamp (perf_counter measures wall
-#: cost and is deliberately NOT listed)
-WALL_CLOCK_CALLS = ("time", "sleep", "monotonic")
+WALL_CLOCK_CALLS = _clock.WALL_CLOCK_CALLS
 
 
-def _py_files(path: str) -> list[str]:
-    if os.path.isfile(path):
-        return [path]
-    out = []
-    for root, _dirs, files in os.walk(path):
-        out.extend(
-            os.path.join(root, f) for f in files if f.endswith(".py")
-        )
-    return sorted(out)
-
-
-def _is_jax(name: str) -> bool:
-    return name == "jax" or name.startswith("jax.")
+def _parse(path: str) -> ast.Module:
+    with open(path) as f:
+        return ast.parse(f.read(), filename=path)
 
 
 def check_jax_free(path: str) -> list[str]:
-    """Module-level ``import jax`` / ``from jax ...`` statements in one
-    file (imports nested in functions are lazy and fine; class bodies and
-    ``if TYPE_CHECKING`` don't occur for jax here and stay flagged to keep
-    the rule simple)."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    bad = []
-
-    class V(ast.NodeVisitor):
-        def __init__(self) -> None:
-            self.depth = 0
-
-        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-            self.depth += 1
-            self.generic_visit(node)
-            self.depth -= 1
-
-        visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
-
-        def visit_Import(self, node: ast.Import) -> None:
-            if self.depth == 0:
-                for alias in node.names:
-                    if _is_jax(alias.name):
-                        bad.append((node.lineno, f"import {alias.name}"))
-
-        def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-            if self.depth == 0 and node.module and _is_jax(node.module):
-                bad.append((node.lineno, f"from {node.module} import ..."))
-
-    V().visit(tree)
+    """Module-level jax import sites in one file, old message format."""
     rel = os.path.relpath(path, REPO)
     return [
         f"{rel}:{line}: module-level jax import in a jax-free layer"
         f" ({stmt}); import inside the function that needs it"
-        for line, stmt in bad
+        for line, stmt in _jaxfree.module_level_jax_imports(_parse(path))
     ]
 
 
 def check_scheduler_subprocess(path: str) -> list[str]:
-    """Raw ``subprocess.<call>`` sites in one schedulers/ file outside the
-    sanctioned seam functions."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    bad = []
-
-    class V(ast.NodeVisitor):
-        def __init__(self) -> None:
-            self.func_stack: list[str] = []
-
-        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-            self.func_stack.append(node.name)
-            self.generic_visit(node)
-            self.func_stack.pop()
-
-        visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
-
-        def visit_Call(self, node: ast.Call) -> None:
-            fn = node.func
-            if (
-                isinstance(fn, ast.Attribute)
-                and isinstance(fn.value, ast.Name)
-                and fn.value.id == "subprocess"
-                and fn.attr in SUBPROCESS_CALLS
-                and not any(
-                    f in SUBPROCESS_SEAM_FUNCS for f in self.func_stack
-                )
-            ):
-                bad.append((node.lineno, f"subprocess.{fn.attr}"))
-            self.generic_visit(node)
-
-    V().visit(tree)
+    """Raw subprocess sites outside the seam in one file, old format."""
     rel = os.path.relpath(path, REPO)
     return [
         f"{rel}:{line}: raw {call} in schedulers/ outside the"
         f" {'/'.join(SUBPROCESS_SEAM_FUNCS)} seam; route it through the"
         " backend's resilient _run_cmd"
-        for line, call in bad
+        for line, call in _subproc.raw_subprocess_sites(
+            _parse(path), SUBPROCESS_SEAM_FUNCS
+        )
     ]
 
 
 def check_wall_clock(path: str) -> list[str]:
-    """Raw ``time.time()``/``time.sleep()``/``time.monotonic()`` *call*
-    sites in one sim-hosted file. Only ``ast.Call`` nodes are flagged:
-    ``clock: Callable[[], float] = time.time`` default-arg references are
-    the injection idiom itself and must stay legal."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    bad = []
-
-    class V(ast.NodeVisitor):
-        def visit_Call(self, node: ast.Call) -> None:
-            fn = node.func
-            if (
-                isinstance(fn, ast.Attribute)
-                and isinstance(fn.value, ast.Name)
-                and fn.value.id == "time"
-                and fn.attr in WALL_CLOCK_CALLS
-            ):
-                bad.append((node.lineno, f"time.{fn.attr}()"))
-            self.generic_visit(node)
-
-    V().visit(tree)
+    """Raw wall-clock *call* sites in one file, old message format
+    (``ast.Call`` only — ``clock=time.time`` default-arg references are
+    the injection idiom and stay legal)."""
     rel = os.path.relpath(path, REPO)
     return [
-        f"{rel}:{line}: raw {call} in a sim-hosted module; go through"
-        " the injected clock seam (sim/clock.py) so virtual time stays"
-        " deterministic"
-        for line, call in bad
+        f"{rel}:{line}: raw time.{attr}() in a sim-hosted module; go"
+        " through the injected clock seam (sim/clock.py) so virtual time"
+        " stays deterministic"
+        for line, attr in _clock.wall_clock_sites(_parse(path))
     ]
 
 
 def main() -> int:
-    violations: list[str] = []
-    for target in JAX_FREE:
-        for path in _py_files(os.path.join(PKG, target)):
-            violations.extend(check_jax_free(path))
-    for path in _py_files(os.path.join(PKG, "schedulers")):
-        violations.extend(check_scheduler_subprocess(path))
-    exempt = os.path.join(PKG, SIM_CLOCK_EXEMPT)
-    for target in SIM_HOSTED:
-        for path in _py_files(os.path.join(PKG, target)):
-            if path == exempt:
-                continue
-            violations.extend(check_wall_clock(path))
+    from torchx_tpu.analyze.selfcheck import (
+        BASELINE_FILENAME,
+        Baseline,
+        LEGACY_PASSES,
+        SelfCheckConfig,
+        run_selfcheck,
+    )
+
+    config = SelfCheckConfig.for_repo(REPO)
+    raw = run_selfcheck(config, passes=LEGACY_PASSES)
+    baseline = Baseline.load(os.path.join(REPO, BASELINE_FILENAME))
+    kept, _suppressed = baseline.apply(raw)
+    violations = [
+        f"{d.field}: [{d.code}] {d.message}" for d in kept.diagnostics
+    ]
     for v in violations:
         print(v)
     if violations:

@@ -1,0 +1,672 @@
+"""Selfcheck analyzer tests: seeded mutations per pass (exact TPX9xx
+code / file / line), a negative fixture per pass, the transitive
+jax-free proof catching an indirect import the legacy single-file lint
+provably misses, the derived sim-hosted set, baseline triage semantics,
+and the `tpx selfcheck` CLI exit-code contract (0 clean / 1 findings /
+2 usage errors)."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from torchx_tpu.analyze.selfcheck import (
+    BASELINE_FILENAME,
+    Baseline,
+    PASSES,
+    SelfCheckConfig,
+    build_graph,
+    run_selfcheck,
+)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def make_repo(tmp_path, files):
+    """Materialize a synthetic torchx_tpu tree and return its config."""
+    pkg = tmp_path / "torchx_tpu"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").touch()
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        init = p.parent
+        while init != pkg:
+            (init / "__init__.py").touch()
+            init = init.parent
+    return SelfCheckConfig(repo_root=str(tmp_path), pkg_root=str(pkg))
+
+
+def findings(config, passes=None):
+    return run_selfcheck(config, passes=passes).diagnostics
+
+
+def keyed(diags):
+    return sorted((d.code, d.field) for d in diags)
+
+
+def load_legacy_shim():
+    spec = importlib.util.spec_from_file_location(
+        "lint_internal_under_test",
+        os.path.join(REPO, "scripts", "lint_internal.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# jax-free (TPX901)
+# ---------------------------------------------------------------------------
+
+
+class TestJaxFree:
+    def test_direct_import_flagged(self, tmp_path):
+        cfg = make_repo(tmp_path, {"cli/app.py": "import os\nimport jax\n"})
+        out = findings(cfg, passes=("jax-free",))
+        assert keyed(out) == [("TPX901", "torchx_tpu/cli/app.py:2")]
+
+    def test_transitive_import_flagged_where_legacy_misses(self, tmp_path):
+        # cli/app.py itself never mentions jax -- the old single-file
+        # lint provably passes it -- but its eager import chain reaches a
+        # module-level jax import two hops away.
+        cfg = make_repo(
+            tmp_path,
+            {
+                "cli/app.py": "from torchx_tpu.middle import go\n",
+                "middle.py": "from torchx_tpu.heavy import f\n\n\ndef go():\n    return f()\n",
+                "heavy.py": "import jax\n\n\ndef f():\n    return jax\n",
+            },
+        )
+        out = findings(cfg, passes=("jax-free",))
+        assert ("TPX901", "torchx_tpu/cli/app.py:1") in keyed(out)
+        (diag,) = [d for d in out if d.field == "torchx_tpu/cli/app.py:1"]
+        assert "torchx_tpu/middle.py" in diag.message
+        assert "torchx_tpu/heavy.py" in diag.message
+
+        # the legacy checker sees no module-level jax import in app.py
+        shim = load_legacy_shim()
+        assert shim.check_jax_free(str(tmp_path / "torchx_tpu/cli/app.py")) == []
+
+    def test_lazy_and_type_checking_imports_allowed(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "cli/app.py": (
+                    "from typing import TYPE_CHECKING\n"
+                    "if TYPE_CHECKING:\n"
+                    "    import jax\n"
+                    "\n"
+                    "def go():\n"
+                    "    import jax as j\n"
+                    "    return j\n"
+                ),
+            },
+        )
+        assert findings(cfg, passes=("jax-free",)) == []
+
+    def test_type_checking_edge_not_in_graph(self, tmp_path):
+        make_repo(
+            tmp_path,
+            {
+                "a.py": (
+                    "from typing import TYPE_CHECKING\n"
+                    "if TYPE_CHECKING:\n"
+                    "    from torchx_tpu.b import T\n"
+                ),
+                "b.py": "T = int\n",
+            },
+        )
+        g = build_graph(
+            str(tmp_path / "torchx_tpu"), "torchx_tpu", str(tmp_path)
+        )
+        assert g.eager["torchx_tpu.a"] == []
+        assert g.lazy["torchx_tpu.a"] == []
+
+
+# ---------------------------------------------------------------------------
+# clock discipline (TPX910)
+# ---------------------------------------------------------------------------
+
+
+class TestClock:
+    def test_reachable_module_flagged(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "sim/harness.py": "from torchx_tpu.work import tick\n",
+                "work.py": "import time\n\n\ndef tick():\n    time.sleep(1)\n",
+            },
+        )
+        out = findings(cfg, passes=("clock",))
+        assert keyed(out) == [("TPX910", "torchx_tpu/work.py:5")]
+        assert "eager import closure" in out[0].message
+
+    def test_unreachable_module_not_flagged(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "sim/harness.py": "x = 1\n",
+                "work.py": "import time\n\n\ndef tick():\n    time.sleep(1)\n",
+            },
+        )
+        assert findings(cfg, passes=("clock",)) == []
+
+    def test_injection_default_and_perf_counter_allowed(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "sim/harness.py": "from torchx_tpu.work import tick\n",
+                "work.py": (
+                    "import time\n"
+                    "\n"
+                    "\n"
+                    "def tick(clock=time.time, sleep=time.sleep):\n"
+                    "    t0 = time.perf_counter()\n"
+                    "    return clock() - t0\n"
+                ),
+            },
+        )
+        assert findings(cfg, passes=("clock",)) == []
+
+    def test_annotated_module_flagged(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "sim/harness.py": "x = 1\n",
+                "work.py": (
+                    "# tpx: sim-hosted\n"
+                    "import time\n"
+                    "\n"
+                    "\n"
+                    "def tick():\n"
+                    "    return time.monotonic()\n"
+                ),
+            },
+        )
+        out = findings(cfg, passes=("clock",))
+        assert keyed(out) == [("TPX910", "torchx_tpu/work.py:6")]
+        assert "sim-hosted'" in out[0].message
+
+
+# ---------------------------------------------------------------------------
+# lock discipline (TPX920/TPX921)
+# ---------------------------------------------------------------------------
+
+_THREADED_CLASS = """\
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop)
+        self._thread.start()
+
+    def _loop(self):
+        {write}
+"""
+
+
+class TestLocks:
+    def test_unguarded_cross_thread_write_flagged(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {"svc.py": _THREADED_CLASS.format(write="self.count += 1")},
+        )
+        out = findings(cfg, passes=("locks",))
+        assert keyed(out) == [("TPX920", "torchx_tpu/svc.py:14")]
+        assert "Thread(target=self._loop)" in out[0].message
+
+    def test_guarded_write_allowed(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "svc.py": _THREADED_CLASS.format(
+                    write="with self._lock:\n            self.count += 1"
+                )
+            },
+        )
+        assert findings(cfg, passes=("locks",)) == []
+
+    def test_shared_suffix_without_lock_flagged(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "svc.py": (
+                    "class StatsDaemon:\n"
+                    "    def __init__(self):\n"
+                    "        self.n = 0\n"
+                    "\n"
+                    "    def bump(self):\n"
+                    "        self.n += 1\n"
+                ),
+            },
+        )
+        out = findings(cfg, passes=("locks",))
+        assert keyed(out) == [("TPX921", "torchx_tpu/svc.py:1")]
+
+    def test_private_helper_class_exempt_from_suffix(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "svc.py": (
+                    "class _RowCollector:\n"
+                    "    def __init__(self):\n"
+                    "        self.rows = []\n"
+                    "\n"
+                    "    def add(self, r):\n"
+                    "        self.rows = self.rows + [r]\n"
+                ),
+            },
+        )
+        assert findings(cfg, passes=("locks",)) == []
+
+    def test_shared_annotation_forces_analysis(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "svc.py": (
+                    "# tpx: shared\n"
+                    "class Plain:\n"
+                    "    def __init__(self):\n"
+                    "        self.n = 0\n"
+                    "\n"
+                    "    def bump(self):\n"
+                    "        self.n += 1\n"
+                ),
+            },
+        )
+        out = findings(cfg, passes=("locks",))
+        assert keyed(out) == [("TPX921", "torchx_tpu/svc.py:2")]
+
+
+# ---------------------------------------------------------------------------
+# crash-safe journaling (TPX930/931/932)
+# ---------------------------------------------------------------------------
+
+
+class TestJournal:
+    def test_append_without_fsync_flagged(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "store.py": (
+                    "def log(path, line):\n"
+                    '    with open(path + ".jsonl", "a") as f:\n'
+                    "        f.write(line)\n"
+                ),
+            },
+        )
+        out = findings(cfg, passes=("journal",))
+        assert keyed(out) == [("TPX930", "torchx_tpu/store.py:2")]
+
+    def test_append_with_fsync_allowed(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "store.py": (
+                    "import os\n"
+                    "\n"
+                    "\n"
+                    "def log(path, line):\n"
+                    '    with open(path + ".jsonl", "a") as f:\n'
+                    "        f.write(line)\n"
+                    "        f.flush()\n"
+                    "        os.fsync(f.fileno())\n"
+                ),
+            },
+        )
+        assert findings(cfg, passes=("journal",)) == []
+
+    def test_state_rewrite_without_replace_flagged(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "store.py": (
+                    "import json\n"
+                    "\n"
+                    "\n"
+                    "def save(doc):\n"
+                    '    with open("state.json", "w") as f:\n'
+                    "        json.dump(doc, f)\n"
+                ),
+            },
+        )
+        out = findings(cfg, passes=("journal",))
+        assert keyed(out) == [("TPX931", "torchx_tpu/store.py:5")]
+
+    def test_atomic_rewrite_allowed(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "store.py": (
+                    "import json\n"
+                    "import os\n"
+                    "\n"
+                    "\n"
+                    "def save(doc):\n"
+                    '    with open("state.json.tmp", "w") as f:\n'
+                    "        json.dump(doc, f)\n"
+                    "        f.flush()\n"
+                    "        os.fsync(f.fileno())\n"
+                    '    os.replace("state.json.tmp", "state.json")\n'
+                ),
+            },
+        )
+        assert findings(cfg, passes=("journal",)) == []
+
+    def test_hand_rolled_reader_flagged(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "store.py": (
+                    "import json\n"
+                    "\n"
+                    "\n"
+                    "def load(path):\n"
+                    '    with open(path + ".jsonl") as f:\n'
+                    "        return [json.loads(x) for x in f]\n"
+                ),
+            },
+        )
+        out = findings(cfg, passes=("journal",))
+        assert keyed(out) == [("TPX932", "torchx_tpu/store.py:5")]
+
+    def test_helper_reader_allowed(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "store.py": (
+                    "from torchx_tpu.util.jsonl import iter_jsonl\n"
+                    "\n"
+                    "\n"
+                    "def load(path):\n"
+                    '    return list(iter_jsonl(path + ".jsonl"))\n'
+                ),
+            },
+        )
+        assert findings(cfg, passes=("journal",)) == []
+
+
+# ---------------------------------------------------------------------------
+# env registry (TPX940)
+# ---------------------------------------------------------------------------
+
+
+class TestEnvRegistry:
+    def test_raw_literal_flagged(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "mod.py": (
+                    "import os\n"
+                    "\n"
+                    'A = os.environ.get("TPX_FOO")\n'
+                    'B = os.environ["TPX_BAR"]\n'
+                    'C = os.getenv("TPX_BAZ", "0")\n'
+                ),
+            },
+        )
+        out = findings(cfg, passes=("env",))
+        assert keyed(out) == [
+            ("TPX940", "torchx_tpu/mod.py:3"),
+            ("TPX940", "torchx_tpu/mod.py:4"),
+            ("TPX940", "torchx_tpu/mod.py:5"),
+        ]
+
+    def test_settings_and_non_tpx_exempt(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "settings.py": 'import os\n\nV = os.environ.get("TPX_FOO")\n',
+                "mod.py": 'import os\n\nHOME = os.environ.get("HOME")\n',
+            },
+        )
+        assert findings(cfg, passes=("env",)) == []
+
+
+# ---------------------------------------------------------------------------
+# scheduler subprocess seam (TPX950)
+# ---------------------------------------------------------------------------
+
+
+class TestSubprocessSeam:
+    def test_raw_call_flagged(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "schedulers/gq.py": (
+                    "import subprocess\n"
+                    "\n"
+                    "\n"
+                    "def submit(cmd):\n"
+                    "    return subprocess.run(cmd)\n"
+                ),
+            },
+        )
+        out = findings(cfg, passes=("subprocess",))
+        assert keyed(out) == [("TPX950", "torchx_tpu/schedulers/gq.py:5")]
+
+    def test_seam_function_allowed(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "schedulers/gq.py": (
+                    "import subprocess\n"
+                    "\n"
+                    "\n"
+                    "def _run_cmd(cmd):\n"
+                    "    return subprocess.run(cmd)\n"
+                ),
+            },
+        )
+        assert findings(cfg, passes=("subprocess",)) == []
+
+
+# ---------------------------------------------------------------------------
+# engine + baseline
+# ---------------------------------------------------------------------------
+
+
+class TestEngineAndBaseline:
+    def test_unknown_pass_rejected(self, tmp_path):
+        cfg = make_repo(tmp_path, {"mod.py": "x = 1\n"})
+        with pytest.raises(ValueError, match="unknown selfcheck pass"):
+            run_selfcheck(cfg, passes=("nope",))
+
+    def test_only_files_filters_findings_not_graph(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {
+                "cli/app.py": "from torchx_tpu.heavy import f\n",
+                "heavy.py": "import jax\n\n\ndef f():\n    return jax\n",
+                "mod.py": 'import os\n\nA = os.environ.get("TPX_FOO")\n',
+            },
+        )
+        report = run_selfcheck(
+            cfg, only_files={"torchx_tpu/cli/app.py"}
+        )
+        # the transitive proof (whole-program graph) survives the filter;
+        # the env finding in the unchanged file is filtered out
+        assert keyed(report.diagnostics) == [
+            ("TPX901", "torchx_tpu/cli/app.py:1")
+        ]
+
+    def test_baseline_roundtrip_and_line_insensitivity(self, tmp_path):
+        cfg = make_repo(
+            tmp_path,
+            {"mod.py": 'import os\n\nA = os.environ.get("TPX_FOO")\n'},
+        )
+        report = run_selfcheck(cfg, passes=("env",))
+        assert report.diagnostics
+        path = str(tmp_path / BASELINE_FILENAME)
+        Baseline.from_report(report).save(path)
+
+        # same file + code suppresses even when the line moved
+        cfg2 = make_repo(
+            tmp_path,
+            {"mod.py": 'import os\n\n\n\nA = os.environ.get("TPX_FOO")\n'},
+        )
+        kept, suppressed = Baseline.load(path).apply(
+            run_selfcheck(cfg2, passes=("env",))
+        )
+        assert kept.diagnostics == [] and suppressed == 1
+
+    def test_malformed_baseline_raises(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text('{"not": "a baseline"}')
+        with pytest.raises(ValueError, match="not a selfcheck baseline"):
+            Baseline.load(str(p))
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        b = Baseline.load(str(tmp_path / "absent.json"))
+        assert b.suppressions == {}
+
+    def test_all_passes_registered(self):
+        assert set(PASSES) == {
+            "jax-free",
+            "clock",
+            "locks",
+            "journal",
+            "env",
+            "subprocess",
+        }
+
+
+# ---------------------------------------------------------------------------
+# the real tree
+# ---------------------------------------------------------------------------
+
+
+class TestRealTree:
+    def test_repo_runs_clean_under_baseline(self):
+        cfg = SelfCheckConfig.for_repo(REPO)
+        report = run_selfcheck(cfg)
+        baseline = Baseline.load(os.path.join(REPO, BASELINE_FILENAME))
+        kept, _suppressed = baseline.apply(report)
+        assert kept.diagnostics == [], kept.render()
+
+    def test_derived_sim_hosted_set_covers_legacy_list(self):
+        # reachability from sim/harness.py must rediscover the core of
+        # the old hand-maintained SIM_HOSTED tuple
+        from torchx_tpu.analyze.selfcheck import clock as clock_pass
+        from torchx_tpu.analyze.selfcheck.engine import PassContext
+
+        cfg = SelfCheckConfig.for_repo(REPO)
+        ctx = PassContext(
+            config=cfg,
+            graph=build_graph(cfg.pkg_root, cfg.pkg_name, cfg.repo_root),
+        )
+        hosted = clock_pass.sim_hosted_modules(ctx)
+        for mod in (
+            "torchx_tpu.sim.harness",
+            "torchx_tpu.fleet.queue",
+            "torchx_tpu.control.reconciler",
+            "torchx_tpu.serve.pool",
+        ):
+            assert mod in hosted, sorted(hosted)
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def run_cli(*args, cwd=None):
+    return subprocess.run(
+        [sys.executable, "-m", "torchx_tpu.cli.main", "selfcheck", *args],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=cwd or REPO,
+    )
+
+
+class TestCli:
+    def test_findings_exit_1_then_baselined_exit_0(self, tmp_path):
+        make_repo(
+            tmp_path,
+            {"mod.py": 'import os\n\nA = os.environ.get("TPX_FOO")\n'},
+        )
+        r = run_cli("--root", str(tmp_path))
+        assert r.returncode == 1, (r.stdout, r.stderr)
+        assert "TPX940" in r.stdout
+
+        r = run_cli("--root", str(tmp_path), "--update-baseline")
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        assert (tmp_path / BASELINE_FILENAME).exists()
+
+        r = run_cli("--root", str(tmp_path))
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        assert "suppressed" in r.stdout
+
+    def test_json_reports_stable_shape(self, tmp_path):
+        make_repo(
+            tmp_path,
+            {"mod.py": 'import os\n\nA = os.environ.get("TPX_FOO")\n'},
+        )
+        r = run_cli("--root", str(tmp_path), "--json")
+        assert r.returncode == 1, (r.stdout, r.stderr)
+        doc = json.loads(r.stdout)
+        assert doc["version"] == 1
+        assert doc["suppressed"] == 0
+        (diag,) = doc["diagnostics"]
+        assert diag["code"] == "TPX940"
+        assert diag["field"] == "torchx_tpu/mod.py:3"
+
+    def test_unknown_pass_exit_2(self):
+        r = run_cli("--passes", "bogus")
+        assert r.returncode == 2, (r.stdout, r.stderr)
+        assert "unknown pass" in r.stderr
+
+    def test_bad_root_exit_2(self, tmp_path):
+        r = run_cli("--root", str(tmp_path / "nowhere"))
+        assert r.returncode == 2, (r.stdout, r.stderr)
+
+    def test_list_passes(self):
+        r = run_cli("--list-passes")
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        assert set(r.stdout.split()) == set(PASSES)
+
+
+# ---------------------------------------------------------------------------
+# legacy shim
+# ---------------------------------------------------------------------------
+
+
+class TestLegacyShim:
+    def test_single_file_checkers_keep_old_formats(self, tmp_path):
+        shim = load_legacy_shim()
+        p = tmp_path / "m.py"
+
+        p.write_text("import jax\n")
+        (v,) = shim.check_jax_free(str(p))
+        assert "module-level jax import" in v
+
+        p.write_text(
+            "import subprocess\n\n\ndef go():\n    subprocess.run(['x'])\n"
+        )
+        (v,) = shim.check_scheduler_subprocess(str(p))
+        assert "_run_cmd" in v
+
+        p.write_text("import time\n\n\ndef go():\n    time.sleep(1)\n")
+        (v,) = shim.check_wall_clock(str(p))
+        assert "clock seam" in v
+
+    def test_main_clean_contract(self):
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "lint_internal.py")],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "SELF_LINT: clean" in r.stdout

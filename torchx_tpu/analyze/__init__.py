@@ -84,6 +84,20 @@ Diagnostic codes
 | TPX705 | info | deep preflight skipped: no parallelism plan resolvable from the role args (``tpx explain`` only — the submit gate falls back to the TPX110 heuristic) | use a builtin ``--config`` name to enable static sharding/HBM analysis |
 | TPX706 | error | the role's resolved plan diverges from the pinned ``tpx tune`` artifact (``$TPX_PLAN_ARTIFACT``): a tuned knob (config/mesh/batch/seq/remat/int8) was changed after tuning | re-run ``tpx tune`` for the new config, or fix the drifted flag to match the artifact (the message lists each diverging field) |
 | TPX707 | error | the pinned ``$TPX_PLAN_ARTIFACT`` file is unreadable, malformed, or fails its content digest (edited by hand?) | re-emit the artifact with ``tpx tune``, or unset ``TPX_PLAN_ARTIFACT`` to submit unpinned |
+| TPX901 | error | selfcheck: a jax-free layer imports jax eagerly — directly or through a chain of module-level imports (``tpx selfcheck``, whole-program import graph) | make the first edge of the evidence chain a function-local import |
+| TPX910 | error | selfcheck: raw ``time.time/sleep/monotonic()`` call in a sim-hosted module (derived by reachability from ``sim/harness.py``) outside the clock seams | accept injected ``clock``/``sleep`` callables defaulting to the real ones |
+| TPX920 | error | selfcheck: unguarded mutable attribute write in a class whose instances cross threads (thread-entry evidence in the message) | wrap the write in ``with self._lock:`` |
+| TPX921 | warning | selfcheck: thread-crossing class allocates no lock at all | allocate ``self._lock = threading.Lock()`` in ``__init__`` |
+| TPX930 | error | selfcheck: append handle on a journal path with no flush+fsync before the write is claimed durable | append through ``util.jsonl.append_jsonl`` |
+| TPX931 | warning | selfcheck: state-file rewrite (``open(*.json, "w")``) without tmp + fsync + ``os.replace`` | rewrite through ``util.jsonl.rewrite_json`` |
+| TPX932 | warning | selfcheck: journal reader hand-rolls ``json.loads`` per line instead of the torn-line-holdback helper | read through ``util.jsonl.iter_jsonl`` |
+| TPX940 | warning | selfcheck: raw ``"TPX*"`` env literal outside ``settings.py`` bypasses the env registry | add/reuse an ``ENV_*`` constant in ``torchx_tpu/settings.py`` |
+| TPX950 | error | selfcheck: raw ``subprocess.*`` in ``schedulers/`` outside the resilient ``_run_cmd``/``_popen`` seam | route it through the backend's ``_run_cmd`` |
+
+The TPX9xx rows are emitted by ``tpx selfcheck``
+(:mod:`torchx_tpu.analyze.selfcheck`), the whole-program invariant
+analyzer over the launcher's own source tree, not by the submit-path
+``analyze()`` gate.
 """
 
 from torchx_tpu.analyze.diagnostics import (

@@ -1,0 +1,68 @@
+"""Whole-program invariant analyzer for the launcher's own source tree.
+
+The launcher's production claims rest on invariants that no unit test
+can watch globally: jax-free layers stay jax-free *transitively*,
+sim-hosted modules never read the wall clock (the sim journal must be a
+pure function of the seed), shared state in the threaded control plane
+is lock-guarded, every journal write is crash-safe, and every ``TPX_*``
+env knob lives in the registry. ``tpx selfcheck`` proves them
+statically over the whole ``torchx_tpu/`` tree: one parse per module,
+one import graph, six passes, coded TPX9xx diagnostics on the standard
+:class:`~torchx_tpu.analyze.diagnostics.LintReport` model (stable
+``--json``, human render, exit 0 clean / 1 findings / 2 usage error).
+
+Passes and codes
+----------------
+
+| code | severity | pass | meaning |
+|---|---|---|---|
+| TPX901 | error | jax-free | a jax-free layer imports jax eagerly — directly or through a chain of module-level imports (the evidence chain is in the message) |
+| TPX910 | error | clock | raw ``time.time/sleep/monotonic()`` call in a sim-hosted module (derived by reachability from ``sim/harness.py``), outside the clock seams |
+| TPX920 | error | locks | unguarded mutable attribute write in a class whose instances cross threads (thread-entry evidence in the message) |
+| TPX921 | warning | locks | thread-crossing class allocates no lock at all |
+| TPX930 | error | journal | append handle on a ``*.jsonl`` path with no flush+fsync before the write is claimed durable |
+| TPX931 | warning | journal | state-file rewrite (``open(*.json, "w")``) without tmp+fsync+``os.replace`` |
+| TPX932 | warning | journal | journal reader hand-rolls ``json.loads`` per line instead of the torn-line-holdback helper (``util.jsonl.iter_jsonl``) |
+| TPX940 | warning | env | raw ``"TPX*"`` env literal outside ``settings.py`` bypasses the env registry |
+| TPX950 | error | subprocess | raw ``subprocess.*`` in ``schedulers/`` outside the resilient ``_run_cmd``/``_popen`` seam |
+
+Heuristic passes (TPX92x/93x) pair with a checked-in triaged baseline
+(``selfcheck_baseline.json``, file+code keys, no line numbers):
+pre-existing findings a human judged benign are suppressed; anything new
+fails the tier-1 SELFCHECK gate. ``scripts/lint_internal.py`` survives
+as a thin shim over :data:`~torchx_tpu.analyze.selfcheck.engine.LEGACY_PASSES`.
+"""
+
+from torchx_tpu.analyze.selfcheck.baseline import (
+    BASELINE_FILENAME,
+    Baseline,
+    finding_file,
+)
+from torchx_tpu.analyze.selfcheck.engine import (
+    LEGACY_PASSES,
+    PASSES,
+    PassContext,
+    SelfCheckConfig,
+    run_selfcheck,
+)
+from torchx_tpu.analyze.selfcheck.graph import (
+    Edge,
+    ImportGraph,
+    ModuleInfo,
+    build_graph,
+)
+
+__all__ = [
+    "BASELINE_FILENAME",
+    "Baseline",
+    "finding_file",
+    "LEGACY_PASSES",
+    "PASSES",
+    "PassContext",
+    "SelfCheckConfig",
+    "run_selfcheck",
+    "Edge",
+    "ImportGraph",
+    "ModuleInfo",
+    "build_graph",
+]

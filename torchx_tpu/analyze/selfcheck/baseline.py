@@ -1,0 +1,105 @@
+"""Triaged suppression baseline for ``tpx selfcheck``.
+
+The passes are heuristic by design; findings a human has reviewed and
+judged benign are recorded in a checked-in baseline file
+(``selfcheck_baseline.json`` at the repo root) and suppressed on later
+runs. Keys are **file + code only** — deliberately no line numbers, so
+unrelated edits to a triaged file don't churn the baseline — and the
+suppression file never grows implicitly: ``tpx selfcheck
+--update-baseline`` rewrites it from the current findings, which a
+reviewer then diffs like any other change.
+
+Format (stable, sorted)::
+
+    {
+      "version": 1,
+      "suppressions": {
+        "torchx_tpu/serve/engine.py": ["TPX920"],
+        ...
+      }
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from torchx_tpu.analyze.diagnostics import Diagnostic, LintReport
+
+BASELINE_FILENAME = "selfcheck_baseline.json"
+
+
+def finding_file(diag: Diagnostic) -> str:
+    """The repo-relative file a selfcheck diagnostic is anchored to
+    (its ``field`` is ``path:line``)."""
+    return (diag.field or "").rsplit(":", 1)[0]
+
+
+@dataclass
+class Baseline:
+    """file -> set of suppressed TPX9xx codes."""
+
+    suppressions: dict[str, set[str]] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        """Read a baseline file; a missing file is an empty baseline
+        (malformed content raises ``ValueError`` — a corrupt baseline
+        must fail loudly, not silently unsuppress everything)."""
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or "suppressions" not in doc:
+            raise ValueError(f"not a selfcheck baseline: {path}")
+        return cls(
+            suppressions={
+                str(file): set(map(str, codes))
+                for file, codes in doc["suppressions"].items()
+            }
+        )
+
+    @classmethod
+    def from_report(cls, report: LintReport) -> "Baseline":
+        """Baseline that suppresses exactly the report's findings."""
+        sup: dict[str, set[str]] = {}
+        for d in report.diagnostics:
+            sup.setdefault(finding_file(d), set()).add(d.code)
+        return cls(suppressions=sup)
+
+    def is_suppressed(self, diag: Diagnostic) -> bool:
+        """True when the diagnostic's file + code pair is baselined."""
+        return diag.code in self.suppressions.get(finding_file(diag), ())
+
+    def apply(self, report: LintReport) -> tuple[LintReport, int]:
+        """Split a raw report into (unsuppressed report, suppressed
+        count)."""
+        kept = LintReport(target=report.target, scheduler=report.scheduler)
+        suppressed = 0
+        for d in report.diagnostics:
+            if self.is_suppressed(d):
+                suppressed += 1
+            else:
+                kept.diagnostics.append(d)
+        kept.sort()
+        return kept, suppressed
+
+    def save(self, path: str) -> None:
+        """Write the stable sorted form (atomic tmp + fsync + replace —
+        the baseline gates CI and must never be observed torn)."""
+        doc = {
+            "version": 1,
+            "suppressions": {
+                file: sorted(codes)
+                for file, codes in sorted(self.suppressions.items())
+            },
+        }
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=False)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)

@@ -1,0 +1,110 @@
+"""TPX910 — clock discipline on the *derived* sim-hosted module set.
+
+Every module the virtual-time simulator hosts must reach the wall clock
+only through its injected clock seam: one raw ``time.time()`` /
+``time.sleep()`` / ``time.monotonic()`` call site breaks virtual-time
+determinism silently — the sim keeps running, but the journal stops
+being a pure function of the seed.
+
+The old lint (``scripts/lint_internal.py`` rule 3) policed a
+hand-maintained ``SIM_HOSTED`` tuple, which rotted as subsystems were
+added. This pass derives the hosted set by **reachability**: the eager
+import closure of ``sim/harness.py`` (everything the harness wires onto
+the VirtualClock), plus configured extension roots (the supervisor,
+which the sim drives through scenario events rather than imports), plus
+any module annotated ``# tpx: sim-hosted``.
+
+Only ``ast.Call`` nodes are flagged: ``clock: Callable[[], float] =
+time.time`` default-argument references are the injection idiom itself
+and must stay legal. ``time.perf_counter`` measures wall cost (never
+scheduling) and is allowed everywhere; the clock seams themselves
+(``sim/clock.py``, ``util/times.py``) are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import TYPE_CHECKING
+
+from torchx_tpu.analyze.diagnostics import Diagnostic, Severity
+
+if TYPE_CHECKING:
+    from torchx_tpu.analyze.selfcheck.engine import PassContext
+
+CODE = "TPX910"
+
+#: time attributes that schedule or stamp (perf_counter deliberately absent)
+WALL_CLOCK_CALLS = ("time", "sleep", "monotonic")
+
+#: module-body comment that opts a module into the hosted set explicitly
+SIM_HOSTED_ANNOTATION = "# tpx: sim-hosted"
+
+
+def wall_clock_sites(tree: ast.Module) -> list[tuple[int, str]]:
+    """Raw wall-clock *call* sites in one parsed module — the single-file
+    primitive behind the legacy shim. Returns ``(lineno, attr)`` pairs."""
+    sites: list[tuple[int, str]] = []
+
+    class V(ast.NodeVisitor):
+        def visit_Call(self, node: ast.Call) -> None:
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "time"
+                and fn.attr in WALL_CLOCK_CALLS
+            ):
+                sites.append((node.lineno, fn.attr))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return sites
+
+
+def sim_hosted_modules(ctx: "PassContext") -> dict[str, str]:
+    """Derive the hosted set: module name -> one-line evidence of *why*
+    it is hosted (shown in the diagnostic message)."""
+    hosted: dict[str, str] = {}
+    entry = ctx.module_at(ctx.config.sim_entry)
+    if entry is not None:
+        why = f"in the eager import closure of {ctx.config.sim_entry}"
+        for mod in sorted(ctx.graph.eager_closure(entry.name)):
+            hosted[mod] = why
+    for root in ctx.config.sim_extra_roots:
+        for info in ctx.modules_under(root):
+            hosted.setdefault(info.name, f"under sim extension root {root!r}")
+    for info in ctx.graph.modules.values():
+        if SIM_HOSTED_ANNOTATION in info.source:
+            hosted.setdefault(info.name, "annotated '# tpx: sim-hosted'")
+    return hosted
+
+
+def check(ctx: "PassContext") -> list[Diagnostic]:
+    """Flag raw wall-clock calls in every derived sim-hosted module."""
+    out: list[Diagnostic] = []
+    exempt = {
+        ctx.module_at(p).name
+        for p in ctx.config.clock_seams
+        if ctx.module_at(p) is not None
+    }
+    for mod, why in sorted(sim_hosted_modules(ctx).items()):
+        if mod in exempt:
+            continue
+        info = ctx.graph.modules[mod]
+        for lineno, attr in wall_clock_sites(info.tree):
+            out.append(
+                ctx.finding(
+                    CODE,
+                    Severity.ERROR,
+                    info,
+                    lineno,
+                    f"raw time.{attr}() in a sim-hosted module ({why});"
+                    " virtual time silently diverges",
+                    hint=(
+                        "go through the injected clock seam"
+                        " (sim/clock.py) — accept clock/sleep callables"
+                        " defaulting to time.time/time.sleep"
+                    ),
+                )
+            )
+    return out

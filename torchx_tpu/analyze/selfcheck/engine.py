@@ -1,0 +1,224 @@
+"""The selfcheck pass engine: one parse, one graph, six passes.
+
+:func:`run_selfcheck` scans the package tree once
+(:mod:`.graph`), hands the shared :class:`PassContext` to every
+registered pass, and aggregates the findings into the repo's standard
+:class:`~torchx_tpu.analyze.diagnostics.LintReport` (stable ``--json``,
+human render, deterministic order). The baseline is applied by the
+caller (:mod:`torchx_tpu.cli.cmd_selfcheck` / the legacy shim), so the
+raw findings stay inspectable.
+
+Everything here is jax-free and stdlib-only: ``tpx selfcheck`` runs on
+the CLI fast path.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from torchx_tpu.analyze.diagnostics import Diagnostic, LintReport, Severity
+from torchx_tpu.analyze.selfcheck import (
+    clock,
+    envreg,
+    jaxfree,
+    journal,
+    locks,
+    subproc,
+)
+from torchx_tpu.analyze.selfcheck.graph import (
+    ImportGraph,
+    ModuleInfo,
+    build_graph,
+)
+
+#: packages/modules (paths relative to the package root) that must stay
+#: jax-free — transitively, over eager imports
+DEFAULT_JAX_FREE = (
+    "cli",
+    "supervisor",
+    "control",
+    "analyze",
+    "fleet",
+    "tune",
+    "pipelines",
+    "parallel/mesh_config.py",
+    "obs/telemetry.py",
+    "obs/slo.py",
+    "obs/stitch.py",
+    "obs/profile.py",
+    "sim",
+)
+
+
+@dataclass
+class SelfCheckConfig:
+    """What to scan and which seams/annotations are sanctioned.
+
+    Attributes:
+        repo_root: directory findings are reported relative to.
+        pkg_root: the package source dir (``<repo>/torchx_tpu``).
+        pkg_name: dotted package name (``torchx_tpu``).
+        jax_free: path prefixes (relative to ``pkg_root``) proven
+            transitively jax-free by TPX901.
+        sim_entry: the sim harness whose eager import closure derives
+            the sim-hosted set for TPX910.
+        sim_extra_roots: path prefixes additionally treated as
+            sim-hosted (subsystems the sim drives through events, not
+            imports).
+        clock_seams: modules allowed to touch the wall clock (the
+            injected-clock seams themselves).
+        journal_seams: modules exempt from TPX93x (the durable-IO
+            helpers).
+        settings_path: the env registry module (exempt from TPX940).
+        schedulers_dir: tree checked by TPX950.
+        subprocess_seams: function names sanctioned to call subprocess
+            inside ``schedulers/``.
+        shared_class_suffixes: class-name patterns treated as
+            thread-crossing by TPX92x.
+    """
+
+    repo_root: str
+    pkg_root: str
+    pkg_name: str = "torchx_tpu"
+    jax_free: tuple[str, ...] = DEFAULT_JAX_FREE
+    sim_entry: str = "sim/harness.py"
+    sim_extra_roots: tuple[str, ...] = ("supervisor",)
+    clock_seams: tuple[str, ...] = ("sim/clock.py", "util/times.py")
+    journal_seams: tuple[str, ...] = ("util/jsonl.py",)
+    settings_path: str = "settings.py"
+    schedulers_dir: str = "schedulers"
+    subprocess_seams: tuple[str, ...] = ("_run_cmd", "_popen")
+    shared_class_suffixes: tuple[str, ...] = (
+        "Daemon",
+        "Reconciler",
+        "Collector",
+        "Monitor",
+    )
+
+    @classmethod
+    def for_repo(cls, repo_root: Optional[str] = None) -> "SelfCheckConfig":
+        """Default config for this repository (or the installed package
+        when no repo root is given)."""
+        if repo_root is None:
+            import torchx_tpu
+
+            pkg_root = os.path.dirname(os.path.abspath(torchx_tpu.__file__))
+            repo_root = os.path.dirname(pkg_root)
+        else:
+            pkg_root = os.path.join(repo_root, "torchx_tpu")
+        return cls(repo_root=repo_root, pkg_root=pkg_root)
+
+
+@dataclass
+class PassContext:
+    """Shared state handed to every pass: the parsed tree + config."""
+
+    config: SelfCheckConfig
+    graph: ImportGraph
+    _by_pkg_path: dict[str, ModuleInfo] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        for info in self.graph.modules.values():
+            self._by_pkg_path[self.pkg_path(info)] = info
+
+    def pkg_path(self, info: ModuleInfo) -> str:
+        """``info``'s path relative to the package root, ``/``-separated
+        (the form config prefixes use)."""
+        rel = os.path.relpath(info.path, self.config.pkg_root)
+        return rel.replace(os.sep, "/")
+
+    def module_at(self, pkg_path: str) -> Optional[ModuleInfo]:
+        """The module at a package-relative path, or None."""
+        return self._by_pkg_path.get(pkg_path)
+
+    def all_modules(self) -> list[ModuleInfo]:
+        """Every scanned module, in deterministic name order."""
+        return [
+            self.graph.modules[n] for n in sorted(self.graph.modules)
+        ]
+
+    def modules_under(self, *prefixes: str) -> list[ModuleInfo]:
+        """Modules whose package-relative path matches a prefix (exact
+        file, or anything under a directory prefix)."""
+        out = []
+        for info in self.all_modules():
+            p = self.pkg_path(info)
+            for prefix in prefixes:
+                if p == prefix or p.startswith(prefix.rstrip("/") + "/"):
+                    out.append(info)
+                    break
+        return out
+
+    def jax_free_modules(self) -> list[ModuleInfo]:
+        """Every module under a jax-free root."""
+        return self.modules_under(*self.config.jax_free)
+
+    def finding(
+        self,
+        code: str,
+        severity: Severity,
+        info: ModuleInfo,
+        lineno: int,
+        message: str,
+        hint: str = "",
+    ) -> Diagnostic:
+        """One selfcheck diagnostic anchored to ``file:line`` (the
+        ``field`` carries the location; baseline keys on file + code)."""
+        return Diagnostic(
+            code=code,
+            severity=severity,
+            message=message,
+            field=f"{info.relpath}:{lineno}",
+            hint=hint,
+        )
+
+
+#: pass name -> callable; run order = table order (also the docs order)
+PASSES: dict[str, Callable[[PassContext], list[Diagnostic]]] = {
+    "jax-free": jaxfree.check,
+    "clock": clock.check,
+    "locks": locks.check,
+    "journal": journal.check,
+    "env": envreg.check,
+    "subprocess": subproc.check,
+}
+
+#: the subset equivalent to the retired scripts/lint_internal.py rules
+LEGACY_PASSES = ("jax-free", "clock", "subprocess")
+
+
+def run_selfcheck(
+    config: Optional[SelfCheckConfig] = None,
+    passes: Optional[tuple[str, ...]] = None,
+    only_files: Optional[set[str]] = None,
+) -> LintReport:
+    """Run the analyzer and return the RAW report (baseline not yet
+    applied).
+
+    Args:
+        config: what to scan; defaults to this repository.
+        passes: subset of :data:`PASSES` names to run (default: all).
+        only_files: when given, keep only findings anchored in these
+            repo-relative files (the ``--changed-only`` filter) — the
+            graph is still built over the whole tree, so transitive
+            proofs stay whole-program.
+    """
+    config = config or SelfCheckConfig.for_repo()
+    unknown = set(passes or ()) - set(PASSES)
+    if unknown:
+        raise ValueError(f"unknown selfcheck pass(es): {sorted(unknown)}")
+    graph = build_graph(config.pkg_root, config.pkg_name, config.repo_root)
+    ctx = PassContext(config=config, graph=graph)
+    report = LintReport(target="torchx_tpu selfcheck")
+    for name in passes or tuple(PASSES):
+        report.extend(PASSES[name](ctx))
+    if only_files is not None:
+        report.diagnostics = [
+            d
+            for d in report.diagnostics
+            if (d.field or "").rsplit(":", 1)[0] in only_files
+        ]
+    report.sort()
+    return report

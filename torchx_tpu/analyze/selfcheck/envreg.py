@@ -1,0 +1,105 @@
+"""TPX940 — the environment-variable registry.
+
+``torchx_tpu/settings.py`` is the central registry of every ``TPX_*``
+environment variable the framework reads or writes: the docs, the
+preflight env rules (TPX202) and the schedulers' injection tables are
+all generated against it. A raw string literal (``os.environ.get(
+"TPX_FOO")``) elsewhere bypasses the registry — the knob becomes
+undocumented, unflagged by TPX202, and invisible to grep-by-constant.
+
+The pass flags any ``os.environ[...]`` subscript (read or write),
+``os.environ.get/setdefault/pop(...)`` and ``os.getenv(...)`` whose key
+is a string literal starting with ``TPX`` in any module other than
+``settings.py``. Access through a named constant (``settings.ENV_*``)
+is invisible to the pass by construction — that is the sanctioned
+route.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import TYPE_CHECKING
+
+from torchx_tpu.analyze.diagnostics import Diagnostic, Severity
+
+if TYPE_CHECKING:
+    from torchx_tpu.analyze.selfcheck.engine import PassContext
+
+CODE = "TPX940"
+
+_ENV_METHODS = ("get", "setdefault", "pop")
+
+
+def _is_environ(node: ast.expr) -> bool:
+    """``os.environ`` or a bare ``environ`` name."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return isinstance(node.value, ast.Name) and node.value.id == "os"
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _tpx_literal(node: ast.expr) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if node.value.startswith("TPX"):
+            return node.value
+    return ""
+
+
+def env_literal_sites(tree: ast.Module) -> list[tuple[int, str]]:
+    """(lineno, key) pairs for raw ``TPX*`` env-literal access in one
+    parsed module."""
+    sites: list[tuple[int, str]] = []
+
+    class V(ast.NodeVisitor):
+        def visit_Subscript(self, node: ast.Subscript) -> None:
+            if _is_environ(node.value) and (key := _tpx_literal(node.slice)):
+                sites.append((node.lineno, key))
+            self.generic_visit(node)
+
+        def visit_Call(self, node: ast.Call) -> None:
+            fn = node.func
+            key = _tpx_literal(node.args[0]) if node.args else ""
+            if key:
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in _ENV_METHODS
+                    and _is_environ(fn.value)
+                ):
+                    sites.append((node.lineno, key))
+                elif (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr == "getenv"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "os"
+                ):
+                    sites.append((node.lineno, key))
+                elif isinstance(fn, ast.Name) and fn.id == "getenv":
+                    sites.append((node.lineno, key))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return sites
+
+
+def check(ctx: "PassContext") -> list[Diagnostic]:
+    """Flag raw TPX env literals everywhere but the registry module."""
+    out: list[Diagnostic] = []
+    registry = ctx.module_at(ctx.config.settings_path)
+    for info in ctx.all_modules():
+        if registry is not None and info.name == registry.name:
+            continue
+        for lineno, key in env_literal_sites(info.tree):
+            out.append(
+                ctx.finding(
+                    CODE,
+                    Severity.WARNING,
+                    info,
+                    lineno,
+                    f"raw env literal {key!r} outside settings.py bypasses"
+                    " the env registry",
+                    hint=(
+                        "add/reuse an ENV_* constant in"
+                        " torchx_tpu/settings.py and read through it"
+                    ),
+                )
+            )
+    return out

@@ -1,0 +1,281 @@
+"""Whole-program import graph over the ``torchx_tpu`` source tree.
+
+One parse per module, shared by every pass (:mod:`.engine` owns the
+cache). Two edge sets per module:
+
+* **eager** — imports executed when the module is imported: module-level
+  statements *and* class-body statements (a class body runs at import
+  time). These are the edges the transitive jax-free proof (TPX901) and
+  the sim-hosted reachability derivation (TPX910) walk.
+* **lazy** — imports nested inside a function/method body. They are the
+  sanctioned escape hatch for heavy deps (``tpx explain --aot``) and are
+  deliberately NOT walked by the closure.
+
+Importing a submodule executes every ancestor package's ``__init__``, so
+an eager edge to ``torchx_tpu.control.events`` also adds an eager edge to
+``torchx_tpu.control`` — without this, a jax import hidden in a package
+``__init__`` would escape the proof.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source module of the scanned package."""
+
+    #: dotted module name (``torchx_tpu.cli.main``; packages use the
+    #: package name itself for their ``__init__.py``)
+    name: str
+    #: path relative to the repo root (``torchx_tpu/cli/main.py``)
+    relpath: str
+    #: absolute filesystem path
+    path: str
+    #: parsed AST (one parse, shared by all passes)
+    tree: ast.Module
+    #: raw source text (comment-level annotations, e.g. ``# tpx: shared``)
+    source: str
+
+    def source_lines(self) -> list[str]:
+        """Source split into lines (1-indexed via ``lines[lineno - 1]``)."""
+        return self.source.splitlines()
+
+
+@dataclass
+class Edge:
+    """One import site: importer -> target at a line."""
+
+    target: str
+    lineno: int
+
+
+@dataclass
+class ImportGraph:
+    """Eager/lazy import edges for every module of one package.
+
+    Attributes:
+        modules: dotted name -> :class:`ModuleInfo` for every ``.py`` file.
+        eager: intra-package eager edges (module -> imported modules).
+        lazy: intra-package function-local edges (not walked by closures).
+        eager_external: eager imports leaving the package, by top-level
+            distribution name (``jax``, ``numpy``, ``time``, ...).
+        lazy_external: same for function-local imports.
+    """
+
+    modules: dict[str, ModuleInfo] = field(default_factory=dict)
+    eager: dict[str, list[Edge]] = field(default_factory=dict)
+    lazy: dict[str, list[Edge]] = field(default_factory=dict)
+    eager_external: dict[str, list[Edge]] = field(default_factory=dict)
+    lazy_external: dict[str, list[Edge]] = field(default_factory=dict)
+
+    def eager_closure(self, start: str) -> set[str]:
+        """Every module reachable from ``start`` over eager edges,
+        ``start`` included."""
+        seen = {start}
+        stack = [start]
+        while stack:
+            mod = stack.pop()
+            for e in self.eager.get(mod, ()):
+                if e.target not in seen:
+                    seen.add(e.target)
+                    stack.append(e.target)
+        return seen
+
+    def eager_chain(self, start: str, dst: str) -> Optional[list[str]]:
+        """Shortest eager import chain ``start -> ... -> dst`` (module
+        names, both ends included), or None when unreachable. BFS with
+        sorted neighbor order, so the evidence chain is deterministic."""
+        if start == dst:
+            return [start]
+        prev: dict[str, str] = {}
+        queue = [start]
+        seen = {start}
+        while queue:
+            nxt: list[str] = []
+            for mod in queue:
+                for e in sorted(self.eager.get(mod, ()), key=lambda e: e.target):
+                    if e.target in seen:
+                        continue
+                    seen.add(e.target)
+                    prev[e.target] = mod
+                    if e.target == dst:
+                        chain = [dst]
+                        while chain[-1] != start:
+                            chain.append(prev[chain[-1]])
+                        return list(reversed(chain))
+                    nxt.append(e.target)
+            queue = nxt
+        return None
+
+    def first_eager_edge(self, src: str, dst: str) -> Optional[Edge]:
+        """The earliest eager import site of ``dst`` inside ``src``."""
+        hits = [e for e in self.eager.get(src, ()) if e.target == dst]
+        return min(hits, key=lambda e: e.lineno) if hits else None
+
+
+def _iter_py_files(pkg_root: str) -> Iterator[str]:
+    for root, dirs, files in os.walk(pkg_root):
+        dirs.sort()
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def module_name_for(pkg_root: str, pkg_name: str, path: str) -> str:
+    """Dotted module name of one source file under the package root."""
+    rel = os.path.relpath(path, pkg_root)
+    parts = rel[: -len(".py")].split(os.sep)
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join([pkg_name, *parts]) if parts else pkg_name
+
+
+def scan_package(pkg_root: str, pkg_name: str, repo_root: str) -> dict[str, ModuleInfo]:
+    """Parse every ``.py`` file under ``pkg_root`` once."""
+    modules: dict[str, ModuleInfo] = {}
+    for path in _iter_py_files(pkg_root):
+        with open(path) as f:
+            source = f.read()
+        modules[module_name_for(pkg_root, pkg_name, path)] = ModuleInfo(
+            name=module_name_for(pkg_root, pkg_name, path),
+            relpath=os.path.relpath(path, repo_root),
+            path=path,
+            tree=ast.parse(source, filename=path),
+            source=source,
+        )
+    return modules
+
+
+def _is_type_checking(test: ast.expr) -> bool:
+    """True for ``TYPE_CHECKING`` / ``typing.TYPE_CHECKING`` guards."""
+    if isinstance(test, ast.Name):
+        return test.id == "TYPE_CHECKING"
+    return (
+        isinstance(test, ast.Attribute)
+        and test.attr == "TYPE_CHECKING"
+        and isinstance(test.value, ast.Name)
+        and test.value.id == "typing"
+    )
+
+
+class _ImportCollector(ast.NodeVisitor):
+    """Collect (dotted target, lineno, lazy) triples from one module.
+
+    Depth counts enclosing function bodies only: class bodies execute at
+    import time, so imports there stay eager."""
+
+    def __init__(self, mod_name: str, is_package: bool) -> None:
+        self.mod_name = mod_name
+        self.is_package = is_package
+        self.depth = 0
+        self.found: list[tuple[str, int, bool]] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.depth += 1
+        self.generic_visit(node)
+        self.depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+    visit_Lambda = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_If(self, node: ast.If) -> None:
+        # `if TYPE_CHECKING:` bodies never execute at runtime — imports
+        # there are type-only and contribute no edge (eager OR lazy).
+        if _is_type_checking(node.test):
+            for stmt in node.orelse:
+                self.visit(stmt)
+            return
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self.found.append((alias.name, node.lineno, self.depth > 0))
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.level == 0:
+            base = node.module or ""
+        else:
+            # relative import: resolve against this module's package
+            parts = self.mod_name.split(".")
+            if not self.is_package:
+                parts = parts[:-1]  # the containing package
+            parts = parts[: len(parts) - (node.level - 1)]
+            base = ".".join(parts)
+            if node.module:
+                base = f"{base}.{node.module}" if base else node.module
+        lazy = self.depth > 0
+        self.found.append((base, node.lineno, lazy))
+        # `from M import a`: when M.a is itself a module, the import
+        # binds and executes it — add the submodule edge too (resolution
+        # against the scanned module set happens in build_graph).
+        for alias in node.names:
+            if alias.name != "*":
+                self.found.append((f"{base}.{alias.name}", node.lineno, lazy))
+
+
+def _ancestors(mod: str, pkg_name: str) -> Iterator[str]:
+    parts = mod.split(".")
+    for i in range(1, len(parts)):
+        anc = ".".join(parts[:i])
+        if anc == pkg_name or anc.startswith(pkg_name + "."):
+            yield anc
+
+
+def build_graph(
+    pkg_root: str, pkg_name: str, repo_root: str
+) -> ImportGraph:
+    """Scan the package and resolve every import into graph edges."""
+    modules = scan_package(pkg_root, pkg_name, repo_root)
+    g = ImportGraph(modules=modules)
+    for name, info in modules.items():
+        is_package = info.relpath.endswith("__init__.py")
+        collector = _ImportCollector(name, is_package)
+        collector.visit(info.tree)
+        eager: dict[str, int] = {}
+        lazy: dict[str, int] = {}
+        eager_ext: dict[str, int] = {}
+        lazy_ext: dict[str, int] = {}
+        for target, lineno, is_lazy in collector.found:
+            if target in modules:
+                intra: list[str] = [target]
+            elif target == pkg_name or target.startswith(pkg_name + "."):
+                # `from M import name` where name is a symbol, or a
+                # dangling intra-package path: credit the longest prefix
+                # that IS a scanned module.
+                parts = target.split(".")
+                intra = []
+                for i in range(len(parts) - 1, 0, -1):
+                    prefix = ".".join(parts[:i])
+                    if prefix in modules:
+                        intra = [prefix]
+                        break
+            else:
+                top = target.split(".")[0]
+                if not top:
+                    continue
+                bucket = lazy_ext if is_lazy else eager_ext
+                if top not in bucket or lineno < bucket[top]:
+                    bucket[top] = lineno
+                continue
+            for t in intra:
+                # importing a submodule executes every ancestor package
+                for resolved in (t, *_ancestors(t, pkg_name)):
+                    if resolved == name or resolved not in modules:
+                        continue
+                    bucket = lazy if is_lazy else eager
+                    if resolved not in bucket or lineno < bucket[resolved]:
+                        bucket[resolved] = lineno
+        g.eager[name] = [Edge(t, ln) for t, ln in sorted(eager.items())]
+        g.lazy[name] = [Edge(t, ln) for t, ln in sorted(lazy.items())]
+        g.eager_external[name] = [
+            Edge(t, ln) for t, ln in sorted(eager_ext.items())
+        ]
+        g.lazy_external[name] = [
+            Edge(t, ln) for t, ln in sorted(lazy_ext.items())
+        ]
+    return g

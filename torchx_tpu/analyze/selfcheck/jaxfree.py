@@ -1,0 +1,115 @@
+"""TPX901 — the transitive jax-free proof.
+
+The jax-free layers (``cli/``, ``supervisor/``, ``control/``, ...) must
+never import jax *eagerly*, directly or through any chain of eager
+intra-package imports: ``tpx --help`` and the client-side supervisor run
+on machines without an accelerator runtime, and one eager import
+regresses CLI latency by seconds. The old module-level lint
+(``scripts/lint_internal.py`` rule 1) only looked at each hand-listed
+file's own import statements — a jax-free module importing a module that
+imports jax slipped through. This pass walks the whole eager import
+graph and reports the shortest offending chain as evidence.
+
+Function-local (lazy) imports remain the sanctioned escape hatch and are
+never walked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import TYPE_CHECKING
+
+from torchx_tpu.analyze.diagnostics import Diagnostic, Severity
+
+if TYPE_CHECKING:
+    from torchx_tpu.analyze.selfcheck.engine import PassContext
+
+CODE = "TPX901"
+
+
+def module_level_jax_imports(tree: ast.Module) -> list[tuple[int, str]]:
+    """Module-level ``import jax`` / ``from jax ...`` sites in one parsed
+    module — the single-file primitive behind the legacy shim
+    (``scripts/lint_internal.py check_jax_free``). Returns
+    ``(lineno, statement)`` pairs."""
+
+    sites: list[tuple[int, str]] = []
+
+    class V(ast.NodeVisitor):
+        depth = 0
+
+        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+            self.depth += 1
+            self.generic_visit(node)
+            self.depth -= 1
+
+        visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+        def visit_Import(self, node: ast.Import) -> None:
+            if self.depth == 0:
+                for alias in node.names:
+                    if alias.name == "jax" or alias.name.startswith("jax."):
+                        sites.append((node.lineno, f"import {alias.name}"))
+
+        def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+            if (
+                self.depth == 0
+                and node.module
+                and (node.module == "jax" or node.module.startswith("jax."))
+            ):
+                sites.append((node.lineno, f"from {node.module} import ..."))
+
+    V().visit(tree)
+    return sites
+
+
+def check(ctx: "PassContext") -> list[Diagnostic]:
+    """Prove every module under a jax-free root stays jax-free
+    transitively over eager imports."""
+    out: list[Diagnostic] = []
+    g = ctx.graph
+    for info in ctx.jax_free_modules():
+        direct = [
+            e for e in g.eager_external.get(info.name, []) if e.target == "jax"
+        ]
+        if direct:
+            out.append(
+                ctx.finding(
+                    CODE,
+                    Severity.ERROR,
+                    info,
+                    direct[0].lineno,
+                    "module-level jax import in a jax-free layer",
+                    hint="import jax inside the function that needs it",
+                )
+            )
+            continue
+        for mod in sorted(g.eager_closure(info.name) - {info.name}):
+            jax_edges = [
+                e for e in g.eager_external.get(mod, []) if e.target == "jax"
+            ]
+            if not jax_edges:
+                continue
+            chain = g.eager_chain(info.name, mod) or [info.name, mod]
+            rendered = " -> ".join(
+                g.modules[m].relpath if m in g.modules else m for m in chain
+            )
+            entry = g.first_eager_edge(info.name, chain[1])
+            out.append(
+                ctx.finding(
+                    CODE,
+                    Severity.ERROR,
+                    info,
+                    entry.lineno if entry else 1,
+                    f"jax-free layer transitively imports jax: {rendered}"
+                    f" -> jax (jax imported at"
+                    f" {g.modules[mod].relpath}:{jax_edges[0].lineno})",
+                    hint=(
+                        "make the first edge of the chain a function-local"
+                        " import, or move the jax dependency out of the"
+                        " eagerly-imported module"
+                    ),
+                )
+            )
+            break  # one chain per module is enough evidence
+    return out

@@ -1,0 +1,227 @@
+"""TPX930/931/932 — crash-safe journaling discipline.
+
+Every durable decision in the launcher travels through JSONL journals
+(attempt ledger, control store, tune journal, pipeline journal, obs
+sinks) and small JSON state files (manifests, calibration tables,
+discovery files). The durability contract is uniform:
+
+* **TPX930** (error): an append handle on a ``*.jsonl`` path must
+  flush + ``os.fsync`` before the write can be claimed durable — a
+  buffered append lost in a crash silently rewrites history on replay.
+* **TPX931** (warning): a state-file rewrite (``open(path.json, "w")``)
+  must go through tmp + fsync + ``os.replace`` so concurrent readers
+  (and crash recovery) never observe a torn file.
+* **TPX932** (warning): a journal *reader* must route through the
+  torn-line-holdback helper (:func:`torchx_tpu.util.jsonl.iter_jsonl`)
+  instead of hand-rolling ``json.loads`` per line — a killed writer
+  leaves one torn final line, and ad-hoc readers get the holdback
+  subtly wrong (skip-all-garbage vs hold-back-tail).
+
+Analysis granularity is the innermost enclosing function: the open, the
+fsync and the replace are expected to be visible in one function body
+(that is how every sanctioned site in the repo is written). A path is
+journal-shaped when its expression mentions ``.jsonl`` or ``journal``.
+:mod:`torchx_tpu.util.jsonl` is the sanctioned seam and is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import TYPE_CHECKING, Optional
+
+from torchx_tpu.analyze.diagnostics import Diagnostic, Severity
+
+if TYPE_CHECKING:
+    from torchx_tpu.analyze.selfcheck.engine import PassContext
+
+CODE_APPEND_FSYNC = "TPX930"
+CODE_REWRITE_ATOMIC = "TPX931"
+CODE_READER_HOLDBACK = "TPX932"
+
+#: calls that mark a function as routing through the sanctioned helpers
+HELPER_NAMES = ("iter_jsonl", "read_jsonl", "append_jsonl", "rewrite_json")
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _open_mode(node: ast.Call) -> str:
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+        if isinstance(node.args[1].value, str):
+            return node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            if isinstance(kw.value.value, str):
+                return kw.value.value
+    return "r"
+
+
+def _target_text(node: ast.Call) -> str:
+    if not node.args:
+        return ""
+    try:
+        return ast.unparse(node.args[0]).lower()
+    except Exception:  # noqa: BLE001 - unparse of exotic nodes
+        return ""
+
+
+def _literal_target(node: ast.Call) -> Optional[str]:
+    if node.args and isinstance(node.args[0], ast.Constant):
+        if isinstance(node.args[0].value, str):
+            return node.args[0].value
+    return None
+
+
+class _FuncFacts(ast.NodeVisitor):
+    """Everything this pass needs to know about one function body."""
+
+    def __init__(self) -> None:
+        self.opens: list[ast.Call] = []
+        self.has_fsync = False
+        self.has_replace = False
+        self.has_loads = False
+        self.uses_helper = False
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return  # nested functions are analyzed on their own
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _call_name(node)
+        if isinstance(node.func, ast.Name) and name == "open":
+            self.opens.append(node)
+        elif name == "fsync":
+            self.has_fsync = True
+        elif name in ("replace", "rename"):
+            self.has_replace = True
+        elif name == "loads":
+            self.has_loads = True
+        elif name in HELPER_NAMES:
+            self.uses_helper = True
+        self.generic_visit(node)
+
+
+def _functions(tree: ast.Module) -> list[ast.FunctionDef]:
+    out: list[ast.FunctionDef] = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+            out.append(node)
+            self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    V().visit(tree)
+    return out
+
+
+def journal_sites(
+    tree: ast.Module,
+) -> list[tuple[str, int, str]]:
+    """(code, lineno, detail) findings for one parsed module."""
+    sites: list[tuple[str, int, str]] = []
+    for fn in _functions(tree):
+        facts = _FuncFacts()
+        for stmt in fn.body:
+            facts.visit(stmt)
+        for call in facts.opens:
+            mode = _open_mode(call)
+            text = _target_text(call)
+            journalish = ".jsonl" in text or "journal" in text
+            if "a" in mode and journalish and not facts.has_fsync:
+                sites.append(
+                    (
+                        CODE_APPEND_FSYNC,
+                        call.lineno,
+                        f"append handle on a journal path in {fn.name}()"
+                        " with no os.fsync before the write is claimed"
+                        " durable",
+                    )
+                )
+            elif "w" in mode and not journalish:
+                lit = _literal_target(call)
+                if (
+                    lit is not None
+                    and lit.endswith(".json")
+                    and not facts.has_replace
+                ):
+                    sites.append(
+                        (
+                            CODE_REWRITE_ATOMIC,
+                            call.lineno,
+                            f"state-file rewrite of {lit!r} in {fn.name}()"
+                            " without tmp + fsync + os.replace; a crash"
+                            " mid-write leaves a torn file",
+                        )
+                    )
+            elif (
+                "w" not in mode
+                and "a" not in mode
+                and "x" not in mode
+                and journalish
+                and facts.has_loads
+                and not facts.uses_helper
+            ):
+                sites.append(
+                    (
+                        CODE_READER_HOLDBACK,
+                        call.lineno,
+                        f"hand-rolled journal reader in {fn.name}();"
+                        " torn-line holdback must come from one helper",
+                    )
+                )
+    return sites
+
+
+_HINTS = {
+    CODE_APPEND_FSYNC: (
+        "append through util.jsonl.append_jsonl (O_APPEND + flush +"
+        " os.fsync), or fsync the handle before returning"
+    ),
+    CODE_REWRITE_ATOMIC: (
+        "write through util.jsonl.rewrite_json (tmp + fsync +"
+        " os.replace)"
+    ),
+    CODE_READER_HOLDBACK: (
+        "read through util.jsonl.iter_jsonl (skips exactly the torn"
+        " final line)"
+    ),
+}
+
+
+def check(ctx: "PassContext") -> list[Diagnostic]:
+    """Apply the journaling rules to every module except the helper
+    seam itself."""
+    out: list[Diagnostic] = []
+    exempt = {
+        ctx.module_at(p).name
+        for p in ctx.config.journal_seams
+        if ctx.module_at(p) is not None
+    }
+    severities = {
+        CODE_APPEND_FSYNC: Severity.ERROR,
+        CODE_REWRITE_ATOMIC: Severity.WARNING,
+        CODE_READER_HOLDBACK: Severity.WARNING,
+    }
+    for info in ctx.all_modules():
+        if info.name in exempt:
+            continue
+        for code, lineno, detail in journal_sites(info.tree):
+            out.append(
+                ctx.finding(
+                    code,
+                    severities[code],
+                    info,
+                    lineno,
+                    detail,
+                    hint=_HINTS[code],
+                )
+            )
+    return out

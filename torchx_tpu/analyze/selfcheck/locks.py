@@ -1,0 +1,250 @@
+"""TPX920/TPX921 — lock discipline for thread-crossing classes.
+
+The threaded control plane (reconciler, telemetry collector, control
+daemon, serve engine) shares instance state across threads. A class
+whose instances cross a thread boundary must guard mutable attribute
+writes with its lock: an unguarded ``self.x = ...`` racing a reader on
+another thread is the exact bug class the step-down incidents in the
+gang-scheduling literature trace back to.
+
+A class is **thread-crossing** when any of:
+
+* one of its own methods spawns ``threading.Thread(target=self.<m>)``
+  (the instance's bound method runs on another thread) — the evidence
+  chain in the diagnostic names this site;
+* its name matches a known shared-service suffix (``Daemon``,
+  ``Reconciler``, ``Collector``, ``Monitor``, ...);
+* its ``class`` line (or the line above) carries a ``# tpx: shared``
+  annotation.
+
+For a thread-crossing class:
+
+* **TPX921** (warning): the class allocates no lock at all (no
+  ``self._x = threading.Lock()/RLock()/Condition()``) — there is nothing
+  to guard with.
+* **TPX920** (error): a mutable attribute write outside ``__init__``
+  (construction happens-before the thread starts and is exempt) is not
+  enclosed in ``with self.<lock>:``.
+
+Heuristic by design: the baseline file is the triage mechanism for
+sites a human has judged benign (e.g. writes that happen strictly
+before the thread is started).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import TYPE_CHECKING, Optional
+
+from torchx_tpu.analyze.diagnostics import Diagnostic, Severity
+
+if TYPE_CHECKING:
+    from torchx_tpu.analyze.selfcheck.engine import PassContext
+    from torchx_tpu.analyze.selfcheck.graph import ModuleInfo
+
+CODE_UNGUARDED = "TPX920"
+CODE_NO_LOCK = "TPX921"
+
+SHARED_ANNOTATION = "# tpx: shared"
+
+#: ``threading`` factories whose result counts as a guard
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+
+#: attributes whose writes are structurally safe: the lock itself is
+#: assigned unguarded by definition, and thread/daemon handles are
+#: written before the thread they name exists
+_EXEMPT_ATTR_HINTS = ("lock", "cond", "mutex", "thread")
+
+
+def _is_lock_factory(node: ast.expr) -> bool:
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_FACTORIES:
+            return True
+        if isinstance(fn, ast.Name) and fn.id in _LOCK_FACTORIES:
+            return True
+    return False
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "Thread":
+        return isinstance(fn.value, ast.Name) and fn.value.id == "threading"
+    return isinstance(fn, ast.Name) and fn.id == "Thread"
+
+
+class _ClassScan(ast.NodeVisitor):
+    """One class body: lock attrs, thread-entry evidence, write sites.
+
+    Run twice per class: the first sweep collects lock allocations (so a
+    guard used in a method defined textually before ``__init__`` still
+    resolves), the second records writes and guard coverage against the
+    full lock set."""
+
+    def __init__(self, known_locks: Optional[set[str]] = None) -> None:
+        self.lock_attrs: set[str] = set(known_locks or ())
+        #: (method, lineno) of a Thread(target=self.<m>) spawn
+        self.thread_entries: list[tuple[str, int]] = []
+        #: (attr, lineno, method, guarded)
+        self.writes: list[tuple[str, int, str, bool]] = []
+        self._method: Optional[str] = None
+        self._guard_depth = 0
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        outer, self._method = self._method, node.name if self._method is None else self._method
+        self.generic_visit(node)
+        self._method = outer
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_With(self, node: ast.With) -> None:
+        guards = sum(
+            1
+            for item in node.items
+            if (attr := _self_attr(item.context_expr)) is not None
+            and (
+                attr in self.lock_attrs
+                or any(h in attr.lower() for h in ("lock", "cond", "mutex"))
+            )
+        )
+        self._guard_depth += guards
+        self.generic_visit(node)
+        self._guard_depth -= guards
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_thread_ctor(node) and self._method is not None:
+            for kw in node.keywords:
+                if kw.arg == "target" and (m := _self_attr(kw.value)):
+                    self.thread_entries.append((m, node.lineno))
+        self.generic_visit(node)
+
+    def _record_write(self, target: ast.expr, lineno: int) -> None:
+        attr = _self_attr(target)
+        if attr is None or self._method is None:
+            return
+        self.writes.append(
+            (attr, lineno, self._method, self._guard_depth > 0)
+        )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for elt in t.elts:
+                    self._record_write(elt, node.lineno)
+            else:
+                self._record_write(t, node.lineno)
+        # lock allocation: self.<x> = threading.Lock()
+        if _is_lock_factory(node.value):
+            for t in node.targets:
+                if (attr := _self_attr(t)) is not None:
+                    self.lock_attrs.add(attr)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_write(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_write(node.target, node.lineno)
+            if _is_lock_factory(node.value) and (
+                attr := _self_attr(node.target)
+            ):
+                self.lock_attrs.add(attr)
+        self.generic_visit(node)
+
+
+def _is_annotated_shared(info: "ModuleInfo", node: ast.ClassDef) -> bool:
+    lines = info.source_lines()
+    for lineno in (node.lineno, node.lineno - 1):
+        if 1 <= lineno <= len(lines) and SHARED_ANNOTATION in lines[lineno - 1]:
+            return True
+    return False
+
+
+def _classes(tree: ast.Module) -> list[ast.ClassDef]:
+    out: list[ast.ClassDef] = []
+
+    class V(ast.NodeVisitor):
+        def visit_ClassDef(self, node: ast.ClassDef) -> None:
+            out.append(node)
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+def check(ctx: "PassContext") -> list[Diagnostic]:
+    """Flag unguarded shared-state writes in thread-crossing classes."""
+    out: list[Diagnostic] = []
+    for info in ctx.all_modules():
+        for cls in _classes(info.tree):
+            prescan = _ClassScan()
+            for stmt in cls.body:
+                prescan.visit(stmt)
+            scan = _ClassScan(known_locks=prescan.lock_attrs)
+            for stmt in cls.body:
+                scan.visit(stmt)
+            evidence: Optional[str] = None
+            if scan.thread_entries:
+                m, ln = scan.thread_entries[0]
+                evidence = (
+                    f"Thread(target=self.{m}) at {info.relpath}:{ln}"
+                )
+            elif not cls.name.startswith("_") and any(
+                cls.name.endswith(suffix)
+                for suffix in ctx.config.shared_class_suffixes
+            ):
+                # private helper classes (AST visitors, local accumulators)
+                # are not shared services even when the suffix matches
+                evidence = f"class name matches shared-service pattern {cls.name!r}"
+            elif _is_annotated_shared(info, cls):
+                evidence = "annotated '# tpx: shared'"
+            if evidence is None:
+                continue
+            if not scan.lock_attrs:
+                out.append(
+                    ctx.finding(
+                        CODE_NO_LOCK,
+                        Severity.WARNING,
+                        info,
+                        cls.lineno,
+                        f"thread-crossing class {cls.name} ({evidence})"
+                        " allocates no lock; its mutable state cannot be"
+                        " guarded",
+                        hint="allocate self._lock = threading.Lock() in"
+                        " __init__ and guard every cross-thread write",
+                    )
+                )
+                continue
+            for attr, lineno, method, guarded in scan.writes:
+                if guarded or method == "__init__":
+                    continue
+                if attr in scan.lock_attrs or any(
+                    h in attr.lower() for h in _EXEMPT_ATTR_HINTS
+                ):
+                    continue
+                out.append(
+                    ctx.finding(
+                        CODE_UNGUARDED,
+                        Severity.ERROR,
+                        info,
+                        lineno,
+                        f"unguarded write to self.{attr} in"
+                        f" {cls.name}.{method}; instances cross threads"
+                        f" ({evidence})",
+                        hint=f"wrap the write in `with self."
+                        f"{sorted(scan.lock_attrs)[0]}:`",
+                    )
+                )
+    return out

@@ -1,0 +1,81 @@
+"""TPX950 — scheduler subprocess calls go through the resilient seam.
+
+Raw ``subprocess.run/Popen/check_*/call`` in ``schedulers/`` bypasses
+the retry/circuit-breaker wrapper (:mod:`torchx_tpu.resilience.call`):
+one un-retried ``gcloud`` 503 then surfaces as a user-visible submit
+failure. The only sanctioned call sites are the ``_run_cmd`` methods
+(the seam each backend funnels through) and the local scheduler's
+``_popen`` (data-plane replica spawn, not a control-plane call).
+
+This is the old lint's rule 2 (``scripts/lint_internal.py``) rehosted
+on the pass engine unchanged in semantics.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import TYPE_CHECKING
+
+from torchx_tpu.analyze.diagnostics import Diagnostic, Severity
+
+if TYPE_CHECKING:
+    from torchx_tpu.analyze.selfcheck.engine import PassContext
+
+CODE = "TPX950"
+
+SUBPROCESS_CALLS = ("run", "Popen", "check_call", "check_output", "call")
+
+
+def raw_subprocess_sites(
+    tree: ast.Module, seam_funcs: tuple[str, ...]
+) -> list[tuple[int, str]]:
+    """``(lineno, call)`` for raw subprocess sites outside the seam
+    functions — the single-file primitive behind the legacy shim."""
+    sites: list[tuple[int, str]] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.stack: list[str] = []
+
+        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+            self.stack.append(node.name)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+        def visit_Call(self, node: ast.Call) -> None:
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "subprocess"
+                and fn.attr in SUBPROCESS_CALLS
+                and not any(f in seam_funcs for f in self.stack)
+            ):
+                sites.append((node.lineno, f"subprocess.{fn.attr}"))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return sites
+
+
+def check(ctx: "PassContext") -> list[Diagnostic]:
+    """Flag raw subprocess sites in every ``schedulers/`` module."""
+    out: list[Diagnostic] = []
+    seams = ctx.config.subprocess_seams
+    for info in ctx.modules_under(ctx.config.schedulers_dir):
+        for lineno, call in raw_subprocess_sites(info.tree, seams):
+            out.append(
+                ctx.finding(
+                    CODE,
+                    Severity.ERROR,
+                    info,
+                    lineno,
+                    f"raw {call} in schedulers/ outside the"
+                    f" {'/'.join(seams)} seam",
+                    hint="route it through the backend's resilient"
+                    " _run_cmd",
+                )
+            )
+    return out

@@ -1064,7 +1064,9 @@ def main(argv: Optional[list[str]] = None) -> None:
     )
     args = parser.parse_args(argv)
     if args.port_stride and args.port:
-        replica_id = int(os.environ.get("TPX_REPLICA_ID", "0") or "0")
+        from torchx_tpu.settings import ENV_TPX_REPLICA_ID
+
+        replica_id = int(os.environ.get(ENV_TPX_REPLICA_ID, "0") or "0")
         args.port += args.port_stride * replica_id
     _assert_platform()
     t0 = time.monotonic()

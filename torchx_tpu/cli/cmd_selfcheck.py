@@ -1,0 +1,174 @@
+"""``tpx selfcheck`` — the whole-program invariant analyzer, as a CLI.
+
+Runs the :mod:`torchx_tpu.analyze.selfcheck` passes over the package's
+own source tree and reports TPX9xx diagnostics on the standard lint
+report model. The checked-in triaged baseline
+(``selfcheck_baseline.json`` at the repo root) suppresses findings a
+human has reviewed; anything unsuppressed fails the run.
+
+* ``--json`` — the stable machine-readable report (plus the suppressed
+  count), for CI consumers;
+* ``--changed-only`` — keep only findings anchored in files changed in
+  the working tree (vs ``HEAD``, plus untracked) — the import graph is
+  still whole-program, so transitive proofs don't weaken;
+* ``--update-baseline`` — retriage: rewrite the baseline from the
+  current raw findings (review the diff like any other change);
+* ``--passes`` — comma-separated subset (default: all).
+
+Exit codes: 0 clean, 1 any unsuppressed finding (selfcheck findings are
+invariant violations — warnings gate too), 2 usage errors.
+
+This module must stay import-light: ``tpx selfcheck --help`` never
+imports jax (tier-1 asserts it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from torchx_tpu.cli.cmd_base import SubCommand
+
+
+class CmdSelfcheck(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        subparser.add_argument(
+            "--json",
+            action="store_true",
+            help="emit the stable JSON report instead of text",
+        )
+        subparser.add_argument(
+            "--changed-only",
+            action="store_true",
+            help="only report findings in files changed vs HEAD"
+            " (graph/proofs stay whole-program)",
+        )
+        subparser.add_argument(
+            "--update-baseline",
+            action="store_true",
+            help="rewrite the triaged baseline from the current findings",
+        )
+        subparser.add_argument(
+            "--baseline",
+            type=str,
+            default=None,
+            help="baseline file (default: selfcheck_baseline.json next to"
+            " the package)",
+        )
+        subparser.add_argument(
+            "--passes",
+            type=str,
+            default=None,
+            help="comma-separated pass subset (default: all); see"
+            " `tpx selfcheck --list-passes`",
+        )
+        subparser.add_argument(
+            "--list-passes",
+            action="store_true",
+            help="print the registered pass names and exit",
+        )
+        subparser.add_argument(
+            "--root",
+            type=str,
+            default=None,
+            help="repo root to scan (default: the checkout this package"
+            " is imported from)",
+        )
+
+    def run(self, args: argparse.Namespace) -> None:
+        from torchx_tpu.analyze.selfcheck import (
+            BASELINE_FILENAME,
+            Baseline,
+            PASSES,
+            SelfCheckConfig,
+            run_selfcheck,
+        )
+
+        if args.list_passes:
+            for name in PASSES:
+                print(name)
+            sys.exit(0)
+
+        passes = None
+        if args.passes:
+            passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+            unknown = set(passes) - set(PASSES)
+            if unknown:
+                print(
+                    f"error: unknown pass(es) {sorted(unknown)};"
+                    f" available: {list(PASSES)}",
+                    file=sys.stderr,
+                )
+                sys.exit(2)
+
+        config = SelfCheckConfig.for_repo(args.root)
+        if not os.path.isdir(config.pkg_root):
+            print(
+                f"error: no package tree at {config.pkg_root!r}",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+
+        only_files = None
+        if args.changed_only:
+            only_files = self._changed_files(config.repo_root)
+
+        raw = run_selfcheck(config, passes=passes, only_files=only_files)
+
+        baseline_path = args.baseline or os.path.join(
+            config.repo_root, BASELINE_FILENAME
+        )
+        if args.update_baseline:
+            Baseline.from_report(raw).save(baseline_path)
+            print(
+                f"selfcheck: baseline rewritten with"
+                f" {len(raw.diagnostics)} finding(s) -> {baseline_path}"
+            )
+            sys.exit(0)
+
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"error: bad baseline {baseline_path!r}: {e}", file=sys.stderr)
+            sys.exit(2)
+        kept, suppressed = baseline.apply(raw)
+
+        if args.json:
+            doc = kept.to_dict()
+            doc["suppressed"] = suppressed
+            print(json.dumps(doc, indent=2))
+        else:
+            print(kept.render())
+            if suppressed:
+                print(f"({suppressed} baselined finding(s) suppressed)")
+        sys.exit(1 if kept.diagnostics else 0)
+
+    @staticmethod
+    def _changed_files(repo_root: str) -> set[str]:
+        """Repo-relative paths changed vs HEAD, plus untracked files."""
+        import subprocess
+
+        files: set[str] = set()
+        for cmd in (
+            ["git", "diff", "--name-only", "HEAD"],
+            ["git", "ls-files", "--others", "--exclude-standard"],
+        ):
+            try:
+                out = subprocess.run(
+                    cmd,
+                    cwd=repo_root,
+                    capture_output=True,
+                    text=True,
+                    check=True,
+                    timeout=30,
+                ).stdout
+            except (OSError, subprocess.SubprocessError) as e:
+                print(
+                    f"error: --changed-only needs git in {repo_root}: {e}",
+                    file=sys.stderr,
+                )
+                sys.exit(2)
+            files.update(line.strip() for line in out.splitlines() if line.strip())
+        return files

@@ -128,7 +128,9 @@ class CmdTune(SubCommand):
                 )
                 sys.exit(2)
 
-        devices = args.devices or int(os.environ.get("TPX_TUNE_DEVICES", 8))
+        from torchx_tpu.settings import ENV_TPX_TUNE_DEVICES
+
+        devices = args.devices or int(os.environ.get(ENV_TPX_TUNE_DEVICES, 8))
         from torchx_tpu.tune.driver import TuneError, run_tune
 
         try:

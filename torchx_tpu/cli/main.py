@@ -52,6 +52,7 @@ BUILTIN_CMDS: dict[str, tuple[str, str]] = {
     "top": ("torchx_tpu.cli.cmd_top", "CmdTop"),
     "pipeline": ("torchx_tpu.cli.cmd_pipeline", "CmdPipeline"),
     "sim": ("torchx_tpu.cli.cmd_sim", "CmdSim"),
+    "selfcheck": ("torchx_tpu.cli.cmd_selfcheck", "CmdSelfcheck"),
 }
 
 

@@ -43,11 +43,17 @@ def maybe_inject_fault() -> None:
     attempt (creates the marker), which lets retry/elastic-restart e2e
     tests prove a gang recovers. ``TPX_EXAMPLE_THROWS_REPLICA=N`` scopes
     the fault to one replica of the gang."""
-    spec = os.environ.get("TPX_EXAMPLE_THROWS")
+    from torchx_tpu.settings import (
+        ENV_TPX_EXAMPLE_THROWS,
+        ENV_TPX_EXAMPLE_THROWS_REPLICA,
+        ENV_TPX_REPLICA_ID,
+    )
+
+    spec = os.environ.get(ENV_TPX_EXAMPLE_THROWS)
     if not spec:
         return
-    want = os.environ.get("TPX_EXAMPLE_THROWS_REPLICA")
-    if want is not None and os.environ.get("TPX_REPLICA_ID", "0") != want:
+    want = os.environ.get(ENV_TPX_EXAMPLE_THROWS_REPLICA)
+    if want is not None and os.environ.get(ENV_TPX_REPLICA_ID, "0") != want:
         return
     if spec.startswith("once:"):
         marker = spec[len("once:"):]

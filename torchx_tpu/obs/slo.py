@@ -41,6 +41,7 @@ from dataclasses import dataclass, field, replace
 from typing import Callable, Optional
 
 from torchx_tpu.obs.telemetry import MetricStore
+from torchx_tpu.util.jsonl import append_jsonl
 
 logger = logging.getLogger(__name__)
 
@@ -291,9 +292,7 @@ class SloEngine:
         if not self.journal_path:
             return
         try:
-            os.makedirs(os.path.dirname(self.journal_path) or ".", exist_ok=True)
-            with open(self.journal_path, "a") as f:
-                f.write(json.dumps(alert.to_json()) + "\n")
+            append_jsonl(self.journal_path, alert.to_json())
         except OSError as e:
             logger.warning("slo journal write failed: %s", e)
 

@@ -202,13 +202,15 @@ class PipelineEngine:
 
     def set_slo_signal(self, signal: Callable[[], Optional[float]]) -> None:
         """Attach the burn-rate feed used by promotion gates."""
-        self._slo_signal = signal
+        with self._lock:
+            self._slo_signal = signal
 
     def set_pool_provider(
         self, provider: Callable[[PipelineStage], Any]
     ) -> None:
         """Attach the serve-pool lookup used by promote stages."""
-        self._pool_provider = provider
+        with self._lock:
+            self._pool_provider = provider
 
     @property
     def incumbent(self) -> Optional[dict]:

@@ -98,6 +98,10 @@ DEFAULT_DESCRIBE_CACHE_TTL = 1.0
 # (torchx_tpu/tune/). Default ~/.torchx_tpu/tune.
 ENV_TPX_TUNE_DIR = "TPX_TUNE_DIR"
 
+# Device count assumed by `tpx tune` when --devices is not passed
+# (defaults to 8, one v5p host).
+ENV_TPX_TUNE_DEVICES = "TPX_TUNE_DEVICES"
+
 # Path to a tune plan artifact (torchx_tpu/tune/artifact.py) pinned for
 # submission: the submit gate (rules.check_plan_artifact) diffs every
 # plan-shaped role against it and errors on divergence (TPX706) or an
@@ -173,6 +177,13 @@ ENV_TPX_NUM_REPLICAS = "TPX_NUM_REPLICAS"
 # these three instead of TPX_REPLICA_ID and the bootstrap derives
 # ``replica_id = slice_id * hosts_per_slice + host_id``.
 ENV_TPX_SLICE_ID = "TPX_SLICE_ID"
+
+# Fault-injection hook for the example apps (examples/compute_mesh_size):
+# "1" always throws, "once:/path/marker" throws only on the first attempt.
+# _REPLICA scopes the fault to one replica of the gang. Used by
+# retry/elastic-restart e2e tests to prove a gang recovers.
+ENV_TPX_EXAMPLE_THROWS = "TPX_EXAMPLE_THROWS"
+ENV_TPX_EXAMPLE_THROWS_REPLICA = "TPX_EXAMPLE_THROWS_REPLICA"
 ENV_TPX_HOST_ID = "TPX_HOST_ID"  # host index within the slice
 ENV_TPX_HOSTS_PER_SLICE = "TPX_HOSTS_PER_SLICE"
 

@@ -15,9 +15,10 @@ by arrival:
 
 from __future__ import annotations
 
-import json
 import math
 import random
+
+from torchx_tpu.util.jsonl import iter_jsonl
 
 #: class -> (arrival weight, (min,max) duration seconds, replica choices)
 CLASS_MIX = {
@@ -97,34 +98,26 @@ def replay_trace(journal_path: str) -> list[dict]:
     placed: dict[str, float] = {}
     done: dict[str, float] = {}
     t0: float | None = None
-    with open(journal_path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                e = json.loads(line)
-            except ValueError:
-                continue
-            ts = float(e.get("time_usec", 0) or 0) / 1e6
-            if t0 is None:
-                t0 = ts
-            kind, job = e.get("kind"), str(e.get("job", ""))
-            if not job:
-                continue
-            if kind == "submit":
-                submits[job] = {
-                    "job": job,
-                    "arrival": max(0.0, ts - t0),
-                    "klass": str(e.get("klass", "batch")),
-                    "tenant": str(e.get("tenant", "replay")),
-                    "replicas": int(e.get("replicas", 1)),
-                    "elastic": bool(e.get("elastic", False)),
-                }
-            elif kind == "place":
-                placed.setdefault(job, ts)
-            elif kind == "terminal":
-                done.setdefault(job, ts)
+    for e in iter_jsonl(journal_path, skip="all"):
+        ts = float(e.get("time_usec", 0) or 0) / 1e6
+        if t0 is None:
+            t0 = ts
+        kind, job = e.get("kind"), str(e.get("job", ""))
+        if not job:
+            continue
+        if kind == "submit":
+            submits[job] = {
+                "job": job,
+                "arrival": max(0.0, ts - t0),
+                "klass": str(e.get("klass", "batch")),
+                "tenant": str(e.get("tenant", "replay")),
+                "replicas": int(e.get("replicas", 1)),
+                "elastic": bool(e.get("elastic", False)),
+            }
+        elif kind == "place":
+            placed.setdefault(job, ts)
+        elif kind == "terminal":
+            done.setdefault(job, ts)
     out = []
     for job, doc in submits.items():
         if job in placed and job in done:
