@@ -1,0 +1,348 @@
+#!/usr/bin/env python
+"""Federation failover benchmark: two live cells, one dies mid-trace.
+
+The headline robustness question: when a regional cell drains (planned)
+or its daemon is killed outright (unplanned), does the federation router
+degrade gracefully — zero dropped requests, bounded failover p99 — or
+does the loss surface to callers?
+
+Three phases, one artifact (``BENCH_FED_r01.json``):
+
+* **drain** — two local ``tpx control`` daemons as cells under a
+  phase-shifted synthetic diurnal request trace. Mid-trace, cell A is
+  drained via its ``/v1/cell/drain`` verb; the router must route every
+  subsequent request to the survivor. After the uncordon, traffic
+  returns. Reported: request count, dropped count (target **zero**),
+  TTFT p99 before/during/after the drain window, per-cell counts.
+* **kill** — same topology, but cell A's daemon gets SIGKILL with no
+  warning. The router's per-cell circuit breaker must absorb the dial
+  failures: no request errors surface while the survivor has capacity,
+  and the first post-kill success lands within one breaker window.
+* **sim** — the deterministic twin: the bundled ``federation-two-cell``
+  scenario run twice at the same seed through
+  :class:`~torchx_tpu.federation.sim.FederationSimHarness`; journal
+  sha256s must be byte-identical, drops must be zero.
+
+Usage:
+    python scripts/bench_federation.py [--ticks 30] [--per-tick 10]
+        [--out BENCH_FED_r01.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _p99(samples: list[float]) -> float | None:
+    if not samples:
+        return None
+    xs = sorted(samples)
+    return round(xs[min(len(xs) - 1, math.ceil(0.99 * len(xs)) - 1)], 6)
+
+
+def _boot_cell(name: str, state_dir: str) -> tuple[subprocess.Popen, dict]:
+    """Start one `tpx control --cell NAME` daemon; return (proc, discovery)."""
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "torchx_tpu.cli.main",
+            "control",
+            "--cell",
+            name,
+            "--state-dir",
+            state_dir,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    discovery = os.path.join(state_dir, "control.json")
+    deadline = time.monotonic() + 60
+    while not os.path.exists(discovery):
+        if proc.poll() is not None:
+            raise RuntimeError(f"cell {name} died: {proc.stdout.read()}")
+        if time.monotonic() > deadline:
+            raise RuntimeError(f"cell {name} never wrote its discovery file")
+        time.sleep(0.1)
+    with open(discovery) as f:
+        return proc, json.load(f)
+
+
+def _router_for(docs: dict):
+    from torchx_tpu.federation import CellHandle, CellRegistry, FederationRouter
+
+    registry = CellRegistry()
+    for name, doc in docs.items():
+        registry.add(name, doc["addr"], doc["token"])
+    handles = []
+    for spec in registry.cells():
+        h = CellHandle(spec)
+        # home-region affinity: each cell advertises its own digest space
+        h.update_prefix_digests([f"{spec.name}:blk{i}" for i in range(8)])
+        handles.append(h)
+    return FederationRouter(handles, probe_ttl_s=0.25)
+
+
+def _diurnal(frac: float, phase_h: float, per_tick: int) -> int:
+    day = frac + phase_h / 24.0
+    return max(1, round(per_tick * (0.65 + 0.35 * math.sin(2 * math.pi * (day - 0.25)))))
+
+
+def _drive(
+    router,
+    ticks: int,
+    per_tick: int,
+    on_tick=None,
+    phase_of=None,
+    submit_dir: str | None = None,
+    tick_s: float = 0.25,
+) -> dict:
+    """Dispatch a phase-shifted diurnal request trace through the router.
+
+    Each tick lasts ``tick_s`` of wall time (so probe TTLs actually
+    expire mid-trace, as they would in production). The bulk traffic is
+    routed daemon round-trips (``list``) stamped with the home region's
+    prefix chain so affinity keeps steady-state traffic local; one REAL
+    job submit rides every tick to exercise the drain-503 spill path.
+    Returns per-phase latency samples + outcome counts."""
+    from torchx_tpu.federation import FederationError
+
+    regions = {"us-east1": 0.0, "eu-west4": 8.0}
+    stats: dict = {
+        "requests": 0,
+        "dropped": 0,
+        "per_cell": {},
+        "submits_per_cell": {"pre": {}, "during": {}, "post": {}},
+        "samples": {"pre": [], "during": [], "post": []},
+        "errors": [],
+    }
+    for tick in range(ticks):
+        t_tick = time.perf_counter()
+        if on_tick is not None:
+            on_tick(tick)
+        phase = phase_of(tick) if phase_of is not None else "pre"
+        for region, phase_h in regions.items():
+            n = _diurnal(tick / ticks, phase_h, per_tick)
+            chain = [f"{region}:blk{i}" for i in range(8)]
+            for _ in range(n):
+                stats["requests"] += 1
+                t0 = time.perf_counter()
+                try:
+                    cell, _ = router.dispatch(
+                        lambda c: c.list(), chain=chain
+                    )
+                except FederationError as e:
+                    stats["dropped"] += 1
+                    stats["errors"].append(str(e))
+                    continue
+                stats["samples"][phase].append(time.perf_counter() - t0)
+                stats["per_cell"][cell] = stats["per_cell"].get(cell, 0) + 1
+        if submit_dir is not None:
+            stats["requests"] += 1
+            # alternate the submit's home region so both cells see their
+            # share when healthy (and the uncordoned cell's return shows)
+            home = list(regions)[tick % len(regions)]
+            t0 = time.perf_counter()
+            try:
+                cell, _ = router.submit(
+                    "utils.echo",
+                    ["--msg", f"bench-{tick}"],
+                    "local",
+                    chain=[f"{home}:blk{i}" for i in range(8)],
+                    cfg={"log_dir": os.path.join(submit_dir, str(tick))},
+                )
+            except FederationError as e:
+                stats["dropped"] += 1
+                stats["errors"].append(str(e))
+            else:
+                stats["samples"][phase].append(time.perf_counter() - t0)
+                per = stats["submits_per_cell"][phase]
+                per[cell] = per.get(cell, 0) + 1
+        remaining = tick_s - (time.perf_counter() - t_tick)
+        if remaining > 0:
+            time.sleep(remaining)
+    return stats
+
+
+def _finish(stats: dict) -> dict:
+    samples = stats.pop("samples")
+    all_samples = [s for xs in samples.values() for s in xs]
+    stats["ttft_p99_s"] = _p99(all_samples)
+    stats["ttft_p99_pre_s"] = _p99(samples["pre"])
+    stats["ttft_p99_during_s"] = _p99(samples["during"])
+    stats["ttft_p99_post_s"] = _p99(samples["post"])
+    stats["errors"] = stats["errors"][:5]  # samples, not the full flood
+    return stats
+
+
+def bench_drain(base: str, ticks: int, per_tick: int) -> dict:
+    """Planned failover: drain cell A mid-trace, uncordon near the end."""
+    from torchx_tpu.control.client import ControlClient
+
+    drain_at, uncordon_at = ticks // 3, (2 * ticks) // 3
+    procs, docs = {}, {}
+    try:
+        for name in ("us-east1", "eu-west4"):
+            procs[name], docs[name] = _boot_cell(
+                name, os.path.join(base, "drain", name)
+            )
+        router = _router_for(docs)
+        victim = ControlClient(
+            docs["us-east1"]["addr"], docs["us-east1"]["token"]
+        )
+
+        def on_tick(tick: int) -> None:
+            if tick == drain_at:
+                victim.cell_drain()
+            elif tick == uncordon_at:
+                victim.cell_uncordon()
+
+        def phase_of(tick: int) -> str:
+            if tick < drain_at:
+                return "pre"
+            return "during" if tick < uncordon_at else "post"
+
+        stats = _drive(
+            router,
+            ticks,
+            per_tick,
+            on_tick=on_tick,
+            phase_of=phase_of,
+            submit_dir=os.path.join(base, "drain", "logs"),
+        )
+        stats["drained_cell"] = "us-east1"
+        return _finish(stats)
+    finally:
+        for p in procs.values():
+            p.terminate()
+        for p in procs.values():
+            p.wait(timeout=10)
+
+
+def bench_kill(base: str, ticks: int, per_tick: int) -> dict:
+    """Unplanned failover: SIGKILL cell A's daemon mid-trace."""
+    from torchx_tpu import settings
+
+    kill_at = ticks // 2
+    procs, docs = {}, {}
+    killed_at_s: list[float] = []
+    recovered_at_s: list[float] = []
+    try:
+        for name in ("us-east1", "eu-west4"):
+            procs[name], docs[name] = _boot_cell(
+                name, os.path.join(base, "kill", name)
+            )
+        router = _router_for(docs)
+
+        def on_tick(tick: int) -> None:
+            if tick == kill_at:
+                procs["us-east1"].send_signal(signal.SIGKILL)
+                killed_at_s.append(time.perf_counter())
+
+        def phase_of(tick: int) -> str:
+            return "pre" if tick < kill_at else "during"
+
+        stats = _drive(
+            router,
+            ticks,
+            per_tick,
+            on_tick=on_tick,
+            phase_of=phase_of,
+            submit_dir=os.path.join(base, "kill", "logs"),
+        )
+        # first successful dispatch after the kill bounds the blackout
+        post = stats["samples"]["during"]
+        if killed_at_s and post:
+            recovered_at_s.append(killed_at_s[0] + post[0])
+        stats["killed_cell"] = "us-east1"
+        stats["breaker_window_s"] = settings.FEDERATION_BREAKER_COOLDOWN_SECONDS
+        stats["spillover_within_breaker_window"] = bool(
+            post and post[0] <= settings.FEDERATION_BREAKER_COOLDOWN_SECONDS
+        )
+        return _finish(stats)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            p.wait(timeout=10)
+
+
+def bench_sim(base: str, seed: int = 11) -> dict:
+    """The deterministic twin: same-seed runs must be byte-identical."""
+    from torchx_tpu.federation.sim import FederationSimHarness
+    from torchx_tpu.sim.scenarios import get_scenario
+
+    reports = []
+    for tag in ("a", "b"):
+        scenario = get_scenario("federation-two-cell")
+        harness = FederationSimHarness(
+            scenario, seed=seed, state_dir=os.path.join(base, "sim", tag)
+        )
+        reports.append(harness.run())
+    a, b = reports
+    return {
+        "scenario": "federation-two-cell",
+        "seed": seed,
+        "journal_sha256": a.journal_sha256,
+        "deterministic": a.journal_sha256 == b.journal_sha256,
+        "stats": a.stats,
+    }
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--ticks", type=int, default=30)
+    parser.add_argument("--per-tick", type=int, default=10)
+    parser.add_argument("--out", default="BENCH_FED_r01.json")
+    args = parser.parse_args(argv)
+
+    base = tempfile.mkdtemp(prefix="tpx_bench_fed_")
+    os.environ.setdefault("TPX_OBS_DIR", os.path.join(base, "obs"))
+    os.environ["TPX_FEDERATION_DIR"] = os.path.join(base, "fed")
+    os.environ.setdefault("TPX_WATCH_INTERVAL", "0.1")
+
+    drain = bench_drain(os.path.join(base, "d"), args.ticks, args.per_tick)
+    # fresh registry root per phase: the kill run re-registers its cells
+    os.environ["TPX_FEDERATION_DIR"] = os.path.join(base, "fed-kill")
+    kill = bench_kill(os.path.join(base, "k"), args.ticks, args.per_tick)
+    sim = bench_sim(base)
+
+    report = {
+        "bench": "federation_failover",
+        "ticks": args.ticks,
+        "per_tick": args.per_tick,
+        "drain": drain,
+        "kill": kill,
+        "sim": sim,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(report, indent=1, sort_keys=True))
+
+    ok = (
+        drain["dropped"] == 0
+        and kill["dropped"] == 0
+        and kill["spillover_within_breaker_window"]
+        and sim["deterministic"]
+        and sim["stats"]["dropped"] == 0
+        # while a cell is down, every submit lands on the survivor
+        and set(drain["submits_per_cell"]["during"]) == {"eu-west4"}
+        and set(kill["submits_per_cell"]["during"]) == {"eu-west4"}
+    )
+    print(f"federation failover acceptance: {'PASS' if ok else 'FAIL'}")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -814,4 +814,105 @@ assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
 EOF
 then echo "PROFILE_SMOKE=ok"; else echo "PROFILE_SMOKE=FAILED"; rc=1; fi
 rm -rf "$prof_dir"
+
+# Federation smoke: boot two `tpx control` daemons as cells, register
+# them with `tpx cell add`, submit through the federation router, drain
+# one cell mid-stream with `tpx cell drain`, and assert every subsequent
+# request lands on the survivor with ZERO request errors. `tpx cell list
+# --json` must report the drained lifecycle state, and `tpx cell --help`
+# must stay jax-free on the lazy dispatch path.
+fed_dir=$(mktemp -d /tmp/tpx_fed_smoke.XXXXXX)
+if timeout -k 10 300 env JAX_PLATFORMS=cpu FED_DIR="$fed_dir" \
+    TPX_OBS_DIR="$fed_dir/obs" TPX_FEDERATION_DIR="$fed_dir/fed" \
+    TPX_WATCH_INTERVAL=0.1 \
+    python - <<'EOF'
+import json, os, subprocess, sys, time
+
+base = os.environ["FED_DIR"]
+tpx = [sys.executable, "-m", "torchx_tpu.cli.main"]
+cells = {"us-east1": None, "eu-west4": None}
+daemons = []
+try:
+    for name in cells:
+        state = os.path.join(base, name)
+        p = subprocess.Popen(
+            tpx + ["control", "--cell", name, "--state-dir", state],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        daemons.append(p)
+        discovery = os.path.join(state, "control.json")
+        deadline = time.monotonic() + 60
+        while not os.path.exists(discovery):
+            assert p.poll() is None, p.stdout.read()
+            assert time.monotonic() < deadline, f"{name} never wrote discovery"
+            time.sleep(0.1)
+        cells[name] = json.load(open(discovery))
+
+    for name, doc in cells.items():
+        r = subprocess.run(
+            tpx + ["cell", "add", name, "--addr", doc["addr"],
+                   "--token", doc["token"]],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+
+    from torchx_tpu.federation import CellHandle, CellRegistry, FederationRouter
+
+    registry = CellRegistry()
+    assert len(registry) == 2, registry.cells()
+    router = FederationRouter(
+        [CellHandle(spec) for spec in registry.cells()], probe_ttl_s=0.0
+    )
+    log_dir = os.path.join(base, "logs")
+
+    def submit(i):
+        return router.submit(
+            "utils.echo", ["--msg", f"fed-{i}"], "local",
+            cfg={"log_dir": os.path.join(log_dir, str(i))},
+        )
+
+    pre = [submit(i) for i in range(4)]
+    assert all(reply.get("handle") for _, reply in pre), pre
+
+    # drain one cell through the CLI; the router must route away from it
+    r = subprocess.run(
+        tpx + ["cell", "drain", "us-east1", "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    assert json.loads(r.stdout)["draining"] is True, r.stdout
+
+    post = [submit(i) for i in range(4, 10)]  # zero errors: all spill over
+    assert all(cell == "eu-west4" for cell, _ in post), post
+    assert all(reply.get("handle") for _, reply in post), post
+
+    r = subprocess.run(
+        tpx + ["cell", "list", "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    listed = json.loads(r.stdout)["cells"]
+    assert listed["us-east1"]["state"] in ("DRAINING", "DRAINED"), listed
+    assert listed["eu-west4"]["state"] == "HEALTHY", listed
+finally:
+    for p in daemons:
+        p.terminate()
+    for p in daemons:
+        p.wait(timeout=10)
+
+# the cell verb rides the lazy dispatcher: its help never imports jax
+r = subprocess.run(
+    [sys.executable, "-c", (
+        "import sys\n"
+        "from torchx_tpu.cli.main import main\n"
+        "try: main(['cell', '--help'])\n"
+        "except SystemExit: pass\n"
+        "assert 'jax' not in sys.modules, 'tpx cell --help imported jax'\n"
+    )],
+    capture_output=True, text=True, timeout=60,
+)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+EOF
+then echo "FED_SMOKE=ok"; else echo "FED_SMOKE=FAILED"; rc=1; fi
+rm -rf "$fed_dir"
 exit $rc

@@ -76,6 +76,7 @@ Diagnostic codes
 | TPX602 | warning | fleet class ``batch``/``preemptible`` (a preemption-market victim) with neither ``elastic_reshape`` nor a checkpoint-dir flag — every market shrink/preemption costs full progress | make the gang elastic (policy ``elastic_reshape`` + mesh, submit ``elastic=true``) or pass ``--ckpt-dir`` |
 | TPX603 | warning | pipeline promotion stage (``tpx/pipeline=promote`` metadata) on a backend without ``/metricz`` scrape — the canary burn-rate gate sees zero samples and silently degrades to eval-score-only | run the promote stage on a scrape-reachable backend (local, docker, gke, slurm) or accept eval-score-only gating |
 | TPX604 | warning | simulation scenario names a backend other than ``sim`` — the virtual-time harness only drives the modeled executor, so every journaled placement is simulated regardless of the label | set ``"backend": "sim"`` (or drop the key) so the journal cannot be mistaken for a real-backend run |
+| TPX605 | warning | federation config with a single cell (no failover possible — a drain or daemon loss leaves the router nowhere to spill), or a multi-cell promotion wave without per-cell rollback enabled (a bad candidate halted in one region still rolls into the next) | register at least two cells (``tpx cell add``); enable rollback with a finite ``burn_threshold > 0`` on every promote stage of a multi-cell wave |
 | TPX700 | error | deep preflight: sharding propagation found a resharding boundary GSPMD resolves by involuntary full rematerialization (dim-sharded gather/dispatch into a batch/seq-sharded consumer with no output constraint) | pin the gather/combine output with ``with_sharding_constraint`` (see ``models/llama.py forward_features``), or train with ``torchx_tpu.examples.train_llama`` |
 | TPX701 | error | deep preflight: static HBM fit exceeded — params + optimizer + gradients + activations + logits outgrow the per-chip budget under the headroom | raise ``fsdp``/``tp``, lower ``--batch``/``--seq``, or use ``--remat-policy full`` |
 | TPX702 | warning | deep preflight: a DCN-classified mesh axis (``fsdp``/``ep``/``tp``/``sp``) carries ICI-scale collective traffic — cross-slice bandwidth will pace every step | keep fsdp/ep/tp/sp inside a slice; put only dp/pp on the cross-slice dimension |

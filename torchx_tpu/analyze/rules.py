@@ -20,6 +20,7 @@ Code families (full table in docs/api/analyze.md):
 
 from __future__ import annotations
 
+import math
 import os
 from dataclasses import dataclass
 from string import Template
@@ -1243,6 +1244,87 @@ def check_sim_scenario(scenario: Mapping[str, Any]) -> Iterator[Diagnostic]:
             " cannot be mistaken for a real-backend run"
         ),
     )
+
+
+def check_federation_config(
+    config: Mapping[str, Any]
+) -> Iterator[Diagnostic]:
+    """TPX605: a federation setup that cannot actually fail over.
+
+    Like TPX604, not an AppDef rule — federation configs (scenario dicts
+    with a ``cells`` list, or ``tpx cell`` registry snapshots) are plain
+    dicts, called directly by the CLI. Two shapes warn:
+
+    * a single registered cell: every routing decision has exactly one
+      answer, so a drain or daemon loss drops traffic — the federation
+      layer is pure overhead until a second cell exists;
+    * multiple cells with a promotion wave configured but per-cell
+      rollback disabled (``rollback: false``, or a promote stage whose
+      ``burn_threshold`` can never fire): a bad candidate promoted into
+      region 1 rolls on into region 2 — the wave's whole point is that
+      it halts.
+
+    WARNING, never gating: both setups run, they just degrade the
+    property the operator presumably wanted."""
+    cells = list(config.get("cells") or [])
+    if len(cells) < 2:
+        yield Diagnostic(
+            code="TPX605",
+            severity=Severity.WARNING,
+            field="cells",
+            message=(
+                f"federation config has {len(cells)} cell(s) — no"
+                " failover is possible: a drain or daemon loss leaves"
+                " the router nowhere to spill"
+            ),
+            hint=(
+                "register at least two cells (`tpx cell add`) or run"
+                " single-cell without the federation layer"
+            ),
+        )
+        return
+    promote = config.get("promote")
+    stages: list[Mapping[str, Any]] = []
+    if isinstance(promote, Mapping):
+        stages = [promote]
+    for entry in config.get("pipelines") or []:
+        spec = entry.get("spec") if isinstance(entry, Mapping) else None
+        if isinstance(spec, Mapping):
+            for stage in spec.get("stages") or []:
+                if (
+                    isinstance(stage, Mapping)
+                    and str(stage.get("kind", "")) == "promote"
+                ):
+                    stages.append(stage)
+    for stage in stages:
+        rollback_off = stage.get("rollback") is False
+        try:
+            threshold = float(stage.get("burn_threshold", 1.0))
+        except (TypeError, ValueError):
+            threshold = 1.0
+        if rollback_off or threshold <= 0.0 or not math.isfinite(threshold):
+            name = str(stage.get("name", "promote"))
+            yield Diagnostic(
+                code="TPX605",
+                severity=Severity.WARNING,
+                field=f"promote.{name}",
+                message=(
+                    f"multi-cell promotion stage {name!r} has per-cell"
+                    " rollback disabled"
+                    + (
+                        ""
+                        if rollback_off
+                        else f" (burn_threshold={threshold!r} can never"
+                        " fire)"
+                    )
+                    + " — a bad candidate halted in one region will"
+                    " still roll into the next"
+                ),
+                hint=(
+                    "enable rollback and set a finite burn_threshold > 0"
+                    " on every promote stage of a multi-cell wave"
+                ),
+            )
 
 
 # ---------------------------------------------------------------------------

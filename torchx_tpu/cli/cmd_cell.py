@@ -1,0 +1,183 @@
+"""``tpx cell`` — manage federation cells and their drain lifecycle.
+
+Verbs over :mod:`torchx_tpu.federation`:
+
+* ``tpx cell add NAME --addr URL [--token T]`` — register a cell in the
+  durable registry (``$TPX_FEDERATION_DIR/cells.jsonl``). With no
+  ``--addr``, the local daemon's discovery file is used.
+* ``tpx cell remove NAME`` — forget a cell.
+* ``tpx cell list [--json]`` — registry + live probe per cell
+  (reachable, lifecycle state, rehydration, SLO burn).
+* ``tpx cell status NAME`` — one cell's ``/v1/cell`` payload.
+* ``tpx cell drain NAME`` — begin draining: in-flight work finishes,
+  new submits bounce 503, the federation router routes away.
+* ``tpx cell uncordon NAME`` — reopen a drained cell.
+
+Lifecycle: HEALTHY → DRAINING → DRAINED → UNCORDONED (back to HEALTHY).
+Mutating verbs re-run the TPX605 federation check and print its
+warnings to stderr (single-cell federations cannot fail over).
+
+Module level stays jax-free: ``tpx cell --help`` must not import jax —
+the federation/control imports all happen inside ``run()``.
+
+Exit codes: 0 ok, 1 cell unreachable/refused, 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from torchx_tpu.cli.cmd_base import SubCommand
+
+
+class CmdCell(SubCommand):
+    def add_arguments(self, subparser: argparse.ArgumentParser) -> None:
+        sub = subparser.add_subparsers(dest="action", required=True)
+
+        add = sub.add_parser("add", help="register a cell's daemon")
+        add.add_argument("name", help="cell name (the daemon's --cell)")
+        add.add_argument(
+            "--addr",
+            default=None,
+            help="daemon base URL (default: the local daemon's"
+            " discovery file)",
+        )
+        add.add_argument(
+            "--token",
+            default=None,
+            help="bearer token (default: the local discovery file's)",
+        )
+
+        remove = sub.add_parser("remove", help="forget a cell")
+        remove.add_argument("name")
+
+        lst = sub.add_parser(
+            "list", help="registry + live probe of every cell"
+        )
+        lst.add_argument(
+            "--json", action="store_true", help="machine-readable output"
+        )
+
+        for verb, help_text in (
+            ("status", "one cell's /v1/cell payload"),
+            ("drain", "drain a cell: finish in-flight, refuse new work"),
+            ("uncordon", "reopen a drained cell for new traffic"),
+        ):
+            p = sub.add_parser(verb, help=help_text)
+            p.add_argument("name")
+            p.add_argument(
+                "--json", action="store_true", help="machine-readable output"
+            )
+
+    def run(self, args: argparse.Namespace) -> None:
+        from torchx_tpu.federation.cells import CellRegistry
+
+        registry = CellRegistry()
+        if args.action == "add":
+            self._add(registry, args)
+        elif args.action == "remove":
+            if not registry.remove(args.name):
+                print(f"error: unknown cell {args.name!r}", file=sys.stderr)
+                sys.exit(2)
+            print(f"removed cell {args.name}")
+        elif args.action == "list":
+            self._list(registry, args)
+        else:
+            self._cell_verb(registry, args)
+        if args.action in ("add", "remove", "drain"):
+            self._warn_config(registry)
+
+    # -- verbs -------------------------------------------------------------
+
+    def _add(self, registry, args: argparse.Namespace) -> None:
+        addr, token = args.addr, args.token
+        if not addr or token is None:
+            from torchx_tpu.control.client import _discovery
+
+            found = _discovery()
+            if found is None and not addr:
+                print(
+                    "error: no --addr and no local daemon discovery file;"
+                    " start `tpx control` or pass --addr",
+                    file=sys.stderr,
+                )
+                sys.exit(2)
+            if found is not None:
+                addr = addr or found[0]
+                token = token if token is not None else found[1]
+        spec = registry.add(args.name, addr, token or "")
+        print(f"added cell {spec.name} -> {spec.addr}")
+
+    def _handles(self, registry):
+        from torchx_tpu.federation.cells import CellHandle
+
+        return [CellHandle(spec) for spec in registry.cells()]
+
+    def _list(self, registry, args: argparse.Namespace) -> None:
+        rows = {}
+        for handle in self._handles(registry):
+            snap = handle.probe()
+            rows[handle.name] = {
+                "addr": handle.spec.addr,
+                "reachable": snap["reachable"],
+                "state": snap["state"] if snap["reachable"] else "UNREACHABLE",
+                "rehydrated": snap["rehydrated"],
+                "burn": round(float(snap.get("burn", 0.0)), 3),
+            }
+        if args.json:
+            print(json.dumps({"cells": rows}, indent=2, sort_keys=True))
+        else:
+            if not rows:
+                print("no cells registered (tpx cell add NAME --addr URL)")
+            for name, row in sorted(rows.items()):
+                print(
+                    f"{name:16s} {row['state']:12s} burn={row['burn']:<6g}"
+                    f" rehydrated={str(row['rehydrated']).lower():5s}"
+                    f" {row['addr']}"
+                )
+        self._warn_config(registry)
+
+    def _cell_verb(self, registry, args: argparse.Namespace) -> None:
+        from torchx_tpu.control.client import ControlClient, ControlClientError
+
+        spec = registry.get(args.name)
+        if spec is None:
+            print(f"error: unknown cell {args.name!r}", file=sys.stderr)
+            sys.exit(2)
+        client = ControlClient(spec.addr, spec.token, timeout=10.0)
+        try:
+            if args.action == "drain":
+                payload = client.cell_drain()
+            elif args.action == "uncordon":
+                payload = client.cell_uncordon()
+            else:
+                payload = client.cell_status()
+        except ControlClientError as e:
+            print(
+                f"error: cell {args.name}: {e.message} (code {e.code})",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        if args.json:
+            print(json.dumps(payload, indent=2, sort_keys=True))
+        else:
+            reh = payload.get("rehydration") or {}
+            print(
+                f"cell {payload.get('cell')}: {payload.get('state')}"
+                f" (inflight={payload.get('inflight', 0)},"
+                f" rehydrated={str(payload.get('rehydrated')).lower()},"
+                f" journal_jobs={reh.get('journal_jobs', 0)})"
+            )
+
+    def _warn_config(self, registry) -> None:
+        from torchx_tpu.analyze.rules import check_federation_config
+
+        config = {"cells": [s.to_json() for s in registry.cells()]}
+        for diag in check_federation_config(config):
+            print(
+                f"{diag.severity.value}[{diag.code}]: {diag.message}"
+                + (f"\n  hint: {diag.hint}" if diag.hint else ""),
+                file=sys.stderr,
+            )

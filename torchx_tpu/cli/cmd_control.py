@@ -80,6 +80,14 @@ class CmdControl(SubCommand):
             help="telemetry collector cycle"
             " (default $TPX_TELEMETRY_INTERVAL or 5s)",
         )
+        subparser.add_argument(
+            "--cell",
+            default=None,
+            metavar="NAME",
+            help="federation cell name this daemon answers as"
+            " (default $TPX_CELL or 'default'); register it with"
+            " `tpx cell add` to route through the federation layer",
+        )
 
     def run(self, args: argparse.Namespace) -> None:
         from torchx_tpu.control.daemon import ControlDaemon, control_dir
@@ -102,11 +110,13 @@ class CmdControl(SubCommand):
             fleet=fleet,
             slos=args.slo,
             scrape_interval=args.scrape_interval,
+            cell=args.cell,
         )
         recovered = len(daemon.store)
         print(
             f"tpx control: serving on {daemon.addr}"
-            f" (state {daemon.state_dir}, {recovered} jobs rehydrated)",
+            f" (cell {daemon.cell}, state {daemon.state_dir},"
+            f" {recovered} jobs rehydrated)",
             flush=True,
         )
         if fleet is not None:

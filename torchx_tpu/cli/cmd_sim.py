@@ -88,19 +88,35 @@ class CmdSim(SubCommand):
             print(f"error: {e}", file=sys.stderr)
             sys.exit(2)
 
-        from torchx_tpu.analyze.rules import check_sim_scenario
+        # a scenario with a "cells" list is a federation scenario and
+        # runs on the federation harness (TPX605 checks its shape); all
+        # others run the single-cell fleet harness (TPX604)
+        federated = bool(scenario.get("cells"))
+        if federated:
+            from torchx_tpu.analyze.rules import check_federation_config
 
-        for diag in check_sim_scenario(scenario):
+            diags = check_federation_config(scenario)
+        else:
+            from torchx_tpu.analyze.rules import check_sim_scenario
+
+            diags = check_sim_scenario(scenario)
+        for diag in diags:
             print(
                 f"{diag.severity.value}[{diag.code}]: {diag.message}"
                 + (f"\n  hint: {diag.hint}" if diag.hint else ""),
                 file=sys.stderr,
             )
 
-        from torchx_tpu.sim import SimHarness
+        if federated:
+            from torchx_tpu.federation.sim import FederationSimHarness
 
+            harness_cls = FederationSimHarness
+        else:
+            from torchx_tpu.sim import SimHarness
+
+            harness_cls = SimHarness
         try:
-            report = SimHarness(
+            report = harness_cls(
                 scenario,
                 seed=args.seed,
                 state_dir=args.out,
@@ -112,8 +128,32 @@ class CmdSim(SubCommand):
 
         if args.json:
             print(json.dumps(report.to_dict(), indent=2))
+        elif federated:
+            print(self._render_fed(report))
         else:
             print(self._render(report))
+
+    @staticmethod
+    def _render_fed(report) -> str:  # noqa: ANN001 - SimReport
+        s = report.stats
+        per_cell = s.get("per_cell") or {}
+        lines = [
+            f"fed sim: {report.scenario} seed={report.seed} —"
+            f" {report.virtual_s / 3600.0:.2f} virtual hours in"
+            f" {report.wall_s:.2f}s wall ({report.speedup:,.0f}x)",
+            f"  requests: {s.get('requests', 0)} served,"
+            f" {s.get('dropped', 0)} dropped,"
+            f" {s.get('spillovers', 0)} spilled cross-cell",
+            f"  ttft p99: {s.get('ttft_p99_s', 0.0):.3f}s overall"
+            f" (pre {s.get('ttft_p99_pre_s', 0.0):.3f}s,"
+            f" failover {s.get('ttft_p99_during_s', 0.0):.3f}s,"
+            f" post {s.get('ttft_p99_post_s', 0.0):.3f}s)",
+            "  per cell: "
+            + ", ".join(f"{c}={n}" for c, n in sorted(per_cell.items())),
+        ]
+        lines.append(f"journal: {report.journal_path}")
+        lines.append(f"sha256:  {report.journal_sha256}")
+        return "\n".join(lines)
 
     @staticmethod
     def _render(report) -> str:  # noqa: ANN001 - SimReport

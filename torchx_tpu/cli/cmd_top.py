@@ -56,6 +56,25 @@ def build_snapshot(client: Any) -> dict:
             snap[key] = fetch()
         except ControlClientError as e:
             snap[key] = {"error": e.message}
+    # federation panel: the registry's cells, live-probed. Only present
+    # when cells are registered — a single-daemon setup stays clean.
+    try:
+        from torchx_tpu.federation.cells import CellHandle, CellRegistry
+
+        cells = {}
+        for spec in CellRegistry().cells():
+            probe = CellHandle(spec).probe()
+            cells[spec.name] = {
+                "state": (
+                    probe["state"] if probe["reachable"] else "UNREACHABLE"
+                ),
+                "rehydrated": probe["rehydrated"],
+                "burn": round(float(probe.get("burn", 0.0)), 3),
+            }
+        if cells:
+            snap["cells"] = cells
+    except OSError as e:
+        snap["cells"] = {"error": str(e)}
     panels = []
     try:
         names = set(client.metrics_query().get("names", []))
@@ -138,6 +157,21 @@ def render_top(snap: dict) -> str:
                 + "  ".join(
                     f"{name} {b.get('short')}/{b.get('long')}"
                     for name, b in sorted(burns.items())
+                )
+            )
+
+    cells = snap.get("cells")
+    if cells:
+        if "error" in cells:
+            lines.append(f"cells: error: {cells['error']}")
+        else:
+            lines.append(
+                "cells: "
+                + "  ".join(
+                    f"{name}={c.get('state')}"
+                    f"(burn {c.get('burn', 0.0):g})"
+                    + ("" if c.get("rehydrated") else " REHYDRATING")
+                    for name, c in sorted(cells.items())
                 )
             )
 

@@ -48,6 +48,7 @@ BUILTIN_CMDS: dict[str, tuple[str, str]] = {
     "tracker": ("torchx_tpu.cli.cmd_tracker", "CmdTracker"),
     "serve-pool": ("torchx_tpu.cli.cmd_serve_pool", "CmdServePool"),
     "control": ("torchx_tpu.cli.cmd_control", "CmdControl"),
+    "cell": ("torchx_tpu.cli.cmd_cell", "CmdCell"),
     "queue": ("torchx_tpu.cli.cmd_queue", "CmdQueue"),
     "top": ("torchx_tpu.cli.cmd_top", "CmdTop"),
     "pipeline": ("torchx_tpu.cli.cmd_pipeline", "CmdPipeline"),

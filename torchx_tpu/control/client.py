@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import time
 import urllib.error
 import urllib.request
@@ -35,8 +36,26 @@ class ControlClientError(RuntimeError):
         self.message = message
 
 
+class _Throttled(Exception):
+    """Internal: a 429 with its (capped) Retry-After hint attached."""
+
+    def __init__(self, message: str, retry_after: float) -> None:
+        super().__init__(message)
+        self.message = message
+        self.retry_after = retry_after
+
+
 class ControlClient:
-    """Thin JSON-over-HTTP wrapper mirroring the daemon's verb set."""
+    """Thin JSON-over-HTTP wrapper mirroring the daemon's verb set.
+
+    Throttling (HTTP 429) is absorbed here: the daemon has always sent a
+    ``Retry-After`` header plus a ``retry_after_seconds`` body field with
+    its 429s, and the client honors them — capped, jittered sleep, then
+    retry, up to ``retry_429`` attempts — instead of bouncing the error
+    to every caller. A 429'd request was *refused*, never executed, so
+    the replay is idempotent by construction. ``sleep``/``rng`` are
+    injectable so tests assert the backoff without wall time.
+    """
 
     def __init__(
         self,
@@ -44,15 +63,56 @@ class ControlClient:
         token: str,
         timeout: float = DEFAULT_TIMEOUT,
         clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+        retry_429: int = settings.CONTROL_429_MAX_RETRIES,
     ) -> None:
         self.addr = addr.rstrip("/")
         self.token = token
         self.timeout = timeout
+        self.retry_429 = max(0, int(retry_429))
         self._clock = clock
+        self._sleep = sleep
+        self._rng = rng or random.Random()
 
     # -- plumbing ----------------------------------------------------------
 
+    def _retry_after(self, err: urllib.error.HTTPError, body: dict) -> float:
+        """The daemon's throttle hint, header first (the HTTP-standard
+        spelling), body field second, default third — capped so a bogus
+        hint cannot park the caller."""
+        raw = err.headers.get("Retry-After") if err.headers else None
+        if raw is None:
+            raw = body.get("retry_after_seconds")
+        try:
+            hint = float(raw) if raw is not None else float(
+                settings.CONTROL_RETRY_AFTER_SECONDS
+            )
+        except (TypeError, ValueError):
+            hint = float(settings.CONTROL_RETRY_AFTER_SECONDS)
+        return max(0.0, min(hint, settings.CONTROL_429_RETRY_CAP_SECONDS))
+
     def _request(
+        self,
+        path: str,
+        payload: Optional[dict] = None,
+        timeout: Optional[float] = None,
+    ) -> dict:
+        attempt = 0
+        while True:
+            try:
+                return self._request_once(path, payload, timeout)
+            except _Throttled as t:
+                if attempt >= self.retry_429:
+                    raise ControlClientError(429, t.message) from t
+                attempt += 1
+                # ±10% jitter so N throttled clients don't re-dial in
+                # one synchronized wave when the hint expires
+                self._sleep(
+                    t.retry_after * (1.0 + self._rng.uniform(-0.1, 0.1))
+                )
+
+    def _request_once(
         self,
         path: str,
         payload: Optional[dict] = None,
@@ -74,9 +134,12 @@ class ControlClient:
                 return json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:
             try:
-                message = json.loads(e.read() or b"{}").get("error", str(e))
+                body = json.loads(e.read() or b"{}")
+                message = body.get("error", str(e))
             except ValueError:
-                message = str(e)
+                body, message = {}, str(e)
+            if e.code == 429:
+                raise _Throttled(message, self._retry_after(e, body)) from e
             raise ControlClientError(e.code, message) from e
         except (urllib.error.URLError, OSError, ValueError) as e:
             raise ControlClientError(0, f"control daemon unreachable: {e}") from e
@@ -237,6 +300,22 @@ class ControlClient:
         their backends and the pipeline journals CANCELLED."""
         return self._request("/v1/pipelines/cancel", {"pipeline": pipeline})
 
+    def cell_status(self) -> dict:
+        """The daemon's federation-cell identity + lifecycle
+        (``GET /v1/cell``): ``{"cell", "state", "draining",
+        "rehydrated", "rehydration"}``."""
+        return self._request("/v1/cell")
+
+    def cell_drain(self) -> dict:
+        """Begin draining this cell: in-flight work keeps running, new
+        submissions are refused with 503 so a federation router spills
+        them to the next-best cell."""
+        return self._request("/v1/cell/drain", {})
+
+    def cell_uncordon(self) -> dict:
+        """Reopen a drained/draining cell for new traffic."""
+        return self._request("/v1/cell/uncordon", {})
+
     def status(self, handle: str) -> dict:
         """One job's recorded state: answered from the daemon's
         reconciler journal + shared describe cache, not a fresh backend
@@ -258,21 +337,51 @@ class ControlClient:
         """Cancel the job on its backend (and release the tenant's slot)."""
         self._request("/v1/cancel", {"handle": handle})
 
+    #: consecutive transport failures :meth:`wait` rides out before the
+    #: error surfaces (a daemon restart drops every in-flight long-poll;
+    #: the journal-rehydrated successor answers the re-issued one).
+    WAIT_RECONNECT_ATTEMPTS = 10
+
     def wait(self, handle: str, timeout: Optional[float] = None) -> dict:
         """Block until terminal: chained bounded long-polls against
         ``/v1/wait`` (each HTTP request stays short; the daemon's
-        reconciler wakes it the moment the terminal event lands)."""
+        reconciler wakes it the moment the terminal event lands).
+
+        A transport failure mid-chain — the daemon restarting under the
+        wait is the common case — is retried with capped jittered
+        backoff instead of erroring: the successor daemon rehydrates its
+        journal, so the re-issued poll resolves against the recorded
+        (possibly already-terminal) state. Only
+        :data:`WAIT_RECONNECT_ATTEMPTS` *consecutive* failures surface.
+        """
         deadline = None if timeout is None else self._clock() + timeout
         from urllib.parse import quote
 
+        transport_failures = 0
         while True:
             budget = 30.0
             if deadline is not None:
                 budget = min(budget, max(0.1, deadline - self._clock()))
-            payload = self._request(
-                f"/v1/wait?handle={quote(handle, safe='')}&timeout={budget:g}",
-                timeout=budget + 15.0,
-            )
+            try:
+                payload = self._request(
+                    f"/v1/wait?handle={quote(handle, safe='')}"
+                    f"&timeout={budget:g}",
+                    timeout=budget + 15.0,
+                )
+            except ControlClientError as e:
+                if e.code != 0:
+                    raise  # a real HTTP verdict (401/404/...) is final
+                transport_failures += 1
+                if transport_failures >= self.WAIT_RECONNECT_ATTEMPTS:
+                    raise
+                if deadline is not None and self._clock() >= deadline:
+                    raise TimeoutError(
+                        f"app {handle} unreachable at deadline: {e.message}"
+                    ) from e
+                delay = min(0.25 * (2.0 ** (transport_failures - 1)), 5.0)
+                self._sleep(delay * (1.0 + self._rng.uniform(-0.1, 0.1)))
+                continue
+            transport_failures = 0
             if payload.get("terminal"):
                 return payload
             if deadline is not None and self._clock() >= deadline:

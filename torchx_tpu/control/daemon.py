@@ -34,6 +34,22 @@ API (JSON; Bearer-token auth on every ``/v1`` route):
                                   promote DAG to the pipeline engine
     GET  /v1/pipelines[?pipeline=] -> one pipeline's full record, or all
     POST /v1/pipelines/cancel {"pipeline"} -> the cancelled record
+    GET  /v1/cell                 -> federation identity + lifecycle:
+                                  {"cell", "state", "draining",
+                                  "rehydrated", "rehydration", "inflight"}
+    POST /v1/cell/drain           -> begin draining (durable): in-flight
+                                  work finishes, new submits bounce 503
+    POST /v1/cell/uncordon        -> reopen a drained cell for traffic
+
+Every daemon is one federation *cell* (``--cell``/``$TPX_CELL``,
+default ``default``): journal records carry the cell name, ``/healthz``
+reports rehydration progress so a router can tell "booting, journal
+replaying" from "healthy", and the drain verbs drive the
+HEALTHY → DRAINING → DRAINED → UNCORDONED lifecycle the
+:mod:`torchx_tpu.federation` router keys off. While draining, submit
+verbs (``/v1/submit``, ``/v1/pipelines``) refuse with 503 +
+``{"code": "cell_draining"}`` — a deliberate *don't-retry-here* verdict:
+the federation router spills the request to the next-best cell instead.
 
 The daemon also hosts the fleet **telemetry plane**: a
 :class:`~torchx_tpu.obs.telemetry.Collector` scrapes registered replica
@@ -168,6 +184,7 @@ class _FleetExecutor:
                 app_id=app_id,
                 state=AppState.SUBMITTED,
                 source="fleet",
+                cell=daemon.cell,
             )
         )
         daemon.reconciler.track(
@@ -222,6 +239,7 @@ class _PipelineExecutor:
                 app_id=app_id,
                 state=AppState.SUBMITTED,
                 source="pipeline",
+                cell=daemon.cell,
             )
         )
         daemon.reconciler.track(
@@ -308,6 +326,10 @@ class ControlDaemon:
             :data:`~torchx_tpu.settings.DEFAULT_TELEMETRY_INTERVAL`).
         telemetry: set False to run without the collector/SLO plane
             (``/metricz`` then serves only the daemon's own registry).
+        cell: federation cell name this daemon answers as (default
+            ``$TPX_CELL`` or
+            :data:`~torchx_tpu.settings.DEFAULT_CELL_NAME`). Stamped
+            into every journal record and served on ``/v1/cell``.
     """
 
     def __init__(
@@ -323,6 +345,7 @@ class ControlDaemon:
         telemetry: bool = True,
         pipeline_pool_provider: Optional[Any] = None,
         clock: Callable[[], float] = time.monotonic,
+        cell: Optional[str] = None,
     ) -> None:
         if runner is None:
             from torchx_tpu.runner.api import get_runner
@@ -331,12 +354,38 @@ class ControlDaemon:
         self.runner = runner
         self.clock = clock
         self.state_dir = state_dir or control_dir()
+        self.cell = (
+            cell
+            or os.environ.get(settings.ENV_TPX_CELL, "").strip()
+            or settings.DEFAULT_CELL_NAME
+        )
+        # rehydration status, surfaced on /healthz so a federation
+        # router (and operators) can tell "booting, journal replaying"
+        # from "healthy" — routers treat a not-yet-rehydrated cell as
+        # drained. Flipped True as the LAST act of __init__.
+        self.rehydrated = False
+        self.rehydration = {
+            "journal_jobs": 0,
+            "fleet_reowned": 0,
+            "pipelines_reowned": 0,
+        }
+        # drain state is durable (state_dir/cell.json): a drained cell
+        # that restarts comes back drained — the operator uncordons, not
+        # the crash
+        self._cell_path = os.path.join(self.state_dir, "cell.json")
+        self._draining = False
+        try:
+            with open(self._cell_path) as f:
+                self._draining = bool(json.load(f).get("draining"))
+        except (OSError, ValueError):
+            pass
         self.tenant_cap = (
             tenant_cap
             if tenant_cap is not None
             else settings.DEFAULT_CONTROL_TENANT_CAP
         )
         self.store = JobStateStore(os.path.join(self.state_dir, "store"))
+        self.rehydration["journal_jobs"] = len(self.store)
         self.reconciler = Reconciler(store=self.store, clock=clock)
         runner.attach_reconciler(self.reconciler)
         self.root_token = secrets.token_hex(16)
@@ -402,6 +451,7 @@ class ControlDaemon:
                     self.reconciler.track(
                         sched_name, runner._scheduler(sched_name), app_id
                     )
+                    self.rehydration["fleet_reowned"] += 1
                 except Exception as e:  # noqa: BLE001 - degrade to poll
                     logger.warning(
                         "fleet rehydrate: cannot track %s: %s", handle, e
@@ -438,10 +488,18 @@ class ControlDaemon:
                     runner._scheduler(item["scheduler"]),
                     item["app_id"],
                 )
+                self.rehydration["pipelines_reowned"] += 1
             except Exception as e:  # noqa: BLE001 - degrade to poll
                 logger.warning(
                     "pipeline rehydrate: cannot track %s: %s", handle, e
                 )
+        self.rehydrated = True
+        obs_metrics.FED_CELL_STATE.set(
+            float(obs_metrics.CELL_STATE_VALUES["DRAINING"])
+            if self._draining
+            else float(obs_metrics.CELL_STATE_VALUES["HEALTHY"]),
+            cell=self.cell,
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -593,6 +651,7 @@ class ControlDaemon:
         scheduler = req.get("scheduler")
         if not component or not scheduler:
             raise _DaemonError(400, "submit needs component and scheduler")
+        self._check_not_draining()
         if self.fleet is not None:
             return self._op_fleet_submit(tenant, req)
         active = self._active_jobs(tenant)
@@ -635,6 +694,7 @@ class ControlDaemon:
                 app_id=app_id,
                 state=AppState.SUBMITTED,
                 source="daemon",
+                cell=self.cell,
             )
         )
         self.reconciler.track(
@@ -823,6 +883,97 @@ class ControlDaemon:
             raise _DaemonError(400, f"missing query parameter {key!r}")
         return str(vals[0])
 
+    # -- federation cell lifecycle -----------------------------------------
+
+    def _inflight(self) -> int:
+        """Jobs whose last journaled state is still live, across all
+        tenants — the number a draining cell waits on before it counts
+        as DRAINED."""
+        with self._lock:
+            handles = list(self._jobs)
+        n = 0
+        for handle in handles:
+            scheduler, app_id = self._split_handle(handle)
+            event = self.reconciler.latest(
+                scheduler, app_id
+            ) or self.store.latest(scheduler, app_id)
+            if event is None or not (
+                event.terminal or event.state == AppState.UNKNOWN
+            ):
+                n += 1
+        return n
+
+    def _cell_state(self) -> str:
+        """The lifecycle label: DRAINING until in-flight work finishes,
+        then DRAINED; HEALTHY when not draining."""
+        if not self._draining:
+            return "HEALTHY"
+        return "DRAINING" if self._inflight() > 0 else "DRAINED"
+
+    def cell_payload(self) -> dict:
+        """The ``/v1/cell`` body: identity + lifecycle + rehydration."""
+        state = self._cell_state()
+        obs_metrics.FED_CELL_STATE.set(
+            float(obs_metrics.CELL_STATE_VALUES.get(state, 0)),
+            cell=self.cell,
+        )
+        return {
+            "cell": self.cell,
+            "state": state,
+            "draining": self._draining,
+            "inflight": self._inflight(),
+            "rehydrated": self.rehydrated,
+            "rehydration": dict(self.rehydration),
+        }
+
+    def _persist_cell(self) -> None:
+        from torchx_tpu.util.jsonl import rewrite_json
+
+        rewrite_json(
+            self._cell_path, {"cell": self.cell, "draining": self._draining}
+        )
+
+    def _check_not_draining(self) -> None:
+        """503 new work away while draining. Deliberately NOT a 429: the
+        client must not retry against this daemon — the federation
+        router reads ``code: cell_draining`` and spills to another cell."""
+        if self._draining:
+            raise _DaemonError(
+                503,
+                f"cell {self.cell!r} is draining; submit elsewhere",
+                payload={
+                    "code": "cell_draining",
+                    "cell": self.cell,
+                    "state": self._cell_state(),
+                },
+                headers={
+                    "Retry-After": str(settings.CONTROL_RETRY_AFTER_SECONDS)
+                },
+            )
+
+    def _op_cell(self, tenant: str, query: dict) -> dict:
+        return self.cell_payload()
+
+    def _op_cell_drain(self, tenant: str, req: dict) -> dict:
+        """Begin draining: durable flag first (journal-before-act), then
+        refuse new submits. In-flight jobs keep running to terminal."""
+        self._draining = True
+        self._persist_cell()
+        logger.info("cell %s draining (%d in flight)", self.cell, self._inflight())
+        return self.cell_payload()
+
+    def _op_cell_uncordon(self, tenant: str, req: dict) -> dict:
+        """Reopen the cell; reports the transitional UNCORDONED label
+        once (subsequent reads say HEALTHY)."""
+        was_draining = self._draining
+        self._draining = False
+        self._persist_cell()
+        payload = self.cell_payload()
+        if was_draining:
+            payload["state"] = "UNCORDONED"
+        logger.info("cell %s uncordoned", self.cell)
+        return payload
+
     # -- telemetry plane ---------------------------------------------------
 
     def _ingest_self(self) -> None:
@@ -909,6 +1060,7 @@ class ControlDaemon:
         """``POST /v1/pipelines``: validate the spec, journal, start."""
         from torchx_tpu.pipelines.dag import PipelineSpec
 
+        self._check_not_draining()
         doc = req.get("spec")
         if not isinstance(doc, dict):
             raise _DaemonError(400, "submit needs a 'spec' object")
@@ -1013,11 +1165,17 @@ class ControlDaemon:
                     self._reply(
                         200,
                         {
-                            "status": "ok",
+                            "status": (
+                                "ok" if daemon.rehydrated else "rehydrating"
+                            ),
                             "jobs": len(daemon.store),
                             "addr": daemon.addr,
                             "tenant_cap": daemon.tenant_cap,
                             "fleet": daemon.fleet is not None,
+                            "cell": daemon.cell,
+                            "draining": daemon._draining,
+                            "rehydrated": daemon.rehydrated,
+                            "rehydration": dict(daemon.rehydration),
                         },
                     )
                 elif url.path == "/metricz":
@@ -1066,6 +1224,10 @@ class ControlDaemon:
                             self._tenant(), query
                         ),
                     )
+                elif url.path == "/v1/cell":
+                    self._run(
+                        "cell", lambda: daemon._op_cell(self._tenant(), query)
+                    )
                 elif url.path == "/v1/logs":
                     self._logs(query)
                 else:
@@ -1106,6 +1268,20 @@ class ControlDaemon:
                     self._run(
                         "pipeline_cancel",
                         lambda: daemon._op_pipeline_cancel(
+                            self._tenant(), self._body()
+                        ),
+                    )
+                elif url.path == "/v1/cell/drain":
+                    self._run(
+                        "cell_drain",
+                        lambda: daemon._op_cell_drain(
+                            self._tenant(), self._body()
+                        ),
+                    )
+                elif url.path == "/v1/cell/uncordon":
+                    self._run(
+                        "cell_uncordon",
+                        lambda: daemon._op_cell_uncordon(
                             self._tenant(), self._body()
                         ),
                     )

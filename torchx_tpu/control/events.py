@@ -33,6 +33,10 @@ class StateEvent:
         time_usec: observation wall-clock stamp.
         resp: the confirming describe response, when the adapter made one
             (terminal transitions always do).
+        cell: federation cell the observing control daemon belongs to
+            (empty outside a daemon / in single-cell direct mode). Makes
+            every journal record cell-addressable, so merged multi-cell
+            journals stay attributable.
     """
 
     scheduler: str
@@ -41,6 +45,7 @@ class StateEvent:
     source: str = "poll"
     time_usec: int = field(default_factory=epoch_usec)
     resp: Optional[DescribeAppResponse] = None
+    cell: str = ""
 
     @property
     def terminal(self) -> bool:
@@ -48,14 +53,19 @@ class StateEvent:
         return is_terminal(self.state)
 
     def serialize(self) -> dict:
-        """JSONL-safe record (the JobStateStore's line format)."""
-        return {
+        """JSONL-safe record (the JobStateStore's line format). The
+        ``cell`` key is written only when set, so single-cell journals
+        keep their pre-federation byte format."""
+        doc = {
             "scheduler": self.scheduler,
             "app_id": self.app_id,
             "state": self.state.name,
             "source": self.source,
             "time_usec": self.time_usec,
         }
+        if self.cell:
+            doc["cell"] = self.cell
+        return doc
 
     @staticmethod
     def deserialize(doc: dict) -> "StateEvent":
@@ -71,6 +81,7 @@ class StateEvent:
             state=state,
             source=str(doc.get("source", "poll")),
             time_usec=int(doc.get("time_usec", 0) or 0),
+            cell=str(doc.get("cell", "")),
         )
 
 

@@ -1,0 +1,68 @@
+"""Multi-cell federation: SLO-burn-aware global routing with drain failover.
+
+One control daemon owning one fleet is one failure domain. This package
+puts a thin federation layer over N regional *cells* — each cell is an
+ordinary ``tpx control`` daemon (plus its fleet and serve pool) made
+cell-addressable by PR 19's ``--cell`` identity — whose headline
+property is **graceful degradation under cell loss**: a drained,
+partitioned, or killed cell costs latency, never requests.
+
+The pieces:
+
+- :class:`~torchx_tpu.federation.cells.CellRegistry` — the durable
+  address book (``$TPX_FEDERATION_DIR/cells.jsonl``), journaled with the
+  same append-only idiom as every other tpx store. Lifecycle state lives
+  in each cell's daemon (durable across its restarts), not here — the
+  registry only answers *where the cells are*.
+- :class:`~torchx_tpu.federation.cells.CellHandle` — one cell's client +
+  per-cell :class:`~torchx_tpu.resilience.breaker.CircuitBreaker` +
+  cached health/burn probe.
+- :class:`~torchx_tpu.federation.router.FederationRouter` — scores cells
+  by SLO burn rate (each daemon's ``/v1/alerts`` long-window burns) and
+  prefix-cache affinity (PR 12's positional digest chains, exported
+  cross-cell), dispatches to the best admissible cell, and spills to the
+  next-best on drain/overload/unreachability with capped jittered
+  backoff. Not-yet-rehydrated cells count as drained; a cell over its
+  burn budget is demoted, not excluded.
+- :class:`~torchx_tpu.federation.promote.FederationPromoter` — rolls a
+  train→eval→promote pipeline region by region, halting the wave the
+  moment any cell rolls back or exceeds the burn threshold.
+- :class:`~torchx_tpu.federation.sim.FederationSimHarness` — the
+  two-cell drain/kill scenario replayed deterministically in virtual
+  time (``tpx sim run --scenario federation-two-cell``), driving the
+  *production* router.
+
+Cell lifecycle: ``HEALTHY → DRAINING → DRAINED → UNCORDONED`` (uncordon
+returns the cell to HEALTHY; the UNCORDONED label is the transitional
+acknowledgment). ``tpx cell`` drives it from the CLI.
+"""
+
+from torchx_tpu.federation.cells import (
+    CellHandle,
+    CellRegistry,
+    CellSpec,
+    DRAINED,
+    DRAINING,
+    HEALTHY,
+    LIFECYCLE,
+    UNCORDONED,
+    federation_dir,
+)
+from torchx_tpu.federation.promote import FederationPromoter, WaveResult
+from torchx_tpu.federation.router import FederationError, FederationRouter
+
+__all__ = [
+    "HEALTHY",
+    "DRAINING",
+    "DRAINED",
+    "UNCORDONED",
+    "LIFECYCLE",
+    "CellSpec",
+    "CellHandle",
+    "CellRegistry",
+    "FederationError",
+    "FederationRouter",
+    "FederationPromoter",
+    "WaveResult",
+    "federation_dir",
+]

@@ -1,0 +1,241 @@
+"""Cell identity, the durable cell registry, and per-cell handles.
+
+A *cell* is one control daemon (plus whatever fleet/serve planes it
+owns) addressed by name. The registry is the federation's address book:
+an append-only JSONL journal under ``$TPX_FEDERATION_DIR`` replayed on
+load, same idiom as every other tpx store. It records *where cells are*
+— their lifecycle state (draining/drained) is owned by each cell's own
+daemon and survives that daemon's restarts via its ``cell.json``, so a
+registry copied between operator machines never disagrees with the
+cells themselves about health.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from torchx_tpu import settings
+from torchx_tpu.control.client import ControlClient, ControlClientError
+from torchx_tpu.resilience.breaker import CircuitBreaker
+
+__all__ = [
+    "HEALTHY",
+    "DRAINING",
+    "DRAINED",
+    "UNCORDONED",
+    "LIFECYCLE",
+    "CellSpec",
+    "CellHandle",
+    "CellRegistry",
+    "federation_dir",
+]
+
+#: lifecycle label: accepting traffic.
+HEALTHY = "HEALTHY"
+#: lifecycle label: refusing new work, finishing in-flight work.
+DRAINING = "DRAINING"
+#: lifecycle label: draining finished — nothing in flight, nothing new.
+DRAINED = "DRAINED"
+#: lifecycle label: the transitional acknowledgment of an uncordon
+#: (subsequent reads say HEALTHY).
+UNCORDONED = "UNCORDONED"
+
+#: the full cell lifecycle, in order.
+LIFECYCLE = (HEALTHY, DRAINING, DRAINED, UNCORDONED)
+
+
+def federation_dir() -> str:
+    """State root for the federation layer: ``$TPX_FEDERATION_DIR``,
+    default ``~/.torchx_tpu/federation``."""
+    raw = os.environ.get(settings.ENV_TPX_FEDERATION_DIR)
+    if raw and raw.strip():
+        return raw
+    return os.path.join(os.path.expanduser("~"), ".torchx_tpu", "federation")
+
+
+@dataclass(frozen=True)
+class CellSpec:
+    """One registry entry: how to reach one cell's daemon."""
+
+    #: cell name (the daemon's ``--cell`` identity).
+    name: str
+    #: daemon base URL, e.g. ``http://127.0.0.1:PORT``.
+    addr: str
+    #: bearer token for the daemon's ``/v1`` routes.
+    token: str = ""
+
+    def to_json(self) -> dict:
+        """Plain-dict form for the registry journal."""
+        return {"cell": self.name, "addr": self.addr, "token": self.token}
+
+
+class CellRegistry:
+    """The durable cell address book.
+
+    Append-only JSONL journal (``cells.jsonl``, 0600 — it carries
+    tokens) replayed on load: ``add`` rows upsert, ``remove`` rows
+    delete, last writer wins. Mutations journal-then-apply, so a crash
+    between the two replays to the journaled state.
+    """
+
+    JOURNAL = "cells.jsonl"
+
+    def __init__(self, root: Optional[str] = None) -> None:
+        self.root = root or federation_dir()
+        self.path = os.path.join(self.root, self.JOURNAL)
+        self._cells: dict[str, CellSpec] = {}
+        self._rehydrate()
+
+    def _rehydrate(self) -> None:
+        from torchx_tpu.util.jsonl import iter_jsonl
+
+        for row in iter_jsonl(self.path):
+            op = str(row.get("op", ""))
+            name = str(row.get("cell", ""))
+            if not name:
+                continue
+            if op == "add":
+                self._cells[name] = CellSpec(
+                    name=name,
+                    addr=str(row.get("addr", "")),
+                    token=str(row.get("token", "")),
+                )
+            elif op == "remove":
+                self._cells.pop(name, None)
+
+    def _journal(self, row: dict) -> None:
+        from torchx_tpu.util.jsonl import append_jsonl
+
+        os.makedirs(self.root, exist_ok=True)
+        append_jsonl(self.path, row)
+        os.chmod(self.path, 0o600)
+
+    def add(self, name: str, addr: str, token: str = "") -> CellSpec:
+        """Register (or re-address) a cell."""
+        if not name or not addr:
+            raise ValueError("cell add needs a name and an addr")
+        spec = CellSpec(name=name, addr=addr.rstrip("/"), token=token)
+        self._journal({"op": "add", **spec.to_json()})
+        self._cells[name] = spec
+        return spec
+
+    def remove(self, name: str) -> bool:
+        """Forget a cell; False when it was never registered."""
+        if name not in self._cells:
+            return False
+        self._journal({"op": "remove", "cell": name})
+        del self._cells[name]
+        return True
+
+    def get(self, name: str) -> Optional[CellSpec]:
+        """One cell's spec, or None."""
+        return self._cells.get(name)
+
+    def cells(self) -> list[CellSpec]:
+        """All registered cells, name-sorted (deterministic routing
+        tie-break order)."""
+        return [self._cells[k] for k in sorted(self._cells)]
+
+    def __len__(self) -> int:
+        return len(self._cells)
+
+
+class CellHandle:
+    """One cell as the router sees it: client + breaker + cached probe.
+
+    The probe collapses ``/healthz`` + ``/v1/cell`` + ``/v1/alerts``
+    into one snapshot dict; dial failures feed the per-cell
+    :class:`~torchx_tpu.resilience.breaker.CircuitBreaker` so a dead
+    daemon fails fast instead of stacking timeouts on every request.
+    ``prefix_digests`` holds the cell's exported prefix-cache chain
+    digests (PR 12) for the router's affinity score — fed by
+    :meth:`update_prefix_digests` from each cell's serve pool summary.
+    """
+
+    def __init__(
+        self,
+        spec: CellSpec,
+        client: Optional[ControlClient] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.spec = spec
+        # probes must not block routing: short timeout, no 429 loitering
+        self.client = client or ControlClient(
+            spec.addr, spec.token, timeout=5.0, retry_429=0
+        )
+        self.breaker = breaker or CircuitBreaker(
+            f"cell:{spec.name}",
+            trip_after=settings.FEDERATION_BREAKER_TRIP_AFTER,
+            cooldown_seconds=settings.FEDERATION_BREAKER_COOLDOWN_SECONDS,
+            clock=clock,
+        )
+        self.prefix_digests: set[str] = set()
+        #: last probe snapshot (see :meth:`probe`); starts pessimistic.
+        self.last_probe: dict = {"reachable": False}
+        #: clock() stamp of the last probe, -inf = never.
+        self.probed_at: float = float("-inf")
+        self._clock = clock
+
+    @property
+    def name(self) -> str:
+        """The cell's registry name."""
+        return self.spec.name
+
+    def update_prefix_digests(self, digests) -> None:
+        """Replace the cell's exported prefix-chain digest set (from its
+        serve pool's ``federation_summary()``)."""
+        self.prefix_digests = set(str(d) for d in digests)
+
+    def probe(self) -> dict:
+        """Refresh and return the cached health snapshot.
+
+        ``{"reachable", "rehydrated", "draining", "state", "burn"}`` —
+        ``state`` is the daemon's lifecycle label, ``burn`` the max
+        long-window SLO burn across its SLOs (0.0 when none evaluate).
+        A transport failure records on the breaker and yields
+        ``reachable: False``; a not-yet-rehydrated daemon is reachable
+        but the router treats it as drained.
+        """
+        snap: dict = {
+            "reachable": False,
+            "rehydrated": False,
+            "draining": False,
+            "state": DRAINED,
+            "burn": 0.0,
+        }
+        try:
+            cell = self.client.cell_status()
+            snap["reachable"] = True
+            snap["rehydrated"] = bool(cell.get("rehydrated"))
+            snap["draining"] = bool(cell.get("draining"))
+            snap["state"] = str(cell.get("state", HEALTHY))
+            self.breaker.record_success()
+        except ControlClientError as e:
+            if e.code == 0:
+                self.breaker.record_failure()
+            elif e.code == 404:
+                # pre-federation daemon: no /v1/cell route — reachable,
+                # never drains, rehydration unknown -> assume complete
+                snap.update(
+                    reachable=True, rehydrated=True, state=HEALTHY
+                )
+                self.breaker.record_success()
+            self.last_probe = snap
+            self.probed_at = self._clock()
+            return snap
+        try:
+            alerts = self.client.alerts()
+            burns = alerts.get("burns") or {}
+            snap["burn"] = max(
+                (float(b.get("long", 0.0)) for b in burns.values()),
+                default=0.0,
+            )
+        except ControlClientError:
+            pass  # burn stays 0.0: no telemetry is not unhealth
+        self.last_probe = snap
+        self.probed_at = self._clock()
+        return snap

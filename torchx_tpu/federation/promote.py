@@ -1,0 +1,165 @@
+"""Region-by-region promotion waves with per-cell rollback.
+
+A single-cell promotion is PR 15's pipeline engine: train → eval →
+canary → promote-or-rollback inside one daemon.
+:class:`FederationPromoter` lifts that to N cells *sequentially*: the
+candidate rolls into one region at a time, and the wave halts the
+moment any cell's pipeline rolls back (its own canary gate fired) or
+the cell's observed SLO burn crosses the threshold — the remaining
+regions never see the candidate. Each cell's rollback is the engine's
+own (PR 15 journal-before-act), so a halted wave leaves every touched
+cell either fully promoted or fully restored, never half-rolled.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from torchx_tpu import settings
+from torchx_tpu.control.client import ControlClientError
+from torchx_tpu.federation.router import FederationRouter
+
+__all__ = ["FederationPromoter", "WaveResult"]
+
+#: pipeline terminal states that halt the wave.
+_HALTING_STATES = frozenset({"ROLLED_BACK", "FAILED", "CANCELLED"})
+#: pipeline terminal states that advance the wave.
+_ADVANCE_STATES = frozenset({"PROMOTED", "SUCCEEDED"})
+
+
+@dataclass
+class WaveResult:
+    """One wave's outcome, cell by cell."""
+
+    #: cells whose pipeline reached PROMOTED/SUCCEEDED.
+    promoted: list[str] = field(default_factory=list)
+    #: cells the wave never reached (halted earlier).
+    skipped: list[str] = field(default_factory=list)
+    #: per-cell record: {"pipeline", "state", "reason"}.
+    cells: dict = field(default_factory=dict)
+    #: True when the wave stopped before the last cell.
+    halted: bool = False
+    #: why the wave halted ("" when it ran to completion).
+    halt_reason: str = ""
+
+    def to_dict(self) -> dict:
+        """JSON form for the CLI."""
+        return {
+            "promoted": list(self.promoted),
+            "skipped": list(self.skipped),
+            "cells": dict(self.cells),
+            "halted": self.halted,
+            "halt_reason": self.halt_reason,
+        }
+
+
+class FederationPromoter:
+    """Drives one pipeline spec through cells in order.
+
+    Args:
+        router: the federation router (cell handles + probes).
+        burn_threshold: observed per-cell long-window burn at/above
+            which the wave halts even if the cell's pipeline promoted —
+            the next region must not inherit a candidate that is
+            burning its first region's SLO.
+        poll_interval_s: pipeline status poll cadence.
+        timeout_s: per-cell ceiling from submit to terminal.
+        clock/sleep: injectable for tests.
+    """
+
+    def __init__(
+        self,
+        router: FederationRouter,
+        burn_threshold: float = settings.DEFAULT_FEDERATION_BURN_BUDGET,
+        poll_interval_s: float = 0.5,
+        timeout_s: float = 600.0,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
+        self.router = router
+        self.burn_threshold = float(burn_threshold)
+        self.poll_interval_s = float(poll_interval_s)
+        self.timeout_s = float(timeout_s)
+        self._clock = clock
+        self._sleep = sleep
+
+    def _wave_order(self, order: Optional[list[str]]) -> list[str]:
+        """Explicit order, else healthiest-first (lowest burn): the cell
+        most likely to absorb a bad candidate cheaply goes first."""
+        if order:
+            return list(order)
+        snap = self.router.snapshot()
+        return sorted(snap, key=lambda n: (snap[n].get("burn", 0.0), n))
+
+    def run_wave(
+        self, spec: dict, order: Optional[list[str]] = None
+    ) -> WaveResult:
+        """Submit ``spec`` (a PipelineSpec dict) to each cell in turn.
+
+        A cell whose daemon refuses the submit (draining, unreachable)
+        is recorded as skipped *without* halting the wave — routing away
+        from a drained region is normal operation, not a bad candidate.
+        A pipeline that rolls back, fails, times out, or leaves the cell
+        burning at/over ``burn_threshold`` halts the wave.
+        """
+        result = WaveResult()
+        names = self._wave_order(order)
+        handles = {h.name: h for h in self.router.cells()}
+        for i, name in enumerate(names):
+            handle = handles.get(name)
+            if handle is None:
+                result.cells[name] = {"state": "UNKNOWN_CELL", "reason": ""}
+                continue
+            if result.halted:
+                result.skipped.append(name)
+                continue
+            try:
+                reply = handle.client.pipeline_submit(spec)
+                pid = str(reply.get("pipeline", ""))
+            except ControlClientError as e:
+                result.cells[name] = {
+                    "state": "UNREACHED",
+                    "reason": f"{e.code}: {e.message}",
+                }
+                continue
+            record = self._await_terminal(handle, pid)
+            state = str(record.get("state", ""))
+            result.cells[name] = {
+                "pipeline": pid,
+                "state": state,
+                "reason": str(record.get("reason", "")),
+            }
+            burn = float(handle.probe().get("burn", 0.0))
+            if state in _ADVANCE_STATES and burn < self.burn_threshold:
+                result.promoted.append(name)
+                continue
+            result.halted = True
+            result.halt_reason = (
+                f"cell {name}: pipeline {state or 'TIMEOUT'}"
+                if state not in _ADVANCE_STATES
+                else f"cell {name}: burn {burn:.2f} >="
+                f" {self.burn_threshold:.2f} after promote"
+            )
+            result.skipped.extend(names[i + 1 :])
+            break
+        return result
+
+    def _await_terminal(self, handle, pid: str) -> dict:
+        """Poll one cell's pipeline to terminal (bounded)."""
+        deadline = self._clock() + self.timeout_s
+        record: dict = {}
+        while self._clock() < deadline:
+            try:
+                record = handle.client.pipeline_status(pid)
+            except ControlClientError as e:
+                if e.code != 0:
+                    return {"state": "FAILED", "reason": e.message}
+                # transport blip: the daemon may be restarting; its
+                # journal will answer once rehydrated
+            state = str(record.get("state", ""))
+            if state in _HALTING_STATES or state in _ADVANCE_STATES:
+                return record
+            self._sleep(self.poll_interval_s)
+        return dict(record, state=record.get("state", "") or "TIMEOUT")

@@ -1,0 +1,262 @@
+"""The federation router: burn-aware, affinity-aware, spill-to-survive.
+
+:class:`FederationRouter` owns a set of
+:class:`~torchx_tpu.federation.cells.CellHandle` and answers one
+question per request: *which cell, in what order of preference*. The
+ordering is two-tiered:
+
+- **admissible** cells — reachable, journal-rehydrated, not
+  draining/drained, breaker not OPEN — sorted by score;
+- cells whose SLO burn exceeds the budget are **demoted** to a second
+  tier, not excluded: a hot cell beats a dropped request.
+
+Score within a tier = long-window burn minus an affinity bonus scaled
+by prefix-chain overlap (PR 12's positional digests: the longest chain
+prefix the cell's exported digest set already holds), name as the final
+deterministic tie-break.
+
+:meth:`FederationRouter.dispatch` walks candidates in order, records
+each dial on the cell's circuit breaker, and sleeps a capped jittered
+backoff between full passes — it raises
+:class:`FederationError` only when every cell refused across every
+round, which is the "no healthy cell anywhere" verdict, never a single
+cell's failure. A 503 ``cell_draining`` verdict marks the cell drained
+in the cached probe and moves on immediately (the daemon said
+*don't retry here*, not *I am sick*).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+from torchx_tpu import settings
+from torchx_tpu.control.client import ControlClientError
+from torchx_tpu.federation.cells import CellHandle, DRAINING
+from torchx_tpu.obs import metrics as obs_metrics
+from torchx_tpu.resilience.breaker import BreakerState, STATE_VALUES
+from torchx_tpu.resilience.policy import CallPolicy
+
+__all__ = ["FederationError", "FederationRouter"]
+
+#: HTTP verdicts that mean "try another cell" rather than "bad request":
+#: transport (0), throttled past the client's own retries (429), and
+#: draining/unavailable (503).
+SPILL_CODES = frozenset({0, 429, 503})
+
+
+class FederationError(RuntimeError):
+    """Every cell refused: carries the per-cell last-error map."""
+
+    def __init__(self, message: str, errors: Optional[dict] = None) -> None:
+        super().__init__(message)
+        self.errors = dict(errors or {})
+
+
+class FederationRouter:
+    """Routes requests across cells by SLO burn + prefix affinity.
+
+    Args:
+        handles: the cells, as :class:`CellHandle` (or anything
+            duck-typing its ``name``/``client``/``breaker``/``probe``
+            surface — the sim harness substitutes virtual cells).
+        burn_budget: long-window burn at/above which a cell is demoted
+            to the second preference tier.
+        affinity_bonus: score credit for a full prefix-chain overlap
+            (scaled linearly by overlap fraction).
+        policy: backoff shape between full candidate passes.
+        max_rounds: full passes over the candidate list before
+            :class:`FederationError`.
+        probe_ttl_s: probe cache lifetime; candidates re-probe lazily.
+        clock/sleep/rng: injectable for tests and the virtual-time sim.
+    """
+
+    def __init__(
+        self,
+        handles: Iterable[CellHandle],
+        burn_budget: float = settings.DEFAULT_FEDERATION_BURN_BUDGET,
+        affinity_bonus: float = 0.25,
+        policy: Optional[CallPolicy] = None,
+        max_rounds: int = 3,
+        probe_ttl_s: float = 2.0,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        self._handles: dict[str, CellHandle] = {}
+        for h in handles:
+            self._handles[h.name] = h
+        self.burn_budget = float(burn_budget)
+        self.affinity_bonus = float(affinity_bonus)
+        self.policy = policy or CallPolicy(
+            backoff_seconds=0.2, backoff_max_seconds=2.0
+        )
+        self.max_rounds = max(1, int(max_rounds))
+        self.probe_ttl_s = float(probe_ttl_s)
+        self._clock = clock
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+
+    # -- membership --------------------------------------------------------
+
+    def add_cell(self, handle: CellHandle) -> None:
+        """Add (or replace) a cell."""
+        self._handles[handle.name] = handle
+
+    def remove_cell(self, name: str) -> bool:
+        """Drop a cell; False when unknown."""
+        return self._handles.pop(name, None) is not None
+
+    def cells(self) -> list[CellHandle]:
+        """All handles, name-sorted."""
+        return [self._handles[k] for k in sorted(self._handles)]
+
+    # -- scoring -----------------------------------------------------------
+
+    def _fresh_probe(self, handle: CellHandle) -> dict:
+        if self._clock() - handle.probed_at >= self.probe_ttl_s:
+            snap = handle.probe()
+            obs_metrics.FED_CELL_BURN.set(
+                float(snap.get("burn", 0.0)), cell=handle.name
+            )
+            obs_metrics.FED_BREAKER_STATE.set(
+                float(STATE_VALUES[handle.breaker.state]), cell=handle.name
+            )
+        return handle.last_probe
+
+    def _overlap(self, handle: CellHandle, chain: Sequence[str]) -> float:
+        """Fraction of the request's prefix chain this cell already
+        holds, counted as the longest matching *prefix* (the chain is
+        positional: a later block without its predecessors is no hit)."""
+        if not chain or not handle.prefix_digests:
+            return 0.0
+        n = 0
+        for digest in chain:
+            if digest not in handle.prefix_digests:
+                break
+            n += 1
+        return n / len(chain)
+
+    def candidates(
+        self, chain: Optional[Sequence[str]] = None
+    ) -> list[CellHandle]:
+        """Cells in dispatch preference order (may be empty).
+
+        Tier 0: admissible and under the burn budget. Tier 1: admissible
+        but burning over budget (degraded beats dropped). Excluded:
+        unreachable, not rehydrated (treated as drained), draining or
+        drained, breaker OPEN.
+        """
+        scored = []
+        for handle in self.cells():
+            snap = self._fresh_probe(handle)
+            if not snap.get("reachable") or not snap.get("rehydrated"):
+                continue
+            if snap.get("draining") or snap.get("state") in (
+                "DRAINING",
+                "DRAINED",
+            ):
+                continue
+            if handle.breaker.state is BreakerState.OPEN:
+                continue
+            burn = float(snap.get("burn", 0.0))
+            tier = 0 if burn < self.burn_budget else 1
+            score = burn - self.affinity_bonus * self._overlap(
+                handle, chain or ()
+            )
+            scored.append((tier, score, handle.name, handle))
+        scored.sort(key=lambda t: t[:3])
+        return [t[3] for t in scored]
+
+    def snapshot(self) -> dict:
+        """Per-cell observed state for ``tpx cell list`` / ``tpx top``."""
+        out = {}
+        for handle in self.cells():
+            snap = dict(self._fresh_probe(handle))
+            snap["breaker"] = handle.breaker.state.value
+            out[handle.name] = snap
+        return out
+
+    # -- dispatch ----------------------------------------------------------
+
+    def dispatch(
+        self,
+        fn: Callable[[Any], Any],
+        chain: Optional[Sequence[str]] = None,
+    ) -> tuple[str, Any]:
+        """Run ``fn(cell.client)`` on the best cell, spilling on failure.
+
+        Returns ``(cell_name, result)``. Per dial: success closes the
+        cell's breaker; a transport failure trips it a step; a
+        :data:`SPILL_CODES` verdict moves to the next candidate (a 503
+        additionally marks the cached probe draining so the cell drops
+        out of the very next candidate list without waiting for the
+        probe TTL). Any other HTTP error is the *request's* fault and
+        re-raises immediately — a malformed submit must not be replayed
+        against every region. Between rounds the candidate list is
+        rebuilt (probes refresh) after a capped jittered backoff.
+        Raises :class:`FederationError` when all rounds exhaust.
+        """
+        errors: dict[str, str] = {}
+        for round_no in range(1, self.max_rounds + 1):
+            first_choice = True
+            for handle in self.candidates(chain):
+                if not handle.breaker.allow():
+                    errors[handle.name] = "breaker open"
+                    first_choice = False
+                    continue
+                if not first_choice:
+                    obs_metrics.FED_SPILLOVERS.inc(reason="spill")
+                try:
+                    result = fn(handle.client)
+                except ControlClientError as e:
+                    errors[handle.name] = f"{e.code}: {e.message}"
+                    if e.code == 0:
+                        handle.breaker.record_failure()
+                        obs_metrics.FED_REQUESTS.inc(
+                            cell=handle.name, outcome="error"
+                        )
+                    else:
+                        # the daemon answered: transport is fine
+                        handle.breaker.record_success()
+                        obs_metrics.FED_REQUESTS.inc(
+                            cell=handle.name, outcome="refused"
+                        )
+                    if e.code == 503:
+                        handle.last_probe = dict(
+                            handle.last_probe, draining=True, state=DRAINING
+                        )
+                    if e.code not in SPILL_CODES:
+                        raise
+                    first_choice = False
+                    continue
+                handle.breaker.record_success()
+                obs_metrics.FED_REQUESTS.inc(
+                    cell=handle.name, outcome="ok"
+                )
+                return handle.name, result
+            if round_no < self.max_rounds:
+                self._sleep(self.policy.backoff_delay(round_no, self._rng))
+        raise FederationError(
+            f"no cell accepted the request after {self.max_rounds}"
+            f" round(s): {errors or 'no admissible cells'}",
+            errors=errors,
+        )
+
+    def submit(
+        self,
+        component: str,
+        args: list[str],
+        scheduler: str,
+        chain: Optional[Sequence[str]] = None,
+        **kw: Any,
+    ) -> tuple[str, dict]:
+        """Submit a job through the best cell; returns
+        ``(cell_name, daemon_reply)``."""
+        return self.dispatch(
+            lambda client: client.submit_job(
+                component, args, scheduler, **kw
+            ),
+            chain=chain,
+        )

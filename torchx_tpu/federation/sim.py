@@ -1,0 +1,407 @@
+"""Two-cell federation scenarios on virtual time, driving the real router.
+
+Same contract as :class:`~torchx_tpu.sim.harness.SimHarness`: the
+journal bytes are a pure function of ``(scenario, seed)`` — no wall
+time, no unseeded randomness — so a control-plane change is
+regression-tested by diffing two journals. What runs under the clock is
+the **production** :class:`~torchx_tpu.federation.router.FederationRouter`
+(and its per-cell breakers), not a model of it: each cell is a
+:class:`_SimCellClient` that answers the router's probe/dispatch surface
+with scripted health and a load-dependent TTFT, and the scenario's
+faults (``cell_drain`` / ``cell_kill`` / ``cell_uncordon`` /
+``cell_restore``) flip that state mid-trace.
+
+This is the deterministic twin of ``scripts/bench_federation.py``: the
+bench runs the same diurnal-partitioned two-cell drain against real
+daemons and reports wall numbers; this harness replays the shape in
+virtual time so CI can assert *zero drops* and bounded failover p99
+without booting anything.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import random
+import tempfile
+import time
+from typing import Any, Optional
+
+from torchx_tpu.control.client import ControlClientError
+from torchx_tpu.federation.cells import CellHandle, CellSpec
+from torchx_tpu.federation.router import (
+    FederationError,
+    FederationRouter,
+)
+from torchx_tpu.obs import metrics as obs_metrics
+from torchx_tpu.resilience.policy import CallPolicy
+from torchx_tpu.sim.harness import SimReport
+
+__all__ = ["FederationSimHarness"]
+
+#: fault kinds this harness understands.
+FED_FAULT_KINDS = ("cell_drain", "cell_uncordon", "cell_kill", "cell_restore")
+
+
+def _p99(samples: list) -> float:
+    """p99 of a sample list (0.0 when empty), nearest-rank."""
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    idx = max(0, math.ceil(0.99 * len(ordered)) - 1)
+    return float(ordered[idx])
+
+
+class _SimCellClient:
+    """One virtual cell answering the router's client surface.
+
+    TTFT is load-dependent: at or under ``capacity_rps`` the cell serves
+    at ``ttft_base_s``; past it, TTFT climbs linearly toward
+    ``ttft_degraded_s`` — which is exactly what the surviving cell feels
+    when it absorbs a drained region's traffic."""
+
+    def __init__(
+        self,
+        name: str,
+        capacity_rps: float,
+        ttft_base_s: float,
+        ttft_degraded_s: float,
+        tick_s: float,
+        harness: "FederationSimHarness",
+    ) -> None:
+        self.name = name
+        self.capacity_rps = float(capacity_rps)
+        self.ttft_base_s = float(ttft_base_s)
+        self.ttft_degraded_s = float(ttft_degraded_s)
+        self.tick_s = float(tick_s)
+        self._h = harness
+        self.draining = False
+        self.killed = False
+        self.rehydrated = True
+        self.burn = 0.0
+        self.tick_load = 0
+        self.served = 0
+
+    def _check_up(self) -> None:
+        if self.killed:
+            raise ControlClientError(
+                0, f"cell {self.name}: connection refused"
+            )
+
+    def cell_status(self) -> dict:
+        self._check_up()
+        return {
+            "cell": self.name,
+            "state": "DRAINING" if self.draining else "HEALTHY",
+            "draining": self.draining,
+            "rehydrated": self.rehydrated,
+            "rehydration": {},
+            "inflight": 0,
+        }
+
+    def healthz(self) -> dict:
+        self._check_up()
+        return {"status": "ok", "cell": self.name, "rehydrated": True}
+
+    def alerts(self) -> dict:
+        self._check_up()
+        b = round(self.burn, 3)
+        return {
+            "enabled": True,
+            "alerts": [],
+            "burns": {"ttft": {"short": b, "long": b}},
+            "slos": ["ttft"],
+        }
+
+    def serve(self) -> float:
+        """One request dial: TTFT in seconds, or the refusal verdicts
+        the real daemon would give (transport when killed, 503 when
+        draining). Every dial — refused or served — counts an attempt
+        so the harness can charge failover latency."""
+        self._h.attempts += 1
+        self._check_up()
+        if self.draining:
+            raise ControlClientError(
+                503, f"cell {self.name!r} is draining; submit elsewhere"
+            )
+        self.tick_load += 1
+        capacity_per_tick = max(1.0, self.capacity_rps * self.tick_s)
+        over = max(0.0, self.tick_load / capacity_per_tick - 1.0)
+        self.served += 1
+        return self.ttft_base_s + (
+            self.ttft_degraded_s - self.ttft_base_s
+        ) * min(1.0, over)
+
+
+class FederationSimHarness:
+    """Replay one federation scenario deterministically.
+
+    Accepts the same ``(scenario, seed, state_dir, journal_path)``
+    surface as :class:`~torchx_tpu.sim.harness.SimHarness` so
+    ``tpx sim run`` routes here transparently when the scenario carries
+    a ``cells`` list. Returns the same
+    :class:`~torchx_tpu.sim.harness.SimReport`.
+    """
+
+    def __init__(
+        self,
+        scenario: dict,
+        seed: Optional[int] = None,
+        state_dir: Optional[str] = None,
+        journal_path: Optional[str] = None,
+    ) -> None:
+        if not scenario.get("cells"):
+            raise ValueError("federation scenario needs a 'cells' list")
+        self.scenario = scenario
+        self.seed = int(
+            seed if seed is not None else scenario.get("seed", 11)
+        )
+        self.state_dir = state_dir or tempfile.mkdtemp(prefix="tpx-fedsim-")
+        self.journal_path = journal_path or os.path.join(
+            self.state_dir, "sim_journal.jsonl"
+        )
+        self._now = 0.0
+        self._rows: list[str] = []
+        self._rng = random.Random(self.seed)
+        serve = dict(scenario.get("serve") or {})
+        self.tick_s = float(scenario.get("metrics_interval_s", 60.0))
+        self.dial_timeout_s = float(serve.get("dial_timeout_s", 0.1))
+        self.slo_target_s = float(serve.get("slo_target_s", 0.5))
+        self.requests_per_tick = float(serve.get("requests_per_tick", 4.0))
+        self.attempts = 0
+        self._clients: dict[str, _SimCellClient] = {}
+        self._regions: dict[str, dict] = {}
+        handles = []
+        for spec in scenario["cells"]:
+            name = str(spec["name"])
+            client = _SimCellClient(
+                name,
+                capacity_rps=float(spec.get("capacity_rps", 0.1)),
+                ttft_base_s=float(serve.get("ttft_base_s", 0.08)),
+                ttft_degraded_s=float(serve.get("ttft_degraded_s", 0.4)),
+                tick_s=self.tick_s,
+                harness=self,
+            )
+            handle = CellHandle(
+                CellSpec(name=name, addr=f"sim://{name}"),
+                client=client,
+                clock=self.clock,
+            )
+            # each region's requests carry its home chain, and the home
+            # cell exports exactly those digests: affinity keeps traffic
+            # regional until health says otherwise
+            chain = [f"{name}:blk{i}" for i in range(8)]
+            handle.update_prefix_digests(chain)
+            self._clients[name] = client
+            self._regions[name] = {
+                "chain": chain,
+                "phase_h": float(spec.get("phase_h", 0.0)),
+            }
+            handles.append(handle)
+        self.router = FederationRouter(
+            handles,
+            burn_budget=float(scenario.get("burn_budget", 2.0)),
+            policy=CallPolicy(backoff_seconds=0.2, backoff_max_seconds=2.0),
+            probe_ttl_s=self.tick_s / 2.0,
+            clock=self.clock,
+            sleep=self._advance,
+            rng=random.Random(self.seed ^ 0x51ED),
+        )
+
+    # -- virtual time --------------------------------------------------------
+
+    def clock(self) -> float:
+        """The virtual instant, seconds since scenario start."""
+        return self._now
+
+    def _advance(self, seconds: float) -> None:
+        self._now += max(0.0, float(seconds))
+
+    def _emit(self, kind: str, **fields: Any) -> None:
+        row = {"t": round(self._now, 6), "kind": kind}
+        row.update(fields)
+        self._rows.append(json.dumps(row, sort_keys=True))
+
+    # -- the run -------------------------------------------------------------
+
+    def _rate(self, t: float, phase_h: float) -> float:
+        """Diurnal request rate for one region at virtual ``t``: the
+        same day-curve shape as the fleet trace, phase-shifted per
+        region (partitioned traffic, not mirrored)."""
+        day_frac = (t / 86400.0 + phase_h / 24.0) % 1.0
+        return self.requests_per_tick * (
+            0.65 + 0.35 * math.sin(2.0 * math.pi * (day_frac - 0.25))
+        )
+
+    def _apply_fault(self, fault: dict) -> None:
+        kind = str(fault.get("kind", ""))
+        cell = str(fault.get("cell", ""))
+        client = self._clients.get(cell)
+        if client is None or kind not in FED_FAULT_KINDS:
+            return
+        if kind == "cell_drain":
+            client.draining = True
+        elif kind == "cell_uncordon":
+            client.draining = False
+        elif kind == "cell_kill":
+            client.killed = True
+        elif kind == "cell_restore":
+            client.killed = False
+            client.rehydrated = True
+        obs_metrics.SIM_FAULTS.inc(kind=kind)
+        self._emit("fault", fault=kind, cell=cell)
+
+    def run(self) -> SimReport:
+        """Execute the scenario; returns the run report (journal bytes
+        are a pure function of scenario + seed)."""
+        wall_start = time.perf_counter()
+        horizon = float(self.scenario.get("hours", 1.0)) * 3600.0
+        faults = sorted(
+            (dict(f) for f in self.scenario.get("faults", [])),
+            key=lambda f: (float(f.get("t", 0.0)), str(f.get("kind", ""))),
+        )
+        disrupt_ts = [
+            float(f.get("t", 0.0))
+            for f in faults
+            if f.get("kind") in ("cell_drain", "cell_kill")
+        ]
+        restore_ts = [
+            float(f.get("t", 0.0))
+            for f in faults
+            if f.get("kind") in ("cell_uncordon", "cell_restore")
+        ]
+        drain_t = min(disrupt_ts) if disrupt_ts else None
+        recover_t = min(restore_ts) if restore_ts else None
+        self._emit(
+            "begin",
+            scenario=str(self.scenario.get("name", "")),
+            seed=self.seed,
+            cells=sorted(self._clients),
+            hours=round(horizon / 3600.0, 6),
+        )
+        samples: dict[str, list[float]] = {"pre": [], "during": [], "post": []}
+        dropped = 0
+        spillovers = 0
+        requests = 0
+        t = 0.0
+        while t < horizon:
+            self._now = t
+            while faults and float(faults[0].get("t", 0.0)) <= t:
+                self._apply_fault(faults.pop(0))
+            for client in self._clients.values():
+                client.tick_load = 0
+            tick_ttfts: dict[str, list[float]] = {
+                n: [] for n in self._clients
+            }
+            for region in sorted(self._regions):
+                info = self._regions[region]
+                rate = self._rate(t, info["phase_h"])
+                n = int(rate) + (
+                    1 if self._rng.random() < (rate - int(rate)) else 0
+                )
+                for _ in range(n):
+                    requests += 1
+                    self.attempts = 0
+                    start = self._now
+                    try:
+                        cell, ttft = self.router.dispatch(
+                            lambda c: c.serve(), chain=info["chain"]
+                        )
+                    except FederationError:
+                        dropped += 1
+                        self._emit("drop", region=region)
+                        self._now = start
+                        continue
+                    # a request pays one dial timeout per refused dial
+                    # plus whatever backoff the router slept (already in
+                    # virtual time via the injected sleep)
+                    latency = ttft + self.dial_timeout_s * max(
+                        0, self.attempts - 1
+                    ) + (self._now - start)
+                    self._now = start
+                    if cell != region:
+                        spillovers += 1
+                    if drain_t is None or t < drain_t:
+                        phase = "pre"
+                    elif recover_t is None or t < recover_t:
+                        phase = "during"
+                    else:
+                        phase = "post"
+                    samples[phase].append(latency)
+                    tick_ttfts[cell].append(latency)
+                    self._emit(
+                        "request",
+                        region=region,
+                        cell=cell,
+                        ttft=round(latency, 6),
+                        attempts=self.attempts,
+                    )
+            for name in sorted(self._clients):
+                client = self._clients[name]
+                # tick burn = how far this tick's p99 sits over the SLO
+                # target; feeds the router's scoring via /v1/alerts
+                client.burn = (
+                    _p99(tick_ttfts[name]) / self.slo_target_s
+                    if tick_ttfts[name]
+                    else 0.0
+                )
+            self._emit(
+                "tick",
+                loads={
+                    n: self._clients[n].tick_load
+                    for n in sorted(self._clients)
+                },
+                burns={
+                    n: round(self._clients[n].burn, 3)
+                    for n in sorted(self._clients)
+                },
+            )
+            t += self.tick_s
+        self._now = horizon
+        all_samples = samples["pre"] + samples["during"] + samples["post"]
+        stats = {
+            "requests": requests,
+            "dropped": dropped,
+            "spillovers": spillovers,
+            "ttft_p99_s": round(_p99(all_samples), 6),
+            "ttft_p99_pre_s": round(_p99(samples["pre"]), 6),
+            "ttft_p99_during_s": round(_p99(samples["during"]), 6),
+            "ttft_p99_post_s": round(_p99(samples["post"]), 6),
+            "per_cell": {
+                n: self._clients[n].served for n in sorted(self._clients)
+            },
+            "faults": len(disrupt_ts) + len(restore_ts),
+        }
+        self._emit("end", virtual_s=round(horizon, 6), **stats)
+        return self._finalize(horizon, time.perf_counter() - wall_start, stats)
+
+    def _finalize(
+        self, virtual_s: float, wall_s: float, stats: dict
+    ) -> SimReport:
+        payload = ("\n".join(self._rows) + "\n").encode()
+        os.makedirs(os.path.dirname(self.journal_path) or ".", exist_ok=True)
+        with open(self.journal_path, "wb") as f:
+            f.write(payload)
+        digest = hashlib.sha256(payload).hexdigest()
+        speedup = virtual_s / wall_s if wall_s > 0 else 0.0
+        kinds: dict[str, int] = {}
+        for line in self._rows:
+            k = json.loads(line)["kind"]
+            kinds[k] = kinds.get(k, 0) + 1
+        for k, n in sorted(kinds.items()):
+            obs_metrics.SIM_EVENTS.inc(n, kind=k)
+        obs_metrics.SIM_VIRTUAL_SECONDS.set(virtual_s)
+        obs_metrics.SIM_WALL_SECONDS.set(wall_s)
+        obs_metrics.SIM_SPEEDUP.set(speedup)
+        return SimReport(
+            scenario=str(self.scenario.get("name", "")),
+            seed=self.seed,
+            virtual_s=virtual_s,
+            wall_s=wall_s,
+            speedup=speedup,
+            journal_path=self.journal_path,
+            journal_sha256=digest,
+            stats=stats,
+        )

@@ -786,3 +786,48 @@ SIM_SPEEDUP = REGISTRY.gauge(
     "tpx_sim_speedup",
     "virtual-over-wall time ratio of the last completed sim run",
 )
+
+
+# -- federation (torchx_tpu/federation/) ------------------------------------
+
+#: gauge encoding for a cell's lifecycle state (UNCORDONED is
+#: transitional and reads back as HEALTHY).
+CELL_STATE_VALUES = {"HEALTHY": 0, "DRAINING": 1, "DRAINED": 2}
+
+#: one cell's lifecycle state, using :data:`CELL_STATE_VALUES`.
+FED_CELL_STATE = REGISTRY.gauge(
+    "tpx_federation_cell_state",
+    "federation cell lifecycle (0=healthy, 1=draining, 2=drained)",
+    ("cell",),
+)
+
+#: the long-window SLO burn the router last observed per cell.
+FED_CELL_BURN = REGISTRY.gauge(
+    "tpx_federation_cell_burn",
+    "max long-window SLO burn rate the router last observed, per cell",
+    ("cell",),
+)
+
+#: requests the federation router dispatched, by target cell + outcome
+#: (ok/error/refused).
+FED_REQUESTS = REGISTRY.counter(
+    "tpx_federation_requests_total",
+    "requests dispatched by the federation router, by cell and outcome",
+    ("cell", "outcome"),
+)
+
+#: requests that landed on a cell other than the router's first choice
+#: (burn over budget, breaker open, drain, or dial failure).
+FED_SPILLOVERS = REGISTRY.counter(
+    "tpx_federation_spillovers_total",
+    "requests spilled past the first-choice cell, by reason",
+    ("reason",),
+)
+
+#: per-cell circuit breaker state
+#: (:data:`torchx_tpu.resilience.breaker.STATE_VALUES` encoding).
+FED_BREAKER_STATE = REGISTRY.gauge(
+    "tpx_federation_breaker_state",
+    "per-cell dial circuit breaker state (0=closed, 1=half-open, 2=open)",
+    ("cell",),
+)

@@ -37,6 +37,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import os
 import threading
 import time
 import urllib.error
@@ -44,6 +45,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Optional, Sequence
 
+from torchx_tpu import settings
 from torchx_tpu.obs import metrics as obs_metrics
 from torchx_tpu.obs import trace as obs_trace
 from torchx_tpu.serve.kv_transfer import TransferConfig
@@ -372,6 +374,18 @@ class LeastLoadedRouter:
                 return 0.0
             return sum(s.queue_depth for s in healthy) / len(healthy)
 
+    def prefix_digests(self) -> list[str]:
+        """Union of every healthy replica's published prefix-chain
+        digests, sorted — the cell's cache-affinity summary that
+        :meth:`ServePool.federation_summary` exports cross-cell (the
+        federation router matches incoming prompts' chains against it)."""
+        with self._lock:
+            out: set[str] = set()
+            for s in self._replicas.values():
+                if s.healthy:
+                    out.update(s.prefix_summary)
+            return sorted(out)
+
 
 # =========================================================================
 # Pool: runner-backed mechanism
@@ -406,8 +420,16 @@ class ServePool:
         reconciler: Optional[Any] = None,
         slo_signal: Optional[Callable[[], Optional[float]]] = None,
         restart: Optional[Callable[[int, str], None]] = None,
+        cell: str = "",
     ) -> None:
         self._runner = runner
+        # which federation cell this pool serves in; the summary below is
+        # what a CellHandle feeds the cross-cell router's affinity score
+        self.cell = (
+            cell
+            or os.environ.get(settings.ENV_TPX_CELL, "").strip()
+            or settings.DEFAULT_CELL_NAME
+        )
         self._app = app
         self._scheduler = scheduler
         self._cfg = cfg or {}
@@ -476,6 +498,21 @@ class ServePool:
         """Where replica ``replica_id`` listens (port-stride convention
         shared with ``components.serve.generate_server``)."""
         return f"http://127.0.0.1:{self._base_port + self._port_stride * replica_id}"
+
+    def federation_summary(self) -> dict:
+        """This cell's serve-plane export for the federation layer.
+
+        ``prefix_digests`` (union of replica prefix-cache summaries) is
+        the affinity signal :class:`torchx_tpu.federation.router.
+        FederationRouter` scores against; ``p99_s``/``queue_depth`` are
+        the health context a cross-cell dashboard shows next to burn."""
+        return {
+            "cell": self.cell,
+            "prefix_digests": self.router.prefix_digests(),
+            "p99_s": self.router.p99_s(),
+            "queue_depth": self.router.queue_depth(),
+            "replicas": self._replicas,
+        }
 
     # -- checkpoint rollout ------------------------------------------------
 
@@ -711,6 +748,7 @@ class DisaggServePool:
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
         reconciler: Optional[Any] = None,
+        cell: str = "",
     ) -> None:
         self._runner = runner
         self._app = app
@@ -730,6 +768,7 @@ class DisaggServePool:
             clock=clock,
             sleep=sleep,
             reconciler=reconciler,
+            cell=cell,
         )
         self.decode = ServePool(
             runner,
@@ -758,6 +797,19 @@ class DisaggServePool:
     def replicas(self) -> int:
         """Total replicas across both gangs (the SERVE_REPLICAS gauge)."""
         return self.prefill.replicas + self.decode.replicas
+
+    @property
+    def cell(self) -> str:
+        """The federation cell this pool serves in (both gangs share it)."""
+        return self.prefill.cell
+
+    def federation_summary(self) -> dict:
+        """Cross-cell export: the prefill gang's cache-affinity summary
+        (client traffic and the prefix cache live there) with the total
+        replica count across both gangs."""
+        summary = self.prefill.federation_summary()
+        summary["replicas"] = self.replicas
+        return summary
 
     def start(self) -> str:
         """Submit the two-role app ONCE; both controllers share the
@@ -837,6 +889,7 @@ def _make_router_handler(pool: ServePool) -> type:
                     200,
                     {
                         "status": "ok",
+                        "cell": pool.cell,
                         "replicas": pool.replicas,
                         "healthy": sum(
                             1 for s in statuses.values() if s.healthy
@@ -845,6 +898,10 @@ def _make_router_handler(pool: ServePool) -> type:
                         "p99_s": router.p99_s(),
                     },
                 )
+            elif self.path == "/v1/federation":
+                # the cross-cell export: cell identity + prefix-cache
+                # digest union, probed by CellHandle for affinity routing
+                self._reply(200, pool.federation_summary())
             elif self.path == "/metricz":
                 # the router process's registry (routing counters, pool
                 # gauges) in proper exposition format — a scrape target

@@ -158,6 +158,40 @@ DEFAULT_CONTROL_TENANT_CAP = 64
 # Retry-After header and the retry_after_seconds field of the error body).
 CONTROL_RETRY_AFTER_SECONDS = 5
 
+# Bounded 429 retry budget of ControlClient: how many times a throttled
+# request sleeps out the daemon's Retry-After hint and retries before the
+# 429 surfaces to the caller. A 429'd request never executed, so the
+# retry is replay-safe (unlike transport errors on submits).
+CONTROL_429_MAX_RETRIES = 3
+
+# Ceiling (seconds) on a single Retry-After sleep honored by the client —
+# a daemon bug (or a hostile proxy) must not park a CLI for an hour.
+CONTROL_429_RETRY_CAP_SECONDS = 30.0
+
+# This control daemon's cell name within a federation. Every journal
+# record, /healthz reply and metric the daemon emits carries it, so a
+# federation router (torchx_tpu/federation/) can address N regional
+# daemons as cells. Unset = "default" (single-cell, pre-federation
+# behavior unchanged).
+ENV_TPX_CELL = "TPX_CELL"
+DEFAULT_CELL_NAME = "default"
+
+# State root of the federation layer: the durable cell registry
+# (cells.jsonl) lives here. Default ~/.torchx_tpu/federation.
+ENV_TPX_FEDERATION_DIR = "TPX_FEDERATION_DIR"
+
+# Long-window SLO burn rate at/above which the federation router stops
+# preferring a cell and spills new traffic to the next-best cell (the
+# cell stays admissible as a last resort — never a hard fail while any
+# cell answers).
+DEFAULT_FEDERATION_BURN_BUDGET = 1.0
+
+# Per-cell circuit breaker of the federation router: consecutive
+# transport failures before the cell is skipped without a dial, and how
+# long it sits out before a half-open probe.
+FEDERATION_BREAKER_TRIP_AFTER = 3
+FEDERATION_BREAKER_COOLDOWN_SECONDS = 5.0
+
 # ---------------------------------------------------------------------------
 # In-job (injected by schedulers into every replica)
 # ---------------------------------------------------------------------------

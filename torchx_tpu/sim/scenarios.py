@@ -154,6 +154,36 @@ BUNDLED_SCENARIOS: dict[str, dict[str, Any]] = {
             },
         ],
     },
+    # the federation acceptance scenario: two regional cells under
+    # phase-shifted diurnal traffic; one cell drains mid-trace and
+    # uncordons later. Run by FederationSimHarness (the "cells" key is
+    # the routing signal): asserts zero dropped requests and a bounded
+    # failover p99 — the deterministic twin of scripts/bench_federation.
+    "federation-two-cell": {
+        "name": "federation-two-cell",
+        "backend": "sim",
+        "fleet": "sim:v5e-4x8",
+        "seed": 11,
+        "hours": 1.5,
+        "metrics_interval_s": 60.0,
+        "burn_budget": 2.0,
+        "cells": [
+            {"name": "us-east1", "capacity_rps": 0.05, "phase_h": 0.0},
+            {"name": "eu-west4", "capacity_rps": 0.05, "phase_h": 8.0},
+        ],
+        "serve": {
+            "ttft_base_s": 0.08,
+            "ttft_degraded_s": 0.4,
+            "requests_per_tick": 4,
+            "dial_timeout_s": 0.1,
+            "slo_target_s": 0.5,
+            "slos": DEFAULT_SIM_SLOS,
+        },
+        "faults": [
+            {"t": 1800.0, "kind": "cell_drain", "cell": "us-east1"},
+            {"t": 3600.0, "kind": "cell_uncordon", "cell": "us-east1"},
+        ],
+    },
 }
 
 
