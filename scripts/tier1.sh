@@ -815,6 +815,71 @@ EOF
 then echo "PROFILE_SMOKE=ok"; else echo "PROFILE_SMOKE=FAILED"; rc=1; fi
 rm -rf "$prof_dir"
 
+# Overlap smoke: the step-time knobs end to end on the CPU sim. A
+# profiled train through the CLI flags (--grad-bucket-mb auto +
+# reference kernels) must surface a measured overlap_frac in
+# `tpx profile --json`; an unprofiled bucketed run must produce a loss
+# BITWISE identical to the single-sync run (bucket boundaries are value
+# identities); and `tpx --help` must stay jax-free with the new knobs
+# in the tree.
+ov_dir=$(mktemp -d /tmp/tpx_overlap_smoke.XXXXXX)
+if timeout -k 10 420 env JAX_PLATFORMS=cpu OV_DIR="$ov_dir" \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - <<'EOF'
+import glob, json, os, subprocess, sys, time
+
+base = os.environ["OV_DIR"]
+os.environ["TPX_OBS_DIR"] = os.path.join(base, "obs")
+os.environ["TPX_TUNE_DIR"] = os.path.join(base, "tune")
+os.environ["TPX_PROFILE"] = "1"
+
+from torchx_tpu.examples.train_llama import main as train_main
+from torchx_tpu.examples.train_llama import parse_mesh_arg, train
+from torchx_tpu.models import llama
+
+train_main(["--config", "tiny", "--mesh", "fsdp=-1", "--batch", "8",
+            "--seq", "128", "--steps", "8",
+            "--grad-bucket-mb", "auto", "--kernels", "reference"])
+
+journals = glob.glob(os.path.join(base, "obs", "*", "profile.jsonl"))
+assert len(journals) == 1, journals
+r = subprocess.run(
+    [sys.executable, "-m", "torchx_tpu.cli.main", "profile",
+     journals[0], "--json"],
+    capture_output=True, text=True, timeout=120,
+)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+s = json.loads(r.stdout)
+assert s["overlap_frac"] is not None, s
+assert 0.0 <= s["overlap_frac"] <= 1.0, s["overlap_frac"]
+
+# bitwise loss parity: bucketed vs single-sync, unprofiled
+del os.environ["TPX_PROFILE"]
+cfg = llama.llama_tiny()
+mesh = parse_mesh_arg("fsdp=-1")
+a = train(cfg, mesh, batch=8, seq=128, steps=8,
+          launch_anchor=time.monotonic())
+b = train(cfg, mesh, batch=8, seq=128, steps=8, grad_bucket_mb="auto",
+          launch_anchor=time.monotonic())
+assert b["grad_buckets"] >= 1 and b["grad_bucket_mb"] > 0, b
+assert a["loss"] == b["loss"], (a["loss"], b["loss"])
+
+# the launcher CLI stays jax-free with the step-time knobs in the tree
+r = subprocess.run(
+    [sys.executable, "-c", (
+        "import sys\n"
+        "from torchx_tpu.cli.main import main\n"
+        "try: main(['--help'])\n"
+        "except SystemExit: pass\n"
+        "assert 'jax' not in sys.modules, 'tpx --help imported jax'\n"
+    )],
+    capture_output=True, text=True, timeout=60,
+)
+assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+EOF
+then echo "OVERLAP_SMOKE=ok"; else echo "OVERLAP_SMOKE=FAILED"; rc=1; fi
+rm -rf "$ov_dir"
+
 # Federation smoke: boot two `tpx control` daemons as cells, register
 # them with `tpx cell add`, submit through the federation router, drain
 # one cell mid-stream with `tpx cell drain`, and assert every subsequent

@@ -299,6 +299,74 @@ class TestMeshRules:
         assert "TPX111" in codes(report)
 
 
+class TestKernelsRule:
+    """TPX112: ``--kernels pallas`` that will silently fall back."""
+
+    def test_pallas_without_tpu_resource_warns(self):
+        report = analyze(
+            app_with(
+                entrypoint="python",
+                args=["-m", "t", "--config", "llama3_8b", "--kernels", "pallas"],
+            )
+        )
+        diags = [d for d in report.diagnostics if d.code == "TPX112"]
+        assert len(diags) == 1
+        assert "non-TPU" in diags[0].message
+        assert "fall back" in diags[0].message
+
+    def test_pallas_on_tpu_with_tileable_shapes_is_clean(self):
+        # llama3_8b: head_dim 128, dim 4096, seq 256 — all tileable
+        report = analyze(
+            app_with(
+                entrypoint="python",
+                args=[
+                    "-m", "t", "--config", "llama3_8b",
+                    "--kernels", "pallas", "--seq", "256",
+                ],
+                resource=Resource(tpu=TpuSlice("v5e", 8)),
+            )
+        )
+        assert "TPX112" not in codes(report)
+
+    def test_pallas_untileable_shapes_warn_even_on_tpu(self):
+        # tiny: head_dim 16, dim 64 — neither kernel can tile
+        report = analyze(
+            app_with(
+                entrypoint="python",
+                args=["-m", "t", "--config", "tiny", "--kernels=pallas"],
+                resource=Resource(tpu=TpuSlice("v5e", 8)),
+            )
+        )
+        diags = [d for d in report.diagnostics if d.code == "TPX112"]
+        assert len(diags) == 1
+        assert "head_dim 16" in diags[0].message
+        assert "reference" in diags[0].message
+
+    def test_pallas_ragged_seq_warns(self):
+        report = analyze(
+            app_with(
+                entrypoint="python",
+                args=[
+                    "-m", "t", "--config", "llama3_8b",
+                    "--kernels", "pallas", "--seq", "100",
+                ],
+                resource=Resource(tpu=TpuSlice("v5e", 8)),
+            )
+        )
+        diags = [d for d in report.diagnostics if d.code == "TPX112"]
+        assert len(diags) == 1 and "seq 100" in diags[0].message
+
+    def test_reference_and_interpret_never_fire(self):
+        for kernels in ("reference", "interpret"):
+            report = analyze(
+                app_with(
+                    entrypoint="python",
+                    args=["-m", "t", "--config", "tiny", "--kernels", kernels],
+                )
+            )
+            assert "TPX112" not in codes(report)
+
+
 class TestTpuSliceEdgeCases:
     """Satellite: TpuSlice naming/shape edge cases backing the TPX1xx rules."""
 

@@ -536,3 +536,53 @@ class TestInterpretability:
         # gradients actually flow: saliency is strictly positive somewhere
         assert float(jnp.max(sal)) > 0
         assert not jnp.isnan(ig).any()
+
+
+class TestTrainStepTimeKnobs:
+    """The --grad-bucket-mb / --kernels / launch-anchor trainer wiring."""
+
+    def _train(self, **kw):
+        from torchx_tpu.examples.train_llama import train
+        from torchx_tpu.parallel.mesh import MeshConfig as MC
+
+        return train(
+            llama.llama_tiny(),
+            MC(dp=1, fsdp=-1, tp=1, sp=1),
+            batch=8,
+            seq=32,
+            steps=4,
+            warmup=2,
+            **kw,
+        )
+
+    def test_bucketed_loss_bitwise_equals_single_sync(self):
+        ref = self._train(grad_bucket_mb=0)
+        bucketed = self._train(grad_bucket_mb="auto")
+        assert bucketed["grad_buckets"] >= 1
+        assert bucketed["grad_bucket_mb"] > 0
+        assert any(t["chosen"] for t in bucketed["grad_bucket_trials"])
+        # barriers are value identities: losses agree to the last bit
+        assert bucketed["loss"] == ref["loss"]
+
+    def test_explicit_bucket_mb_plumbs_through(self):
+        out = self._train(grad_bucket_mb="16")
+        assert out["grad_bucket_mb"] == 16
+        assert out["grad_bucket_trials"][0]["reason"] == "explicit --grad-bucket-mb"
+
+    def test_launch_anchor_reanchors_first_step(self):
+        # a later in-process train() re-anchored at its own call must
+        # report seconds for ITS launch, not the age of the process
+        # (the bench int8-leg drift this seam exists to fix)
+        import time
+
+        self._train()  # consume any first-train process-start anchoring
+        t0 = time.monotonic()
+        out = self._train(launch_anchor=t0)
+        own = time.monotonic() - t0
+        assert 0 < out["launch_to_first_step_s"] <= own
+        process_age = time.monotonic() - 0  # sanity: anchor is not epoch
+        assert out["launch_to_first_step_s"] < process_age
+
+    def test_kernels_flag_reported(self):
+        out = self._train(kernels="pallas")  # degrades to reference on CPU
+        assert out["kernels"] == "reference"

@@ -1,0 +1,165 @@
+"""Bucketed gradient-sync tests (parallel/overlap.py).
+
+The load-bearing property: bucket boundaries are pure scheduling. At ANY
+bucket size the per-leaf gradients must be bitwise identical to the
+single-sync step — psum is leafwise, barriers are value-identities.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from torchx_tpu.parallel import overlap
+from torchx_tpu.parallel.mesh import MeshConfig, make_mesh
+from torchx_tpu.parallel.mesh import shard_map as tpx_shard_map
+
+MIB = 1024 * 1024
+
+
+def _grad_tree(key=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(key), 4)
+    return {
+        "wq": jax.random.normal(ks[0], (8, 64, 32), dtype=dtype),
+        "wo": jax.random.normal(ks[1], (8, 32, 64), dtype=dtype),
+        "norm": jax.random.normal(ks[2], (8, 64), dtype=dtype),
+        "emb": jax.random.normal(ks[3], (8, 128, 64), dtype=dtype),
+    }
+
+
+class TestPlanBuckets:
+    def test_reverse_order_and_cap(self):
+        tree = {"a": jnp.zeros((256,)), "b": jnp.zeros((256,)), "c": jnp.zeros((256,))}
+        plan = overlap.plan_buckets(tree, 2 * 256 * 4)
+        # leaves flatten a, b, c -> reverse issue order starts at c
+        assert plan.buckets[0] == (2, 1)
+        assert plan.buckets[1] == (0,)
+        assert plan.n_buckets == 2
+        assert plan.total_bytes == 3 * 256 * 4
+
+    def test_oversize_leaf_gets_own_bucket(self):
+        tree = [jnp.zeros((1024,)), jnp.zeros((8,)), jnp.zeros((8,))]
+        plan = overlap.plan_buckets(tree, 64)
+        assert (0,) in plan.buckets
+        assert all(len(b) >= 1 for b in plan.buckets)
+
+    def test_single_bucket_when_cap_huge(self):
+        plan = overlap.plan_buckets(_grad_tree(), 10 * MIB)
+        assert plan.n_buckets == 1
+        assert set(plan.buckets[0]) == set(range(4))
+
+    def test_deterministic(self):
+        a = overlap.plan_buckets(_grad_tree(), MIB)
+        b = overlap.plan_buckets(_grad_tree(1), MIB)  # same structure
+        assert a.buckets == b.buckets
+
+    def test_describe(self):
+        d = overlap.plan_buckets(_grad_tree(), MIB).describe()
+        assert set(d) == {"bucket_mb", "n_buckets", "total_mb", "largest_bucket_mb"}
+
+
+class TestResolveBucketMb:
+    def test_explicit_passthrough(self):
+        mb, trials = overlap.resolve_bucket_mb(_grad_tree(), 16)
+        assert mb == 16
+        assert len(trials) == 1 and trials[0].chosen
+        assert trials[0].to_dict()["reason"] == "explicit --grad-bucket-mb"
+
+    def test_explicit_invalid(self):
+        with pytest.raises(ValueError):
+            overlap.resolve_bucket_mb(_grad_tree(), -4)
+
+    def test_auto_picks_smallest_acceptable(self):
+        mb, trials = overlap.resolve_bucket_mb(_grad_tree(), "auto")
+        assert mb in overlap.BUCKET_MB_CANDIDATES
+        chosen = [t for t in trials if t.chosen]
+        assert len(chosen) == 1 and chosen[0].bucket_mb == mb
+        plan = overlap.plan_buckets(_grad_tree(), mb * MIB)
+        assert plan.n_buckets <= overlap.TARGET_BUCKETS
+
+    def test_auto_records_all_candidates(self):
+        _, trials = overlap.resolve_bucket_mb(_grad_tree(), "auto")
+        assert [t.bucket_mb for t in trials] == list(overlap.BUCKET_MB_CANDIDATES)
+
+
+class TestBitwiseEquality:
+    """Gradients bitwise-equal to single-sync at any bucket size."""
+
+    @pytest.mark.parametrize("cap_bytes", [1, 4096, MIB, 64 * MIB])
+    def test_bucketed_psum_matches_single_psum(self, cap_bytes):
+        mesh = make_mesh(MeshConfig(dp=8, fsdp=1, tp=1, sp=1))
+        tree = _grad_tree()
+        plan = overlap.plan_buckets(tree, cap_bytes)
+        spec = P("dp")
+
+        def bucketed(g):
+            return overlap.bucketed_psum(g, "dp", plan)
+
+        def single(g):
+            return jax.tree_util.tree_map(lambda x: jax.lax.psum(x, "dp"), g)
+
+        specs = jax.tree_util.tree_map(lambda _: spec, tree)
+        run = lambda fn: tpx_shard_map(  # noqa: E731
+            fn,
+            mesh=mesh,
+            in_specs=(specs,),
+            out_specs=specs,
+            axis_names=frozenset(dict(mesh.shape)),
+            check_vma=False,
+        )(tree)
+        got = run(bucketed)
+        want = run(single)
+        for a, b in zip(jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("cap_bytes", [1, 4096, 64 * MIB])
+    def test_gspmd_barriers_are_value_identity(self, cap_bytes):
+        tree = _grad_tree(dtype=jnp.bfloat16)
+        plan = overlap.plan_buckets(tree, cap_bytes)
+        out = jax.jit(lambda g: overlap.apply_bucketed_barriers(g, plan))(tree)
+        for a, b in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(tree)):
+            assert np.array_equal(
+                np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32)
+            )
+
+
+class TestBucketedSync:
+    def test_off_switch(self):
+        tree = _grad_tree()
+        out, plan = overlap.bucketed_sync(tree, bucket_mb=0)
+        assert plan is None
+        assert out is tree
+
+    def test_gspmd_mode_outside_manual_region(self):
+        tree = _grad_tree()
+        out, plan = overlap.bucketed_sync(tree, bucket_mb=1, mode="auto")
+        assert plan is not None and plan.n_buckets >= 1
+        for a, b in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(tree)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_manual_mode_inside_shard_map(self):
+        mesh = make_mesh(MeshConfig(dp=8, fsdp=1, tp=1, sp=1))
+        tree = {"w": jnp.arange(16, dtype=jnp.float32).reshape(8, 2)}
+        spec = {"w": P("dp")}
+
+        def fn(g):
+            out, plan = overlap.bucketed_sync(g, bucket_mb=1, mode="auto")
+            assert plan is not None
+            return out
+
+        got = tpx_shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=spec,
+            axis_names=frozenset(dict(mesh.shape)),
+            check_vma=False,
+        )(tree)
+        col_sum = np.asarray(tree["w"]).sum(axis=0)
+        want = np.tile(col_sum, (8, 1))
+        np.testing.assert_array_equal(np.asarray(got["w"]), want)
+
+    def test_unknown_mode_raises(self):
+        with pytest.raises(ValueError):
+            overlap.bucketed_sync(_grad_tree(), bucket_mb=1, mode="nope")

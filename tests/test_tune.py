@@ -373,6 +373,65 @@ class TestCalibrationTable:
                 "v5e", predicted_step_s=1.0, measured_step_s=2.0, alpha=1.0
             )
 
+    def test_observe_overlap_ema_and_clamp(self, tmp_path):
+        table = CalibrationTable(str(tmp_path / "cal.json"))
+        out = table.observe_overlap("v5e", measured_overlap_frac=0.6)
+        assert out["overlap"]["after"] == pytest.approx(0.3)  # 0 + 0.5*0.6
+        out = table.observe_overlap("v5e", measured_overlap_frac=0.6)
+        assert out["overlap"]["after"] == pytest.approx(0.45)
+        # runaway 1.0 never makes collectives free
+        for _ in range(20):
+            out = table.observe_overlap("v5e", measured_overlap_frac=5.0)
+        assert table.scales_for("v5e").overlap_frac <= 0.95
+        with pytest.raises(ValueError, match="alpha"):
+            table.observe_overlap("v5e", measured_overlap_frac=0.5, alpha=0.0)
+
+    def test_overlap_frac_survives_other_observes(self, tmp_path):
+        path = str(tmp_path / "cal.json")
+        table = CalibrationTable(path)
+        table.observe_overlap("v5e", measured_overlap_frac=0.8)
+        frac = table.scales_for("v5e").overlap_frac
+        assert frac > 0
+        table.observe("v5e", predicted_step_s=1.0, measured_step_s=2.0)
+        table.observe_collectives(
+            "v5e", predicted_collective_s=1.0, measured_collective_s=2.0
+        )
+        assert table.scales_for("v5e").overlap_frac == pytest.approx(frac)
+        table.save()
+        assert CalibrationTable.load(path).scales_for(
+            "v5e"
+        ).overlap_frac == pytest.approx(frac)
+
+    def test_rank_discounts_overlapped_collectives(self, tmp_path):
+        from torchx_tpu.analyze.plan import plan_from_role
+        from torchx_tpu.components import dist
+        from torchx_tpu.tune.rank import predicted_step_cost
+
+        app = dist.spmd(
+            "--config", "llama3_1b", "--mesh", "dp=2,fsdp=4",
+            m="torchx_tpu.examples.train_llama", j="1x8",
+        )
+        plan = plan_from_role(app.roles[0])
+        assert plan is not None
+        base = predicted_step_cost(plan, generation="v5e")
+        assert base.collective_s > 0
+        table = CalibrationTable(str(tmp_path / "cal.json"))
+        table.observe_overlap("v5e", measured_overlap_frac=0.95, alpha=0.9)
+        cal = table.scales_for("v5e")
+        discounted = predicted_step_cost(
+            plan, generation="v5e", calibration=cal
+        )
+        # the StepCost still reports the full modeled collective time;
+        # only the rank key charges the exposed share
+        assert discounted.collective_s == pytest.approx(base.collective_s)
+        assert discounted.step_s < base.step_s
+        # identity calibration (overlap never observed) is bit-identical
+        from torchx_tpu.tune.calibrate import CalibrationScales
+
+        assert predicted_step_cost(
+            plan, generation="v5e", calibration=CalibrationScales()
+        ).step_s == base.step_s
+
 
 # ---------------------------------------------------------------------------
 # artifact: digest, tamper, diff, and the submit-gate pin

@@ -45,6 +45,7 @@ Diagnostic codes
 | TPX103 | error | TPU-looking key in ``resource.devices`` | TPU chips are allocated via ``resource.tpu``, never devices |
 | TPX110 | warning | ``--mesh`` pairs expert parallelism (``ep``) with ``fsdp``/``sp`` sharding: embedding/expert gathers reshard dim-sharded → batch/seq-sharded, which GSPMD partitions by involuntary full rematerialization unless gather outputs carry explicit sharding constraints (heuristic fallback — when the role resolves into a full parallelism plan, TPX700 propagation supersedes this) | pin gather outputs with ``with_sharding_constraint``, or use ``torchx_tpu.examples.train_llama`` which already does |
 | TPX111 | error | unknown mesh axis name in a ``--mesh`` role arg | use the trainer mesh axes ``pp/dp/fsdp/ep/tp/sp`` |
+| TPX112 | warning | ``--kernels pallas`` will silently fall back to the reference XLA ops: the role has no TPU resource, or the config/seq shapes cannot tile the fused kernels (flash attention needs head_dim 64/128/256 and a 128-divisible sequence; the fused norm needs a lane-aligned dim) | run on TPU with tileable shapes, or drop the flag (``--kernels interpret`` is the parity-testing path) |
 | TPX201 | error | role env overrides a launcher-injected identity/rendezvous var (``TPX_REPLICA_ID``, ``MEGASCALE_*``, ...) | remove it — every scheduler injects it |
 | TPX202 | warning | env var uses a reserved prefix (``TPX_``/``TPU_``/``MEGASCALE_``) but is not a documented knob | rename it |
 | TPX203 | info | ``JAX_*`` env var set (JAX runtime config) | make sure it is intentional |

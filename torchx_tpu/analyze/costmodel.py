@@ -109,6 +109,22 @@ def _scale_of(calibration: Optional[Any], attr: str) -> float:
     return scale if scale > 0 else 1.0
 
 
+def overlap_discount(calibration: Optional[Any]) -> float:
+    """Fraction of the serialized collective time to actually charge:
+    ``1 - overlap_frac`` from the calibration's measured comm/compute
+    overlap (the step profiler's ``1 - exposed/modeled``). Duck-typed
+    like :func:`_scale_of`; ``None``/absent/zero overlap -> 1.0, so
+    uncalibrated predictions and golden fixtures stay bit-identical.
+    Clamped so at least 5% of the collective time is always charged."""
+    if calibration is None:
+        return 1.0
+    try:
+        frac = float(getattr(calibration, "overlap_frac", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        return 1.0
+    return 1.0 - min(max(frac, 0.0), 0.95)
+
+
 def hbm_fit(
     plan: ParallelPlan,
     headroom: float = DEFAULT_HEADROOM,

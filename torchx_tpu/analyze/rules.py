@@ -436,6 +436,109 @@ def check_mesh(ctx: RuleContext) -> Iterator[Diagnostic]:
                 )
 
 
+#: Fused-kernel tileability (ops/fused.py ``FLASH_HEAD_DIMS`` /
+#: ``flash_shapes_ok`` / ``norm_shapes_ok``, duplicated here because
+#: analyze never imports jax): flash attention tiles head_dims of
+#: 64/128/256 over 128-token blocks; the fused norm needs a lane-aligned
+#: model dim.
+_FUSED_HEAD_DIMS = frozenset({64, 128, 256})
+_FUSED_LANE = 128
+
+
+def _flag_value(role: Role, flag: str) -> Optional[str]:
+    """Last value of ``flag`` in a role's arg list (both the two-token
+    ``--flag v`` and one-token ``--flag=v`` spellings)."""
+    args = [str(a) for a in role.args]
+    found: Optional[str] = None
+    for i, a in enumerate(args):
+        if a == flag and i + 1 < len(args):
+            found = args[i + 1]
+        elif a.startswith(flag + "="):
+            found = a.split("=", 1)[1]
+    return found
+
+
+@rule("kernels")
+def check_kernels(ctx: RuleContext) -> Iterator[Diagnostic]:
+    """TPX112: ``--kernels pallas`` that will silently fall back.
+
+    The trainer degrades ``--kernels pallas`` to the reference XLA ops
+    whenever the Mosaic kernels cannot run: on a non-TPU backend, or when
+    the model/sequence shapes do not tile (flash attention needs a
+    head_dim of 64/128/256 and a 128-divisible sequence; the fused norm
+    needs a lane-aligned model dim). The job still trains — but the MFU
+    the flag was supposed to buy never materializes, so surface the
+    fallback at submit time instead of letting someone discover it in a
+    profile three hours into a run.
+    """
+    from torchx_tpu.analyze.plan import MODEL_SHAPES
+
+    for role in ctx.app.roles:
+        if _flag_value(role, "--kernels") != "pallas":
+            continue
+        on_tpu = role.resource is not None and role.resource.tpu is not None
+        if not on_tpu:
+            yield Diagnostic(
+                code="TPX112",
+                severity=Severity.WARNING,
+                role=role.name,
+                field="args.--kernels",
+                message=(
+                    "--kernels pallas on a non-TPU backend: the fused"
+                    " Mosaic kernels need TPU cores, so the trainer will"
+                    " silently fall back to the reference XLA ops"
+                ),
+                hint=(
+                    "request a TPU resource, or drop the flag (use"
+                    " --kernels interpret only for parity testing — it"
+                    " runs the kernels in the Pallas interpreter, slowly)"
+                ),
+            )
+            continue
+        config = _flag_value(role, "--config")
+        model = MODEL_SHAPES.get(config or "")
+        if model is None:
+            continue  # unknown config: nothing shape-checkable
+        problems = []
+        if model.head_dim not in _FUSED_HEAD_DIMS:
+            problems.append(
+                f"head_dim {model.head_dim} (flash attention tiles"
+                f" {'/'.join(str(d) for d in sorted(_FUSED_HEAD_DIMS))})"
+            )
+        if model.dim % _FUSED_LANE:
+            problems.append(
+                f"dim {model.dim} (fused norm needs a multiple of"
+                f" {_FUSED_LANE})"
+            )
+        seq_raw = _flag_value(role, "--seq")
+        try:
+            seq = int(seq_raw) if seq_raw is not None else None
+        except ValueError:
+            seq = None
+        if seq is not None and (seq < _FUSED_LANE or seq % _FUSED_LANE):
+            problems.append(
+                f"seq {seq} (flash attention needs a multiple of"
+                f" {_FUSED_LANE})"
+            )
+        if problems:
+            yield Diagnostic(
+                code="TPX112",
+                severity=Severity.WARNING,
+                role=role.name,
+                field="args.--kernels",
+                message=(
+                    f"--kernels pallas with config {config!r} cannot"
+                    f" tile: {'; '.join(problems)} — the affected ops"
+                    " fall back to the reference XLA path"
+                ),
+                hint=(
+                    "pick a config whose shapes tile (head_dim 64/128/"
+                    "256, dim and seq multiples of 128), or drop the"
+                    " flag; the fallback is correct, just not fused"
+                ),
+            )
+
+
 # ---------------------------------------------------------------------------
 # TPX2xx — env / macros / ports / mounts
 # ---------------------------------------------------------------------------

@@ -146,6 +146,7 @@ def make_train_step(
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
     state_shardings: Optional[Any] = None,
+    grad_bucket_plan: Optional[Any] = None,
 ):
     """The jitted SPMD training step: grads + AdamW update, donated state.
 
@@ -157,12 +158,27 @@ def make_train_step(
     state to the input's shardings. Without it the compiler may pick
     different shardings for the returned opt state than the donated input
     had — then feeding step N's state into step N+1 through an AOT
-    executable trips the strict input-sharding check."""
+    executable trips the strict input-sharding check.
+
+    ``grad_bucket_plan`` (a :class:`~torchx_tpu.parallel.overlap.BucketPlan`)
+    buckets the gradient sync: value-identity barriers at bucket
+    boundaries let XLA issue per-bucket reduces while backward is still
+    running, instead of one fused post-backward collective. Gradients are
+    bitwise identical to the unbucketed step."""
 
     def step(state: TrainState, batch: dict[str, jnp.ndarray]):
         (loss, aux), grads = jax.value_and_grad(llama.loss_and_aux, has_aux=True)(
             state.params, batch, cfg, mesh
         )
+        if grad_bucket_plan is not None:
+            from torchx_tpu.parallel import overlap
+
+            grads, _ = overlap.bucketed_sync(
+                grads,
+                bucket_mb=max(1, grad_bucket_plan.bucket_bytes // (1024 * 1024)),
+                mode="auto",
+                plan=grad_bucket_plan,
+            )
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
@@ -415,10 +431,20 @@ def train(
     profile_dir: Optional[str] = None,
     prefetch: int = 2,
     profile: bool = False,
+    grad_bucket_mb: Any = 0,
+    kernels: str = "reference",
+    launch_anchor: Optional[float] = None,
 ) -> dict[str, float]:
     global _FIRST_TRAIN_PENDING
     t_call = time.monotonic()
-    launch_ref = _PROCESS_START if _FIRST_TRAIN_PENDING else t_call
+    # ``launch_anchor`` re-anchors launch-to-first-step for in-process
+    # callers (the bench legs): without it, every leg after the first
+    # would either inherit process age or measure only its own call —
+    # the caller says explicitly which clock this run starts on.
+    if launch_anchor is not None:
+        launch_ref = launch_anchor
+    else:
+        launch_ref = _PROCESS_START if _FIRST_TRAIN_PENDING else t_call
     _FIRST_TRAIN_PENDING = False
 
     from torchx_tpu.obs import metrics as obs_metrics
@@ -433,6 +459,22 @@ def train(
     _stage("import", t_call - launch_ref)
 
     cfg = dataclasses.replace(cfg, max_seq=seq)
+
+    kernels_used = "reference"
+    if kernels and kernels != "reference":
+        # "pallas" silently degrades to "reference" off-TPU (the Mosaic
+        # kernels need real TPU cores); "interpret" runs the same kernels
+        # through the Pallas interpreter anywhere (tests, CPU sim)
+        from torchx_tpu.ops.fused import resolve_kernels
+
+        kernels_used = resolve_kernels(kernels)
+        cfg = dataclasses.replace(cfg, kernels=kernels_used)
+        if kernels_used != kernels and jax.process_index() == 0:
+            print(
+                f"kernels: {kernels!r} unavailable on this backend;"
+                " using reference ops",
+                flush=True,
+            )
 
     t0 = time.monotonic()
     with _launch_span("launch.backend_init"):
@@ -557,8 +599,28 @@ def train(
     # that each land in (and relaunch from) the persistent XLA cache.
     t0 = time.monotonic()
     state_shardings = jax.tree.map(lambda x: x.sharding, lower_state)
+
+    # resolve --grad-bucket-mb against the (possibly abstract) param tree:
+    # bucket layout only needs shapes/dtypes, so the plan is fixed before
+    # the compile and never perturbs the compilation cache between runs
+    grad_plan = None
+    grad_bucket_mb_used = 0
+    bucket_trials: tuple = ()
+    if grad_bucket_mb not in (0, "0", None, ""):
+        from torchx_tpu.parallel import overlap
+
+        grad_bucket_mb_used, bucket_trials = overlap.resolve_bucket_mb(
+            lower_state.params, grad_bucket_mb
+        )
+        grad_plan = overlap.plan_buckets(
+            lower_state.params, grad_bucket_mb_used * 1024 * 1024
+        )
+        if jax.process_index() == 0:
+            print(f"grad buckets -> {grad_plan.describe()}", flush=True)
+
     train_step = make_train_step(
-        cfg, mesh, optimizer, state_shardings=state_shardings
+        cfg, mesh, optimizer, state_shardings=state_shardings,
+        grad_bucket_plan=grad_plan,
     )
     batch_sds = {
         "tokens": jax.ShapeDtypeStruct(
@@ -678,6 +740,9 @@ def train(
             "launch_to_first_step_s": first_step_s,
             "launch_breakdown": dict(breakdown),
             "remat_policy": remat_policy_used,
+            "kernels": kernels_used,
+            "grad_bucket_mb": grad_bucket_mb_used,
+            "grad_buckets": grad_plan.n_buckets if grad_plan else 0,
         }
 
     # a few untimed warmup steps: dispatch pipelining + allocator settling
@@ -868,10 +933,16 @@ def train(
         "data_wait_frac": data_wait_s / total if total > 0 else 0.0,
         "remat_policy": remat_policy_used,
         "prefetch_depth": prefetch,
+        # step-time optimization knobs actually in effect for this run
+        "kernels": kernels_used,
+        "grad_bucket_mb": grad_bucket_mb_used,
+        "grad_buckets": grad_plan.n_buckets if grad_plan else 0,
         # True when a SIGTERM preemption notice cut the run short (the
         # final checkpoint is durable; the supervisor resubmits from it)
         "preempted": preempted,
     }
+    if bucket_trials:
+        results["grad_bucket_trials"] = [t.to_dict() for t in bucket_trials]
     if profile_summary is not None:
         results["profile"] = profile_summary
     return results
@@ -925,6 +996,23 @@ def main(argv: Optional[list[str]] = None) -> None:
         help="which projections to quantize (implies --int8)",
     )
     parser.add_argument("--lr", type=float, default=None)
+    parser.add_argument(
+        "--grad-bucket-mb",
+        default="0",
+        help="bucket the gradient sync so per-bucket reduces overlap the"
+        " backward pass: a size cap in MiB, 'auto' (remat_auto-style"
+        " candidate ladder), or 0 to keep the single fused sync."
+        " Gradients are bitwise identical either way",
+    )
+    parser.add_argument(
+        "--kernels",
+        default=None,
+        choices=["reference", "pallas", "interpret"],
+        help="attention/norm kernel implementation: 'pallas' selects the"
+        " fused Mosaic kernels on TPU (reference fallback elsewhere);"
+        " 'interpret' runs the same kernels in the Pallas interpreter"
+        " (parity testing); default reference XLA ops",
+    )
     parser.add_argument(
         "--log-every", type=int, default=None,
         help="steps between log lines, >= 1 (each is a device fence;"
@@ -991,6 +1079,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         profile_dir=args.profile_dir,
         prefetch=args.prefetch,
         profile=args.profile,
+        grad_bucket_mb=args.grad_bucket_mb,
+        kernels=args.kernels or "reference",
     )
     if jax.process_index() == 0:
         print("final:", metrics, flush=True)

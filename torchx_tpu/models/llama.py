@@ -97,11 +97,23 @@ class LlamaConfig:
     # store CE logits in f32 instead of bf16 (exact-f32 cross entropy at
     # 2x the logits HBM traffic; see _token_nll for the measured tradeoff)
     ce_f32_logits: bool = False
+    # fused-kernel selection for the layer hot path (ops/fused.py):
+    # "reference" keeps the stock ops; "pallas" swaps in the fused
+    # flash-attention and residual+RMSNorm Mosaic kernels on TPU (each
+    # call site falls back per-shape when gating fails — TPX112 warns at
+    # launch time); "interpret" runs the same kernels in the Pallas
+    # interpreter (CPU parity tests only — slow)
+    kernels: str = "reference"
 
     def __post_init__(self) -> None:
         if self.int8_scope not in ("all", "ffn"):
             raise ValueError(
                 f"int8_scope must be 'all' or 'ffn', got {self.int8_scope!r}"
+            )
+        if self.kernels not in ("reference", "pallas", "interpret"):
+            raise ValueError(
+                "kernels must be 'reference', 'pallas' or 'interpret',"
+                f" got {self.kernels!r}"
             )
 
     @property
@@ -371,16 +383,32 @@ def _layer(
     if cfg.use_ring_attention and mesh is not None and mesh.shape.get("sp", 1) > 1:
         attn_out = ring_attention(q, k, v, mesh)
     else:
-        attn_out = attention(
-            q,
-            k,
-            v,
-            causal=True,
-            impl=cfg.attn_impl,
-            block_q=cfg.attn_block_q,
-            block_kv=cfg.attn_block_kv,
-            mesh=mesh,
-        )
+        attn_out = None
+        if cfg.kernels != "reference":
+            from torchx_tpu.ops.fused import flash_attention as fused_flash
+
+            # None when gating fails (shape/platform/mesh): stock path below
+            attn_out = fused_flash(
+                q,
+                k,
+                v,
+                causal=True,
+                kernels=cfg.kernels,
+                block_q=cfg.attn_block_q,
+                block_kv=cfg.attn_block_kv,
+                mesh=mesh,
+            )
+        if attn_out is None:
+            attn_out = attention(
+                q,
+                k,
+                v,
+                causal=True,
+                impl=cfg.attn_impl,
+                block_q=cfg.attn_block_q,
+                block_kv=cfg.attn_block_kv,
+                mesh=mesh,
+            )
     # named so remat policies can SAVE the kernel output: the attention
     # kernels are not dot_generals, so "dots" alone recomputes the whole
     # flash/splash forward in the backward pass (see "dots_attn")
@@ -388,11 +416,26 @@ def _layer(
     attn_out = maybe_matmul(
         attn_out.reshape(b, s, h * hd), layer["wo"], int8_training=i8_attn
     )
-    x = x + attn_out
-    x = _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
+    if cfg.kernels != "reference":
+        from torchx_tpu.ops.fused import rms_norm_residual
 
-    # mlp block: dense SwiGLU, or sparse MoE when the config carries experts
-    mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps, mesh=mesh)
+        # fused residual-add + RMSNorm: one VMEM pass yields both the mlp
+        # input and the continued stream (degrades internally to the
+        # reference op sequence when gating fails — identical values)
+        mlp_in, x = rms_norm_residual(
+            x,
+            attn_out,
+            layer["mlp_norm"],
+            cfg.norm_eps,
+            kernels=cfg.kernels,
+            mesh=mesh,
+        )
+        x = _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
+    else:
+        x = x + attn_out
+        x = _constraint(x, mesh, ("dp", "fsdp"), "sp", None)
+        # mlp block: dense SwiGLU, or MoE when the config carries experts
+        mlp_in = rms_norm(x, layer["mlp_norm"], cfg.norm_eps, mesh=mesh)
     down, aux = ffn(cfg, layer, mlp_in)
     x = x + down
     return _constraint(x, mesh, ("dp", "fsdp"), "sp", None), aux

@@ -649,5 +649,14 @@ def feed_calibration(
         measured_collective_s=exposed / n,
         alpha=DEFAULT_ALPHA if alpha is None else alpha,
     )
+    overlap = summary.get("overlap_frac")
+    if overlap is not None:
+        # the same run also measured how much collective time the
+        # schedule hid; the ranking's overlap discount learns from it
+        out["overlap"] = table.observe_overlap(
+            generation,
+            measured_overlap_frac=float(overlap),
+            alpha=DEFAULT_ALPHA if alpha is None else alpha,
+        )["overlap"]
     table.save()
     return out

@@ -1,0 +1,506 @@
+"""Fused Pallas training kernels: flash attention and RMSNorm(+residual).
+
+This module is the ``--kernels pallas`` hot path (ISSUE 20 / the 60%-MFU
+push). It owns two hand-written Mosaic kernels, both testable on CPU via
+the Pallas interpreter:
+
+* :func:`flash_attention` — tiled online-softmax attention. The score
+  matrix is never materialized: the kv-sequential grid keeps one
+  ``[block_q, block_kv]`` tile of logits live in VMEM, carrying the
+  running row-max ``m``, denominator ``l`` and f32 accumulator across kv
+  blocks (the standard flash recurrence). The backward is the standard
+  two-kernel flash backward: ``delta = rowsum(dO * O)`` precomputed, one
+  kv-sequential kernel accumulating ``dq``, one q-sequential kernel
+  accumulating ``dk``/``dv`` — logits are recomputed from the saved
+  logsumexp, so residual memory stays O(seq).
+* :func:`rms_norm_residual` — residual add + RMSNorm in one VMEM pass:
+  ``s = x + residual`` (input dtype, bitwise-identical to the unfused
+  add), ``y = rms_norm(s) * w`` in f32. Returns both ``y`` and ``s`` (the
+  stream continues from ``s``). The backward reuses the fused dx+dw
+  kernel from :mod:`torchx_tpu.ops.norms` on ``s`` and routes the ``s``
+  cotangent through both inputs.
+
+Selection contract (the ``--kernels`` flag, TPX112's runtime twin):
+``"pallas"`` compiles Mosaic on TPU and silently resolves to the
+reference ops anywhere else; ``"interpret"`` runs the same kernels in the
+Pallas interpreter (CPU parity tests); ``"reference"`` never enters this
+module. :func:`flash_attention` returns ``None`` whenever gating fails —
+untileable head_dim / ragged sequence / mesh that does not divide — and
+the caller falls back to :func:`torchx_tpu.ops.attention.attention`;
+:func:`rms_norm_residual` degrades internally to the plain-XLA math with
+identical semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchx_tpu.ops.attention import _fit_block, _repeat_kv, _shard_wrap
+from torchx_tpu.ops.norms import _bwd_pallas, _pick_rows, _rms_norm_fwd_math
+
+#: Same "already softmax-dead" constant the xla reference uses.
+NEG_INF = -1e30
+
+#: head dims the flash kernels tile on the MXU (lane-dim friendly).
+FLASH_HEAD_DIMS = (64, 128, 256)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False
+
+
+def flash_shapes_ok(s_q: int, s_k: int, head_dim: int) -> bool:
+    """Static gate for the fused flash kernels: lane-tileable head dim,
+    128-multiple self-attention sequences. (TPX112 duplicates this check
+    statically — analyze never imports jax.)"""
+    return (
+        head_dim in FLASH_HEAD_DIMS
+        and s_q == s_k
+        and s_q % 128 == 0
+        and s_q >= 128
+    )
+
+
+def norm_shapes_ok(d: int) -> bool:
+    """Static gate for the fused norm kernel: lane-aligned feature dim."""
+    return d % 128 == 0
+
+
+# ---------------------------------------------------------------------------
+# flash attention forward
+# ---------------------------------------------------------------------------
+
+
+def _dot(a, b, dims):
+    return jax.lax.dot_general(
+        a, b, (dims, ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *, scale, causal, bq, bk
+):
+    """One (batch*head, q-block, kv-block) grid cell. The kv axis is the
+    innermost (sequential on TPU) grid dim, so ``m``/``l``/``acc`` output
+    blocks are revisited and carry the online-softmax state across kv
+    blocks — no S×S score matrix ever exists."""
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(2)
+    qf = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+    kf = k_ref[0].astype(jnp.float32)  # [bk, d]
+    vf = v_ref[0].astype(jnp.float32)
+    s = _dot(qf, kf, ((1,), (1,)))  # [bq, bk]
+    if causal:
+        i = pl.program_id(1)
+        rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    m_cur = jnp.max(s, axis=-1)  # [bq]
+
+    @pl.when(j == 0)
+    def _init():
+        p = jnp.exp(s - m_cur[:, None])
+        m_ref[0] = m_cur
+        l_ref[0] = jnp.sum(p, axis=-1)
+        acc_ref[0] = _dot(p, vf, ((1,), (0,)))
+
+    @pl.when(j > 0)
+    def _update():
+        m_prev = m_ref[0]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_ref[0] = l_ref[0] * alpha + jnp.sum(p, axis=-1)
+        acc_ref[0] = acc_ref[0] * alpha[:, None] + _dot(p, vf, ((1,), (0,)))
+        m_ref[0] = m_new
+
+
+def _flash_fwd(q3, k3, v3, causal, block_q, block_kv, interpret):
+    """[bh, s, d] x3 -> (o_f32 [bh, s, d], lse [bh, s] f32)."""
+    import jax.experimental.pallas as pl
+
+    bh, s_q, d = q3.shape
+    s_k = k3.shape[1]
+    bq = _fit_block(block_q or 512, s_q)
+    bk = _fit_block(block_kv or 512, s_k)
+    scale = d**-0.5
+    acc, m, l = pl.pallas_call(
+        functools.partial(
+            _flash_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+        ),
+        grid=(bh, s_q // bq, s_k // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    # Normalization outside the kernel avoids a last-kv-block branch;
+    # causal rows always see kv block 0, so l > 0 everywhere.
+    return acc / l[:, :, None], m + jnp.log(l)
+
+
+# ---------------------------------------------------------------------------
+# flash attention backward (standard two-kernel flash bwd)
+# ---------------------------------------------------------------------------
+
+
+def _flash_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+    scale, causal, bq, bk,
+):
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(2)
+    qf = q_ref[0].astype(jnp.float32)
+    kf = k_ref[0].astype(jnp.float32)
+    vf = v_ref[0].astype(jnp.float32)
+    dof = do_ref[0].astype(jnp.float32)
+    s = _dot(qf * scale, kf, ((1,), (1,)))
+    if causal:
+        i = pl.program_id(1)
+        rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    p = jnp.exp(s - lse_ref[0][:, None])  # exact softmax from saved lse
+    dp = _dot(dof, vf, ((1,), (1,)))  # [bq, bk]
+    ds = p * (dp - delta_ref[0][:, None])
+    dq_tile = _dot(ds, kf, ((1,), (0,))) * scale
+
+    @pl.when(j == 0)
+    def _init():
+        dq_ref[0] = dq_tile
+
+    @pl.when(j > 0)
+    def _acc():
+        dq_ref[0] += dq_tile
+
+
+def _flash_dkv_kernel(
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dk_ref, dv_ref, *,
+    scale, causal, bq, bk,
+):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(2)  # q blocks sequential here
+    j = pl.program_id(1)
+    qf = q_ref[0].astype(jnp.float32)
+    kf = k_ref[0].astype(jnp.float32)
+    vf = v_ref[0].astype(jnp.float32)
+    dof = do_ref[0].astype(jnp.float32)
+    s = _dot(qf * scale, kf, ((1,), (1,)))  # [bq, bk]
+    if causal:
+        rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    p = jnp.exp(s - lse_ref[0][:, None])
+    dv_tile = _dot(p, dof, ((0,), (0,)))  # [bk, d]
+    dp = _dot(dof, vf, ((1,), (1,)))
+    ds = p * (dp - delta_ref[0][:, None])
+    dk_tile = _dot(ds, qf, ((0,), (0,))) * scale  # [bk, d]
+
+    @pl.when(i == 0)
+    def _init():
+        dk_ref[0] = dk_tile
+        dv_ref[0] = dv_tile
+
+    @pl.when(i > 0)
+    def _acc():
+        dk_ref[0] += dk_tile
+        dv_ref[0] += dv_tile
+
+
+def _flash_bwd(q3, k3, v3, o_f32, lse, do, causal, block_q, block_kv, interpret):
+    import jax.experimental.pallas as pl
+
+    bh, s_q, d = q3.shape
+    s_k = k3.shape[1]
+    bq = _fit_block(block_q or 512, s_q)
+    bk = _fit_block(block_kv or 512, s_k)
+    scale = d**-0.5
+    delta = jnp.sum(do.astype(jnp.float32) * o_f32, axis=-1)  # [bh, s_q]
+
+    qkv_spec = lambda which: pl.BlockSpec(  # noqa: E731
+        (1, bq, d) if which == "q" else (1, bk, d),
+        (lambda b, i, j: (b, i, 0)) if which == "q" else (lambda b, i, j: (b, j, 0)),
+    )
+    row_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+        ),
+        grid=(bh, s_q // bq, s_k // bk),  # kv sequential: dq accumulates
+        in_specs=[
+            qkv_spec("q"), qkv_spec("k"), qkv_spec("k"),
+            qkv_spec("q"), row_spec, row_spec,
+        ],
+        out_specs=[qkv_spec("q")],
+        out_shape=[jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do, lse, delta)[0]
+
+    # dkv grid swaps roles: q blocks are innermost/sequential, the dk/dv
+    # output blocks at kv position j are revisited across q blocks.
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
+    row_spec_t = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+        ),
+        grid=(bh, s_k // bk, s_q // bq),
+        in_specs=[q_spec, q_spec, row_spec_t, row_spec_t, kv_spec, kv_spec],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_k, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, do, lse, delta, k3, v3)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q3, k3, v3, causal, block_q, block_kv, interpret):
+    o_f32, _ = _flash_fwd(q3, k3, v3, causal, block_q, block_kv, interpret)
+    return o_f32.astype(q3.dtype)
+
+
+def _flash_vjp_fwd(q3, k3, v3, causal, block_q, block_kv, interpret):
+    o_f32, lse = _flash_fwd(q3, k3, v3, causal, block_q, block_kv, interpret)
+    return o_f32.astype(q3.dtype), (q3, k3, v3, o_f32, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_kv, interpret, res, do):
+    q3, k3, v3, o_f32, lse = res
+    dq, dk, dv = _flash_bwd(
+        q3, k3, v3, o_f32, lse, do, causal, block_q, block_kv, interpret
+    )
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,  # [b, s, h, d]
+    k: jnp.ndarray,  # [b, s, kv_h, d]
+    v: jnp.ndarray,
+    causal: bool = True,
+    kernels: str = "pallas",
+    block_q: int = 0,
+    block_kv: int = 0,
+    mesh=None,
+) -> Optional[jnp.ndarray]:
+    """Fused flash attention, or ``None`` when gating says "fall back".
+
+    ``None`` is returned (never raised) when: ``kernels`` does not select
+    this module, ``"pallas"`` was asked for off-TPU (the reference ops are
+    faster than the interpreter there — TPX112's warning), the shapes fail
+    :func:`flash_shapes_ok`, or the mesh does not divide batch/heads. The
+    caller keeps the reference path as the single fallback.
+    """
+    if kernels not in ("pallas", "interpret"):
+        return None
+    if kernels == "pallas" and not _on_tpu():
+        return None
+    if not flash_shapes_ok(q.shape[1], k.shape[1], q.shape[-1]):
+        return None
+    if q.shape[2] % k.shape[2]:
+        return None
+    interpret = kernels == "interpret"
+    n_rep = q.shape[2] // k.shape[2]
+
+    def kernel(q4, k4, v4, seg):  # noqa: ANN001 (matches _shard_wrap)
+        k4 = _repeat_kv(k4, n_rep)
+        v4 = _repeat_kv(v4, n_rep)
+        b, s, h, d = q4.shape
+        to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)  # noqa: E731
+        o3 = _flash(
+            to3(q4), to3(k4), to3(v4), causal, block_q, block_kv, interpret
+        )
+        return o3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    if mesh is None or all(s == 1 for s in dict(mesh.shape).values()):
+        return kernel(q, k, v, None)
+    # may return None when batch/heads don't divide the mesh: fall back
+    return _shard_wrap(kernel, q, k, v, None, mesh, ("dp", "fsdp"), "tp")
+
+
+# ---------------------------------------------------------------------------
+# fused residual-add + RMSNorm
+# ---------------------------------------------------------------------------
+
+
+def _rms_norm_residual_math(x, res, weight, eps):
+    """Reference path: exactly the unfused op sequence, so the fused
+    kernels can be parity-tested bitwise against it."""
+    s = x + res
+    return _rms_norm_fwd_math(s, weight, eps), s
+
+
+def _norm_res_kernel(x_ref, r_ref, w_ref, y_ref, s_ref, *, eps: float):
+    s = x_ref[...] + r_ref[...]  # input dtype: bitwise == unfused add
+    s_ref[...] = s
+    sf = s.astype(jnp.float32)
+    # reciprocal(sqrt(...)) rather than rsqrt: bitwise-identical to
+    # _rms_norm_fwd_math under the interpreter (the parity tests check it)
+    rrms = jnp.reciprocal(
+        jnp.sqrt(jnp.mean(sf * sf, axis=-1, keepdims=True) + eps)
+    )
+    y_ref[...] = ((sf * rrms) * w_ref[...].astype(jnp.float32)).astype(
+        y_ref.dtype
+    )
+
+
+def _norm_res_pallas(x2d, r2d, weight, eps, interpret):
+    """-> (y [n, d], s [n, d]) or None when the shard doesn't tile."""
+    import jax.experimental.pallas as pl
+
+    n, d = x2d.shape
+    rows = _pick_rows(n, d)
+    if rows == 0 or d % 128:
+        return None
+    return pl.pallas_call(
+        functools.partial(_norm_res_kernel, eps=eps),
+        grid=(n // rows,),
+        in_specs=[
+            pl.BlockSpec((rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((rows, d), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, d), x2d.dtype),
+            jax.ShapeDtypeStruct((n, d), x2d.dtype),
+        ],
+        interpret=interpret,
+    )(x2d, r2d, weight.reshape(1, d))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _rms_norm_residual_fused(x, res, weight, eps, interpret):
+    return _rms_norm_residual_math(x, res, weight, eps)
+
+
+def _nr_fwd(x, res, weight, eps, interpret):
+    d = x.shape[-1]
+    out = _norm_res_pallas(
+        x.reshape(-1, d), res.reshape(-1, d), weight, eps, interpret
+    )
+    if out is None:  # untileable shard: plain math, same values
+        y, s = _rms_norm_residual_math(x, res, weight, eps)
+    else:
+        y, s = (a.reshape(x.shape) for a in out)
+    return (y, s), (s, weight)
+
+
+def _nr_bwd(eps, interpret, resids, cot):
+    s, weight = resids
+    dy, ds_out = cot
+    d = s.shape[-1]
+    # the dx+dw kernel from ops/norms runs on the summed stream s; the
+    # extra ds_out cotangent (s is also an output) adds straight through
+    dx2d, dw = _bwd_pallas(
+        s.reshape(-1, d), dy.reshape(-1, d), weight, eps, interpret=interpret
+    )
+    ds = dx2d.reshape(s.shape).astype(s.dtype) + ds_out
+    return ds, ds, dw.astype(weight.dtype)
+
+
+_rms_norm_residual_fused.defvjp(_nr_fwd, _nr_bwd)
+
+
+def rms_norm_residual(
+    x: jnp.ndarray,
+    residual: jnp.ndarray,
+    weight: jnp.ndarray,
+    eps: float = 1e-5,
+    kernels: str = "reference",
+    mesh=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``s = x + residual; y = rms_norm(s) * weight`` -> ``(y, s)``.
+
+    Unlike :func:`flash_attention` this never returns ``None``: every
+    gating failure degrades internally to the reference op sequence with
+    identical values, so call sites need no fallback branch. ``mesh``
+    plays the same role as in :func:`torchx_tpu.ops.norms.rms_norm` —
+    Mosaic kernels cannot be auto-partitioned, so a sharded stream runs
+    the kernel under a full-manual shard_map (weight replicated, its grad
+    summed by the shard_map transpose).
+    """
+    if kernels not in ("pallas", "interpret"):
+        return _rms_norm_residual_math(x, residual, weight, eps)
+    if kernels == "pallas" and not _on_tpu():
+        return _rms_norm_residual_math(x, residual, weight, eps)
+    from torchx_tpu.parallel.mesh import manual_axes
+
+    if manual_axes():
+        # inside a parent manual region (pipeline stage): a nested
+        # shard_map would rebind axes — reference path, every mode
+        return _rms_norm_residual_math(x, residual, weight, eps)
+    if not norm_shapes_ok(x.shape[-1]):
+        return _rms_norm_residual_math(x, residual, weight, eps)
+    interpret = kernels == "interpret"
+    if mesh is None or all(s == 1 for s in dict(mesh.shape).values()):
+        return _rms_norm_residual_fused(x, residual, weight, eps, interpret)
+
+    from jax.sharding import PartitionSpec as P
+
+    sizes = dict(mesh.shape)
+    batch_axes = tuple(a for a in ("dp", "fsdp") if sizes.get(a, 1) > 1)
+    batch_div = 1
+    for a in batch_axes:
+        batch_div *= sizes[a]
+    seq_axis = (
+        "sp"
+        if x.ndim == 3
+        and sizes.get("sp", 1) > 1
+        and x.shape[1] % sizes["sp"] == 0
+        else None
+    )
+    if x.ndim != 3 or (batch_div > 1 and x.shape[0] % batch_div):
+        return _rms_norm_residual_math(x, residual, weight, eps)
+    x_spec = P(batch_axes or None, seq_axis, None)
+    from torchx_tpu.parallel.mesh import shard_map as tpx_shard_map
+
+    fn = tpx_shard_map(
+        lambda xs, rs, ws: _rms_norm_residual_fused(xs, rs, ws, eps, interpret),
+        mesh=mesh,
+        in_specs=(x_spec, x_spec, P(None)),
+        out_specs=(x_spec, x_spec),
+        axis_names=frozenset(sizes),  # Mosaic needs a fully-manual context
+        check_vma=False,
+    )
+    return fn(x, residual, weight)
+
+
+def resolve_kernels(requested: str) -> str:
+    """Resolve a ``--kernels`` request against the runtime platform:
+    ``"pallas"`` off-TPU becomes ``"reference"`` (what TPX112 warns
+    about at launch time); everything else passes through."""
+    if requested == "pallas" and not _on_tpu():
+        return "reference"
+    return requested

@@ -23,6 +23,7 @@ import logging
 import os
 import pickle
 import re
+import threading
 from typing import Any, Optional
 
 import jax
@@ -55,6 +56,14 @@ def _digest_path(path: str) -> Optional[str]:
     except OSError:
         return None
     return h.hexdigest()
+
+
+def _host_copy(x: Any) -> Any:
+    """Device→host leaf transfer that owns its memory (see _pickle_save)."""
+    import numpy as np
+
+    h = jax.device_get(x)
+    return h.copy() if isinstance(h, np.ndarray) else h
 
 
 class Checkpointer:
@@ -102,6 +111,16 @@ class Checkpointer:
         # are not on disk at save() time, so digests finalize at the next
         # synchronization point (wait/close/latest_step/restore)
         self._pending_digests: set[int] = set()
+        # snapshot-then-write state for the pickle fallback: at most one
+        # background writer in flight; its failure is latched and re-raised
+        # at the next synchronization point (wait/save) rather than lost
+        # on a daemon thread
+        self._writer: Optional[threading.Thread] = None
+        self._writer_error: Optional[BaseException] = None
+        # guards _pending_digests and the latched _writer_error: the join
+        # fences already serialize writer vs. step loop, but the latch is
+        # written from the writer thread while the step loop may read it
+        self._lock = threading.Lock()
 
     # -- orbax path --------------------------------------------------------
 
@@ -114,25 +133,26 @@ class Checkpointer:
                 step, args=self._ocp.args.StandardSave(state), force=force
             )
             if saved:
-                self._pending_digests.add(step)
+                with self._lock:
+                    self._pending_digests.add(step)
                 self._write_manifest(step)
             if not self._async:
                 self._mgr.wait_until_finished()
                 self._finalize_digests()
             return bool(saved)
-        saved = self._pickle_save(step, state, force=force)
-        if saved:
-            self._pending_digests.add(step)
-            self._write_manifest(step)
-            self._finalize_digests()
-        return saved
+        return self._pickle_save(step, state, force=force)
 
     def wait(self) -> None:
         """Block until in-flight async saves are durably on disk, then
-        record their content digests in the manifest."""
+        record their content digests in the manifest. A failed background
+        pickle write surfaces HERE (latched from the writer thread) — the
+        SIGTERM force-flush path calls save(force=True) + wait(), so a
+        dying job still learns its final checkpoint did not land."""
+        self._join_writer()
         if self._mgr is not None:
             self._mgr.wait_until_finished()
         self._finalize_digests()
+        self._raise_writer_error()
 
     # -- manifest + digests ------------------------------------------------
 
@@ -202,7 +222,8 @@ class Checkpointer:
     def _finalize_digests(self) -> None:
         """Digest every finalized pending step into the manifest, and drop
         digest entries for steps retention has pruned."""
-        pending, self._pending_digests = self._pending_digests, set()
+        with self._lock:
+            pending, self._pending_digests = self._pending_digests, set()
         if jax.process_index() != 0:
             return
         known = (
@@ -305,6 +326,7 @@ class Checkpointer:
         if self._mgr is not None:
             self.wait()
             return sorted(self._mgr.all_steps(), reverse=True)
+        self._join_writer()  # an in-flight save IS a step once finalized
         return self._pickle_steps()
 
     def restore_latest(self, abstract_state: Any) -> tuple[Optional[int], Any]:
@@ -397,14 +419,15 @@ class Checkpointer:
             # orbax caches the step list; re-open so the quarantined step
             # disappears from all_steps()/latest_step() and save() works
             self._mgr.close()
-            self._mgr = self._ocp.CheckpointManager(
-                self.directory,
-                options=self._ocp.CheckpointManagerOptions(
-                    max_to_keep=self._max_to_keep,
-                    save_interval_steps=self._save_interval,
-                    enable_async_checkpointing=self._async,
-                ),
-            )
+            with self._lock:
+                self._mgr = self._ocp.CheckpointManager(
+                    self.directory,
+                    options=self._ocp.CheckpointManagerOptions(
+                        max_to_keep=self._max_to_keep,
+                        save_interval_steps=self._save_interval,
+                        enable_async_checkpointing=self._async,
+                    ),
+                )
         # repair the manifest: drop the step's digest and point latest_step
         # at the newest surviving step, so the client-side supervisor never
         # injects a quarantined step as TPX_RESUME_STEP on the next attempt
@@ -418,14 +441,20 @@ class Checkpointer:
         )
 
     def close(self) -> None:
-        """Flush in-flight saves and release the manager."""
+        """Flush in-flight saves (both backends) and release the manager;
+        a latched background-write failure surfaces here like at wait()."""
+        self.wait()
         if self._mgr is not None:
-            self.wait()
             self._mgr.close()
 
     # -- pickle fallback ---------------------------------------------------
 
     def _pickle_save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Snapshot-then-write: the device→host transfer is fenced inside
+        this call (after it returns, the train loop may donate/overwrite
+        the device buffers), but in async mode serialization, fsync,
+        digesting and manifest finalization all happen on a background
+        thread — the step loop never stalls on checkpoint I/O."""
         if jax.process_count() > 1:
             # process-0-only pickle files desync hosts on restore (each host
             # must see the same latest step); multi-host requires orbax
@@ -435,8 +464,69 @@ class Checkpointer:
             )
         if step % self._save_interval and not force:
             return False
+        if self._async:
+            # at most one write in flight: back-to-back saves fence on the
+            # previous write rather than racing it for the manifest
+            self._join_writer()
+            self._raise_writer_error()
+        # the snapshot must OWN its memory: device_get can hand back a
+        # view of a live buffer (CPU backend, or an already-host leaf),
+        # and the caller is free to donate/overwrite it the moment save()
+        # returns — copy ndarray leaves so the background writer
+        # serializes the state as of this fence, not of some later step
+        host_state = jax.tree.map(_host_copy, state)
+        with self._lock:
+            self._pending_digests.add(step)
+        if not self._async:
+            self._pickle_write(step, host_state)
+            self._write_manifest(step)
+            self._finalize_digests()
+            return True
+        t = threading.Thread(
+            target=self._writer_main,
+            args=(step, host_state),
+            name=f"tpx-ckpt-writer-{step}",
+            daemon=True,
+        )
+        with self._lock:
+            self._writer = t
+        t.start()
+        return True
+
+    def _writer_main(self, step: int, host_state: Any) -> None:
+        """Background finalization of one pickle save. The manifest's
+        ``latest_step`` is only advanced AFTER the payload is durably on
+        disk, so a crash mid-write can never leave the manifest pointing
+        at a step that does not restore."""
+        try:
+            self._pickle_write(step, host_state)
+            self._write_manifest(step)
+            self._finalize_digests()
+        except BaseException as e:  # noqa: BLE001 - latched, re-raised at wait
+            with self._lock:
+                self._writer_error = e
+
+    def _join_writer(self) -> None:
+        with self._lock:
+            t = self._writer
+        if t is None or t is threading.current_thread():
+            # the writer itself walks the step listing while pruning —
+            # never join yourself
+            return
+        t.join()
+        with self._lock:
+            self._writer = None
+
+    def _raise_writer_error(self) -> None:
+        with self._lock:
+            err, self._writer_error = self._writer_error, None
+        if err is not None:
+            raise RuntimeError(
+                "background checkpoint write failed"
+            ) from err
+
+    def _pickle_write(self, step: int, host_state: Any) -> None:
         path = os.path.join(self.directory, f"step_{step}.pkl")
-        host_state = jax.tree.map(lambda x: jax.device_get(x), state)
         # tmp + fsync + atomic rename: a process killed mid-write (the
         # exact moment a preemption lands) must never leave a truncated
         # step_N.pkl that restore_latest would pick up — the .tmp name
@@ -452,9 +542,9 @@ class Checkpointer:
             if os.path.exists(tmp):
                 os.unlink(tmp)
         self._prune()
-        return True
 
     def _pickle_restore(self, step: int, abstract_state: Any) -> Any:
+        self._join_writer()  # the requested step may still be in flight
         with open(os.path.join(self.directory, f"step_{step}.pkl"), "rb") as f:
             host_state = pickle.load(f)
         # re-shard onto the current mesh layout

@@ -60,11 +60,15 @@ class CalibrationScales:
     ``collective_scale`` the per-axis collective bytes, and
     ``step_time_scale`` the end-to-end predicted step time (what the
     tune ranking and the bench error tracking consume).
+    ``overlap_frac`` is the measured comm/compute overlap fraction (the
+    step profiler's ``1 - exposed/modeled``): the ranking discounts the
+    collective term by it instead of charging exposed comm at 100%.
     """
 
     activation_scale: float = 1.0
     collective_scale: float = 1.0
     step_time_scale: float = 1.0
+    overlap_frac: float = 0.0
     samples: int = 0
 
     def to_dict(self) -> dict:
@@ -72,6 +76,7 @@ class CalibrationScales:
             "activation_scale": self.activation_scale,
             "collective_scale": self.collective_scale,
             "step_time_scale": self.step_time_scale,
+            "overlap_frac": self.overlap_frac,
             "samples": self.samples,
         }
 
@@ -81,6 +86,7 @@ class CalibrationScales:
             activation_scale=float(d.get("activation_scale", 1.0)),
             collective_scale=float(d.get("collective_scale", 1.0)),
             step_time_scale=float(d.get("step_time_scale", 1.0)),
+            overlap_frac=float(d.get("overlap_frac", 0.0)),
             samples=int(d.get("samples", 0)),
         )
 
@@ -221,6 +227,7 @@ class CalibrationTable:
             activation_scale=act,
             collective_scale=coll,
             step_time_scale=step,
+            overlap_frac=cur.overlap_frac,
             samples=cur.samples + 1,
         )
         out["scales"] = self._scales[gen].to_dict()
@@ -262,6 +269,7 @@ class CalibrationTable:
             activation_scale=cur.activation_scale,
             collective_scale=new_scale,
             step_time_scale=cur.step_time_scale,
+            overlap_frac=cur.overlap_frac,
             samples=cur.samples + 1,
         )
         return {
@@ -272,6 +280,51 @@ class CalibrationTable:
                 "measured": m,
                 "err_before": abs(p - m) / m,
                 "err_after": abs(p * (new_scale / cur.collective_scale) - m) / m,
+            },
+            "scales": self._scales[gen].to_dict(),
+        }
+
+    def observe_overlap(
+        self,
+        generation: str,
+        *,
+        measured_overlap_frac: float,
+        alpha: float = DEFAULT_ALPHA,
+    ) -> dict[str, Any]:
+        """Fold a measured comm/compute overlap fraction into the table.
+
+        The step profiler's summary reports ``overlap_frac = 1 -
+        exposed/modeled`` per run; the EMA here (``new = old + alpha *
+        (measured - old)``) converges on the schedule's steady overlap,
+        and the ranking (:func:`torchx_tpu.tune.rank.predicted_step_cost`)
+        charges only ``collective_s * (1 - overlap_frac)`` instead of the
+        fully-serialized collective time. Clamped to [0, 0.95]: some
+        collective time is always exposed (the last bucket has no
+        compute left to hide behind), and a runaway 1.0 would make every
+        collective free and un-rank mesh choices entirely.
+        """
+        if not 0.0 < alpha < 1.0:
+            raise ValueError(f"alpha must be in (0, 1), got {alpha}")
+        m = min(max(float(measured_overlap_frac), 0.0), 0.95)
+        gen = generation_key(generation)
+        cur = self._scales.get(gen, CalibrationScales())
+        new_frac = min(
+            max(cur.overlap_frac + alpha * (m - cur.overlap_frac), 0.0), 0.95
+        )
+        self._scales[gen] = CalibrationScales(
+            activation_scale=cur.activation_scale,
+            collective_scale=cur.collective_scale,
+            step_time_scale=cur.step_time_scale,
+            overlap_frac=new_frac,
+            samples=cur.samples + 1,
+        )
+        return {
+            "generation": gen,
+            "alpha": alpha,
+            "overlap": {
+                "measured": m,
+                "before": cur.overlap_frac,
+                "after": new_frac,
             },
             "scales": self._scales[gen].to_dict(),
         }

@@ -122,7 +122,14 @@ def predicted_step_cost(
     penalty = 1.0 + 2.0 * max(0.0, pressure - PRESSURE_KNEE)
 
     scale = float(getattr(calibration, "step_time_scale", 1.0) or 1.0)
-    step_s = (compute_s + collective_s) * penalty * scale
+    # charge only the EXPOSED share of collective time: profiled runs
+    # measure how much comm the schedule hides behind compute (bucketed
+    # grad sync, async collectives) and the calibration carries it as
+    # overlap_frac; uncalibrated -> discount 1.0, identical to before
+    exposed_collective_s = collective_s * costmodel.overlap_discount(
+        calibration
+    )
+    step_s = (compute_s + exposed_collective_s) * penalty * scale
     return StepCost(
         step_s=step_s,
         compute_s=compute_s,
